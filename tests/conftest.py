@@ -1,0 +1,64 @@
+"""Test harness config: run JAX on a virtual 8-device CPU mesh.
+
+Per SURVEY §4: multi-chip code paths (shard_map / psum over the docs
+axis) are exercised without TPUs by forcing the host platform to expose
+8 devices.
+
+NOTE on this machine: a sitecustomize hook imports jax at interpreter
+startup with JAX_PLATFORMS=axon (single tunneled TPU), so jax's config
+has already read the env by the time conftest runs — setting os.environ
+here is too late for the platform choice. jax.config.update() still
+works because *backend initialization* is lazy; XLA_FLAGS is also still
+unread at this point. Tests must never touch the axon platform: the
+tunnel admits one client, so a second process hangs forever.
+"""
+
+import os
+
+# Read by the CPU client at first backend init (still lazy here).
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses we spawn
+
+import jax  # noqa: E402  (already imported by sitecustomize; this is a no-op)
+
+jax.config.update("jax_platforms", "cpu")
+
+import random  # noqa: E402
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+assert jax.default_backend() == "cpu", (
+    "tests must run on the virtual CPU mesh, not the tunneled TPU; "
+    f"got {jax.default_backend()}")
+assert len(jax.devices()) >= 8, (
+    f"expected 8 virtual CPU devices, got {len(jax.devices())}")
+
+
+@pytest.fixture(autouse=True)
+def _seed_everything():
+    random.seed(1234)
+    np.random.seed(1234)
+
+
+WORDS = [b"the", b"quick", b"brown", b"fox", b"jumps", b"over", b"lazy",
+         b"dog", b"tpu", b"mesh", b"shard", b"psum", b"tfidf", b"corpus",
+         b"vector", b"kernel"]
+
+
+@pytest.fixture
+def toy_corpus_dir(tmp_path):
+    """A reference-contract input dir: input/doc1..doc6, <=16 distinct
+    words, all tokens <16 chars — inside the reference's valid envelope
+    (SURVEY §2.5)."""
+    rng = random.Random(7)
+    input_dir = tmp_path / "input"
+    input_dir.mkdir()
+    for i in range(1, 7):
+        n = rng.randint(3, 40)
+        toks = [rng.choice(WORDS) for _ in range(n)]
+        (input_dir / f"doc{i}").write_bytes(b" ".join(toks) + b"\n")
+    return str(input_dir)

@@ -1,0 +1,133 @@
+"""Unit tests for the core ops: tokenize, hash, histogram, scoring, topk."""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tfidf_tpu.ops.hashing import (device_ngram_ids, fnv1a_hash_words,
+                                   hash_to_vocab, words_to_ids)
+from tfidf_tpu.ops.histogram import df_from_counts, tf_counts, tf_counts_chunked
+from tfidf_tpu.ops.scoring import idf_from_df, tfidf_dense
+from tfidf_tpu.ops.tokenize import char_ngrams, whitespace_tokenize
+from tfidf_tpu.ops.topk import topk_global, topk_per_doc, topk_terms
+
+
+def _fnv1a_scalar(data: bytes, seed: int = 0) -> int:
+    h = 14695981039346656037 ^ seed
+    for b in data:
+        h = ((h ^ b) * 1099511628211) % (1 << 64)
+    return h
+
+
+class TestTokenize:
+    def test_matches_c_isspace_set(self):
+        # fscanf("%s") splits on the C isspace set (TFIDF.c:142-147).
+        data = b"  a\tbb\ncc\x0bdd\x0cee\rff  gg\n"
+        assert whitespace_tokenize(data) == [b"a", b"bb", b"cc", b"dd",
+                                             b"ee", b"ff", b"gg"]
+
+    def test_empty_and_all_space(self):
+        assert whitespace_tokenize(b"") == []
+        assert whitespace_tokenize(b" \n\t ") == []
+
+    def test_truncation_knob(self):
+        assert whitespace_tokenize(b"abcdef xy", truncate_at=3) == [b"abc", b"xy"]
+
+    def test_char_ngrams_order_and_count(self):
+        grams = char_ngrams(b"abcd", 2, 3)
+        assert grams == [b"ab", b"abc", b"bc", b"bcd", b"cd"]
+
+
+class TestHashing:
+    def test_fnv1a_matches_scalar_reference(self):
+        words = [b"", b"a", b"hello", b"the quick brown fox"]
+        got = fnv1a_hash_words(words)
+        want = [_fnv1a_scalar(w) for w in words]
+        assert [int(x) for x in got] == want
+
+    def test_seed_changes_hashes(self):
+        a = fnv1a_hash_words([b"word"], seed=0)
+        b = fnv1a_hash_words([b"word"], seed=1)
+        assert int(a[0]) != int(b[0])
+
+    def test_fold_in_range_and_deterministic(self):
+        ids = words_to_ids([b"alpha", b"beta", b"alpha"], 1 << 16)
+        assert ids.dtype == np.int32
+        assert (0 <= ids).all() and (ids < 1 << 16).all()
+        assert ids[0] == ids[2]
+
+    def test_device_ngram_ids_match_host_hash_structure(self):
+        data = b"abcdef"
+        arr = jnp.array(np.frombuffer(data, np.uint8).astype(np.int32))
+        ids, valid = device_ngram_ids(arr, len(data), n=3, vocab_size=97)
+        assert ids.shape == (6,)
+        assert valid.tolist() == [True, True, True, True, False, False]
+        # same window bytes -> same id
+        arr2 = jnp.array(np.frombuffer(b"xbcdef", np.uint8).astype(np.int32))
+        ids2, _ = device_ngram_ids(arr2, 6, n=3, vocab_size=97)
+        assert ids[1:4].tolist() == ids2[1:4].tolist()
+        assert int(ids[0]) != int(ids2[0]) or data[0:3] == b"xbc"
+
+
+class TestHistogram:
+    def test_counts_and_docsize_invariant(self):
+        toks = jnp.array([[0, 1, 1, 2, 9, 9], [3, 3, 3, 0, 0, 0]], jnp.int32)
+        lens = jnp.array([4, 3], jnp.int32)
+        c = tf_counts(toks, lens, vocab_size=8)
+        assert c.shape == (2, 8)
+        # docSize invariant (TFIDF.c:141-143): row sums == lengths.
+        assert c.sum(axis=1).tolist() == [4, 3]
+        assert c[0, 0] == 1 and c[0, 1] == 2 and c[0, 2] == 1
+        assert c[1, 3] == 3
+
+    def test_padding_never_counted(self):
+        toks = jnp.array([[5, 5, 5, 5]], jnp.int32)
+        c = tf_counts(toks, jnp.array([0], jnp.int32), vocab_size=8)
+        assert int(c.sum()) == 0
+
+    def test_chunked_equals_unchunked(self):
+        rng = np.random.default_rng(0)
+        toks = jnp.array(rng.integers(0, 50, size=(5, 64)), jnp.int32)
+        lens = jnp.array([64, 10, 0, 33, 17], jnp.int32)
+        full = tf_counts(toks, lens, 50)
+        chunked = tf_counts_chunked(toks, lens, 50, chunk=16)
+        assert (np.asarray(full) == np.asarray(chunked)).all()
+
+    def test_df_counts_documents_not_tokens(self):
+        # The currDoc dedup semantics (TFIDF.c:171-188): a word occurring
+        # 3x in one doc contributes 1 to DF.
+        toks = jnp.array([[7, 7, 7], [7, 1, 2]], jnp.int32)
+        lens = jnp.array([3, 3], jnp.int32)
+        df = df_from_counts(tf_counts(toks, lens, 8))
+        assert int(df[7]) == 2 and int(df[1]) == 1 and int(df[0]) == 0
+
+
+class TestScoring:
+    def test_idf_universal_word_is_zero(self):
+        # A word in all docs scores exactly 0 (SURVEY §2.5-10).
+        df = jnp.array([4, 2, 0], jnp.int32)
+        idf = idf_from_df(df, 4)
+        assert float(idf[0]) == 0.0
+        assert float(idf[1]) == pytest.approx(math.log(2), rel=1e-6)
+        assert float(idf[2]) == 0.0  # empty hash bucket guard
+
+    def test_dense_scores_match_manual(self):
+        counts = jnp.array([[2, 0], [1, 1]], jnp.int32)
+        lens = jnp.array([2, 2], jnp.int32)
+        df = jnp.array([2, 1], jnp.int32)
+        s = tfidf_dense(counts, lens, df, 2)
+        assert float(s[0, 0]) == 0.0  # word in all docs
+        assert float(s[1, 1]) == pytest.approx(0.5 * math.log(2), rel=1e-6)
+
+
+class TestTopK:
+    def test_per_doc_and_global(self):
+        s = jnp.array([[0.1, 0.9, 0.5], [0.8, 0.0, 0.2]], jnp.float32)
+        vals, ids = topk_per_doc(s, 2)
+        assert ids[0].tolist() == [1, 2] and ids[1].tolist() == [0, 2]
+        gv, gd, gi = topk_global(s, 2)
+        assert gd.tolist() == [0, 1] and gi.tolist() == [1, 0]
+        tv, ti = topk_terms(s, 1)
+        assert ti.tolist() == [1] or ti.tolist() == [0]

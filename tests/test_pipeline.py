@@ -1,0 +1,105 @@
+"""End-to-end pipeline tests: golden byte-parity and properties."""
+
+import math
+
+import numpy as np
+import pytest
+
+from tfidf_tpu import PipelineConfig, TfidfPipeline, discover_corpus
+from tfidf_tpu.config import VocabMode
+from tfidf_tpu.golden import golden_lines, golden_output
+from tfidf_tpu.io.corpus import Corpus
+
+
+def make_corpus(docs):
+    return Corpus(names=[f"doc{i+1}" for i in range(len(docs))], docs=docs)
+
+
+class TestGoldenOracle:
+    def test_known_small_case(self):
+        # 2 docs; "b" appears in both -> idf 0; "a" only in doc1.
+        corpus = make_corpus([b"a b", b"b b"])
+        lines = golden_lines(corpus)
+        score_a = (1 / 2) * math.log(2 / 1)
+        assert lines == sorted([
+            b"doc1@a\t" + (b"%.16f" % score_a),
+            b"doc1@b\t" + (b"%.16f" % 0.0),
+            b"doc2@b\t" + (b"%.16f" % 0.0),
+        ])
+
+    def test_lexicographic_doc10_before_doc2(self):
+        # strcmp ordering quirk (SURVEY §2.5-9).
+        docs = [b"w"] * 10
+        corpus = make_corpus(docs)
+        lines = golden_lines(corpus)
+        names = [l.split(b"@")[0] for l in lines]
+        assert names.index(b"doc10") < names.index(b"doc2")
+
+
+class TestPipelineGoldenParity:
+    @pytest.mark.parametrize("cfg", [
+        PipelineConfig.golden(),
+        PipelineConfig(vocab_mode=VocabMode.EXACT, doc_chunk=8,
+                       max_doc_len=8),  # force chunked path
+    ])
+    def test_exact_vocab_matches_golden_bytes(self, toy_corpus_dir, cfg):
+        corpus = discover_corpus(toy_corpus_dir)
+        result = TfidfPipeline(cfg).run(corpus)
+        assert result.output_bytes() == golden_output(corpus)
+
+    def test_mesh_padding_docs_do_not_change_output(self, toy_corpus_dir):
+        corpus = discover_corpus(toy_corpus_dir)
+        pipe = TfidfPipeline(PipelineConfig.golden())
+        batch = pipe.pack(corpus, pad_docs_to=8)
+        assert batch.token_ids.shape[0] == 8
+        result = pipe.run_packed(batch)
+        assert result.output_bytes() == golden_output(corpus)
+
+    def test_hashed_vocab_no_collisions_matches_golden(self, toy_corpus_dir):
+        # With a huge hashed vocab and a tiny word set, collisions are
+        # (with this seed) absent, so hashed output == golden output.
+        corpus = discover_corpus(toy_corpus_dir)
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=1 << 20)
+        result = TfidfPipeline(cfg).run(corpus)
+        assert result.output_bytes() == golden_output(corpus)
+
+
+class TestPipelineProperties:
+    def test_tf_row_sums_and_df_bounds(self, toy_corpus_dir):
+        corpus = discover_corpus(toy_corpus_dir)
+        result = TfidfPipeline(PipelineConfig.golden()).run(corpus)
+        d = result.num_docs
+        assert (result.counts.sum(axis=1) == result.lengths[: d]).all()
+        assert (result.df >= 0).all() and (result.df <= d).all()
+        # every word with counts has df >= 1
+        seen = (result.counts > 0).any(axis=0)
+        assert (result.df[seen] >= 1).all()
+
+    def test_topk_config(self, toy_corpus_dir):
+        corpus = discover_corpus(toy_corpus_dir)
+        cfg = PipelineConfig(vocab_mode=VocabMode.EXACT, topk=3)
+        result = TfidfPipeline(cfg).run(corpus)
+        assert result.topk_vals.shape[1] == 3
+        # topk mode honors its contract: dense scores stay on device
+        assert result.scores is None
+        # top-1 per doc matches argmax of a dense run
+        dense = TfidfPipeline(PipelineConfig(vocab_mode=VocabMode.EXACT)).run(corpus)
+        assert (result.topk_ids[:, 0] == dense.scores.argmax(axis=1)).all()
+
+
+class TestDiscovery:
+    def test_strict_contract_missing_doc_raises(self, tmp_path):
+        d = tmp_path / "input"
+        d.mkdir()
+        (d / "doc1").write_bytes(b"x")
+        (d / "other").write_bytes(b"y")  # breaks doc<i> naming
+        with pytest.raises(FileNotFoundError):
+            discover_corpus(str(d))  # doc2 missing -> hard error (TFIDF.c:137)
+
+    def test_nonstrict_loads_any_files(self, tmp_path):
+        d = tmp_path / "input"
+        d.mkdir()
+        (d / "b.txt").write_bytes(b"x")
+        (d / "a.txt").write_bytes(b"y")
+        c = discover_corpus(str(d), strict=False)
+        assert c.names == ["a.txt", "b.txt"]

@@ -1,0 +1,43 @@
+"""tfidf_tpu — a TPU-native distributed TF-IDF framework.
+
+A ground-up JAX/XLA re-design of the capabilities of the MPI reference
+(ndas7/Parallel-Systems-MPI-TFIDF, mounted at /root/reference):
+
+* The reference shards documents round-robin across MPI worker ranks
+  (``TFIDF.c:130``); here the document axis of a packed token batch is
+  sharded across a :class:`jax.sharding.Mesh` axis (``parallel.mesh``).
+* The reference builds per-rank term-frequency tables by linear scan
+  (``TFIDF.c:147-191``); here TF is a masked scatter-add histogram over a
+  hashed vocabulary (``ops.histogram``), O(tokens) instead of O(tokens x
+  vocab).
+* The reference aggregates document frequencies with a custom
+  ``MPI_Reduce`` + ``MPI_Bcast`` pair (``TFIDF.c:215,220``); here that
+  reduce-then-rebroadcast is a single ``lax.psum`` over the mesh's ICI
+  links (``parallel.collectives``).
+* The reference's serial ``MPI_Send``/``MPI_Recv`` gather + root qsort
+  (``TFIDF.c:256-283``) is replaced by device-side top-k plus a single
+  gather (``ops.topk``).
+
+The exact byte-level semantics of the reference (output format, natural-log
+IDF, lexicographic ordering) are preserved by the golden path
+(:mod:`tfidf_tpu.golden`) and the clean-room native bit-reference under
+``native/``, exposed as ``--backend=mpi`` in the CLI.
+"""
+
+from tfidf_tpu.config import PipelineConfig, VocabMode, TokenizerKind
+from tfidf_tpu.pipeline import TfidfPipeline, PipelineResult
+from tfidf_tpu.io.corpus import Corpus, discover_corpus, PackedBatch
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "PipelineConfig",
+    "VocabMode",
+    "TokenizerKind",
+    "TfidfPipeline",
+    "PipelineResult",
+    "Corpus",
+    "discover_corpus",
+    "PackedBatch",
+    "__version__",
+]

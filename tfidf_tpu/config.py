@@ -1,0 +1,112 @@
+"""Configuration for the TPU TF-IDF pipeline.
+
+The reference has *no* config system: ``argc/argv`` are ignored
+(``TFIDF.c:52``) and every knob is a compile-time ``#define``
+(``TFIDF.c:16-20``). Here every knob the reference hardcodes — plus the
+TPU-era ones it lacks — is an explicit dataclass field.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional, Tuple
+
+
+class VocabMode(str, enum.Enum):
+    """How words map to integer vocabulary ids.
+
+    EXACT builds a host-side string->id dictionary over the corpus — the
+    moral equivalent of the reference's string-keyed tables
+    (``TFIDF.c:26-42``), collision-free, used for golden-parity runs.
+
+    HASHED maps words through FNV-1a into a fixed-size vocab (default
+    2^16 per BASELINE config 2). Collisions are possible; this is the
+    scalable path: the DF "set union by string" of the reference's
+    CustomReduce (``TFIDF.c:291-319``) becomes a dense vector add.
+    """
+
+    EXACT = "exact"
+    HASHED = "hashed"
+
+
+class TokenizerKind(str, enum.Enum):
+    """Tokenizer family.
+
+    WHITESPACE mirrors the reference's ``fscanf("%s")`` splitting
+    (``TFIDF.c:142-147``). CHARGRAM is the char n-gram mode of BASELINE
+    config 4 (wide-vocab stress); n-gram ids are computed on device.
+    """
+
+    WHITESPACE = "whitespace"
+    CHARGRAM = "chargram"
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineConfig:
+    """All knobs for a TF-IDF run.
+
+    Attributes:
+      vocab_mode: EXACT (golden parity) or HASHED (scalable).
+      vocab_size: vocabulary size for HASHED mode (ignored for EXACT,
+        where the corpus determines it). 2^16 per BASELINE config 2.
+      hash_seed: FNV-1a seed perturbation, so collision structure can be
+        varied between runs.
+      tokenizer: WHITESPACE or CHARGRAM.
+      ngram_range: inclusive (lo, hi) n-gram sizes for CHARGRAM
+        (BASELINE config 4 uses 3..5).
+      truncate_tokens_at: if set, tokens are truncated to this many
+        bytes before vocab lookup — replicates the reference's 16-char
+        scan-buffer quirk (``MAX_WORD_LENGTH 16``, ``TFIDF.c:18``; see
+        SURVEY §2.5-6) for bit-parity runs. None = no truncation.
+      max_doc_len: packed token-axis length per document. Documents are
+        padded/chunked to this static shape so XLA sees fixed shapes.
+      doc_chunk: when a document exceeds max_doc_len, it is split into
+        chunks of this many tokens whose histograms are summed — the
+        long-document path (SURVEY §5 long-context).
+      mesh_shape: logical device mesh, e.g. ``{"docs": 8}`` or
+        ``{"docs": 4, "vocab": 2}``. Empty = single device.
+      use_pallas: route the TF histogram through the Pallas TPU kernel
+        instead of the XLA scatter-add.
+      score_dtype: dtype for on-device score math. Exact byte parity
+        with the C reference's double math (``TFIDF.c:243-245``) is
+        achieved on host in float64 by the golden formatter, so the
+        device side can stay float32/bfloat16.
+      topk: if set, only the top-k (by score) records per document are
+        gathered to host — the scalable replacement for the reference's
+        full serial gather (``TFIDF.c:256-270``).
+    """
+
+    vocab_mode: VocabMode = VocabMode.EXACT
+    vocab_size: int = 1 << 16
+    hash_seed: int = 0
+    tokenizer: TokenizerKind = TokenizerKind.WHITESPACE
+    ngram_range: Tuple[int, int] = (3, 5)
+    truncate_tokens_at: Optional[int] = None
+    max_doc_len: int = 256
+    doc_chunk: int = 256
+    mesh_shape: dict = dataclasses.field(default_factory=dict)
+    use_pallas: bool = False
+    score_dtype: str = "float32"
+    topk: Optional[int] = None
+
+    def __post_init__(self):
+        if self.vocab_size <= 0:
+            raise ValueError("vocab_size must be positive")
+        lo, hi = self.ngram_range
+        if not (0 < lo <= hi):
+            raise ValueError(f"bad ngram_range {self.ngram_range}")
+        if self.max_doc_len <= 0 or self.doc_chunk <= 0:
+            raise ValueError("max_doc_len/doc_chunk must be positive")
+
+    @staticmethod
+    def golden() -> "PipelineConfig":
+        """Config whose output is byte-identical to the C reference.
+
+        EXACT vocab, no truncation: golden corpora must stay inside the
+        reference's *valid envelope* (SURVEY §2.5) — tokens shorter than
+        16 bytes, since past that the reference's ``fscanf("%s")`` into
+        ``char word[16]`` (``TFIDF.c:18,59``) is undefined behaviour, not
+        a semantics to reproduce.
+        """
+        return PipelineConfig(vocab_mode=VocabMode.EXACT)

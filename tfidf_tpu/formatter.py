@@ -1,0 +1,59 @@
+"""Host-side result formatting with reference byte-parity.
+
+The device computes *exact integers* (TF counts, doc lengths, DF); this
+module performs the final double math on host in the same operation order
+as the C reference (``TFIDF.c:202,243-245``) and emits the same
+``document@word\\t%.16f`` lines in the same ``strcmp`` order
+(``TFIDF.c:273``). Splitting the pipeline there is what lets the TPU side
+run in float32/bfloat16 while the emitted file is still byte-identical to
+the reference (SURVEY §7 "hard parts": bit-identical output).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def format_records(counts: np.ndarray, lengths: np.ndarray, df: np.ndarray,
+                   num_docs: int, names: Sequence[str],
+                   id_to_word: Dict[int, bytes]) -> List[bytes]:
+    """Golden-format lines from integer pipeline outputs.
+
+    Args:
+      counts: int [D, V] per-doc term counts (padding docs all-zero).
+      lengths: int [D] docSize per doc.
+      df: int [V] global document frequencies.
+      num_docs: real (unpadded) document count N.
+      names: D document names; '' entries (mesh padding) are skipped.
+      id_to_word: id -> token bytes for every id with nonzero counts.
+    """
+    counts = np.asarray(counts)
+    lengths = np.asarray(lengths)
+    df = np.asarray(df)
+    lines: List[bytes] = []
+    docs_idx, vocab_idx = np.nonzero(counts)
+    for d, v in zip(docs_idx.tolist(), vocab_idx.tolist()):
+        name = names[d]
+        if not name:
+            continue
+        c = int(counts[d, v])
+        tf = 1.0 * c / int(lengths[d])            # TFIDF.c:202
+        idf = math.log(1.0 * num_docs / int(df[v]))  # TFIDF.c:243
+        score = tf * idf                           # TFIDF.c:244
+        lines.append(b"%s@%s\t%s" % (
+            name.encode(), id_to_word[v], b"%.16f" % score))
+    lines.sort()
+    return lines
+
+
+def to_output_bytes(lines: Sequence[bytes]) -> bytes:
+    """Join lines into the ``output.txt`` byte stream (``TFIDF.c:278-281``)."""
+    return b"".join(line + b"\n" for line in lines)
+
+
+def write_output(path: str, lines: Sequence[bytes]) -> None:
+    with open(path, "wb") as f:
+        f.write(to_output_bytes(lines))

@@ -1,0 +1,5 @@
+"""Corpus IO: discovery, loading, and static-shape packing."""
+
+from tfidf_tpu.io.corpus import Corpus, PackedBatch, discover_corpus, pack_corpus
+
+__all__ = ["Corpus", "PackedBatch", "discover_corpus", "pack_corpus"]

@@ -1,0 +1,182 @@
+"""Corpus discovery, loading, and static-shape packing.
+
+Reference contract (SURVEY §2 C1-C2): rank 0 counts the entries of
+``./input`` via ``opendir``/``readdir`` skipping ``.``/``..``
+(``TFIDF.c:98-110``); documents are 1-indexed and named exactly
+``doc1..docN`` (``TFIDF.c:132-133``); a missing file is a hard error
+(``TFIDF.c:137``). :func:`discover_corpus` honours that contract, plus a
+``strict=False`` mode that accepts any directory of files (sorted by
+name) since real corpora are not named ``doc<i>``.
+
+Packing: TPU kernels need static shapes, so documents are tokenized,
+hashed to ids, and packed into a padded ``[D, L]`` int32 batch with a
+``lengths`` vector — the moral replacement for the reference's
+token-at-a-time ``fscanf`` streaming (``TFIDF.c:147``). ``D`` can be
+padded up to a mesh-divisible count with empty docs (length 0), which the
+masked histogram ignores by construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tfidf_tpu.config import PipelineConfig, TokenizerKind, VocabMode
+from tfidf_tpu.io import fast_tokenizer
+from tfidf_tpu.ops.hashing import words_to_ids
+from tfidf_tpu.ops.tokenize import char_ngrams, whitespace_tokenize
+
+
+@dataclasses.dataclass
+class Corpus:
+    """Raw documents: parallel lists of names and byte contents."""
+
+    names: List[str]
+    docs: List[bytes]
+
+    def __len__(self) -> int:
+        return len(self.docs)
+
+
+@dataclasses.dataclass
+class PackedBatch:
+    """Static-shape device input.
+
+    token_ids: int32 [D, L] vocab ids, padded past each doc's length.
+    lengths: int32 [D] live token counts (== the reference's ``docSize``,
+      ``TFIDF.c:141-143``).
+    num_docs: real document count (D may exceed it via mesh padding).
+    names: D document names ('' for padding docs).
+    vocab_size: V for this batch.
+    id_to_word: optional id -> representative token bytes, for output
+      formatting. EXACT mode: the true inverse vocabulary. HASHED mode:
+      first-seen token per bucket (collisions share a representative).
+    """
+
+    token_ids: np.ndarray
+    lengths: np.ndarray
+    num_docs: int
+    names: List[str]
+    vocab_size: int
+    id_to_word: Optional[Dict[int, bytes]]
+
+
+def discover_corpus(input_dir: str, strict: bool = True) -> Corpus:
+    """Enumerate and load a document directory.
+
+    strict=True: reference contract — count entries, then open
+    ``doc1..docN`` (``TFIDF.c:98-110,132-138``); raises FileNotFoundError
+    if any ``doc<i>`` is missing, matching the reference's hard exit.
+    strict=False: load every regular file, sorted by name.
+    """
+    entries = sorted(e for e in os.listdir(input_dir)
+                     if os.path.isfile(os.path.join(input_dir, e)))
+    if strict:
+        names = [f"doc{i}" for i in range(1, len(entries) + 1)]
+    else:
+        names = entries
+    docs = []
+    for name in names:
+        path = os.path.join(input_dir, name)
+        with open(path, "rb") as f:  # raises like the reference's exit(2)
+            docs.append(f.read())
+    return Corpus(names=names, docs=docs)
+
+
+def _tokens_for(doc: bytes, config: PipelineConfig) -> List[bytes]:
+    if config.tokenizer is TokenizerKind.WHITESPACE:
+        return whitespace_tokenize(doc, config.truncate_tokens_at)
+    lo, hi = config.ngram_range
+    return char_ngrams(doc, lo, hi)
+
+
+def build_exact_vocab(token_docs: Sequence[Sequence[bytes]]) -> Dict[bytes, int]:
+    """String -> id over the corpus, first-appearance order.
+
+    The collision-free analog of the reference's string-keyed tables
+    (``TFIDF.c:26-42``); id order is arbitrary because output is sorted
+    lexicographically at emit time (``TFIDF.c:273``).
+    """
+    vocab: Dict[bytes, int] = {}
+    for toks in token_docs:
+        for t in toks:
+            if t not in vocab:
+                vocab[t] = len(vocab)
+    return vocab
+
+
+def pack_corpus(corpus: Corpus, config: PipelineConfig,
+                pad_docs_to: Optional[int] = None,
+                want_words: bool = True) -> PackedBatch:
+    """Tokenize + id-map + pad into a device-ready batch.
+
+    ``want_words=False`` skips building the id -> word map — the big-run
+    mode where results are consumed by id (top-k recall, benchmarks) and
+    the host should not hold per-token strings.
+
+    HASHED + WHITESPACE uses the native one-pass tokenize+hash kernel
+    (``native/fast_tokenizer.cc``) when built, falling back to the
+    Python path transparently.
+    """
+    use_native_hash = (
+        config.vocab_mode is VocabMode.HASHED
+        and config.tokenizer is TokenizerKind.WHITESPACE
+        and not want_words
+        and fast_tokenizer.available())
+
+    if use_native_hash:
+        vocab_size = config.vocab_size
+        id_docs = [fast_tokenizer.tokenize_hash_ids(
+            doc, vocab_size, config.hash_seed, config.truncate_tokens_at)
+            for doc in corpus.docs]
+        lengths = np.array([len(i) for i in id_docs], dtype=np.int32)
+        id_to_word: Dict[int, bytes] = {}
+    else:
+        token_docs = [_tokens_for(doc, config) for doc in corpus.docs]
+        lengths = np.array([len(t) for t in token_docs], dtype=np.int32)
+
+        if config.vocab_mode is VocabMode.EXACT:
+            vocab = build_exact_vocab(token_docs)
+            vocab_size = max(len(vocab), 1)
+            id_docs = [np.array([vocab[t] for t in toks], dtype=np.int32)
+                       for toks in token_docs]
+            id_to_word = {i: w for w, i in vocab.items()} if want_words else {}
+        else:
+            vocab_size = config.vocab_size
+            id_docs = []
+            id_to_word = {}
+            for toks in token_docs:
+                ids = words_to_ids(toks, vocab_size, config.hash_seed)
+                id_docs.append(ids)
+                if want_words:
+                    for t, i in zip(toks, ids):
+                        id_to_word.setdefault(int(i), t)
+
+    max_len = int(lengths.max(initial=0))
+    chunk = config.doc_chunk
+    # Static L: at least max_doc_len, grown to fit the longest doc, and
+    # always a chunk multiple (tf_counts_chunked's precondition); long
+    # docs then stream through the chunked scan.
+    padded_len = max(config.max_doc_len, max_len, 1)
+    padded_len = ((padded_len + chunk - 1) // chunk) * chunk
+
+    d = len(corpus)
+    d_padded = max(pad_docs_to or d, d)
+    token_ids = np.zeros((d_padded, padded_len), dtype=np.int32)
+    out_lengths = np.zeros((d_padded,), dtype=np.int32)
+    for i, ids in enumerate(id_docs):
+        token_ids[i, : len(ids)] = ids
+        out_lengths[i] = len(ids)
+
+    names = list(corpus.names) + [""] * (d_padded - d)
+    return PackedBatch(
+        token_ids=token_ids,
+        lengths=out_lengths,
+        num_docs=d,
+        names=names,
+        vocab_size=vocab_size,
+        id_to_word=id_to_word,
+    )

@@ -1,0 +1,25 @@
+"""Device and host compute kernels for the TF-IDF pipeline.
+
+Layout mirrors the logical ops layer of the reference (SURVEY §1):
+tokenize (``TFIDF.c:142-147``), TF/DF accumulation (``TFIDF.c:147-191``),
+scoring (``TFIDF.c:227-246``) — each re-designed as an array op rather
+than a linear-scan loop.
+"""
+
+from tfidf_tpu.ops.histogram import tf_counts, df_from_counts, presence
+from tfidf_tpu.ops.scoring import idf_from_df, tfidf_dense, tf_matrix
+from tfidf_tpu.ops.hashing import fnv1a_hash_words, hash_to_vocab
+from tfidf_tpu.ops.tokenize import whitespace_tokenize, char_ngrams
+
+__all__ = [
+    "tf_counts",
+    "df_from_counts",
+    "presence",
+    "idf_from_df",
+    "tfidf_dense",
+    "tf_matrix",
+    "fnv1a_hash_words",
+    "hash_to_vocab",
+    "whitespace_tokenize",
+    "char_ngrams",
+]

@@ -1,0 +1,106 @@
+"""Word -> vocabulary-id hashing.
+
+The reference keys every table by raw strings and resolves ids by linear
+scan (``TFIDF.c:150-188``), which makes its DF aggregation a string-keyed
+set union (``CustomReduce``, ``TFIDF.c:291-319``). Hashing words to a
+fixed integer vocabulary up front collapses all of that: TF/DF tables
+become dense (or sparse) arrays, and the set-union-with-sum becomes a
+plain vector add that ``lax.psum`` handles over ICI (SURVEY §2.4).
+
+Two hash paths:
+
+* ``fnv1a_hash_words``: host-side, vectorized NumPy FNV-1a-64 over a list
+  of byte-string tokens. Used by the whitespace-tokenizer loader.
+* ``device_ngram_ids``: device-side polynomial rolling hash over raw
+  document bytes, producing char n-gram ids without ever materializing
+  n-gram strings on host (BASELINE config 4).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+_FNV_OFFSET = np.uint64(14695981039346656037)
+_FNV_PRIME = np.uint64(1099511628211)
+
+
+def fnv1a_hash_words(words: Sequence[bytes], seed: int = 0) -> np.ndarray:
+    """64-bit FNV-1a of each byte-string, vectorized across words.
+
+    The per-word byte loop is vectorized across the word axis: words are
+    packed into a padded [N, max_len] byte matrix and the hash state is
+    updated column-by-column, masked by word length — O(max_len) NumPy
+    steps regardless of N. ``seed`` perturbs the offset basis so collision
+    structure can be re-rolled.
+    """
+    if len(words) == 0:
+        return np.zeros((0,), dtype=np.uint64)
+    lens = np.fromiter((len(w) for w in words), count=len(words), dtype=np.int64)
+    max_len = int(lens.max(initial=0))
+    mat = np.zeros((len(words), max_len), dtype=np.uint8)
+    for i, w in enumerate(words):
+        mat[i, : len(w)] = np.frombuffer(w, dtype=np.uint8)
+    h = np.full(len(words), _FNV_OFFSET ^ np.uint64(seed), dtype=np.uint64)
+    with np.errstate(over="ignore"):
+        for j in range(max_len):
+            live = j < lens
+            hj = (h ^ mat[:, j].astype(np.uint64)) * _FNV_PRIME
+            h = np.where(live, hj, h)
+    return h
+
+
+def hash_to_vocab(hashes: np.ndarray, vocab_size: int) -> np.ndarray:
+    """Fold 64-bit hashes into [0, vocab_size) with an xor-fold.
+
+    Plain ``% vocab_size`` on a power-of-two vocab keeps only the low
+    bits; xor-folding the high word in first preserves entropy from the
+    full hash (FNV's low bits alone are weak for power-of-two tables).
+    """
+    folded = hashes ^ (hashes >> np.uint64(32))
+    return (folded % np.uint64(vocab_size)).astype(np.int32)
+
+
+def words_to_ids(words: Sequence[bytes], vocab_size: int, seed: int = 0) -> np.ndarray:
+    """Convenience: FNV-1a + fold, the hashed-vocab loader path."""
+    return hash_to_vocab(fnv1a_hash_words(words, seed), vocab_size)
+
+
+# ---------------------------------------------------------------------------
+# Device-side char n-gram ids (BASELINE config 4).
+# ---------------------------------------------------------------------------
+
+# Multiplier for the polynomial rolling hash; odd so it is invertible
+# mod 2^32 and entropy is not lost as windows accumulate.
+_POLY = np.uint32(0x01000193)  # FNV-32 prime reused as the polynomial base
+
+
+def device_ngram_ids(doc_bytes, doc_len, n: int, vocab_size: int, seed: int = 0):
+    """Ids of all length-``n`` byte windows of a document, on device.
+
+    Args:
+      doc_bytes: uint8/int32 array [L] — the raw document, zero-padded.
+      doc_len: scalar int — live byte count.
+      n: window size (static).
+      vocab_size: fold target (static).
+      seed: hash seed (static).
+
+    Returns:
+      (ids, valid): int32 [L] window ids (position i = window starting at
+      i) and bool [L] validity mask (windows that fit inside doc_len).
+      Shapes stay static at [L]; invalid tail windows are masked, which is
+      the TPU idiom for the ragged output (SURVEY §7 "ragged docs").
+    """
+    b = doc_bytes.astype(jnp.uint32)
+    length = b.shape[0]
+    h = jnp.full((length,), np.uint32(seed) ^ np.uint32(0x811C9DC5), dtype=jnp.uint32)
+    # Horner evaluation of the n-byte polynomial at each start position.
+    for j in range(n):
+        shifted = jnp.roll(b, -j)  # window byte j for each start position
+        h = (h ^ shifted) * _POLY
+    h ^= h >> 16
+    ids = (h % np.uint32(vocab_size)).astype(jnp.int32)
+    valid = jnp.arange(length) + n <= doc_len
+    return ids, valid

@@ -1,0 +1,106 @@
+"""Term-frequency and document-frequency histograms.
+
+The reference builds TF with a per-token linear scan over an append-only
+table (``TFIDF.c:150-167``) — O(tokens x distinct-words) per document —
+and DF with a second linear-scan table deduplicated by a ``currDoc``
+field (``TFIDF.c:169-188``). On TPU both collapse into one masked
+scatter-add over the hashed vocab: O(tokens), fixed shapes, and the DF
+"dedup by document" falls out of thresholding the TF histogram
+(``df = sum_d [tf[d, v] > 0]``) instead of being tracked token-by-token.
+
+All shapes here are static (XLA requirement): token batches are padded to
+``[D, L]`` and padding is masked via a sentinel bucket that is sliced off,
+never branched on.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def tf_counts_masked(token_ids: jax.Array, valid: jax.Array,
+                     vocab_size: int, id_offset=0) -> jax.Array:
+    """Histogram of ``token_ids - id_offset`` where ``valid``, else dropped.
+
+    The workhorse behind both the dense path and the sharded path: with a
+    vocab-sharded mesh each shard passes its own ``id_offset`` and width
+    ``vocab_size``; out-of-range ids (another shard's words) and padding
+    both fall into the sentinel bucket and are sliced off.
+    """
+    d, _ = token_ids.shape
+    local = token_ids - id_offset
+    in_range = valid & (local >= 0) & (local < vocab_size)
+    safe = jnp.where(in_range, local, vocab_size)
+    counts = jnp.zeros((d, vocab_size + 1), jnp.int32)
+    counts = counts.at[jnp.arange(d)[:, None], safe].add(1)
+    return counts[:, :vocab_size]
+
+
+def tf_counts(token_ids: jax.Array, lengths: jax.Array, vocab_size: int) -> jax.Array:
+    """Per-document term-frequency histogram.
+
+    Args:
+      token_ids: int32 [D, L] vocab ids, padded arbitrarily past each
+        document's length.
+      lengths: int32 [D] live token counts.
+      vocab_size: static vocabulary size V.
+
+    Returns:
+      int32 [D, V] counts; ``counts[d].sum() == lengths[d]`` (property
+      test pins this — the reference's ``docSize`` invariant,
+      ``TFIDF.c:141-143``).
+
+    Padding handling: padded positions are redirected to a sentinel
+    bucket V which is sliced away — no data-dependent control flow, so
+    the op stays a single fused scatter under ``jit``.
+    """
+    _, length = token_ids.shape
+    mask = jnp.arange(length, dtype=lengths.dtype)[None, :] < lengths[:, None]
+    return tf_counts_masked(token_ids, mask, vocab_size)
+
+
+def presence(counts: jax.Array) -> jax.Array:
+    """int32 [D, V] -> int32 [D, V] 0/1 presence matrix (word-in-doc)."""
+    return (counts > 0).astype(jnp.int32)
+
+
+def df_from_counts(counts: jax.Array) -> jax.Array:
+    """Local document-frequency vector from a shard's TF counts.
+
+    int32 [D, V] -> int32 [V]: number of *local* documents containing
+    each word. The global DF is the mesh-wide ``lax.psum`` of this
+    (``parallel.collectives.global_df``) — the one-collective replacement
+    for the reference's CustomReduce+Bcast pair (``TFIDF.c:215,220``).
+    """
+    return presence(counts).sum(axis=0)
+
+
+def tf_counts_chunked(token_ids: jax.Array, lengths: jax.Array, vocab_size: int,
+                      chunk: int) -> jax.Array:
+    """TF histogram with the token axis processed in fixed chunks.
+
+    Same result as :func:`tf_counts`, but the [D, L] batch is folded to
+    [D, L/chunk, chunk] and reduced with ``lax.scan`` over chunks —
+    bounding live memory at [D, V] + [D, chunk] regardless of L. This is
+    the single-device half of the long-document story (SURVEY §5): a doc
+    whose token stream exceeds one chip's memory shards its *chunks*
+    across a mesh axis and psums the partial histograms
+    (``parallel.longdoc``).
+    """
+    d, length = token_ids.shape
+    if length % chunk != 0:
+        raise ValueError(f"token axis {length} not divisible by chunk {chunk}")
+    n_chunks = length // chunk
+    toks = token_ids.reshape(d, n_chunks, chunk).transpose(1, 0, 2)
+    offsets = jnp.arange(n_chunks, dtype=lengths.dtype) * chunk
+
+    def step(acc, inp):
+        toks_c, off = inp
+        rem = jnp.clip(lengths - off, 0, chunk)
+        acc = acc + tf_counts(toks_c, rem, vocab_size)
+        return acc, None
+
+    init = jnp.zeros((d, vocab_size), jnp.int32)
+    out, _ = jax.lax.scan(step, init, (toks, offsets))
+    return out

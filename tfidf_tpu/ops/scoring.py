@@ -1,0 +1,43 @@
+"""IDF and TF-IDF scoring.
+
+Reference semantics (``TFIDF.c:227-246``): for every (word, document)
+record, ``TF = wordCount / docSize``, ``IDF = log(numDocs / DF)`` (natural
+log, no smoothing — a word present in all documents scores exactly 0,
+SURVEY §2.5-10), ``score = TF * IDF``. The reference resolves DF per
+record by linear-searching the broadcast table (``TFIDF.c:229-234``);
+here the join is a vectorized gather over the dense DF vector.
+
+Device math runs in ``score_dtype`` (float32 by default). Byte-identical
+doubles vs the C reference are produced on *host* by the golden formatter
+(:mod:`tfidf_tpu.golden`) from the exact integer counts, so the device
+never needs float64.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def idf_from_df(df: jax.Array, num_docs, dtype=jnp.float32) -> jax.Array:
+    """``idf[v] = log(num_docs / df[v])``, 0 where df == 0.
+
+    The df==0 guard has no reference analog (impossible by construction
+    there, SURVEY §2.5-10) but is required here: the hashed vocab has
+    empty buckets.
+    """
+    dff = df.astype(dtype)
+    n = jnp.asarray(num_docs, dtype)
+    return jnp.where(df > 0, jnp.log(n / jnp.maximum(dff, 1)), jnp.zeros((), dtype))
+
+
+def tf_matrix(counts: jax.Array, lengths: jax.Array, dtype=jnp.float32) -> jax.Array:
+    """``tf[d, v] = counts[d, v] / docSize[d]`` (``TFIDF.c:202``)."""
+    lens = jnp.maximum(lengths, 1).astype(dtype)
+    return counts.astype(dtype) / lens[:, None]
+
+
+def tfidf_dense(counts: jax.Array, lengths: jax.Array, df: jax.Array,
+                num_docs, dtype=jnp.float32) -> jax.Array:
+    """Dense [D, V] TF-IDF scores = TF ⊙ broadcast(IDF)."""
+    return tf_matrix(counts, lengths, dtype) * idf_from_df(df, num_docs, dtype)[None, :]

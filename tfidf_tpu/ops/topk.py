@@ -1,0 +1,36 @@
+"""Device-side top-k selection.
+
+The reference gathers *every* scored line to rank 0 over a serial
+``MPI_Recv`` loop and qsorts on host (``TFIDF.c:256-283``) — O(ranks)
+latency and O(total records) host memory. At 1M docs that gather dominates
+runtime (SURVEY §7 "hard parts"). Here selection happens on device:
+``lax.top_k`` per document (and/or globally), so only K records per doc
+ever cross the PCIe/host boundary.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def topk_per_doc(scores: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
+    """Top-k (value, vocab-id) per document. [D, V] -> ([D, K], [D, K])."""
+    return lax.top_k(scores, k)
+
+
+def topk_global(scores: jax.Array, k: int) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Global top-k (value, doc-id, vocab-id) over all [D, V] records."""
+    d, v = scores.shape
+    vals, flat = lax.top_k(scores.reshape(-1), k)
+    return vals, flat // v, flat % v
+
+
+def topk_terms(scores: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
+    """Top-k *terms* by corpus-summed TF-IDF mass — the recall metric's
+    term ranking (BASELINE "top-k term recall vs MPI ref")."""
+    per_term = scores.sum(axis=0)
+    return lax.top_k(per_term, k)

@@ -1,0 +1,138 @@
+"""End-to-end TF-IDF pipeline orchestration.
+
+The reference's ``main()`` runs discover -> bcast -> map(TF) ->
+reduce(DF) -> bcast -> score -> gather -> sort -> emit, with every phase
+fenced by ``MPI_Barrier`` (``TFIDF.c:98-283``, six barriers). Here the
+whole compute section is ONE jitted XLA program: phase ordering is data
+dependence, not barriers, and XLA overlaps/fuses freely (SURVEY §2.3
+"overlap of compute & comm").
+
+Single-device and sharded execution share this module: when a
+:class:`~tfidf_tpu.parallel.mesh.MeshPlan` is given, the same step
+function is wrapped in ``shard_map`` with the document axis sharded and
+DF aggregated via ``lax.psum`` (see ``tfidf_tpu/parallel``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tfidf_tpu.config import PipelineConfig
+from tfidf_tpu.formatter import format_records, to_output_bytes
+from tfidf_tpu.io.corpus import Corpus, PackedBatch, pack_corpus
+from tfidf_tpu.ops.histogram import df_from_counts, tf_counts, tf_counts_chunked
+from tfidf_tpu.ops.scoring import tfidf_dense
+from tfidf_tpu.ops.topk import topk_per_doc
+
+
+@dataclasses.dataclass
+class PipelineResult:
+    """Integer-exact pipeline outputs plus device-side scores.
+
+    counts/lengths/df are exact ints — the inputs to byte-parity host
+    formatting. scores is the device float matrix (or None when topk-only
+    was requested). topk_vals/topk_ids hold per-doc top-k when configured.
+    """
+
+    counts: Optional[np.ndarray]
+    lengths: np.ndarray
+    df: np.ndarray
+    num_docs: int
+    names: List[str]
+    id_to_word: Dict[int, bytes]
+    scores: Optional[np.ndarray] = None
+    topk_vals: Optional[np.ndarray] = None
+    topk_ids: Optional[np.ndarray] = None
+
+    def output_lines(self) -> List[bytes]:
+        """Reference-format lines (document@word\\t%.16f, strcmp order)."""
+        if self.counts is None:
+            raise ValueError(
+                "full output lines need dense counts; this was a topk-only "
+                "run (counts stay on device in topk mode)")
+        return format_records(self.counts, self.lengths, self.df,
+                              self.num_docs, self.names, self.id_to_word)
+
+    def output_bytes(self) -> bytes:
+        return to_output_bytes(self.output_lines())
+
+
+def _forward(token_ids, lengths, num_docs, *, vocab_size: int, chunk: int,
+             score_dtype, topk: Optional[int]):
+    """The jitted compute: tokens -> (counts, df, scores | topk).
+
+    Replaces reference phases 1-3 (``TFIDF.c:130-246``) and the
+    CustomReduce (``TFIDF.c:291-319``) with two histograms and an
+    elementwise score — all fused by XLA into one program. When ``topk``
+    is set the dense [D, V] score matrix never leaves the device — only
+    the [D, K] selection does (the scalable replacement for the
+    reference's full gather, ``TFIDF.c:256-270``).
+    """
+    length = token_ids.shape[1]
+    if length > chunk:
+        counts = tf_counts_chunked(token_ids, lengths, vocab_size, chunk)
+    else:
+        counts = tf_counts(token_ids, lengths, vocab_size)
+    df = df_from_counts(counts)
+    scores = tfidf_dense(counts, lengths, df, num_docs, score_dtype)
+    if topk is not None:
+        tv, ti = topk_per_doc(scores, min(topk, vocab_size))
+        return df, tv, ti
+    return counts, df, scores
+
+
+# Module-level jit keyed on the static config so repeat runs with the
+# same shapes/config hit XLA's compilation cache instead of re-tracing.
+_forward_jit = jax.jit(
+    _forward,
+    static_argnames=("vocab_size", "chunk", "score_dtype", "topk"),
+)
+
+
+class TfidfPipeline:
+    """Configured TF-IDF runner: corpus in, scored records out."""
+
+    def __init__(self, config: Optional[PipelineConfig] = None):
+        self.config = config or PipelineConfig()
+
+    def pack(self, corpus: Corpus, pad_docs_to: Optional[int] = None) -> PackedBatch:
+        return pack_corpus(corpus, self.config, pad_docs_to)
+
+    def run_packed(self, batch: PackedBatch) -> PipelineResult:
+        cfg = self.config
+        if cfg.use_pallas:
+            raise NotImplementedError(
+                "use_pallas: Pallas histogram kernel not wired up yet")
+        if cfg.mesh_shape:
+            raise NotImplementedError(
+                "mesh_shape on TfidfPipeline: use tfidf_tpu.parallel for "
+                "sharded execution")
+        out = _forward_jit(
+            jnp.asarray(batch.token_ids), jnp.asarray(batch.lengths),
+            jnp.int32(batch.num_docs), vocab_size=batch.vocab_size,
+            chunk=cfg.doc_chunk, score_dtype=jnp.dtype(cfg.score_dtype),
+            topk=cfg.topk)
+        # topk mode: neither counts nor scores cross the host boundary —
+        # only DF [V] and the [D, K] selection do.
+        result = PipelineResult(
+            counts=None if cfg.topk is not None else np.asarray(out[0]),
+            lengths=np.asarray(batch.lengths),
+            df=np.asarray(out[0 if cfg.topk is not None else 1]),
+            num_docs=batch.num_docs,
+            names=batch.names,
+            id_to_word=batch.id_to_word or {},
+        )
+        if cfg.topk is not None:
+            result.topk_vals = np.asarray(out[1])
+            result.topk_ids = np.asarray(out[2])
+        else:
+            result.scores = np.asarray(out[2])
+        return result
+
+    def run(self, corpus: Corpus) -> PipelineResult:
+        return self.run_packed(self.pack(corpus))
