@@ -1,0 +1,174 @@
+// Thread-backed (and optional MPI-backed) implementations of the Comm
+// interface declared in comm.h.
+
+#include "comm.h"
+
+#include <condition_variable>
+#include <mutex>
+#include <thread>
+
+namespace tfidf {
+namespace {
+
+// Shared state for one thread-“cluster”. A generation-counted barrier
+// plus a mailbox table; every collective is fenced by barriers on both
+// sides, so one mailbox slot per rank suffices.
+struct ThreadWorld {
+  explicit ThreadWorld(int n) : nranks(n), mailbox(n) {}
+
+  const int nranks;
+  std::mutex mu;
+  std::condition_variable cv;
+  int arrived = 0;
+  uint64_t generation = 0;
+  std::vector<std::vector<uint8_t>> mailbox;
+
+  void Barrier() {
+    std::unique_lock<std::mutex> lock(mu);
+    const uint64_t gen = generation;
+    if (++arrived == nranks) {
+      arrived = 0;
+      ++generation;
+      cv.notify_all();
+    } else {
+      cv.wait(lock, [&] { return generation != gen; });
+    }
+  }
+};
+
+class ThreadComm : public Comm {
+ public:
+  ThreadComm(ThreadWorld* world, int rank) : world_(world), rank_(rank) {}
+
+  int rank() const override { return rank_; }
+  int size() const override { return world_->nranks; }
+
+  void Broadcast(std::vector<uint8_t>& buf, int root) override {
+    if (rank_ == root) world_->mailbox[root] = buf;
+    world_->Barrier();  // publish
+    if (rank_ != root) buf = world_->mailbox[root];
+    world_->Barrier();  // consume before root reuses the slot
+  }
+
+  void ReduceToRoot(std::vector<uint8_t>& buf, int root,
+                    const MergeFn& merge) override {
+    world_->mailbox[rank_] = buf;
+    world_->Barrier();  // all contributions published
+    if (rank_ == root) {
+      for (int r = 0; r < world_->nranks; ++r) {
+        if (r == root) continue;
+        merge(world_->mailbox[r], buf);  // deterministic rank order
+      }
+    }
+    world_->Barrier();  // merges done before slots are reused
+  }
+
+  void GatherVariable(const std::vector<uint8_t>& payload, int root,
+                      std::vector<std::vector<uint8_t>>& out) override {
+    world_->mailbox[rank_] = payload;
+    world_->Barrier();
+    if (rank_ == root) out = world_->mailbox;
+    world_->Barrier();
+  }
+
+  void Barrier() override { world_->Barrier(); }
+
+ private:
+  ThreadWorld* world_;
+  int rank_;
+};
+
+}  // namespace
+
+void RunThreadRanks(int nranks, const std::function<void(Comm&)>& body) {
+  ThreadWorld world(nranks);
+  std::vector<std::thread> threads;
+  threads.reserve(nranks);
+  for (int r = 0; r < nranks; ++r) {
+    threads.emplace_back([&world, r, &body] {
+      ThreadComm comm(&world, r);
+      body(comm);
+    });
+  }
+  for (auto& t : threads) t.join();
+}
+
+}  // namespace tfidf
+
+#ifdef TFIDF_HAVE_MPI
+#include <mpi.h>
+
+namespace tfidf {
+namespace {
+
+class MpiComm : public Comm {
+ public:
+  int rank() const override {
+    int r;
+    MPI_Comm_rank(MPI_COMM_WORLD, &r);
+    return r;
+  }
+  int size() const override {
+    int s;
+    MPI_Comm_size(MPI_COMM_WORLD, &s);
+    return s;
+  }
+
+  void Broadcast(std::vector<uint8_t>& buf, int root) override {
+    // Two-phase: size then payload — replaces the reference's derived
+    // datatype (TFIDF.c:78-89) with an explicit length prefix, fixing
+    // its truncated-extent bug (SURVEY §2.5-2) by construction.
+    uint64_t n = buf.size();
+    MPI_Bcast(&n, 1, MPI_UINT64_T, root, MPI_COMM_WORLD);
+    buf.resize(n);
+    if (n) MPI_Bcast(buf.data(), (int)n, MPI_BYTE, root, MPI_COMM_WORLD);
+  }
+
+  void ReduceToRoot(std::vector<uint8_t>& buf, int root,
+                    const MergeFn& merge) override {
+    // Ordered fold at root via the gather primitive: the reference's op
+    // is non-commutative (TFIDF.c:324), so a tree reduction with
+    // arbitrary pairing would change insert-order tie-breaking.
+    std::vector<std::vector<uint8_t>> all;
+    GatherVariable(buf, root, all);
+    if (rank() == root) {
+      for (int r = 0; r < (int)all.size(); ++r) {
+        if (r == root) continue;
+        merge(all[r], buf);
+      }
+    }
+  }
+
+  void GatherVariable(const std::vector<uint8_t>& payload, int root,
+                      std::vector<std::vector<uint8_t>>& out) override {
+    const int nranks = size(), me = rank();
+    if (me == root) {
+      out.assign(nranks, {});
+      out[root] = payload;
+      for (int r = 0; r < nranks; ++r) {
+        if (r == root) continue;
+        uint64_t n;
+        MPI_Recv(&n, 1, MPI_UINT64_T, r, 0, MPI_COMM_WORLD, MPI_STATUS_IGNORE);
+        out[r].resize(n);
+        if (n)
+          MPI_Recv(out[r].data(), (int)n, MPI_BYTE, r, 1, MPI_COMM_WORLD,
+                   MPI_STATUS_IGNORE);
+      }
+    } else {
+      uint64_t n = payload.size();
+      MPI_Send(&n, 1, MPI_UINT64_T, root, 0, MPI_COMM_WORLD);
+      if (n)
+        MPI_Send(const_cast<uint8_t*>(payload.data()), (int)n, MPI_BYTE, root,
+                 1, MPI_COMM_WORLD);
+    }
+  }
+
+  void Barrier() override { MPI_Barrier(MPI_COMM_WORLD); }
+};
+
+}  // namespace
+
+Comm* CreateMpiComm() { return new MpiComm(); }
+
+}  // namespace tfidf
+#endif  // TFIDF_HAVE_MPI

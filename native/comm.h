@@ -1,0 +1,71 @@
+// Minimal collective-communication abstraction for the native
+// bit-reference runtime.
+//
+// The reference talks raw MPI over MPI_COMM_WORLD (SURVEY §2.4:
+// Bcast/Reduce/Send/Recv/Barrier, TFIDF.c:82-325). This layer keeps the
+// same collective *semantics* behind an interface with two backends:
+//
+//   * ThreadComm — ranks are threads in one process, collectives are
+//     shared-memory + barrier. Runs anywhere (this box has no libmpi);
+//     also the TSAN target for race testing (the reference's OpenMP
+//     variant is racy, SURVEY §2.5-8 — ours must not be).
+//   * MpiComm   — thin wrapper over real MPI, compiled when TFIDF_HAVE_MPI
+//     is defined (see Makefile). Gives multi-node parity with the
+//     reference's deployment model.
+//
+// Unlike the reference there is no derived-datatype wire format (the
+// 44-vs-40-byte extent bug of TFIDF.c:78-89 is not reproducible here by
+// construction): payloads are plain byte spans.
+
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <functional>
+#include <vector>
+
+namespace tfidf {
+
+// A user-defined reduction over opaque accumulator blobs, applied
+// pairwise: merge(src, dst) folds src into dst. The reference's
+// CustomReduce (TFIDF.c:291-319) is one instance of this.
+using MergeFn = std::function<void(const std::vector<uint8_t>& src,
+                                   std::vector<uint8_t>& dst)>;
+
+class Comm {
+ public:
+  virtual ~Comm() = default;
+
+  virtual int rank() const = 0;
+  virtual int size() const = 0;
+
+  // Replicate root's buffer to all ranks (MPI_Bcast analog, TFIDF.c:115,220).
+  virtual void Broadcast(std::vector<uint8_t>& buf, int root) = 0;
+
+  // Fold every rank's contribution into rank root's accumulator with a
+  // user merge (MPI_Op_create + MPI_Reduce analog, TFIDF.c:323-325).
+  // Deterministic rank order 1,2,...,N-1 into root's copy: the
+  // reference declares its op non-commutative (commute=0, TFIDF.c:324),
+  // so ordered folding reproduces its insert-order tie-breaking.
+  virtual void ReduceToRoot(std::vector<uint8_t>& buf, int root,
+                            const MergeFn& merge) = 0;
+
+  // Collect each rank's variable-size payload at root, rank order
+  // (MPI_Send/Recv gather analog, TFIDF.c:256-270).
+  virtual void GatherVariable(const std::vector<uint8_t>& payload, int root,
+                              std::vector<std::vector<uint8_t>>& out) = 0;
+
+  // Phase fence (MPI_Barrier analog, TFIDF.c:112 etc.).
+  virtual void Barrier() = 0;
+};
+
+// Run `body(comm)` once per rank on `nranks` ranks using the thread
+// backend. Blocks until all ranks finish.
+void RunThreadRanks(int nranks, const std::function<void(Comm&)>& body);
+
+#ifdef TFIDF_HAVE_MPI
+// MPI-backed Comm for real multi-process runs; caller owns MPI_Init.
+Comm* CreateMpiComm();
+#endif
+
+}  // namespace tfidf

@@ -1,0 +1,87 @@
+// fast_tokenizer — C-ABI tokenize+hash kernel for the host loader.
+//
+// The loader's hot host-side loop is tokenize -> FNV-1a -> fold-to-vocab
+// (the reference does this work token-at-a-time inside fscanf loops,
+// TFIDF.c:142-167; our Python fallback is tfidf_tpu/ops/tokenize.py +
+// hashing.py). This native version does one pass over the raw bytes and
+// writes vocab ids directly — called from Python via ctypes
+// (tfidf_tpu/io/fast_tokenizer.py), no pybind11 needed.
+//
+// Contract matches the Python implementation exactly (tests pin this):
+//   * tokens = maximal runs of non-isspace bytes (fscanf "%s" semantics);
+//   * id = fold64(FNV1a64(token, seed)) % vocab_size, where
+//     fold64(h) = h ^ (h >> 32) — see ops/hashing.py::hash_to_vocab.
+
+#include <cstdint>
+#include <cstddef>
+
+namespace {
+
+constexpr uint64_t kFnvOffset = 14695981039346656037ull;
+constexpr uint64_t kFnvPrime = 1099511628211ull;
+
+// Fixed ASCII whitespace set — the C-locale isspace set and exactly what
+// Python bytes.split() uses. Deliberately NOT std::isspace, which is
+// locale-dependent (CPython calls setlocale at startup, so the host
+// locale could silently change token boundaries vs the Python path).
+inline bool IsSpace(uint8_t c) {
+  return c == ' ' || c == '\t' || c == '\n' || c == '\v' || c == '\f' ||
+         c == '\r';
+}
+
+}  // namespace
+
+extern "C" {
+
+// Count whitespace-delimited tokens in data[0..len).
+int64_t tok_count(const uint8_t* data, int64_t len) {
+  int64_t n = 0, i = 0;
+  while (i < len) {
+    while (i < len && IsSpace(data[i])) ++i;
+    if (i < len) ++n;
+    while (i < len && !IsSpace(data[i])) ++i;
+  }
+  return n;
+}
+
+// Tokenize+hash into out_ids (capacity max_out). Returns the number of
+// tokens written (never more than max_out; call tok_count for sizing).
+// truncate_at > 0 clips each token to that many bytes before hashing
+// (the PipelineConfig.truncate_tokens_at knob).
+int64_t tok_hash_ids(const uint8_t* data, int64_t len, uint64_t seed,
+                     int64_t vocab_size, int64_t truncate_at,
+                     int32_t* out_ids, int64_t max_out) {
+  int64_t n = 0, i = 0;
+  while (i < len && n < max_out) {
+    while (i < len && IsSpace(data[i])) ++i;
+    int64_t start = i;
+    while (i < len && !IsSpace(data[i])) ++i;
+    if (i == start) break;
+    int64_t end = i;
+    if (truncate_at > 0 && end - start > truncate_at) end = start + truncate_at;
+    uint64_t h = kFnvOffset ^ seed;
+    for (int64_t j = start; j < end; ++j) h = (h ^ data[j]) * kFnvPrime;
+    h ^= h >> 32;
+    out_ids[n++] = (int32_t)(h % (uint64_t)vocab_size);
+  }
+  return n;
+}
+
+// Token span extraction for EXACT-vocab mode: writes (offset, length)
+// pairs so Python can slice token bytes without re-scanning.
+int64_t tok_spans(const uint8_t* data, int64_t len, int64_t* out_off,
+                  int64_t* out_len, int64_t max_out) {
+  int64_t n = 0, i = 0;
+  while (i < len && n < max_out) {
+    while (i < len && IsSpace(data[i])) ++i;
+    int64_t start = i;
+    while (i < len && !IsSpace(data[i])) ++i;
+    if (i == start) break;
+    out_off[n] = start;
+    out_len[n] = i - start;
+    ++n;
+  }
+  return n;
+}
+
+}  // extern "C"

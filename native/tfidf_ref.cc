@@ -1,0 +1,297 @@
+// tfidf_ref — clean-room native bit-reference for the TF-IDF pipeline.
+//
+// Reproduces the *semantics and output bytes* of the reference program
+// (SURVEY §2-§3: discover -> bcast -> map TF -> reduce DF -> bcast ->
+// score -> gather -> sort -> emit; TFIDF.c:52-287) while fixing its
+// hazards (SURVEY §2.5): no 32-record caps, no fixed char buffers, no
+// mis-extent wire types, no data races. This is the `--backend=mpi`
+// oracle the JAX/TPU path is diffed against.
+//
+// Parallel structure mirrors the reference exactly:
+//   * rank 0 is a pure coordinator: discovers the corpus (TFIDF.c:98-110),
+//     receives the DF reduction, gathers, sorts, writes (TFIDF.c:260-283);
+//   * worker rank r owns documents r, r+(size-1), r+2(size-1), ...
+//     (static round-robin, TFIDF.c:130);
+//   * refuses idle workers: size-1 > numDocs is a hard error
+//     (TFIDF.c:120-123).
+//
+// Usage:
+//   tfidf_ref <input_dir> <output_file> [nranks]   (thread backend)
+//   mpirun -np N tfidf_ref <input_dir> <output_file>   (MPI build)
+
+#include <algorithm>
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <filesystem>
+#include <fstream>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "comm.h"
+
+namespace tfidf {
+namespace {
+
+// ----- serialization helpers (length-prefixed, little-endian) -----
+
+void PutU32(std::vector<uint8_t>& b, uint32_t v) {
+  b.insert(b.end(), {(uint8_t)v, (uint8_t)(v >> 8), (uint8_t)(v >> 16),
+                     (uint8_t)(v >> 24)});
+}
+
+uint32_t GetU32(const std::vector<uint8_t>& b, size_t& off) {
+  uint32_t v = b[off] | b[off + 1] << 8 | b[off + 2] << 16 |
+               (uint32_t)b[off + 3] << 24;
+  off += 4;
+  return v;
+}
+
+void PutStr(std::vector<uint8_t>& b, const std::string& s) {
+  PutU32(b, (uint32_t)s.size());
+  b.insert(b.end(), s.begin(), s.end());
+}
+
+std::string GetStr(const std::vector<uint8_t>& b, size_t& off) {
+  uint32_t n = GetU32(b, off);
+  std::string s((const char*)b.data() + off, n);
+  off += n;
+  return s;
+}
+
+// ----- DF table: insertion-ordered word -> doc-count map -----
+//
+// Same shape as the reference's u_w table (TFIDF.c:37-42) minus the
+// 32-cap and the in-band length channel (SURVEY §2.5-1,-3): length is
+// explicit in the wire format, capacity is dynamic.
+struct DfTable {
+  std::vector<std::string> words;       // insertion order
+  std::vector<int64_t> doc_counts;      // parallel to words
+  std::unordered_map<std::string, size_t> index;
+
+  void Add(const std::string& w, int64_t n) {
+    auto it = index.find(w);
+    if (it == index.end()) {
+      index.emplace(w, words.size());
+      words.push_back(w);
+      doc_counts.push_back(n);
+    } else {
+      doc_counts[it->second] += n;
+    }
+  }
+
+  std::vector<uint8_t> Serialize() const {
+    std::vector<uint8_t> out;
+    PutU32(out, (uint32_t)words.size());
+    for (size_t i = 0; i < words.size(); ++i) {
+      PutStr(out, words[i]);
+      PutU32(out, (uint32_t)doc_counts[i]);
+    }
+    return out;
+  }
+
+  static DfTable Deserialize(const std::vector<uint8_t>& buf) {
+    DfTable t;
+    size_t off = 0;
+    uint32_t n = GetU32(buf, off);
+    for (uint32_t i = 0; i < n; ++i) {
+      std::string w = GetStr(buf, off);
+      uint32_t c = GetU32(buf, off);
+      t.Add(w, c);
+    }
+    return t;
+  }
+};
+
+// Merge src-rank accumulator into dst — the CustomReduce semantics
+// (TFIDF.c:291-319): sum counts for known words, append unknown words in
+// src order. Applied in ascending rank order (Comm::ReduceToRoot), which
+// reproduces the reference's non-commutative ordered fold (TFIDF.c:324).
+void MergeDf(const std::vector<uint8_t>& src, std::vector<uint8_t>& dst) {
+  DfTable d = DfTable::Deserialize(dst);
+  size_t off = 0;
+  uint32_t n = GetU32(src, off);
+  for (uint32_t i = 0; i < n; ++i) {
+    std::string w = GetStr(src, off);
+    uint32_t c = GetU32(src, off);
+    d.Add(w, c);
+  }
+  dst = d.Serialize();
+}
+
+// ----- tokenizer: fscanf("%s") semantics (TFIDF.c:142-147) -----
+// Fixed ASCII whitespace (the C-locale isspace set) rather than the
+// locale-dependent std::isspace, so output is environment-independent.
+inline bool IsSpaceByte(unsigned char c) {
+  return c == ' ' || c == '\t' || c == '\n' || c == '\v' || c == '\f' ||
+         c == '\r';
+}
+
+std::vector<std::string> Tokenize(const std::string& data) {
+  std::vector<std::string> toks;
+  size_t i = 0, n = data.size();
+  while (i < n) {
+    while (i < n && IsSpaceByte((unsigned char)data[i])) ++i;
+    size_t start = i;
+    while (i < n && !IsSpaceByte((unsigned char)data[i])) ++i;
+    if (i > start) toks.emplace_back(data.substr(start, i - start));
+  }
+  return toks;
+}
+
+struct Record {  // the reference's obj struct (TFIDF.c:26-35), dynamic
+  std::string doc;
+  std::string word;
+  int64_t count;
+  int64_t doc_size;
+};
+
+int PipelineMain(Comm& comm, const std::string& input_dir,
+                 const std::string& output_path) {
+  const int rank = comm.rank(), size = comm.size();
+
+  // Phase 0: discovery on the coordinator (TFIDF.c:98-110), then
+  // broadcast of numDocs (TFIDF.c:115).
+  std::vector<uint8_t> meta(8, 0);
+  if (rank == 0) {
+    uint64_t count = 0;
+    for (auto& e : std::filesystem::directory_iterator(input_dir))
+      if (e.is_regular_file()) ++count;
+    std::memcpy(meta.data(), &count, 8);
+  }
+  comm.Broadcast(meta, 0);
+  uint64_t num_docs;
+  std::memcpy(&num_docs, meta.data(), 8);
+
+  // Need at least one worker rank (the coordinator holds no documents —
+  // a size-1 world would silently emit an empty output).
+  if (size < 2) {
+    if (rank == 0)
+      std::fprintf(stderr, "error: need >=2 ranks (1 coordinator + workers)\n");
+    return 1;
+  }
+  // Worker-count guard (TFIDF.c:120-123).
+  if ((uint64_t)(size - 1) > num_docs) {
+    if (rank == 0)
+      std::fprintf(stderr,
+                   "error: %d workers > %llu documents (reference guard)\n",
+                   size - 1, (unsigned long long)num_docs);
+    return 1;
+  }
+
+  // Phase 1: map/TF on workers over the round-robin shard (TFIDF.c:130).
+  std::vector<Record> records;
+  DfTable local_df;
+  if (rank > 0) {
+    for (uint64_t i = rank; i <= num_docs; i += size - 1) {
+      std::string name = "doc" + std::to_string(i);
+      std::ifstream f(input_dir + "/" + name, std::ios::binary);
+      if (!f) {
+        // Hard exit like the reference (TFIDF.c:137). A plain return
+        // would deadlock peers at the next collective in thread mode.
+        std::fprintf(stderr, "error: cannot open %s/%s\n", input_dir.c_str(),
+                     name.c_str());
+        std::exit(2);
+      }
+      std::string data((std::istreambuf_iterator<char>(f)),
+                       std::istreambuf_iterator<char>());
+      auto toks = Tokenize(data);
+      const int64_t doc_size = (int64_t)toks.size();
+
+      // First-appearance-ordered TF counts (the reference's linear-probe
+      // append table, TFIDF.c:150-167, replaced by a hash index).
+      std::vector<std::string> order;
+      std::unordered_map<std::string, int64_t> counts;
+      for (auto& w : toks) {
+        auto it = counts.find(w);
+        if (it == counts.end()) {
+          counts.emplace(w, 1);
+          order.push_back(w);
+        } else {
+          ++it->second;
+        }
+      }
+      for (auto& w : order)
+        records.push_back(Record{name, w, counts[w], doc_size});
+      // DF: one per word per doc — the currDoc dedup (TFIDF.c:171-188).
+      for (auto& w : order) local_df.Add(w, 1);
+    }
+  }
+
+  // Phase 2: DF reduction to root + broadcast (TFIDF.c:215,220) — the
+  // pair the TPU path collapses into one lax.psum.
+  std::vector<uint8_t> df_wire = local_df.Serialize();
+  comm.ReduceToRoot(df_wire, 0, MergeDf);
+  comm.Broadcast(df_wire, 0);
+  DfTable global_df = DfTable::Deserialize(df_wire);
+
+  // Phase 3: join + score (TFIDF.c:227-246). Same double ops, same order:
+  // TF = 1.0*count/docSize; IDF = log(1.0*numDocs/df); score = TF*IDF.
+  std::vector<uint8_t> lines_wire;
+  PutU32(lines_wire, (uint32_t)records.size());
+  for (auto& r : records) {
+    double tf = 1.0 * (double)r.count / (double)r.doc_size;
+    int64_t df = global_df.doc_counts[global_df.index.at(r.word)];
+    double idf = std::log(1.0 * (double)num_docs / (double)df);
+    double score = tf * idf;
+    char buf[64];
+    int n = std::snprintf(buf, sizeof buf, "%.16f", score);
+    std::string line = r.doc + "@" + r.word + "\t" + std::string(buf, n);
+    PutStr(lines_wire, line);
+  }
+
+  // Phase 4: gather -> sort -> emit (TFIDF.c:253-283).
+  std::vector<std::vector<uint8_t>> gathered;
+  comm.GatherVariable(lines_wire, 0, gathered);
+  if (rank == 0) {
+    std::vector<std::string> lines;
+    for (int r = 1; r < size; ++r) {
+      size_t off = 0;
+      uint32_t n = GetU32(gathered[r], off);
+      for (uint32_t i = 0; i < n; ++i) lines.push_back(GetStr(gathered[r], off));
+    }
+    // strcmp order (TFIDF.c:47-50,273): std::string < is byte-wise.
+    std::sort(lines.begin(), lines.end());
+    std::ofstream out(output_path, std::ios::binary);
+    if (!out) {
+      std::fprintf(stderr, "error: cannot write %s\n", output_path.c_str());
+      std::exit(3);
+    }
+    for (auto& l : lines) out << l << "\n";
+  }
+  comm.Barrier();
+  return 0;
+}
+
+}  // namespace
+}  // namespace tfidf
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::fprintf(stderr,
+                 "usage: %s <input_dir> <output_file> [nranks]\n", argv[0]);
+    return 64;
+  }
+  const std::string input = argv[1], output = argv[2];
+
+#ifdef TFIDF_HAVE_MPI
+  MPI_Init(&argc, &argv);
+  tfidf::Comm* comm = tfidf::CreateMpiComm();
+  int rc = tfidf::PipelineMain(*comm, input, output);
+  delete comm;
+  MPI_Finalize();
+  return rc;
+#else
+  int nranks = argc > 3 ? std::atoi(argv[3]) : 4;
+  if (nranks < 2) nranks = 2;  // coordinator + >=1 worker
+  int rc = 0;
+  tfidf::RunThreadRanks(nranks, [&](tfidf::Comm& c) {
+    int r = tfidf::PipelineMain(c, input, output);
+    if (c.rank() == 0) rc = r;
+  });
+  return rc;
+#endif
+}

@@ -1,0 +1,92 @@
+"""ctypes bindings for the native tokenize+hash kernel.
+
+``native/fast_tokenizer.cc`` implements the loader's host hot loop
+(tokenize -> FNV-1a -> fold) in one C++ pass; this module exposes it to
+Python and transparently falls back to the pure-Python implementation
+when the shared library has not been built (``make -C native``).
+
+The native and Python paths are contract-identical (pinned by
+tests/test_native.py), so callers never need to know which ran.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import List, Optional
+
+import numpy as np
+
+_LIB_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native", "fast_tokenizer.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    if os.environ.get("TFIDF_TPU_NO_NATIVE") or not os.path.exists(_LIB_PATH):
+        _load_failed = True
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        _load_failed = True
+        return None
+    lib.tok_count.restype = ctypes.c_int64
+    lib.tok_count.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.tok_hash_ids.restype = ctypes.c_int64
+    lib.tok_hash_ids.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_uint64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_int32), ctypes.c_int64]
+    lib.tok_spans.restype = ctypes.c_int64
+    lib.tok_spans.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    """True when the native library is loadable."""
+    return _load() is not None
+
+
+def tokenize_hash_ids(data: bytes, vocab_size: int, seed: int = 0,
+                      truncate_at: Optional[int] = None) -> Optional[np.ndarray]:
+    """Native tokenize+hash: doc bytes -> int32 vocab ids.
+
+    Returns None when the native library is unavailable (caller falls
+    back to the Python path).
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    n = lib.tok_count(data, len(data))
+    out = np.empty(n, dtype=np.int32)
+    wrote = lib.tok_hash_ids(
+        data, len(data), ctypes.c_uint64(seed), vocab_size,
+        truncate_at or 0,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), n)
+    assert wrote == n, f"tokenizer wrote {wrote} of {n} tokens"
+    return out
+
+
+def tokenize_spans(data: bytes) -> Optional[List[bytes]]:
+    """Native tokenization returning token byte-strings (EXACT mode)."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = lib.tok_count(data, len(data))
+    offs = np.empty(n, dtype=np.int64)
+    lens = np.empty(n, dtype=np.int64)
+    wrote = lib.tok_spans(
+        data, len(data),
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n)
+    assert wrote == n
+    return [data[o:o + l] for o, l in zip(offs.tolist(), lens.tolist())]
