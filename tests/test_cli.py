@@ -1,0 +1,58 @@
+"""CLI driver tests: both backends end-to-end through main()."""
+
+import os
+
+import pytest
+
+from tfidf_tpu.cli import main
+from tfidf_tpu.golden import golden_output
+from tfidf_tpu import discover_corpus
+
+
+class TestCli:
+    def test_tpu_backend_golden_output(self, toy_corpus_dir, tmp_path):
+        out = tmp_path / "out.txt"
+        rc = main(["run", "--input", toy_corpus_dir, "--output", str(out),
+                   "--backend", "tpu"])
+        assert rc == 0
+        assert out.read_bytes() == golden_output(discover_corpus(toy_corpus_dir))
+
+    def test_mpi_backend_golden_output(self, toy_corpus_dir, tmp_path):
+        out = tmp_path / "out.txt"
+        rc = main(["run", "--input", toy_corpus_dir, "--output", str(out),
+                   "--backend", "mpi", "--nranks", "3"])
+        assert rc == 0
+        assert out.read_bytes() == golden_output(discover_corpus(toy_corpus_dir))
+
+    def test_backends_agree(self, toy_corpus_dir, tmp_path):
+        a, b = tmp_path / "a.txt", tmp_path / "b.txt"
+        assert main(["run", "--input", toy_corpus_dir, "--output", str(a),
+                     "--backend", "tpu"]) == 0
+        assert main(["run", "--input", toy_corpus_dir, "--output", str(b),
+                     "--backend", "mpi"]) == 0
+        assert a.read_bytes() == b.read_bytes()
+
+    def test_topk_report(self, toy_corpus_dir, tmp_path):
+        out = tmp_path / "topk.txt"
+        rc = main(["run", "--input", toy_corpus_dir, "--output", str(out),
+                   "--backend", "tpu", "--topk", "2"])
+        assert rc == 0
+        data = out.read_bytes().splitlines()
+        assert data, "topk report should be non-empty"
+        assert all(b"@" in l and b"\t" in l for l in data)
+
+    def test_sharded_mesh_flag(self, toy_corpus_dir, tmp_path):
+        out = tmp_path / "out.txt"
+        rc = main(["run", "--input", toy_corpus_dir, "--output", str(out),
+                   "--backend", "tpu", "--vocab-mode", "hashed",
+                   "--vocab-size", "32768", "--mesh", "4,1,2"])
+        assert rc == 0
+        assert out.read_bytes() == golden_output(discover_corpus(toy_corpus_dir))
+
+    def test_topk_larger_than_vocab_clamped(self, toy_corpus_dir, tmp_path):
+        # EXACT mode: V derived from corpus (16 words) < topk=50 — must
+        # clamp, not crash (review finding).
+        out = tmp_path / "topk.txt"
+        rc = main(["run", "--input", toy_corpus_dir, "--output", str(out),
+                   "--backend", "tpu", "--topk", "50"])
+        assert rc == 0

@@ -1,0 +1,97 @@
+"""Sharded-execution tests on the 8-device virtual CPU mesh.
+
+Pin the SURVEY §2.3 checklist: document data-parallelism (docs axis),
+vocab sharding (TP analog), sequence sharding for long docs (SP analog),
+and the psum DF collective — all must agree exactly with the
+single-device pipeline, and golden output must be byte-stable under any
+mesh shape (the rank-count-invariance property of the reference,
+TFIDF.c:130 static schedule).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from tfidf_tpu import PipelineConfig, TfidfPipeline, discover_corpus
+from tfidf_tpu.config import VocabMode
+from tfidf_tpu.golden import golden_output
+from tfidf_tpu.parallel import MeshPlan, ShardedPipeline
+
+
+def needs_devices(n):
+    return pytest.mark.skipif(len(jax.devices()) < n,
+                              reason=f"needs {n} virtual devices")
+
+
+MESH_CASES = [
+    dict(docs=8, seq=1, vocab=1),   # pure document DP
+    dict(docs=4, seq=1, vocab=2),   # DP x vocab (TP analog)
+    dict(docs=2, seq=2, vocab=2),   # DP x SP x TP
+    dict(docs=1, seq=8, vocab=1),   # pure sequence parallelism
+]
+
+
+@needs_devices(8)
+class TestShardedMatchesSingleDevice:
+    @pytest.mark.parametrize("mesh_kw", MESH_CASES)
+    def test_counts_df_scores_equal(self, toy_corpus_dir, mesh_kw):
+        corpus = discover_corpus(toy_corpus_dir)
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=64,
+                             max_doc_len=64, doc_chunk=64)
+        single = TfidfPipeline(cfg).run(corpus)
+        plan = MeshPlan.create(**mesh_kw)
+        sharded = ShardedPipeline(plan, cfg).run(corpus)
+        d = single.counts.shape[0]
+        assert (sharded.counts[:d] == single.counts).all()
+        assert (sharded.df == single.df).all()
+        np.testing.assert_allclose(sharded.scores[:d], single.scores,
+                                   rtol=1e-5, atol=1e-7)
+
+    @pytest.mark.parametrize("mesh_kw", MESH_CASES[:2])
+    def test_golden_bytes_mesh_invariant(self, toy_corpus_dir, mesh_kw):
+        # Same property the native oracle pins over nranks: parallel
+        # degree must never change output bytes.
+        corpus = discover_corpus(toy_corpus_dir)
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=1 << 15,
+                             max_doc_len=64, doc_chunk=64)
+        plan = MeshPlan.create(**mesh_kw)
+        assert ShardedPipeline(plan, cfg).run(corpus).output_bytes() == \
+            golden_output(corpus)
+
+    def test_sharded_topk_matches_dense(self, toy_corpus_dir):
+        corpus = discover_corpus(toy_corpus_dir)
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=64,
+                             max_doc_len=64, doc_chunk=64, topk=4)
+        plan = MeshPlan.create(docs=2, seq=1, vocab=4)
+        sharded = ShardedPipeline(plan, cfg).run(corpus)
+        dense = TfidfPipeline(
+            PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=64,
+                           max_doc_len=64, doc_chunk=64)).run(corpus)
+        d = dense.counts.shape[0]
+        # top-1 id agrees; top-k values agree as sorted sets
+        assert (sharded.topk_ids[:d, 0] == dense.scores.argmax(1)).all()
+        np.testing.assert_allclose(
+            sharded.topk_vals[:d],
+            -np.sort(-np.partition(dense.scores, -4, axis=1)[:, -4:], axis=1),
+            rtol=1e-5, atol=1e-7)
+
+
+@needs_devices(8)
+class TestMeshPlan:
+    def test_axis_sizes_and_padding(self):
+        plan = MeshPlan.create(docs=2, seq=2, vocab=2,
+                               devices=jax.devices()[:8])
+        assert plan.n_docs_shards == 2 and plan.n_vocab_shards == 2
+        assert plan.pad_docs(3) == 4 and plan.pad_docs(4) == 4
+        assert plan.pad_vocab(65) == 66
+        assert plan.pad_tokens(7) == 8
+
+    def test_bad_mesh_shape_raises(self):
+        with pytest.raises(ValueError):
+            MeshPlan.create(docs=3, seq=1, vocab=1, devices=jax.devices()[:8])
+        with pytest.raises(ValueError):
+            MeshPlan.create(vocab=3, devices=jax.devices()[:8])
+
+    def test_docs_inference(self):
+        plan = MeshPlan.create(vocab=2, devices=jax.devices()[:8])
+        assert plan.n_docs_shards == 4

@@ -1,0 +1,121 @@
+"""Command-line driver: ``tfidf run --input DIR --backend {tpu,mpi}``.
+
+The reference ignores ``argc/argv`` entirely and hardcodes its input dir,
+output path, and limits as ``#define``s (``TFIDF.c:16-20,52,101,133,274``).
+This driver exposes every knob, per the BASELINE north star: the MPI-
+semantics native path stays available as ``--backend=mpi`` (the oracle),
+the TPU path is ``--backend=tpu``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+from typing import List, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE_BIN = os.path.join(REPO_ROOT, "native", "tfidf_ref")
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tfidf", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+    run = sub.add_parser("run", help="run the TF-IDF pipeline")
+    run.add_argument("--input", required=True, help="document directory")
+    run.add_argument("--output", default="output.txt",
+                     help="output file (reference format)")
+    run.add_argument("--backend", choices=["tpu", "mpi"], default="tpu")
+    run.add_argument("--vocab-mode", choices=["exact", "hashed"],
+                     default="exact")
+    run.add_argument("--vocab-size", type=int, default=1 << 16,
+                     help="hashed vocabulary size")
+    run.add_argument("--tokenizer", choices=["whitespace", "chargram"],
+                     default="whitespace")
+    run.add_argument("--ngram", type=str, default="3,5",
+                     help="chargram n range, e.g. 3,5")
+    run.add_argument("--topk", type=int, default=None,
+                     help="emit only top-k terms per document")
+    run.add_argument("--mesh", type=str, default=None,
+                     help="mesh shape docs,seq,vocab (e.g. 4,1,2); "
+                          "default: single device")
+    run.add_argument("--no-strict", action="store_true",
+                     help="accept any filenames, not just doc<i>")
+    run.add_argument("--nranks", type=int, default=4,
+                     help="ranks for --backend=mpi (thread backend)")
+    return p
+
+
+def _run_mpi(args) -> int:
+    """Dispatch to the native bit-reference (the --backend=mpi oracle)."""
+    if not os.path.exists(NATIVE_BIN):
+        rc = subprocess.run(["make", "-C", os.path.dirname(NATIVE_BIN)],
+                            capture_output=True)
+        if rc.returncode != 0 or not os.path.exists(NATIVE_BIN):
+            sys.stderr.write("error: native backend not built "
+                             "(make -C native failed)\n")
+            return 1
+    proc = subprocess.run(
+        [NATIVE_BIN, args.input, args.output, str(args.nranks)])
+    return proc.returncode
+
+
+def _run_tpu(args) -> int:
+    # Deferred: importing jax is slow and unnecessary for --backend=mpi.
+    from tfidf_tpu.config import PipelineConfig, TokenizerKind, VocabMode
+    from tfidf_tpu.formatter import write_output
+    from tfidf_tpu.io.corpus import discover_corpus
+    from tfidf_tpu.pipeline import TfidfPipeline
+
+    lo, hi = (int(x) for x in args.ngram.split(","))
+    cfg = PipelineConfig(
+        vocab_mode=VocabMode(args.vocab_mode),
+        vocab_size=args.vocab_size,
+        tokenizer=TokenizerKind(args.tokenizer),
+        ngram_range=(lo, hi),
+        topk=args.topk,
+    )
+    corpus = discover_corpus(args.input, strict=not args.no_strict)
+
+    if args.mesh:
+        from tfidf_tpu.parallel import MeshPlan, ShardedPipeline
+        docs, seq, vocab = (int(x) for x in args.mesh.split(","))
+        plan = MeshPlan.create(docs=docs, seq=seq, vocab=vocab)
+        result = ShardedPipeline(plan, cfg).run(corpus)
+    else:
+        result = TfidfPipeline(cfg).run(corpus)
+
+    if args.topk is None:
+        write_output(args.output, result.output_lines())
+    else:
+        _write_topk(args.output, result)
+    print(f"wrote {args.output} ({result.num_docs} docs)")
+    return 0
+
+
+def _write_topk(path: str, result) -> None:
+    """Top-k report: doc@word\\tscore, k lines per doc, score-descending."""
+    lines: List[bytes] = []
+    for d in range(result.num_docs):
+        name = result.names[d].encode()
+        for v, s in zip(result.topk_ids[d], result.topk_vals[d]):
+            if s <= 0:
+                continue  # padding / sub-k docs
+            word = result.id_to_word.get(int(v), b"id:%d" % int(v))
+            lines.append(b"%s@%s\t%.16f" % (name, word, float(s)))
+    with open(path, "wb") as f:
+        f.write(b"".join(l + b"\n" for l in lines))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.cmd == "run":
+        if args.backend == "mpi":
+            return _run_mpi(args)
+        return _run_tpu(args)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

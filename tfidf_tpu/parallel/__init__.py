@@ -1,0 +1,21 @@
+"""Distributed execution: mesh plans, collectives, sharded pipelines.
+
+The reference's distribution model is MPI ranks + explicit messages
+(SURVEY §2.4). Here distribution is *sharding*: a
+:class:`jax.sharding.Mesh` over the chips, `shard_map` for the
+per-shard program, and XLA collectives over ICI — the reduce+bcast
+pair of the reference (``TFIDF.c:215,220``) is one ``lax.psum``.
+"""
+
+from tfidf_tpu.parallel.mesh import MeshPlan, DOCS_AXIS, VOCAB_AXIS, SEQ_AXIS
+from tfidf_tpu.parallel.sharded import ShardedPipeline
+from tfidf_tpu.parallel.collectives import sharded_tf_df
+
+__all__ = [
+    "MeshPlan",
+    "DOCS_AXIS",
+    "VOCAB_AXIS",
+    "SEQ_AXIS",
+    "ShardedPipeline",
+    "sharded_tf_df",
+]

@@ -1,0 +1,118 @@
+"""The sharded TF-IDF compute: shard_map body + XLA collectives.
+
+Collective mapping from the reference (SURVEY §2.4):
+
+* ``MPI_Reduce(CustomReduce) + MPI_Bcast`` of the DF table
+  (``TFIDF.c:215,220``) -> one ``lax.psum`` over the ``docs`` axis. The
+  string-keyed set-union semantics are already gone: hashing made DF a
+  dense vector, and union-with-sum is vector add.
+* ``MPI_Bcast(numDocs)`` (``TFIDF.c:115``) -> a replicated scalar input.
+* serial ``MPI_Send``/``Recv`` gather (``TFIDF.c:256-270``) ->
+  device-side top-k + ``lax.all_gather`` over the vocab axis.
+* six ``MPI_Barrier``s -> nothing; XLA program order is the fence.
+
+The per-shard body computes its own (docs x seq x vocab) block with NO
+redundant work: each vocab shard histograms only its own id range
+(via ``tf_counts_masked``'s offset/width), each seq shard only its token
+chunk, each docs shard only its documents.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tfidf_tpu.ops.histogram import tf_counts_masked
+from tfidf_tpu.ops.scoring import idf_from_df
+from tfidf_tpu.parallel.mesh import DOCS_AXIS, MeshPlan, SEQ_AXIS, VOCAB_AXIS
+
+
+def _shard_body(tokens, lengths, num_docs, *, vocab_size: int,
+                score_dtype, topk: Optional[int]):
+    """Per-shard program. Blocks: tokens [Dl, Ll], lengths [Dl].
+
+    vocab_size here is the *global* (padded) V; each shard owns
+    V / n_vocab_shards contiguous ids.
+    """
+    n_vocab = lax.psum(1, VOCAB_AXIS)
+    v_shard = vocab_size // n_vocab
+    v_start = lax.axis_index(VOCAB_AXIS) * v_shard
+
+    # Sequence shard: this block holds global token positions
+    # [seq_idx*Ll, (seq_idx+1)*Ll) of each document.
+    ll = tokens.shape[1]
+    pos = lax.axis_index(SEQ_AXIS) * ll + jnp.arange(ll, dtype=lengths.dtype)
+    live = pos[None, :] < lengths[:, None]
+
+    # TF histogram of this shard's vocab range over its token chunk,
+    # then combine chunks: the long-document psum (SURVEY §5
+    # long-context — a >chip doc's histogram is assembled over ICI).
+    counts = tf_counts_masked(tokens, live, v_shard, id_offset=v_start)
+    counts = lax.psum(counts, SEQ_AXIS)
+
+    # DF: local docs' presence, summed over the docs axis. This single
+    # psum is the whole Phase-2 of the reference (TFIDF.c:215-220).
+    df = lax.psum((counts > 0).astype(jnp.int32).sum(axis=0), DOCS_AXIS)
+
+    idf = idf_from_df(df, num_docs, score_dtype)
+    lens = jnp.maximum(lengths, 1).astype(score_dtype)
+    scores = counts.astype(score_dtype) / lens[:, None] * idf[None, :]
+
+    if topk is None:
+        return counts, df, scores
+
+    # Per-doc top-k across the vocab axis: local top-k, all_gather the
+    # K-sized candidates (not the V-sized rows), re-select. In topk mode
+    # the per-shard dense counts/scores never leave the device.
+    k_local = min(topk, v_shard)
+    vals, ids = lax.top_k(scores, k_local)
+    ids = ids + v_start
+    vals_g = lax.all_gather(vals, VOCAB_AXIS, axis=1, tiled=True)
+    ids_g = lax.all_gather(ids, VOCAB_AXIS, axis=1, tiled=True)
+    vals_k, sel = lax.top_k(vals_g, min(topk, vals_g.shape[1]))
+    ids_k = jnp.take_along_axis(ids_g, sel, axis=1)
+    return df, vals_k, ids_k
+
+
+@functools.lru_cache(maxsize=64)
+def make_sharded_forward(plan: MeshPlan, vocab_size: int, score_dtype,
+                         topk: Optional[int]):
+    """Build the jitted sharded forward for a mesh plan.
+
+    Returns f(tokens [D, L], lengths [D], num_docs) with D a
+    docs-shard multiple, L a seq-shard multiple, vocab_size a
+    vocab-shard multiple (use plan.pad_*). LRU-cached so repeat runs
+    with the same (plan, vocab, dtype, topk) reuse the jitted program
+    instead of re-tracing.
+    """
+    if vocab_size % plan.n_vocab_shards:
+        raise ValueError(f"vocab_size {vocab_size} not divisible by "
+                         f"{plan.n_vocab_shards} vocab shards")
+    body = functools.partial(_shard_body, vocab_size=vocab_size,
+                             score_dtype=score_dtype, topk=topk)
+    if topk is None:
+        out_specs = (plan.counts_spec(), plan.df_spec(), plan.counts_spec())
+    else:
+        out_specs = (plan.df_spec(),
+                     P(DOCS_AXIS, None), P(DOCS_AXIS, None))
+    # check_vma=False: the top-k outputs are replicated across the vocab
+    # axis by the all_gather+re-select, which the static replication
+    # checker cannot infer.
+    mapped = jax.shard_map(
+        body, mesh=plan.mesh,
+        in_specs=(plan.batch_spec(), plan.lengths_spec(), P()),
+        out_specs=out_specs, check_vma=False)
+    return jax.jit(mapped)
+
+
+def sharded_tf_df(plan: MeshPlan, tokens, lengths, vocab_size: int
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """Counts + global DF only (no scoring) — the minimal DP+psum path."""
+    fwd = make_sharded_forward(plan, vocab_size, jnp.float32, None)
+    counts, df, _ = fwd(tokens, lengths, jnp.int32(1))
+    return counts, df

@@ -1,0 +1,66 @@
+"""Long-document (sequence-parallel) histogram: one doc across the mesh.
+
+The reference streams a document token-by-token on a single rank
+(``TFIDF.c:147``) — a document is bounded by one node's memory and one
+core's scan speed. The TPU-native long-context capability (SURVEY §5):
+split the token stream of ONE document into fixed chunks laid out across
+*every* device of the mesh, histogram each chunk locally, and assemble
+the document's TF vector with a single ``psum`` over all mesh axes.
+This is the ring-attention-shaped pattern for this workload: the
+sharded axis is the sequence, the collective rides ICI.
+
+Composes with the batch pipeline: ``ShardedPipeline`` already seq-shards
+the token axis of a whole batch (``parallel.collectives``); this module
+is the degenerate-but-important case batch=1, where all mesh parallelism
+is spent on sequence length.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tfidf_tpu.ops.histogram import tf_counts_masked
+from tfidf_tpu.parallel.mesh import DOCS_AXIS, MeshPlan, SEQ_AXIS, VOCAB_AXIS
+
+_ALL_AXES = (DOCS_AXIS, SEQ_AXIS, VOCAB_AXIS)
+
+
+def _body(tokens, length, *, vocab_size: int):
+    """Per-device chunk of one document. tokens: [L / n_devices]."""
+    chunk = tokens.shape[0]
+    # Flat device index in the composite (docs, seq, vocab) order that
+    # P(_ALL_AXES) shards the token axis by.
+    idx = lax.axis_index(DOCS_AXIS)
+    idx = idx * lax.psum(1, SEQ_AXIS) + lax.axis_index(SEQ_AXIS)
+    idx = idx * lax.psum(1, VOCAB_AXIS) + lax.axis_index(VOCAB_AXIS)
+    pos = idx * chunk + jnp.arange(chunk, dtype=jnp.int32)
+    valid = pos < length
+    counts = tf_counts_masked(tokens[None, :], valid[None, :], vocab_size)
+    # The one collective: assemble the document histogram over ICI.
+    return lax.psum(counts[0], _ALL_AXES)
+
+
+def make_long_doc_histogram(plan: MeshPlan, vocab_size: int):
+    """Build f(tokens [L], length) -> counts [V] for one huge document.
+
+    L must be a multiple of the total device count (pad with any id and
+    pass the true ``length``). The returned counts are replicated —
+    every device holds the document's full TF vector afterwards, ready
+    for scoring against a DF table.
+    """
+    body = functools.partial(_body, vocab_size=vocab_size)
+    mapped = jax.shard_map(body, mesh=plan.mesh,
+                           in_specs=(P(_ALL_AXES), P()),
+                           out_specs=P())
+    return jax.jit(mapped)
+
+
+def long_doc_histogram(plan: MeshPlan, tokens, length, vocab_size: int):
+    """One-shot convenience wrapper over :func:`make_long_doc_histogram`."""
+    return make_long_doc_histogram(plan, vocab_size)(
+        jnp.asarray(tokens), jnp.asarray(length, jnp.int32))

@@ -1,0 +1,111 @@
+"""Device-mesh construction and axis conventions.
+
+Axis semantics (the TPU-native mapping of the reference's parallelism,
+SURVEY §2.3):
+
+* ``docs``  — data parallelism over documents. The reference's
+  round-robin rank ownership (``TFIDF.c:130``) becomes block-sharding
+  the document axis of the packed batch. Unlike the reference, *every*
+  device computes — no idle coordinator (the reference wastes rank 0,
+  SURVEY §2.3 "do not replicate").
+* ``vocab`` — tensor-parallel analog: the hashed vocabulary axis is
+  sharded when the DF table / score matrix outgrows one chip.
+* ``seq``   — sequence parallelism for long documents: one document's
+  token chunks spread across chips, histogram psum'd (``parallel.longdoc``).
+
+Multi-host: the same mesh spans hosts via ``jax.distributed.initialize``
+(``parallel.multihost``); mesh-axis order puts ``docs`` outermost so DF
+psum segments ride ICI within a slice and only the [V]-sized partial
+crosses DCN.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DOCS_AXIS = "docs"
+VOCAB_AXIS = "vocab"
+SEQ_AXIS = "seq"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    """A named device mesh plus the sharding rules the pipeline uses.
+
+    Build with :meth:`create`; axis sizes must multiply to the device
+    count (all devices participate — SPMD).
+    """
+
+    mesh: Mesh
+
+    @staticmethod
+    def create(docs: int = 0, vocab: int = 1, seq: int = 1,
+               devices: Optional[Sequence[jax.Device]] = None) -> "MeshPlan":
+        """Make a (docs, seq, vocab) mesh.
+
+        ``docs=0`` means "all remaining devices": docs is inferred as
+        n_devices / (vocab * seq).
+        """
+        devs = list(devices if devices is not None else jax.devices())
+        n = len(devs)
+        if docs == 0:
+            if n % (vocab * seq) != 0:
+                raise ValueError(
+                    f"{n} devices not divisible by vocab*seq={vocab * seq}")
+            docs = n // (vocab * seq)
+        if docs * vocab * seq != n:
+            raise ValueError(
+                f"mesh {docs}x{seq}x{vocab} != {n} devices")
+        arr = np.array(devs).reshape(docs, seq, vocab)
+        return MeshPlan(Mesh(arr, (DOCS_AXIS, SEQ_AXIS, VOCAB_AXIS)))
+
+    # --- axis sizes ---
+    @property
+    def n_docs_shards(self) -> int:
+        return self.mesh.shape[DOCS_AXIS]
+
+    @property
+    def n_vocab_shards(self) -> int:
+        return self.mesh.shape[VOCAB_AXIS]
+
+    @property
+    def n_seq_shards(self) -> int:
+        return self.mesh.shape[SEQ_AXIS]
+
+    # --- canonical shardings ---
+    def batch_spec(self) -> P:
+        """[D, L] token batch: docs sharded, token axis seq-sharded."""
+        return P(DOCS_AXIS, SEQ_AXIS)
+
+    def lengths_spec(self) -> P:
+        return P(DOCS_AXIS)
+
+    def counts_spec(self) -> P:
+        """[D, V] counts/scores: docs x vocab sharded."""
+        return P(DOCS_AXIS, VOCAB_AXIS)
+
+    def df_spec(self) -> P:
+        """[V] DF vector: vocab sharded, replicated over docs/seq."""
+        return P(VOCAB_AXIS)
+
+    def sharding(self, spec: P) -> NamedSharding:
+        return NamedSharding(self.mesh, spec)
+
+    def pad_docs(self, num_docs: int) -> int:
+        """Round a document count up to a docs-shard multiple."""
+        shards = self.n_docs_shards
+        return int(math.ceil(max(num_docs, 1) / shards) * shards)
+
+    def pad_vocab(self, vocab_size: int) -> int:
+        shards = self.n_vocab_shards
+        return int(math.ceil(max(vocab_size, 1) / shards) * shards)
+
+    def pad_tokens(self, length: int) -> int:
+        shards = self.n_seq_shards
+        return int(math.ceil(max(length, 1) / shards) * shards)

@@ -1,0 +1,54 @@
+"""Multi-host (DCN) bring-up.
+
+The reference's runtime bring-up is ``MPI_Init``/``MPI_Finalize`` +
+``MPI_Comm_size/rank`` (``TFIDF.c:82-92``); launched as one process per
+rank by mpirun. The JAX equivalent for a multi-host TPU slice is
+``jax.distributed.initialize`` — one process per host, all chips of all
+hosts visible in ``jax.devices()`` afterwards, meshes spanning hosts
+transparently (collectives ride ICI within a slice, DCN across slices).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+
+
+@dataclasses.dataclass(frozen=True)
+class HostTopology:
+    process_id: int
+    num_processes: int
+    local_devices: int
+    global_devices: int
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> HostTopology:
+    """Bring up the multi-host runtime (idempotent, single-host safe).
+
+    On single-host (no coordinator and no TPU cluster env) this is a
+    no-op that just reports the local topology, so the same driver code
+    runs everywhere — unlike the reference, which cannot run without an
+    MPI runtime even on one node.
+    """
+    import os
+    if coordinator_address is not None or num_processes is not None:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id)
+    elif os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        # Cluster env configured (TPU pod / k8s launcher): auto-detect.
+        try:
+            jax.distributed.initialize()
+        except RuntimeError:  # already initialized
+            pass
+    return HostTopology(
+        process_id=jax.process_index(),
+        num_processes=jax.process_count(),
+        local_devices=jax.local_device_count(),
+        global_devices=jax.device_count(),
+    )

@@ -1,0 +1,88 @@
+"""Mesh-sharded end-to-end pipeline.
+
+Wraps the shard_map forward (``parallel.collectives``) with host-side
+packing and explicit device placement. The reference's placement model —
+rank r reads docs r, r+(size-1), ... from its own process
+(``TFIDF.c:130-138``) — becomes: host packs the batch, ``jax.device_put``
+with a NamedSharding splits the document axis across the mesh, XLA owns
+all further movement.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tfidf_tpu.config import PipelineConfig, VocabMode
+from tfidf_tpu.io.corpus import Corpus, PackedBatch, pack_corpus
+from tfidf_tpu.parallel.collectives import make_sharded_forward
+from tfidf_tpu.parallel.mesh import MeshPlan
+from tfidf_tpu.pipeline import PipelineResult
+
+
+class ShardedPipeline:
+    """TF-IDF over a device mesh.
+
+    EXACT vocab mode is supported but sized from the corpus; HASHED is
+    the intended mode at scale (vocab padded to a shard multiple).
+    """
+
+    def __init__(self, plan: MeshPlan, config: Optional[PipelineConfig] = None):
+        self.plan = plan
+        self.config = config or PipelineConfig(vocab_mode=VocabMode.HASHED)
+
+    def pack(self, corpus: Corpus, want_words: bool = True) -> PackedBatch:
+        batch = pack_corpus(corpus, self.config,
+                            pad_docs_to=self.plan.pad_docs(len(corpus)),
+                            want_words=want_words)
+        # Token axis must also split evenly across seq shards.
+        lcm_target = self.plan.pad_tokens(batch.token_ids.shape[1])
+        if lcm_target != batch.token_ids.shape[1]:
+            pad = lcm_target - batch.token_ids.shape[1]
+            batch.token_ids = np.pad(batch.token_ids, ((0, 0), (0, pad)))
+        return batch
+
+    def run_packed(self, batch: PackedBatch) -> PipelineResult:
+        cfg = self.config
+        if cfg.use_pallas:
+            raise NotImplementedError(
+                "use_pallas: Pallas histogram kernel not wired up yet")
+        if cfg.mesh_shape:
+            raise ValueError(
+                "config.mesh_shape is ignored by ShardedPipeline — the "
+                "MeshPlan passed to the constructor is authoritative")
+        vocab_padded = self.plan.pad_vocab(batch.vocab_size)
+        fwd = make_sharded_forward(self.plan, vocab_padded,
+                                   jnp.dtype(cfg.score_dtype), cfg.topk)
+        tokens = jax.device_put(batch.token_ids,
+                                self.plan.sharding(self.plan.batch_spec()))
+        lengths = jax.device_put(batch.lengths,
+                                 self.plan.sharding(self.plan.lengths_spec()))
+        out = fwd(tokens, lengths, jnp.int32(batch.num_docs))
+        # topk mode: dense per-shard counts/scores never leave the devices.
+        if cfg.topk is not None:
+            counts = None
+            df = np.asarray(out[0])[:batch.vocab_size]
+        else:
+            counts = np.asarray(out[0])[:, :batch.vocab_size]
+            df = np.asarray(out[1])[:batch.vocab_size]
+        result = PipelineResult(
+            counts=counts,
+            lengths=np.asarray(batch.lengths),
+            df=df,
+            num_docs=batch.num_docs,
+            names=batch.names,
+            id_to_word=batch.id_to_word or {},
+        )
+        if cfg.topk is not None:
+            result.topk_vals = np.asarray(out[1])
+            result.topk_ids = np.asarray(out[2])
+        else:
+            result.scores = np.asarray(out[2])[:, :batch.vocab_size]
+        return result
+
+    def run(self, corpus: Corpus) -> PipelineResult:
+        return self.run_packed(self.pack(corpus))
