@@ -1,0 +1,109 @@
+"""Headline benchmark: docs/sec on TPU vs the 8-rank CPU oracle.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "docs/sec", "vs_baseline": N}
+
+Method (BASELINE.json north star, scaled to fit a CI budget): generate a
+synthetic Zipf-distributed corpus on disk, run the native bit-reference
+with 8 worker ranks (the "8-rank MPI CPU baseline" — measured, since the
+reference publishes no numbers, BASELINE.md), then run the TPU path
+end-to-end (read + native tokenize/hash + pack + device histogram/DF/
+score/top-k) and report TPU docs/sec with vs_baseline = tpu/cpu ratio.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+N_DOCS = int(os.environ.get("BENCH_DOCS", 2048))
+DOC_LEN = int(os.environ.get("BENCH_DOC_LEN", 256))
+N_WORDS = 8192
+VOCAB = 1 << 16
+TOPK = 16
+
+
+def make_corpus(root: str) -> str:
+    rng = np.random.default_rng(42)
+    words = np.array([f"w{i}".encode() for i in range(N_WORDS)], dtype=object)
+    input_dir = os.path.join(root, "input")
+    os.makedirs(input_dir)
+    zipf = np.clip(rng.zipf(1.3, size=N_DOCS * DOC_LEN), 1, N_WORDS) - 1
+    lens = rng.integers(DOC_LEN // 2, DOC_LEN + 1, N_DOCS)
+    off = 0
+    for i in range(1, N_DOCS + 1):
+        n = int(lens[i - 1])
+        doc = b" ".join(words[zipf[off:off + n]])
+        off += n
+        with open(os.path.join(input_dir, f"doc{i}"), "wb") as f:
+            f.write(doc)
+    return input_dir
+
+
+def bench_native(input_dir: str, out: str) -> float:
+    binary = os.path.join(REPO, "native", "tfidf_ref")
+    if not os.path.exists(binary):
+        subprocess.run(["make", "-C", os.path.join(REPO, "native")],
+                       check=True, capture_output=True)
+    t0 = time.perf_counter()
+    subprocess.run([binary, input_dir, out, "9"], check=True,
+                   stdout=subprocess.DEVNULL)
+    return time.perf_counter() - t0
+
+
+def bench_tpu(input_dir: str) -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from tfidf_tpu.config import PipelineConfig, VocabMode
+    from tfidf_tpu.io.corpus import discover_corpus, pack_corpus
+    from tfidf_tpu.pipeline import TfidfPipeline
+
+    cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=VOCAB,
+                         max_doc_len=DOC_LEN, doc_chunk=DOC_LEN, topk=TOPK)
+    pipe = TfidfPipeline(cfg)
+
+    # Untimed warmup at the full batch shape compiles the program; the
+    # timed run below re-packs from raw bytes and hits the jit cache.
+    corpus = discover_corpus(input_dir)
+    pipe.run_packed(pack_corpus(corpus, cfg, want_words=False))
+
+    t0 = time.perf_counter()
+    corpus = discover_corpus(input_dir)
+    batch = pack_corpus(corpus, cfg, want_words=False)
+    result = pipe.run_packed(batch)
+    assert result.topk_vals is not None
+    return time.perf_counter() - t0
+
+
+def main() -> None:
+    tmp = tempfile.mkdtemp(prefix="tfidf_bench_")
+    try:
+        input_dir = make_corpus(tmp)
+        cpu_s = bench_native(input_dir, os.path.join(tmp, "ref_out.txt"))
+        tpu_s = bench_tpu(input_dir)
+        cpu_dps = N_DOCS / cpu_s
+        tpu_dps = N_DOCS / tpu_s
+        print(json.dumps({
+            "metric": f"docs/sec, {N_DOCS}-doc Zipf corpus, hashed 2^16 "
+                      f"vocab, top-{TOPK} (vs 8-worker native CPU oracle)",
+            "value": round(tpu_dps, 1),
+            "unit": "docs/sec",
+            "vs_baseline": round(tpu_dps / cpu_dps, 2),
+        }))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,95 @@
+"""Char n-gram tests: host path, device path, and their contracts."""
+
+import numpy as np
+import pytest
+
+from tfidf_tpu import PipelineConfig, TfidfPipeline
+from tfidf_tpu.config import TokenizerKind, VocabMode
+from tfidf_tpu.io.corpus import Corpus
+
+
+def poly_hash_ref(window: bytes, seed: int = 0) -> int:
+    """NumPy-free mirror of ops/hashing.device_ngram_ids' rolling hash."""
+    h = (seed ^ 0x811C9DC5) & 0xFFFFFFFF
+    for b in window:
+        h = ((h ^ b) * 0x01000193) & 0xFFFFFFFF
+    h ^= h >> 16
+    return h
+
+
+def chargram_counts_ref(doc: bytes, lo: int, hi: int, vocab: int, seed: int = 0):
+    counts = np.zeros(vocab, np.int64)
+    for n in range(lo, hi + 1):
+        for i in range(len(doc) - n + 1):
+            counts[poly_hash_ref(doc[i:i + n], seed) % vocab] += 1
+    return counts
+
+
+CORPUS = Corpus(names=["doc1", "doc2", "doc3"],
+                docs=[b"abcabc", b"hello world", b"xyz"])
+
+
+class TestDeviceChargram:
+    def test_counts_match_python_rolling_hash(self):
+        cfg = PipelineConfig(tokenizer=TokenizerKind.CHARGRAM,
+                             vocab_mode=VocabMode.HASHED, vocab_size=128,
+                             ngram_range=(2, 3), hash_seed=7)
+        r = TfidfPipeline(cfg).run_bytes(CORPUS)
+        for d, doc in enumerate(CORPUS.docs):
+            want = chargram_counts_ref(doc, 2, 3, 128, 7)
+            assert (r.counts[d] == want).all(), f"doc{d+1}"
+
+    def test_docsize_is_total_ngram_count(self):
+        cfg = PipelineConfig(tokenizer=TokenizerKind.CHARGRAM,
+                             vocab_mode=VocabMode.HASHED, vocab_size=128,
+                             ngram_range=(3, 5))
+        r = TfidfPipeline(cfg).run_bytes(CORPUS)
+        for d, doc in enumerate(CORPUS.docs):
+            want = sum(max(len(doc) - n + 1, 0) for n in range(3, 6))
+            assert int(r.lengths[d]) == want
+        # row sums == docSize (the docSize invariant carried to n-grams)
+        assert (r.counts.sum(axis=1) == r.lengths[:3]).all()
+
+    def test_topk_mode_routes_to_device_path(self):
+        cfg = PipelineConfig(tokenizer=TokenizerKind.CHARGRAM,
+                             vocab_mode=VocabMode.HASHED, vocab_size=128,
+                             ngram_range=(2, 2), topk=4)
+        r = TfidfPipeline(cfg).run(CORPUS)
+        assert r.topk_vals.shape == (3, 4)
+        assert r.counts is None
+        assert r.id_to_word == {}  # device path: ids only
+
+    def test_full_output_routes_to_host_path(self):
+        # Without topk, run() must use the host tokenizer so that full
+        # output lines have word strings (review regression fix).
+        cfg = PipelineConfig(tokenizer=TokenizerKind.CHARGRAM,
+                             vocab_mode=VocabMode.HASHED, vocab_size=1 << 14,
+                             ngram_range=(2, 2))
+        r = TfidfPipeline(cfg).run(CORPUS)
+        lines = r.output_lines()  # must not KeyError
+        assert lines and all(b"@" in l for l in lines)
+
+    def test_sparse_engine_not_hijacked_by_device_path(self):
+        cfg = PipelineConfig(tokenizer=TokenizerKind.CHARGRAM,
+                             vocab_mode=VocabMode.HASHED, vocab_size=1 << 14,
+                             ngram_range=(2, 2), engine="sparse", topk=2)
+        r = TfidfPipeline(cfg).run(CORPUS)
+        assert r.counts is None and r.topk_vals.shape == (3, 2)
+
+    def test_exact_mode_uses_host_strings(self):
+        cfg = PipelineConfig(tokenizer=TokenizerKind.CHARGRAM,
+                             vocab_mode=VocabMode.EXACT, ngram_range=(2, 2))
+        r = TfidfPipeline(cfg).run(CORPUS)
+        # host path: id_to_word holds real n-gram strings
+        assert b"ab" in set(r.id_to_word.values())
+
+    def test_host_fallback_flag(self):
+        base = dict(tokenizer=TokenizerKind.CHARGRAM,
+                    vocab_mode=VocabMode.HASHED, vocab_size=256,
+                    ngram_range=(2, 3))
+        dev = TfidfPipeline(PipelineConfig(**base)).run_bytes(CORPUS)
+        host = TfidfPipeline(
+            PipelineConfig(chargram_on_device=False, **base)).run(CORPUS)
+        # Different hash universes, same aggregate invariants.
+        assert (dev.counts.sum(axis=1) == host.counts.sum(axis=1)).all()
+        assert host.counts.shape == dev.counts.shape

@@ -77,6 +77,30 @@ class TestShardedMatchesSingleDevice:
 
 
 @needs_devices(8)
+class TestLongDoc:
+    def test_mesh_wide_histogram_exact(self):
+        from tfidf_tpu.parallel.longdoc import long_doc_histogram
+        plan = MeshPlan.create(docs=2, seq=2, vocab=2)
+        rng = np.random.default_rng(3)
+        toks = rng.integers(0, 50, size=1024).astype(np.int32)
+        length = 1000  # tail is padding
+        counts = np.asarray(long_doc_histogram(plan, toks, length, 64))
+        ref = np.bincount(toks[:length], minlength=64)
+        assert (counts == ref).all()
+        assert counts.sum() == length
+
+    def test_composes_with_df_scoring(self):
+        # A long doc's histogram slots into the same DF/IDF ops.
+        from tfidf_tpu.ops.scoring import idf_from_df
+        from tfidf_tpu.parallel.longdoc import long_doc_histogram
+        plan = MeshPlan.create(docs=8, seq=1, vocab=1)
+        toks = np.arange(256, dtype=np.int32) % 16
+        counts = long_doc_histogram(plan, toks, 256, 16)
+        idf = idf_from_df((counts > 0).astype(np.int32), 4)
+        assert idf.shape == (16,)
+
+
+@needs_devices(8)
 class TestMeshPlan:
     def test_axis_sizes_and_padding(self):
         plan = MeshPlan.create(docs=2, seq=2, vocab=2,

@@ -1,0 +1,113 @@
+"""Row-sparse engine tests: exactness vs dense, BCOO export, sharding."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tfidf_tpu import PipelineConfig, TfidfPipeline, discover_corpus
+from tfidf_tpu.config import VocabMode
+from tfidf_tpu.golden import golden_output
+from tfidf_tpu.ops.sparse import (sorted_term_counts, sparse_df,
+                                  sparse_topk, to_bcoo)
+from tfidf_tpu.parallel import MeshPlan, ShardedPipeline
+
+
+class TestSortedTermCounts:
+    def test_rle_matches_bincount(self):
+        rng = np.random.default_rng(0)
+        toks = jnp.asarray(rng.integers(0, 20, (4, 32)), jnp.int32)
+        lens = jnp.asarray([32, 5, 0, 17], jnp.int32)
+        ids, counts, head = sorted_term_counts(toks, lens)
+        for d in range(4):
+            got = {int(ids[d, i]): int(counts[d, i])
+                   for i in range(32) if head[d, i]}
+            want_arr = np.bincount(np.asarray(toks)[d, : int(lens[d])],
+                                   minlength=20)
+            want = {v: int(c) for v, c in enumerate(want_arr) if c}
+            assert got == want
+
+    def test_df_matches_dense(self):
+        from tfidf_tpu.ops.histogram import df_from_counts, tf_counts
+        rng = np.random.default_rng(1)
+        toks = jnp.asarray(rng.integers(0, 50, (6, 24)), jnp.int32)
+        lens = jnp.asarray([24, 24, 3, 0, 10, 24], jnp.int32)
+        ids, _, head = sorted_term_counts(toks, lens)
+        dense_df = df_from_counts(tf_counts(toks, lens, 50))
+        assert (np.asarray(sparse_df(ids, head, 50)) == np.asarray(dense_df)).all()
+
+
+class TestSparsePipeline:
+    def test_golden_bytes_equal_dense_engine(self, toy_corpus_dir):
+        corpus = discover_corpus(toy_corpus_dir)
+        dense = TfidfPipeline(PipelineConfig.golden()).run(corpus)
+        sparse = TfidfPipeline(
+            PipelineConfig(vocab_mode=VocabMode.EXACT, engine="sparse")
+        ).run(corpus)
+        assert sparse.counts is None  # [D, V] never materialized
+        assert sparse.output_bytes() == dense.output_bytes()
+        assert sparse.output_bytes() == golden_output(corpus)
+
+    def test_sparse_topk_matches_dense_topk(self, toy_corpus_dir):
+        corpus = discover_corpus(toy_corpus_dir)
+        base = dict(vocab_mode=VocabMode.HASHED, vocab_size=512, topk=3)
+        dense = TfidfPipeline(PipelineConfig(**base)).run(corpus)
+        sparse = TfidfPipeline(PipelineConfig(engine="sparse", **base)).run(corpus)
+        np.testing.assert_allclose(sparse.topk_vals, dense.topk_vals,
+                                   rtol=1e-6)
+        # ids agree wherever scores are distinct & positive
+        agree = (sparse.topk_vals > 0) & (dense.topk_vals > 0)
+        assert (sparse.topk_ids[agree] == dense.topk_ids[agree]).all()
+
+    def test_sub_k_docs_masked(self):
+        from tfidf_tpu.io.corpus import Corpus
+        corpus = Corpus(names=["doc1", "doc2"], docs=[b"a b", b"c"])
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=64,
+                             engine="sparse", topk=4)
+        r = TfidfPipeline(cfg).run(corpus)
+        assert (r.topk_ids[1, 1:] == -1).all()  # doc2 has 1 distinct term
+
+    @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+    def test_sharded_sparse_matches_single(self, toy_corpus_dir):
+        corpus = discover_corpus(toy_corpus_dir)
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=256,
+                             engine="sparse", topk=3, max_doc_len=64,
+                             doc_chunk=64)
+        single = TfidfPipeline(cfg).run(corpus)
+        plan = MeshPlan.create(docs=8, seq=1, vocab=1)
+        sharded = ShardedPipeline(plan, cfg).run(corpus)
+        d = single.topk_vals.shape[0]
+        assert (sharded.df == single.df).all()
+        np.testing.assert_allclose(sharded.topk_vals[:d], single.topk_vals,
+                                   rtol=1e-6)
+
+    @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+    def test_sharded_sparse_requires_docs_only_mesh(self, toy_corpus_dir):
+        corpus = discover_corpus(toy_corpus_dir)
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=256,
+                             engine="sparse")
+        plan = MeshPlan.create(docs=4, seq=1, vocab=2)
+        with pytest.raises(ValueError, match="docs axis only"):
+            ShardedPipeline(plan, cfg).run(corpus)
+
+
+class TestBcooExport:
+    def test_bcoo_todense_matches_counts(self):
+        from tfidf_tpu.ops.histogram import tf_counts
+        rng = np.random.default_rng(2)
+        toks = jnp.asarray(rng.integers(0, 30, (3, 16)), jnp.int32)
+        lens = jnp.asarray([16, 7, 0], jnp.int32)
+        ids, counts, head = sorted_term_counts(toks, lens)
+        bcoo = to_bcoo(ids, counts, head, 30)
+        dense = tf_counts(toks, lens, 30)
+        assert (np.asarray(bcoo.todense()) == np.asarray(dense)).all()
+
+    def test_bcoo_matmul(self):
+        # The sparse term-doc matmul of the north star: S @ q on MXU.
+        toks = jnp.asarray([[1, 1, 2, 3], [3, 3, 3, 0]], jnp.int32)
+        lens = jnp.asarray([4, 4], jnp.int32)
+        ids, counts, head = sorted_term_counts(toks, lens)
+        bcoo = to_bcoo(ids, counts, head, 8)
+        q = jnp.zeros((8,), jnp.float32).at[3].set(1.0)
+        out = bcoo @ q
+        assert out.tolist() == [1.0, 3.0]

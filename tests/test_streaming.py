@@ -1,0 +1,72 @@
+"""Streaming minibatch tests: incremental DF == batch DF, checkpointing."""
+
+import jax
+import numpy as np
+import pytest
+
+from tfidf_tpu import PipelineConfig, TfidfPipeline
+from tfidf_tpu.config import VocabMode
+from tfidf_tpu.io.corpus import Corpus
+from tfidf_tpu.parallel import MeshPlan
+from tfidf_tpu.streaming import StreamingTfidf
+
+
+def corpus_batches():
+    docs = [b"a b c", b"a a d", b"b d e f", b"a", b"c c g", b"h b"]
+    names = [f"doc{i+1}" for i in range(len(docs))]
+    full = Corpus(names=names, docs=docs)
+    batches = [Corpus(names=names[i:i+2], docs=docs[i:i+2])
+               for i in range(0, 6, 2)]
+    return full, batches
+
+
+CFG = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=256,
+                     max_doc_len=8, doc_chunk=8)
+
+
+class TestStreaming:
+    def test_incremental_df_equals_batch_df(self):
+        full, batches = corpus_batches()
+        stream = StreamingTfidf(CFG)
+        for b in batches:
+            stream.update(stream.pack(b))
+        batch_result = TfidfPipeline(CFG).run(full)
+        assert stream.docs_seen == len(full)
+        assert (stream.df() == batch_result.df).all()
+
+    def test_post_pass_scores_match_batch_pipeline(self):
+        full, batches = corpus_batches()
+        stream = StreamingTfidf(CFG)
+        packed = [stream.pack(b) for b in batches]
+        for p in packed:
+            stream.update(p)
+        got = np.concatenate([np.asarray(stream.score(p)) for p in packed])
+        want = TfidfPipeline(CFG).run(full).scores
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_checkpoint_roundtrip(self):
+        full, batches = corpus_batches()
+        a = StreamingTfidf(CFG)
+        a.update(a.pack(batches[0]))
+        state = a.state_dict()
+        b = StreamingTfidf(CFG)
+        b.load_state(state)
+        for batch in batches[1:]:
+            a.update(a.pack(batch))
+            b.update(b.pack(batch))
+        assert (a.df() == b.df()).all() and a.docs_seen == b.docs_seen
+
+    def test_exact_mode_rejected(self):
+        with pytest.raises(ValueError):
+            StreamingTfidf(PipelineConfig(vocab_mode=VocabMode.EXACT))
+
+    @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+    def test_sharded_streaming_matches_single(self):
+        full, batches = corpus_batches()
+        plan = MeshPlan.create(docs=2, seq=2, vocab=2)
+        sharded = StreamingTfidf(CFG, plan)
+        single = StreamingTfidf(CFG)
+        for b in batches:
+            sharded.update(sharded.pack(b))
+            single.update(single.pack(b))
+        assert (sharded.df() == single.df()).all()

@@ -27,6 +27,8 @@ def _build_parser() -> argparse.ArgumentParser:
     run.add_argument("--output", default="output.txt",
                      help="output file (reference format)")
     run.add_argument("--backend", choices=["tpu", "mpi"], default="tpu")
+    run.add_argument("--engine", choices=["dense", "sparse"], default="dense",
+                     help="dense [D,V] histograms or row-sparse O(D*L)")
     run.add_argument("--vocab-mode", choices=["exact", "hashed"],
                      default="exact")
     run.add_argument("--vocab-size", type=int, default=1 << 16,
@@ -75,6 +77,7 @@ def _run_tpu(args) -> int:
         tokenizer=TokenizerKind(args.tokenizer),
         ngram_range=(lo, hi),
         topk=args.topk,
+        engine=args.engine,
     )
     corpus = discover_corpus(args.input, strict=not args.no_strict)
 

@@ -55,6 +55,11 @@ class PipelineConfig:
       tokenizer: WHITESPACE or CHARGRAM.
       ngram_range: inclusive (lo, hi) n-gram sizes for CHARGRAM
         (BASELINE config 4 uses 3..5).
+      chargram_on_device: HASHED chargram mode computes n-gram ids on
+        device from raw bytes (no host n-gram materialization; see
+        ops/hashing.device_ngram_ids). False forces the host path
+        (FNV over materialized n-gram strings). EXACT mode always uses
+        the host path (it needs the strings for the vocabulary).
       truncate_tokens_at: if set, tokens are truncated to this many
         bytes before vocab lookup — replicates the reference's 16-char
         scan-buffer quirk (``MAX_WORD_LENGTH 16``, ``TFIDF.c:18``; see
@@ -79,9 +84,11 @@ class PipelineConfig:
 
     vocab_mode: VocabMode = VocabMode.EXACT
     vocab_size: int = 1 << 16
+    engine: str = "dense"  # "dense" ([D,V] histograms) | "sparse" (row-sparse)
     hash_seed: int = 0
     tokenizer: TokenizerKind = TokenizerKind.WHITESPACE
     ngram_range: Tuple[int, int] = (3, 5)
+    chargram_on_device: bool = True
     truncate_tokens_at: Optional[int] = None
     max_doc_len: int = 256
     doc_chunk: int = 256
@@ -98,6 +105,8 @@ class PipelineConfig:
             raise ValueError(f"bad ngram_range {self.ngram_range}")
         if self.max_doc_len <= 0 or self.doc_chunk <= 0:
             raise ValueError("max_doc_len/doc_chunk must be positive")
+        if self.engine not in ("dense", "sparse"):
+            raise ValueError(f"unknown engine {self.engine!r}")
 
     @staticmethod
     def golden() -> "PipelineConfig":

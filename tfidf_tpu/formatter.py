@@ -17,6 +17,21 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 
+def _record_line(name: str, word: bytes, count: int, doc_size: int,
+                 df_v: int, num_docs: int) -> bytes:
+    """ONE (document, word) output line — the byte-parity-critical math.
+
+    Shared by the dense and sparse formatters so the reference semantics
+    (op order and %.16f formatting) live in exactly one place:
+    TF = 1.0*count/docSize (``TFIDF.c:202``), IDF = log(1.0*N/DF)
+    (``TFIDF.c:243``), line = document@word\\t%.16f (``TFIDF.c:245``).
+    """
+    tf = 1.0 * count / doc_size
+    idf = math.log(1.0 * num_docs / df_v)
+    score = tf * idf
+    return b"%s@%s\t%s" % (name.encode(), word, b"%.16f" % score)
+
+
 def format_records(counts: np.ndarray, lengths: np.ndarray, df: np.ndarray,
                    num_docs: int, names: Sequence[str],
                    id_to_word: Dict[int, bytes]) -> List[bytes]:
@@ -39,12 +54,33 @@ def format_records(counts: np.ndarray, lengths: np.ndarray, df: np.ndarray,
         name = names[d]
         if not name:
             continue
-        c = int(counts[d, v])
-        tf = 1.0 * c / int(lengths[d])            # TFIDF.c:202
-        idf = math.log(1.0 * num_docs / int(df[v]))  # TFIDF.c:243
-        score = tf * idf                           # TFIDF.c:244
-        lines.append(b"%s@%s\t%s" % (
-            name.encode(), id_to_word[v], b"%.16f" % score))
+        lines.append(_record_line(name, id_to_word[v], int(counts[d, v]),
+                                  int(lengths[d]), int(df[v]), num_docs))
+    lines.sort()
+    return lines
+
+
+def format_sparse_records(ids: np.ndarray, counts: np.ndarray,
+                          head: np.ndarray, lengths: np.ndarray,
+                          df: np.ndarray, num_docs: int,
+                          names: Sequence[str],
+                          id_to_word: Dict[int, bytes]) -> List[bytes]:
+    """Golden-format lines from the row-sparse engine's outputs.
+
+    Same math and ordering as :func:`format_records`, sourced from
+    (ids, counts, head) [D, L] triples instead of a dense [D, V] matrix.
+    """
+    ids, counts = np.asarray(ids), np.asarray(counts)
+    head, lengths, df = np.asarray(head), np.asarray(lengths), np.asarray(df)
+    lines: List[bytes] = []
+    docs_idx, slot_idx = np.nonzero(head)
+    for d, i in zip(docs_idx.tolist(), slot_idx.tolist()):
+        name = names[d]
+        if not name:
+            continue
+        v = int(ids[d, i])
+        lines.append(_record_line(name, id_to_word[v], int(counts[d, i]),
+                                  int(lengths[d]), int(df[v]), num_docs))
     lines.sort()
     return lines
 

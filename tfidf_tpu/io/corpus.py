@@ -64,6 +64,37 @@ class PackedBatch:
     id_to_word: Optional[Dict[int, bytes]]
 
 
+@dataclasses.dataclass
+class PackedBytes:
+    """Raw-byte device input for the on-device chargram path.
+
+    byte_ids: int32 [D, B] raw bytes (0..255), zero-padded.
+    byte_lengths: int32 [D] live byte counts.
+    """
+
+    byte_ids: np.ndarray
+    byte_lengths: np.ndarray
+    num_docs: int
+    names: List[str]
+
+
+def pack_bytes(corpus: Corpus, pad_docs_to: Optional[int] = None,
+               pad_len_to: int = 128) -> PackedBytes:
+    """Pack raw document bytes for on-device n-gram hashing."""
+    d = len(corpus)
+    d_padded = max(pad_docs_to or d, d)
+    max_len = max((len(doc) for doc in corpus.docs), default=1)
+    b = max(((max_len + pad_len_to - 1) // pad_len_to) * pad_len_to, pad_len_to)
+    byte_ids = np.zeros((d_padded, b), dtype=np.int32)
+    lengths = np.zeros((d_padded,), dtype=np.int32)
+    for i, doc in enumerate(corpus.docs):
+        byte_ids[i, : len(doc)] = np.frombuffer(doc, np.uint8)
+        lengths[i] = len(doc)
+    names = list(corpus.names) + [""] * (d_padded - d)
+    return PackedBytes(byte_ids=byte_ids, byte_lengths=lengths,
+                       num_docs=d, names=names)
+
+
 def discover_corpus(input_dir: str, strict: bool = True) -> Corpus:
     """Enumerate and load a document directory.
 

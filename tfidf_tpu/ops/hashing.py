@@ -78,29 +78,36 @@ _POLY = np.uint32(0x01000193)  # FNV-32 prime reused as the polynomial base
 
 
 def device_ngram_ids(doc_bytes, doc_len, n: int, vocab_size: int, seed: int = 0):
-    """Ids of all length-``n`` byte windows of a document, on device.
+    """Ids of all length-``n`` byte windows of a document batch, on device.
 
     Args:
-      doc_bytes: uint8/int32 array [L] — the raw document, zero-padded.
-      doc_len: scalar int — live byte count.
+      doc_bytes: uint8/int32 array [..., L] — raw documents, zero-padded.
+      doc_len: int array broadcastable to [...] — live byte counts.
       n: window size (static).
       vocab_size: fold target (static).
       seed: hash seed (static).
 
     Returns:
-      (ids, valid): int32 [L] window ids (position i = window starting at
-      i) and bool [L] validity mask (windows that fit inside doc_len).
-      Shapes stay static at [L]; invalid tail windows are masked, which is
-      the TPU idiom for the ragged output (SURVEY §7 "ragged docs").
+      (ids, valid): int32 [..., L] window ids (position i = window
+      starting at i) and bool [..., L] validity (windows inside doc_len).
+      Shapes stay static; invalid tail windows are masked — the TPU idiom
+      for the ragged output (SURVEY §7 "ragged docs").
+
+    The hash is a polynomial rolling hash (NOT FNV-1a: Horner form maps
+    to n fused multiply-xor vector steps with no per-window inner loop),
+    so hashed-chargram ids differ from the host FNV path's — both are
+    valid "hashed vocab" universes; tests pin each against its own
+    reference.
     """
     b = doc_bytes.astype(jnp.uint32)
-    length = b.shape[0]
-    h = jnp.full((length,), np.uint32(seed) ^ np.uint32(0x811C9DC5), dtype=jnp.uint32)
+    length = b.shape[-1]
+    h = jnp.full(b.shape, np.uint32(seed) ^ np.uint32(0x811C9DC5),
+                 dtype=jnp.uint32)
     # Horner evaluation of the n-byte polynomial at each start position.
     for j in range(n):
-        shifted = jnp.roll(b, -j)  # window byte j for each start position
+        shifted = jnp.roll(b, -j, axis=-1)  # window byte j per start pos
         h = (h ^ shifted) * _POLY
     h ^= h >> 16
     ids = (h % np.uint32(vocab_size)).astype(jnp.int32)
-    valid = jnp.arange(length) + n <= doc_len
+    valid = jnp.arange(length) + n <= jnp.asarray(doc_len)[..., None]
     return ids, valid

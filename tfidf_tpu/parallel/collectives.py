@@ -110,6 +110,48 @@ def make_sharded_forward(plan: MeshPlan, vocab_size: int, score_dtype,
     return jax.jit(mapped)
 
 
+def _psum_df(df):
+    """DF collective for the sparse shard body (module-level so the jit
+    cache key is stable across calls)."""
+    return lax.psum(df, (DOCS_AXIS, SEQ_AXIS, VOCAB_AXIS))
+
+
+def _sparse_shard_body(tokens, lengths, num_docs, *, vocab_size: int,
+                       score_dtype, topk: Optional[int]):
+    """Row-sparse per-shard program (docs axis only; see ops/sparse.py).
+
+    Sorting is row-local, so only the document axis shards; the [V] DF
+    vector is small enough to replicate (256 KB at 2^16 float32), which
+    is exactly why the sparse engine needs no vocab sharding. The body IS
+    ops/sparse.sparse_forward — only the DF reduction differs.
+    """
+    from tfidf_tpu.ops.sparse import sparse_forward
+
+    return sparse_forward(tokens, lengths, num_docs, vocab_size=vocab_size,
+                          score_dtype=score_dtype, topk=topk,
+                          df_reduce=_psum_df)
+
+
+@functools.lru_cache(maxsize=64)
+def make_sparse_sharded_forward(plan: MeshPlan, vocab_size: int, score_dtype,
+                                topk: Optional[int]):
+    """Sharded row-sparse forward. Requires seq=1 and vocab=1 shards —
+    the whole point of the sparse engine is that only the docs axis
+    needs to scale (long docs route through the dense seq-sharded path)."""
+    if plan.n_seq_shards != 1 or plan.n_vocab_shards != 1:
+        raise ValueError("sparse engine shards the docs axis only; build "
+                         "the MeshPlan with seq=1, vocab=1")
+    body = functools.partial(_sparse_shard_body, vocab_size=vocab_size,
+                             score_dtype=score_dtype, topk=topk)
+    n_out = 3 if topk is not None else 5
+    out_specs = (P(VOCAB_AXIS),) + (P(DOCS_AXIS, None),) * (n_out - 1)
+    mapped = jax.shard_map(
+        body, mesh=plan.mesh,
+        in_specs=(plan.batch_spec(), plan.lengths_spec(), P()),
+        out_specs=out_specs, check_vma=False)
+    return jax.jit(mapped)
+
+
 def sharded_tf_df(plan: MeshPlan, tokens, lengths, vocab_size: int
                   ) -> Tuple[jax.Array, jax.Array]:
     """Counts + global DF only (no scoring) — the minimal DP+psum path."""

@@ -18,7 +18,8 @@ import numpy as np
 
 from tfidf_tpu.config import PipelineConfig, VocabMode
 from tfidf_tpu.io.corpus import Corpus, PackedBatch, pack_corpus
-from tfidf_tpu.parallel.collectives import make_sharded_forward
+from tfidf_tpu.parallel.collectives import (make_sharded_forward,
+                                            make_sparse_sharded_forward)
 from tfidf_tpu.parallel.mesh import MeshPlan
 from tfidf_tpu.pipeline import PipelineResult
 
@@ -55,12 +56,14 @@ class ShardedPipeline:
                 "config.mesh_shape is ignored by ShardedPipeline — the "
                 "MeshPlan passed to the constructor is authoritative")
         vocab_padded = self.plan.pad_vocab(batch.vocab_size)
-        fwd = make_sharded_forward(self.plan, vocab_padded,
-                                   jnp.dtype(cfg.score_dtype), cfg.topk)
         tokens = jax.device_put(batch.token_ids,
                                 self.plan.sharding(self.plan.batch_spec()))
         lengths = jax.device_put(batch.lengths,
                                  self.plan.sharding(self.plan.lengths_spec()))
+        if cfg.engine == "sparse":
+            return self._run_sparse(batch, tokens, lengths)
+        fwd = make_sharded_forward(self.plan, vocab_padded,
+                                   jnp.dtype(cfg.score_dtype), cfg.topk)
         out = fwd(tokens, lengths, jnp.int32(batch.num_docs))
         # topk mode: dense per-shard counts/scores never leave the devices.
         if cfg.topk is not None:
@@ -82,6 +85,28 @@ class ShardedPipeline:
             result.topk_ids = np.asarray(out[2])
         else:
             result.scores = np.asarray(out[2])[:, :batch.vocab_size]
+        return result
+
+    def _run_sparse(self, batch: PackedBatch, tokens, lengths) -> PipelineResult:
+        cfg = self.config
+        fwd = make_sparse_sharded_forward(
+            self.plan, batch.vocab_size, jnp.dtype(cfg.score_dtype), cfg.topk)
+        out = fwd(tokens, lengths, jnp.int32(batch.num_docs))
+        result = PipelineResult(
+            counts=None,
+            lengths=np.asarray(batch.lengths),
+            df=np.asarray(out[0]),
+            num_docs=batch.num_docs,
+            names=batch.names,
+            id_to_word=batch.id_to_word or {},
+        )
+        if cfg.topk is not None:
+            result.topk_vals = np.asarray(out[1])
+            result.topk_ids = np.asarray(out[2])
+        else:
+            result.sparse_ids = np.asarray(out[1])
+            result.sparse_counts = np.asarray(out[2])
+            result.sparse_head = np.asarray(out[3])
         return result
 
     def run(self, corpus: Corpus) -> PipelineResult:

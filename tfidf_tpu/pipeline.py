@@ -23,10 +23,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from tfidf_tpu.config import PipelineConfig
-from tfidf_tpu.formatter import format_records, to_output_bytes
+from tfidf_tpu.formatter import (format_records, format_sparse_records,
+                                 to_output_bytes)
 from tfidf_tpu.io.corpus import Corpus, PackedBatch, pack_corpus
 from tfidf_tpu.ops.histogram import df_from_counts, tf_counts, tf_counts_chunked
 from tfidf_tpu.ops.scoring import tfidf_dense
+from tfidf_tpu.ops.sparse import sparse_forward
 from tfidf_tpu.ops.topk import topk_per_doc
 
 
@@ -48,15 +50,24 @@ class PipelineResult:
     scores: Optional[np.ndarray] = None
     topk_vals: Optional[np.ndarray] = None
     topk_ids: Optional[np.ndarray] = None
+    # Row-sparse engine outputs ([D, L] triples; see ops/sparse.py).
+    sparse_ids: Optional[np.ndarray] = None
+    sparse_counts: Optional[np.ndarray] = None
+    sparse_head: Optional[np.ndarray] = None
 
     def output_lines(self) -> List[bytes]:
         """Reference-format lines (document@word\\t%.16f, strcmp order)."""
-        if self.counts is None:
-            raise ValueError(
-                "full output lines need dense counts; this was a topk-only "
-                "run (counts stay on device in topk mode)")
-        return format_records(self.counts, self.lengths, self.df,
-                              self.num_docs, self.names, self.id_to_word)
+        if self.counts is not None:
+            return format_records(self.counts, self.lengths, self.df,
+                                  self.num_docs, self.names, self.id_to_word)
+        if self.sparse_head is not None:
+            return format_sparse_records(
+                self.sparse_ids, self.sparse_counts, self.sparse_head,
+                self.lengths, self.df, self.num_docs, self.names,
+                self.id_to_word)
+        raise ValueError(
+            "full output lines need dense counts or row-sparse triples; "
+            "this was a topk-only run (term data stays on device)")
 
     def output_bytes(self) -> bytes:
         return to_output_bytes(self.output_lines())
@@ -86,11 +97,53 @@ def _forward(token_ids, lengths, num_docs, *, vocab_size: int, chunk: int,
     return counts, df, scores
 
 
-# Module-level jit keyed on the static config so repeat runs with the
+# Module-level jits keyed on the static config so repeat runs with the
 # same shapes/config hit XLA's compilation cache instead of re-tracing.
 _forward_jit = jax.jit(
     _forward,
     static_argnames=("vocab_size", "chunk", "score_dtype", "topk"),
+)
+
+
+_sparse_forward_jit = jax.jit(
+    sparse_forward,
+    static_argnames=("vocab_size", "score_dtype", "topk"),
+)
+
+
+def _chargram_forward(byte_ids, byte_lengths, num_docs, *, vocab_size: int,
+                      ngram_lo: int, ngram_hi: int, seed: int,
+                      score_dtype, topk: Optional[int]):
+    """On-device char n-gram pipeline: raw bytes -> (df, scores | topk).
+
+    N-gram ids are computed by rolling hash on device (BASELINE config 4,
+    wide-vocab stress) — a length-B doc contributes (hi-lo+1) id streams
+    without any host-side n-gram materialization. docSize is the total
+    n-gram count, matching the host chargram tokenizer's token count.
+    """
+    from tfidf_tpu.ops.hashing import device_ngram_ids
+    from tfidf_tpu.ops.histogram import tf_counts_masked
+
+    d, _ = byte_ids.shape
+    counts = jnp.zeros((d, vocab_size), jnp.int32)
+    total_len = jnp.zeros((d,), jnp.int32)
+    for n in range(ngram_lo, ngram_hi + 1):
+        ids, valid = device_ngram_ids(byte_ids, byte_lengths, n, vocab_size,
+                                      seed)
+        counts = counts + tf_counts_masked(ids, valid, vocab_size)
+        total_len = total_len + jnp.maximum(byte_lengths - (n - 1), 0)
+    df = df_from_counts(counts)
+    scores = tfidf_dense(counts, total_len, df, num_docs, score_dtype)
+    if topk is not None:
+        tv, ti = topk_per_doc(scores, min(topk, vocab_size))
+        return df, total_len, tv, ti
+    return counts, df, total_len, scores
+
+
+_chargram_forward_jit = jax.jit(
+    _chargram_forward,
+    static_argnames=("vocab_size", "ngram_lo", "ngram_hi", "seed",
+                     "score_dtype", "topk"),
 )
 
 
@@ -103,7 +156,7 @@ class TfidfPipeline:
     def pack(self, corpus: Corpus, pad_docs_to: Optional[int] = None) -> PackedBatch:
         return pack_corpus(corpus, self.config, pad_docs_to)
 
-    def run_packed(self, batch: PackedBatch) -> PipelineResult:
+    def _check_config(self) -> None:
         cfg = self.config
         if cfg.use_pallas:
             raise NotImplementedError(
@@ -112,6 +165,12 @@ class TfidfPipeline:
             raise NotImplementedError(
                 "mesh_shape on TfidfPipeline: use tfidf_tpu.parallel for "
                 "sharded execution")
+
+    def run_packed(self, batch: PackedBatch) -> PipelineResult:
+        cfg = self.config
+        self._check_config()
+        if cfg.engine == "sparse":
+            return self._run_sparse(batch)
         out = _forward_jit(
             jnp.asarray(batch.token_ids), jnp.asarray(batch.lengths),
             jnp.int32(batch.num_docs), vocab_size=batch.vocab_size,
@@ -134,5 +193,72 @@ class TfidfPipeline:
             result.scores = np.asarray(out[2])
         return result
 
+    def _run_sparse(self, batch: PackedBatch) -> PipelineResult:
+        """Row-sparse engine: O(D x L) memory, no [D, V] materialization."""
+        cfg = self.config
+        out = _sparse_forward_jit(
+            jnp.asarray(batch.token_ids), jnp.asarray(batch.lengths),
+            jnp.int32(batch.num_docs), vocab_size=batch.vocab_size,
+            score_dtype=jnp.dtype(cfg.score_dtype), topk=cfg.topk)
+        result = PipelineResult(
+            counts=None,
+            lengths=np.asarray(batch.lengths),
+            df=np.asarray(out[0]),
+            num_docs=batch.num_docs,
+            names=batch.names,
+            id_to_word=batch.id_to_word or {},
+        )
+        if cfg.topk is not None:
+            result.topk_vals = np.asarray(out[1])
+            result.topk_ids = np.asarray(out[2])
+        else:
+            result.sparse_ids = np.asarray(out[1])
+            result.sparse_counts = np.asarray(out[2])
+            result.sparse_head = np.asarray(out[3])
+            result.scores = None  # dense scores deliberately not built
+        return result
+
+    def run_bytes(self, corpus: Corpus) -> PipelineResult:
+        """On-device chargram path: ship raw bytes, hash n-grams on TPU."""
+        from tfidf_tpu.config import TokenizerKind, VocabMode
+        from tfidf_tpu.io.corpus import pack_bytes
+
+        cfg = self.config
+        self._check_config()
+        if cfg.tokenizer is not TokenizerKind.CHARGRAM:
+            raise ValueError("run_bytes is the chargram device path")
+        if cfg.vocab_mode is not VocabMode.HASHED:
+            raise ValueError("device chargram requires HASHED vocab "
+                             "(EXACT needs host-side n-gram strings)")
+        packed = pack_bytes(corpus)
+        lo, hi = cfg.ngram_range
+        out = _chargram_forward_jit(
+            jnp.asarray(packed.byte_ids), jnp.asarray(packed.byte_lengths),
+            jnp.int32(packed.num_docs), vocab_size=cfg.vocab_size,
+            ngram_lo=lo, ngram_hi=hi, seed=cfg.hash_seed,
+            score_dtype=jnp.dtype(cfg.score_dtype), topk=cfg.topk)
+        if cfg.topk is not None:
+            return PipelineResult(
+                counts=None, lengths=np.asarray(out[1]), df=np.asarray(out[0]),
+                num_docs=packed.num_docs, names=packed.names, id_to_word={},
+                topk_vals=np.asarray(out[2]), topk_ids=np.asarray(out[3]))
+        return PipelineResult(
+            counts=np.asarray(out[0]), lengths=np.asarray(out[2]),
+            df=np.asarray(out[1]), num_docs=packed.num_docs,
+            names=packed.names, id_to_word={}, scores=np.asarray(out[3]))
+
     def run(self, corpus: Corpus) -> PipelineResult:
+        from tfidf_tpu.config import TokenizerKind, VocabMode
+
+        cfg = self.config
+        # Device chargram only serves topk+dense runs: it has no word
+        # strings (id_to_word stays empty -> no full output lines) and
+        # its dense [D, V] histogram defeats engine="sparse". Everything
+        # else takes the host tokenizer path, which can serve both.
+        if (cfg.tokenizer is TokenizerKind.CHARGRAM
+                and cfg.vocab_mode is VocabMode.HASHED
+                and cfg.chargram_on_device
+                and cfg.topk is not None
+                and cfg.engine == "dense"):
+            return self.run_bytes(corpus)
         return self.run_packed(self.pack(corpus))

@@ -1,0 +1,126 @@
+"""Streaming minibatched TF-IDF with incremental DF state.
+
+BASELINE config 5. The reference is a single-shot batch job — its only
+lifecycle is run-once, write ``output.txt``, exit (``TFIDF.c:52-287``);
+corpus growth means rerunning from scratch. Here DF is *state*: an
+``[V]`` int32 vector (sharded over the vocab axis when a mesh is given)
+updated in place per minibatch with a donated-buffer jitted step, so a
+corpus can stream through in fixed-memory minibatches.
+
+Two-phase usage mirrors classic out-of-core TF-IDF:
+
+  1. ``update(batch)`` per minibatch — accumulates DF and the doc count.
+     On a mesh this is the incremental ``lax.psum`` of BASELINE config 5.
+  2. ``score(batch)`` — scores any minibatch against the *current* DF
+     snapshot (so scores after a full pass are exact corpus-wide TF-IDF;
+     scores mid-stream are the online approximation).
+
+State can be checkpointed/restored (``state_dict``/``load_state``) —
+the persist-DF-between-minibatches capability noted in SURVEY §5
+(checkpoint/resume).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tfidf_tpu.config import PipelineConfig, VocabMode
+from tfidf_tpu.io.corpus import Corpus, PackedBatch, pack_corpus
+from tfidf_tpu.ops.histogram import df_from_counts, tf_counts
+from tfidf_tpu.ops.scoring import idf_from_df
+from tfidf_tpu.parallel.mesh import MeshPlan
+
+
+@functools.partial(jax.jit, static_argnames=("vocab_size",), donate_argnums=(0,))
+def _update_df(df_state, token_ids, lengths, *, vocab_size: int):
+    """df_state += DF(minibatch). Donated so the update is in-place."""
+    counts = tf_counts(token_ids, lengths, vocab_size)
+    return df_state + df_from_counts(counts)
+
+
+@functools.partial(jax.jit, static_argnames=("vocab_size", "topk"))
+def _score_batch(df_state, num_docs, token_ids, lengths, *,
+                 vocab_size: int, topk: Optional[int]):
+    counts = tf_counts(token_ids, lengths, vocab_size)
+    idf = idf_from_df(df_state, num_docs)
+    lens = jnp.maximum(lengths, 1).astype(jnp.float32)
+    scores = counts.astype(jnp.float32) / lens[:, None] * idf[None, :]
+    if topk is None:
+        return scores
+    return jax.lax.top_k(scores, min(topk, vocab_size))
+
+
+class StreamingTfidf:
+    """Fixed-memory streaming TF-IDF over minibatches.
+
+    Requires HASHED vocab (a fixed id space across batches — EXACT mode
+    would renumber words per batch).
+    """
+
+    def __init__(self, config: Optional[PipelineConfig] = None,
+                 plan: Optional[MeshPlan] = None):
+        cfg = config or PipelineConfig(vocab_mode=VocabMode.HASHED)
+        if cfg.vocab_mode is not VocabMode.HASHED:
+            raise ValueError("streaming requires VocabMode.HASHED "
+                             "(fixed vocab ids across minibatches)")
+        self.config = cfg
+        self.plan = plan
+        self._vocab = (plan.pad_vocab(cfg.vocab_size) if plan
+                       else cfg.vocab_size)
+        df = jnp.zeros((self._vocab,), jnp.int32)
+        if plan is not None:
+            df = jax.device_put(df, plan.sharding(plan.df_spec()))
+        self._df = df
+        self._docs_seen = 0
+
+    # --- state ---
+    @property
+    def docs_seen(self) -> int:
+        return self._docs_seen
+
+    def df(self) -> np.ndarray:
+        return np.asarray(self._df)[: self.config.vocab_size]
+
+    def state_dict(self) -> Dict[str, np.ndarray]:
+        return {"df": np.asarray(self._df),
+                "docs_seen": np.asarray(self._docs_seen)}
+
+    def load_state(self, state: Dict[str, np.ndarray]) -> None:
+        df = jnp.asarray(state["df"])
+        if df.shape != (self._vocab,):
+            raise ValueError(f"df shape {df.shape} != ({self._vocab},)")
+        if self.plan is not None:
+            df = jax.device_put(df, self.plan.sharding(self.plan.df_spec()))
+        self._df = df
+        self._docs_seen = int(state["docs_seen"])
+
+    # --- packing ---
+    def pack(self, corpus: Corpus) -> PackedBatch:
+        pad = (self.plan.pad_docs(len(corpus)) if self.plan else None)
+        return pack_corpus(corpus, self.config, pad_docs_to=pad,
+                           want_words=False)
+
+    def _place(self, batch: PackedBatch):
+        toks, lens = jnp.asarray(batch.token_ids), jnp.asarray(batch.lengths)
+        if self.plan is not None:
+            toks = jax.device_put(toks, self.plan.sharding(self.plan.batch_spec()))
+            lens = jax.device_put(lens, self.plan.sharding(self.plan.lengths_spec()))
+        return toks, lens
+
+    # --- the two phases ---
+    def update(self, batch: PackedBatch) -> None:
+        """Fold one minibatch into the DF state (incremental psum)."""
+        toks, lens = self._place(batch)
+        self._df = _update_df(self._df, toks, lens, vocab_size=self._vocab)
+        self._docs_seen += batch.num_docs
+
+    def score(self, batch: PackedBatch):
+        """Score a minibatch against the current DF snapshot."""
+        toks, lens = self._place(batch)
+        return _score_batch(self._df, jnp.int32(self._docs_seen), toks, lens,
+                            vocab_size=self._vocab, topk=self.config.topk)
