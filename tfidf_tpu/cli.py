@@ -29,6 +29,8 @@ def _build_parser() -> argparse.ArgumentParser:
     run.add_argument("--backend", choices=["tpu", "mpi"], default="tpu")
     run.add_argument("--engine", choices=["dense", "sparse"], default="dense",
                      help="dense [D,V] histograms or row-sparse O(D*L)")
+    run.add_argument("--pallas", action="store_true",
+                     help="use the Pallas TPU histogram kernel")
     run.add_argument("--vocab-mode", choices=["exact", "hashed"],
                      default="exact")
     run.add_argument("--vocab-size", type=int, default=1 << 16,
@@ -78,6 +80,7 @@ def _run_tpu(args) -> int:
         ngram_range=(lo, hi),
         topk=args.topk,
         engine=args.engine,
+        use_pallas=args.pallas,
     )
     corpus = discover_corpus(args.input, strict=not args.no_strict)
 

@@ -1,0 +1,115 @@
+"""Pallas TPU kernels for the histogram hot path.
+
+The TF histogram is the pipeline's hot op (the reference spends its time
+in the equivalent token-scan loop, ``TFIDF.c:147-191``, SURVEY §3.1
+"HOT LOOP"). The XLA lowering of the scatter-add in ``ops.histogram`` is
+serviceable but scatter on TPU serializes; this kernel reformulates the
+histogram as a **compare-and-reduce** over vocab tiles — a dense VPU
+pattern with no scatter at all:
+
+    counts[d, v] = sum_l valid[d, l] * (tokens[d, l] == v)
+
+tiled (TILE_D docs x TILE_V vocab lanes) over a grid, streaming the
+token axis through VMEM in CHUNK_L slices. DF falls out in the same
+pass: the df output block is revisited by every doc-tile grid step and
+accumulated in place — TPU grids iterate sequentially, which is exactly
+the revisit-and-accumulate idiom.
+
+Lane/sublane shapes follow the TPU tiling table (pallas_guide.md): the
+vocab axis rides the 128-wide lane dimension, docs ride sublanes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+TILE_D = 8      # doc rows per program (sublane dimension)
+TILE_V = 128    # vocab lanes per program (lane dimension)
+CHUNK_L = 128   # token-axis VMEM streaming chunk
+
+
+def _hist_kernel(tokens_ref, len_ref, counts_ref, df_ref):
+    """One (vocab-tile, doc-tile) program: counts block + df accumulation.
+
+    Grid order is (vocab major, docs MINOR): Pallas TPU keeps an output
+    block resident only across *consecutive* grid steps, and the df
+    block (0, j) must accumulate across all doc tiles — so the doc
+    dimension has to be innermost for the revisits to be back-to-back.
+    """
+    i = pl.program_id(1)                       # doc tile (minor)
+    v_start = pl.program_id(0) * TILE_V        # vocab tile (major)
+
+    lens = len_ref[:]                          # [TILE_D, 1]
+    length = tokens_ref.shape[1]
+
+    vids = v_start + jax.lax.broadcasted_iota(jnp.int32, (1, 1, TILE_V), 2)
+
+    def body(c, acc):
+        toks_c = tokens_ref[:, pl.ds(c * CHUNK_L, CHUNK_L)]  # [TILE_D, CHUNK_L]
+        pos = c * CHUNK_L + jax.lax.broadcasted_iota(
+            jnp.int32, (1, CHUNK_L), 1)
+        valid = pos < lens                     # [TILE_D, CHUNK_L]
+        eq = (toks_c[:, :, None] == vids) & valid[:, :, None]
+        return acc + jnp.sum(eq.astype(jnp.int32), axis=1)
+
+    counts = jax.lax.fori_loop(0, length // CHUNK_L, body,
+                               jnp.zeros((TILE_D, TILE_V), jnp.int32))
+    counts_ref[:] = counts
+
+    # DF: the same (0, j) df block is revisited by every doc-tile step i;
+    # initialize on the first visit, accumulate presence afterwards.
+    @pl.when(i == 0)
+    def _():
+        df_ref[:] = jnp.zeros_like(df_ref)
+    df_ref[:] += jnp.sum((counts > 0).astype(jnp.int32), axis=0,
+                         keepdims=True)
+
+
+def _pad_to(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+@functools.partial(jax.jit, static_argnames=("vocab_size", "interpret"))
+def tf_df_pallas(token_ids: jax.Array, lengths: jax.Array, *,
+                 vocab_size: int, interpret: bool = False
+                 ) -> Tuple[jax.Array, jax.Array]:
+    """Fused TF histogram + DF via the Pallas kernel.
+
+    Drop-in equivalent of ``tf_counts`` + ``df_from_counts`` (tests pin
+    exact equality). Pads D/L/V up to tile multiples and slices back.
+    ``interpret=True`` runs the kernel in interpreter mode (CPU tests).
+    """
+    d, length = token_ids.shape
+    dp, lp, vp = _pad_to(d, TILE_D), _pad_to(length, CHUNK_L), _pad_to(
+        vocab_size, TILE_V)
+    toks = jnp.zeros((dp, lp), jnp.int32).at[:d, :length].set(token_ids)
+    lens = jnp.zeros((dp, 1), jnp.int32).at[:d, 0].set(lengths)
+
+    counts, df = pl.pallas_call(
+        _hist_kernel,
+        grid=(vp // TILE_V, dp // TILE_D),  # docs minor: see _hist_kernel
+        in_specs=[
+            pl.BlockSpec((TILE_D, lp), lambda j, i: (i, 0)),
+            pl.BlockSpec((TILE_D, 1), lambda j, i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((TILE_D, TILE_V), lambda j, i: (i, j)),
+            pl.BlockSpec((1, TILE_V), lambda j, i: (0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((dp, vp), jnp.int32),
+            jax.ShapeDtypeStruct((1, vp), jnp.int32),
+        ],
+        interpret=interpret,
+    )(toks, lens)
+    return counts[:d, :vocab_size], df[0, :vocab_size]
+
+
+def default_interpret() -> bool:
+    """Interpret mode unless we are actually on TPU hardware."""
+    return jax.default_backend() != "tpu"

@@ -49,15 +49,16 @@ def sorted_term_counts(token_ids: jax.Array, lengths: jax.Array
     valid = pos < lengths[:, None]
     sentinel = jnp.iinfo(jnp.int32).max
     sorted_ids = jnp.sort(jnp.where(valid, token_ids, sentinel), axis=1)
-    still_valid = pos < lengths[:, None]  # sorted validity: first `len` slots
+    # Post-sort validity is the same mask: sentinels sort to the tail, so
+    # the first lengths[d] slots are exactly the live ones.
     prev = jnp.concatenate(
         [jnp.full((d, 1), -1, sorted_ids.dtype), sorted_ids[:, :-1]], axis=1)
-    head = still_valid & (sorted_ids != prev)
+    head = valid & (sorted_ids != prev)
     # Run-length via segment ids: run[d, i] = index of the run slot i is in.
     run = jnp.cumsum(head.astype(jnp.int32), axis=1) - 1  # -1 before 1st head
     run_safe = jnp.clip(run, 0, length - 1)
     run_sizes = jnp.zeros((d, length), jnp.int32).at[
-        jnp.arange(d)[:, None], run_safe].add(still_valid.astype(jnp.int32))
+        jnp.arange(d)[:, None], run_safe].add(valid.astype(jnp.int32))
     counts = jnp.take_along_axis(run_sizes, run_safe, axis=1)
     return sorted_ids, counts, head
 

@@ -74,7 +74,8 @@ class PipelineResult:
 
 
 def _forward(token_ids, lengths, num_docs, *, vocab_size: int, chunk: int,
-             score_dtype, topk: Optional[int]):
+             score_dtype, topk: Optional[int], use_pallas: bool = False,
+             pallas_interpret: bool = False):
     """The jitted compute: tokens -> (counts, df, scores | topk).
 
     Replaces reference phases 1-3 (``TFIDF.c:130-246``) and the
@@ -83,13 +84,22 @@ def _forward(token_ids, lengths, num_docs, *, vocab_size: int, chunk: int,
     is set the dense [D, V] score matrix never leaves the device — only
     the [D, K] selection does (the scalable replacement for the
     reference's full gather, ``TFIDF.c:256-270``).
+
+    ``use_pallas`` swaps the XLA scatter-add histogram for the Pallas
+    compare-and-reduce kernel (``ops.pallas_kernels``), which also fuses
+    the DF pass.
     """
     length = token_ids.shape[1]
-    if length > chunk:
+    if use_pallas:
+        from tfidf_tpu.ops.pallas_kernels import tf_df_pallas
+        counts, df = tf_df_pallas(token_ids, lengths, vocab_size=vocab_size,
+                                  interpret=pallas_interpret)
+    elif length > chunk:
         counts = tf_counts_chunked(token_ids, lengths, vocab_size, chunk)
+        df = df_from_counts(counts)
     else:
         counts = tf_counts(token_ids, lengths, vocab_size)
-    df = df_from_counts(counts)
+        df = df_from_counts(counts)
     scores = tfidf_dense(counts, lengths, df, num_docs, score_dtype)
     if topk is not None:
         tv, ti = topk_per_doc(scores, min(topk, vocab_size))
@@ -101,7 +111,8 @@ def _forward(token_ids, lengths, num_docs, *, vocab_size: int, chunk: int,
 # same shapes/config hit XLA's compilation cache instead of re-tracing.
 _forward_jit = jax.jit(
     _forward,
-    static_argnames=("vocab_size", "chunk", "score_dtype", "topk"),
+    static_argnames=("vocab_size", "chunk", "score_dtype", "topk",
+                     "use_pallas", "pallas_interpret"),
 )
 
 
@@ -158,9 +169,6 @@ class TfidfPipeline:
 
     def _check_config(self) -> None:
         cfg = self.config
-        if cfg.use_pallas:
-            raise NotImplementedError(
-                "use_pallas: Pallas histogram kernel not wired up yet")
         if cfg.mesh_shape:
             raise NotImplementedError(
                 "mesh_shape on TfidfPipeline: use tfidf_tpu.parallel for "
@@ -171,11 +179,17 @@ class TfidfPipeline:
         self._check_config()
         if cfg.engine == "sparse":
             return self._run_sparse(batch)
+        if cfg.use_pallas:
+            from tfidf_tpu.ops.pallas_kernels import default_interpret
+            interpret = default_interpret()
+        else:
+            interpret = False
         out = _forward_jit(
             jnp.asarray(batch.token_ids), jnp.asarray(batch.lengths),
             jnp.int32(batch.num_docs), vocab_size=batch.vocab_size,
             chunk=cfg.doc_chunk, score_dtype=jnp.dtype(cfg.score_dtype),
-            topk=cfg.topk)
+            topk=cfg.topk, use_pallas=cfg.use_pallas,
+            pallas_interpret=interpret)
         # topk mode: neither counts nor scores cross the host boundary —
         # only DF [V] and the [D, K] selection do.
         result = PipelineResult(

@@ -32,7 +32,7 @@ import numpy as np
 from tfidf_tpu.config import PipelineConfig, VocabMode
 from tfidf_tpu.io.corpus import Corpus, PackedBatch, pack_corpus
 from tfidf_tpu.ops.histogram import df_from_counts, tf_counts
-from tfidf_tpu.ops.scoring import idf_from_df
+from tfidf_tpu.ops.scoring import tfidf_dense
 from tfidf_tpu.parallel.mesh import MeshPlan
 
 
@@ -43,13 +43,12 @@ def _update_df(df_state, token_ids, lengths, *, vocab_size: int):
     return df_state + df_from_counts(counts)
 
 
-@functools.partial(jax.jit, static_argnames=("vocab_size", "topk"))
+@functools.partial(jax.jit,
+                   static_argnames=("vocab_size", "topk", "score_dtype"))
 def _score_batch(df_state, num_docs, token_ids, lengths, *,
-                 vocab_size: int, topk: Optional[int]):
+                 vocab_size: int, topk: Optional[int], score_dtype):
     counts = tf_counts(token_ids, lengths, vocab_size)
-    idf = idf_from_df(df_state, num_docs)
-    lens = jnp.maximum(lengths, 1).astype(jnp.float32)
-    scores = counts.astype(jnp.float32) / lens[:, None] * idf[None, :]
+    scores = tfidf_dense(counts, lengths, df_state, num_docs, score_dtype)
     if topk is None:
         return scores
     return jax.lax.top_k(scores, min(topk, vocab_size))
@@ -123,4 +122,5 @@ class StreamingTfidf:
         """Score a minibatch against the current DF snapshot."""
         toks, lens = self._place(batch)
         return _score_batch(self._df, jnp.int32(self._docs_seen), toks, lens,
-                            vocab_size=self._vocab, topk=self.config.topk)
+                            vocab_size=self._vocab, topk=self.config.topk,
+                            score_dtype=jnp.dtype(self.config.score_dtype))
