@@ -1,0 +1,93 @@
+"""Vectorizer estimator and observability-utils tests."""
+
+import math
+import time
+
+import numpy as np
+import pytest
+
+from tfidf_tpu import PipelineConfig, TfidfPipeline
+from tfidf_tpu.config import VocabMode
+from tfidf_tpu.io.corpus import Corpus
+from tfidf_tpu.models import TfidfVectorizer
+from tfidf_tpu.utils import PhaseTimer, Throughput, trace_region
+
+CFG = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=256,
+                     max_doc_len=8, doc_chunk=8)
+CORPUS = Corpus(names=["doc1", "doc2", "doc3", "doc4"],
+                docs=[b"a b c", b"a a d", b"b d e", b"a c"])
+
+
+class TestVectorizer:
+    def test_fit_transform_matches_pipeline(self):
+        vec = TfidfVectorizer(CFG, batch_docs=2)
+        scores = vec.fit_transform(CORPUS)
+        want = TfidfPipeline(CFG).run(CORPUS).scores
+        np.testing.assert_allclose(scores, want, rtol=1e-6)
+
+    def test_transform_out_of_corpus_uses_fitted_idf(self):
+        vec = TfidfVectorizer(CFG).fit(CORPUS)
+        new = Corpus(names=["x1"], docs=[b"a a b"])
+        scores = vec.transform(new)
+        # manual: tf(a)=2/3, idf(a)=ln(4/3) from the FITTED corpus
+        from tfidf_tpu.ops.hashing import words_to_ids
+        ida, idb = words_to_ids([b"a", b"b"], 256)
+        assert scores[0, ida] == pytest.approx((2 / 3) * math.log(4 / 3), rel=1e-5)
+        assert scores[0, idb] == pytest.approx((1 / 3) * math.log(4 / 2), rel=1e-5)
+
+    def test_idf_property(self):
+        vec = TfidfVectorizer(CFG).fit(CORPUS)
+        idf = vec.idf_
+        from tfidf_tpu.ops.hashing import words_to_ids
+        ide = words_to_ids([b"e"], 256)[0]
+        assert idf[ide] == pytest.approx(math.log(4 / 1))
+
+    def test_refit_replaces_state_partial_fit_accumulates(self):
+        a = Corpus(names=["doc1"], docs=[b"a b"])
+        b = Corpus(names=["doc2"], docs=[b"c d"])
+        vec = TfidfVectorizer(CFG).fit(a)
+        vec.fit(b)  # sklearn semantics: REPLACES
+        assert vec.num_docs_ == 1
+        vec2 = TfidfVectorizer(CFG).fit(a).partial_fit(b)  # accumulates
+        assert vec2.num_docs_ == 2
+        assert (vec2.df_ >= vec.df_).all()
+
+    def test_unfitted_transform_raises(self):
+        with pytest.raises(RuntimeError):
+            TfidfVectorizer(CFG).transform(CORPUS)
+
+    def test_state_roundtrip(self):
+        a = TfidfVectorizer(CFG).fit(CORPUS)
+        b = TfidfVectorizer(CFG).load_state(a.state_dict())
+        np.testing.assert_allclose(a.transform(CORPUS), b.transform(CORPUS))
+
+    def test_exact_vocab_rejected(self):
+        with pytest.raises(ValueError):
+            TfidfVectorizer(PipelineConfig(vocab_mode=VocabMode.EXACT))
+
+
+class TestUtils:
+    def test_phase_timer_accumulates(self):
+        t = PhaseTimer()
+        with t.phase("a"):
+            time.sleep(0.01)
+        with t.phase("a"):
+            time.sleep(0.01)
+        with t.phase("b"):
+            pass
+        assert t.seconds("a") >= 0.02
+        assert [n for n, _ in t.items()] == ["a", "b"]
+        assert "a" in t.report() and "%" in t.report()
+
+    def test_throughput(self):
+        tp = Throughput()
+        with tp.measure(100):
+            time.sleep(0.01)
+        assert tp.docs == 100
+        assert 0 < tp.docs_per_sec <= 100 / 0.01
+
+    def test_trace_region_noop_and_enabled(self):
+        with trace_region("x", enabled=False):
+            pass
+        with trace_region("x", enabled=True):
+            pass  # must not raise with jax importable

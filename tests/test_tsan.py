@@ -1,0 +1,38 @@
+"""Race detection for the native thread-comm runtime.
+
+The reference's hybrid (OpenMP) variant has real data races on its
+shared index counters and scratch buffers (SURVEY §2.5-8). Our thread
+backend replaces that with barrier-fenced mailbox collectives — this
+test builds the ThreadSanitizer binary and runs a multi-rank job under
+TSAN, failing on any reported race.
+"""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                          "native")
+TSAN_BIN = os.path.join(NATIVE_DIR, "tfidf_ref_tsan")
+
+
+@pytest.mark.skipif(shutil.which("g++") is None or shutil.which("make") is None,
+                    reason="needs g++ and make")
+def test_thread_backend_race_free(toy_corpus_dir, tmp_path):
+    build = subprocess.run(["make", "-C", NATIVE_DIR, "tfidf_ref_tsan"],
+                           capture_output=True)
+    if build.returncode != 0:
+        pytest.skip(f"tsan build unavailable: {build.stderr.decode()[-200:]}")
+    out = tmp_path / "out.txt"
+    proc = subprocess.run(
+        [TSAN_BIN, toy_corpus_dir, str(out), "6"],
+        capture_output=True,
+        env={**os.environ, "TSAN_OPTIONS": "halt_on_error=1 exitcode=66"})
+    assert proc.returncode != 66, f"TSAN race:\n{proc.stderr.decode()[-2000:]}"
+    assert proc.returncode == 0, proc.stderr.decode()[-500:]
+    # and the TSAN build still produces correct bytes
+    from tfidf_tpu import discover_corpus
+    from tfidf_tpu.golden import golden_output
+    assert out.read_bytes() == golden_output(discover_corpus(toy_corpus_dir))

@@ -1,0 +1,12 @@
+"""Model-level APIs built on the pipeline: the TF-IDF vectorizer.
+
+The reference's "model" is the TF-IDF statistic itself (SURVEY §1:
+"no model layer"). This package gives it the standard estimator shape —
+fit (learn DF over a corpus), transform (score documents against it) —
+so the framework slots into feature-extraction workflows, not just the
+batch job the reference hardcodes.
+"""
+
+from tfidf_tpu.models.vectorizer import TfidfVectorizer
+
+__all__ = ["TfidfVectorizer"]

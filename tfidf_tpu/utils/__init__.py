@@ -1,0 +1,12 @@
+"""Observability utilities: phase timing, throughput, profiler hooks.
+
+The reference has no tracing/metrics at all — stage ``printf``s were its
+only observability (SURVEY §5, ``TFIDF.c:200,237``). Here every pipeline
+phase can be timed (:class:`PhaseTimer`), throughput is first-class
+(docs/sec — it IS the north-star metric), and ``jax.profiler`` traces
+can wrap any region for TPU timeline inspection.
+"""
+
+from tfidf_tpu.utils.timing import PhaseTimer, Throughput, trace_region
+
+__all__ = ["PhaseTimer", "Throughput", "trace_region"]

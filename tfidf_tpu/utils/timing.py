@@ -1,0 +1,91 @@
+"""Phase wall-clock timing, throughput counters, and profiler regions."""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+class PhaseTimer:
+    """Accumulates wall-clock per named phase.
+
+    The reference's phases are implicit between ``MPI_Barrier``s with no
+    timing (SURVEY §6: no timing calls anywhere). Usage::
+
+        timer = PhaseTimer()
+        with timer.phase("pack"):
+            batch = pipe.pack(corpus)
+        with timer.phase("device"):
+            result = pipe.run_packed(batch)
+        print(timer.report())
+    """
+
+    def __init__(self) -> None:
+        self._acc: Dict[str, float] = {}
+        self._order: List[str] = []
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            if name not in self._acc:
+                self._order.append(name)
+                self._acc[name] = 0.0
+            self._acc[name] += dt
+
+    def seconds(self, name: str) -> float:
+        return self._acc.get(name, 0.0)
+
+    def items(self) -> List[Tuple[str, float]]:
+        return [(n, self._acc[n]) for n in self._order]
+
+    def report(self) -> str:
+        total = sum(self._acc.values()) or 1.0
+        rows = [f"{n:>12}: {s * 1e3:9.1f} ms ({100 * s / total:4.1f}%)"
+                for n, s in self.items()]
+        return "\n".join(rows)
+
+
+class Throughput:
+    """docs/sec counter — the north-star metric (BASELINE.json)."""
+
+    def __init__(self) -> None:
+        self._docs = 0
+        self._seconds = 0.0
+
+    @contextlib.contextmanager
+    def measure(self, num_docs: int) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._seconds += time.perf_counter() - t0
+            self._docs += num_docs
+
+    @property
+    def docs_per_sec(self) -> float:
+        return self._docs / self._seconds if self._seconds else 0.0
+
+    @property
+    def docs(self) -> int:
+        return self._docs
+
+
+@contextlib.contextmanager
+def trace_region(name: str, enabled: bool = True) -> Iterator[None]:
+    """jax.profiler TraceAnnotation wrapper (no-op when disabled).
+
+    Regions named here show up on the TPU timeline in a
+    ``jax.profiler.trace`` capture — the replacement for the reference's
+    debug printf stage markers (``TFIDF.c:200,237``).
+    """
+    if not enabled:
+        yield
+        return
+    import jax.profiler
+    with jax.profiler.TraceAnnotation(name):
+        yield
