@@ -54,7 +54,12 @@ def _hist_kernel(tokens_ref, len_ref, counts_ref, df_ref):
         pos = c * CHUNK_L + jax.lax.broadcasted_iota(
             jnp.int32, (1, CHUNK_L), 1)
         valid = pos < lens                     # [TILE_D, CHUNK_L]
-        eq = (toks_c[:, :, None] == vids) & valid[:, :, None]
+        # Mask via a 2D where (padding slots -> -1, matching no vocab id)
+        # BEFORE the 3D broadcast: Mosaic only supports minor-dim
+        # insertion on 32-bit types, so the i1 `valid` must not grow a
+        # trailing dim.
+        toks_c = jnp.where(valid, toks_c, -1)
+        eq = toks_c[:, :, None] == vids
         return acc + jnp.sum(eq.astype(jnp.int32), axis=1)
 
     counts = jax.lax.fori_loop(0, length // CHUNK_L, body,
