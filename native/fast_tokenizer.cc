@@ -15,21 +15,9 @@
 #include <cstdint>
 #include <cstddef>
 
-namespace {
+#include "tokenize_common.h"
 
-constexpr uint64_t kFnvOffset = 14695981039346656037ull;
-constexpr uint64_t kFnvPrime = 1099511628211ull;
-
-// Fixed ASCII whitespace set — the C-locale isspace set and exactly what
-// Python bytes.split() uses. Deliberately NOT std::isspace, which is
-// locale-dependent (CPython calls setlocale at startup, so the host
-// locale could silently change token boundaries vs the Python path).
-inline bool IsSpace(uint8_t c) {
-  return c == ' ' || c == '\t' || c == '\n' || c == '\v' || c == '\f' ||
-         c == '\r';
-}
-
-}  // namespace
+using tfidf::IsSpace;
 
 extern "C" {
 
@@ -51,20 +39,8 @@ int64_t tok_count(const uint8_t* data, int64_t len) {
 int64_t tok_hash_ids(const uint8_t* data, int64_t len, uint64_t seed,
                      int64_t vocab_size, int64_t truncate_at,
                      int32_t* out_ids, int64_t max_out) {
-  int64_t n = 0, i = 0;
-  while (i < len && n < max_out) {
-    while (i < len && IsSpace(data[i])) ++i;
-    int64_t start = i;
-    while (i < len && !IsSpace(data[i])) ++i;
-    if (i == start) break;
-    int64_t end = i;
-    if (truncate_at > 0 && end - start > truncate_at) end = start + truncate_at;
-    uint64_t h = kFnvOffset ^ seed;
-    for (int64_t j = start; j < end; ++j) h = (h ^ data[j]) * kFnvPrime;
-    h ^= h >> 32;
-    out_ids[n++] = (int32_t)(h % (uint64_t)vocab_size);
-  }
-  return n;
+  return tfidf::TokenizeHashInto(data, len, seed, vocab_size, truncate_at,
+                                 out_ids, max_out);
 }
 
 // Token span extraction for EXACT-vocab mode: writes (offset, length)

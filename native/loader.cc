@@ -1,0 +1,175 @@
+// loader — native parallel corpus loader (read + tokenize + hash + pack).
+//
+// The reference streams each document token-at-a-time through fscanf on
+// one MPI rank (TFIDF.c:134-147, two passes per file: docSize count then
+// re-scan). This loader is the framework's host data-loader equivalent,
+// built for feeding a TPU: a std::thread pool with an atomic work queue
+// reads doc files into an in-memory arena, counts tokens (pass 1), then
+// tokenizes+FNV-hashes straight into the caller's padded [D, L] int32
+// batch (pass 2) — the same two-pass shape as the reference, but
+// per-file work-stolen across threads and with zero Python in the loop.
+//
+// C ABI (ctypes from tfidf_tpu/io/fast_tokenizer.py):
+//   loader_open(paths, n_docs, n_threads) -> handle   (reads + counts)
+//   loader_token_count(h, i) / loader_max_count(h) / loader_error(h)
+//   loader_fill(h, seed, vocab, trunc, ids, stride, lengths, n_threads)
+//   loader_close(h)
+//
+// Tokenize/hash semantics are contract-identical to fast_tokenizer.cc
+// (fixed ASCII isspace, FNV-1a64 ^ seed, xor-fold, % vocab) — pinned by
+// tests/test_native.py against the Python path.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "tokenize_common.h"
+
+namespace {
+
+using tfidf::IsSpace;
+
+struct Loader {
+  std::vector<std::string> paths;
+  std::vector<std::string> docs;     // file contents (arena)
+  std::vector<int64_t> counts;       // tokens per doc
+  std::atomic<int64_t> failed{-1};   // first doc index that failed to read
+};
+
+int64_t CountTokens(const uint8_t* data, int64_t len) {
+  int64_t n = 0, i = 0;
+  while (i < len) {
+    while (i < len && IsSpace(data[i])) ++i;
+    if (i < len) ++n;
+    while (i < len && !IsSpace(data[i])) ++i;
+  }
+  return n;
+}
+
+bool ReadFile(const std::string& path, std::string* out) {
+  FILE* f = std::fopen(path.c_str(), "rb");
+  if (!f) return false;
+  std::fseek(f, 0, SEEK_END);
+  long sz = std::ftell(f);
+  if (sz < 0) { std::fclose(f); return false; }
+  std::fseek(f, 0, SEEK_SET);
+  out->resize((size_t)sz);
+  size_t got = sz ? std::fread(&(*out)[0], 1, (size_t)sz, f) : 0;
+  std::fclose(f);
+  return got == (size_t)sz;
+}
+
+// Work-stealing parallel-for over [0, n): threads pop the next index
+// from a shared atomic — dynamic scheduling, so a few huge documents
+// don't stall a static stripe (the reference's static round-robin
+// schedule, TFIDF.c:130, has exactly that imbalance failure mode).
+template <typename Fn>
+void ParallelFor(int64_t n, int n_threads, Fn fn) {
+  if (n_threads <= 1 || n <= 1) {
+    for (int64_t i = 0; i < n; ++i) fn(i);
+    return;
+  }
+  std::atomic<int64_t> next{0};
+  auto worker = [&] {
+    for (;;) {
+      int64_t i = next.fetch_add(1, std::memory_order_relaxed);
+      if (i >= n) return;
+      fn(i);
+    }
+  };
+  std::vector<std::thread> pool;
+  int spawn = (int)std::min<int64_t>(n_threads, n) - 1;
+  pool.reserve(spawn);
+  for (int t = 0; t < spawn; ++t) pool.emplace_back(worker);
+  worker();
+  for (auto& th : pool) th.join();
+}
+
+// Tokenize+hash every loaded doc into the caller's padded [D, stride]
+// batch of T-typed ids (shared contract: tokenize_common.h).
+template <typename T>
+void FillImpl(Loader* L, uint64_t seed, int64_t vocab_size,
+              int64_t truncate_at, T* out_ids, int64_t stride,
+              int32_t* out_lengths, int n_threads) {
+  ParallelFor((int64_t)L->docs.size(), n_threads, [=](int64_t d) {
+    int64_t n = tfidf::TokenizeHashInto(
+        reinterpret_cast<const uint8_t*>(L->docs[d].data()),
+        (int64_t)L->docs[d].size(), seed, vocab_size, truncate_at,
+        out_ids + d * stride, stride);
+    out_lengths[d] = (int32_t)n;
+  });
+}
+
+}  // namespace
+
+extern "C" {
+
+// paths: n_docs NUL-terminated strings, back to back. Reads every file
+// and counts its tokens in parallel. Returns a handle (never null);
+// check loader_error() before trusting the data.
+void* loader_open(const char* paths, int64_t n_docs, int n_threads) {
+  Loader* L = new Loader;
+  L->paths.reserve(n_docs);
+  const char* p = paths;
+  for (int64_t i = 0; i < n_docs; ++i) {
+    L->paths.emplace_back(p);
+    p += L->paths.back().size() + 1;
+  }
+  L->docs.resize(n_docs);
+  L->counts.assign(n_docs, 0);
+  ParallelFor(n_docs, n_threads, [L](int64_t i) {
+    if (!ReadFile(L->paths[i], &L->docs[i])) {
+      int64_t expect = -1;
+      L->failed.compare_exchange_strong(expect, i);
+      return;
+    }
+    L->counts[i] = CountTokens(
+        reinterpret_cast<const uint8_t*>(L->docs[i].data()),
+        (int64_t)L->docs[i].size());
+  });
+  return L;
+}
+
+// Index of the first unreadable file, or -1. (The reference hard-exits
+// on open failure, TFIDF.c:137; Python raises FileNotFoundError.)
+int64_t loader_error(void* handle) {
+  return static_cast<Loader*>(handle)->failed.load();
+}
+
+int64_t loader_token_count(void* handle, int64_t doc) {
+  return static_cast<Loader*>(handle)->counts[doc];
+}
+
+int64_t loader_max_count(void* handle) {
+  Loader* L = static_cast<Loader*>(handle);
+  int64_t m = 0;
+  for (int64_t c : L->counts) m = c > m ? c : m;
+  return m;
+}
+
+// Tokenize+hash every doc into out_ids (row i at out_ids + i*stride;
+// caller zero-fills for padding) and out_lengths. stride must be >=
+// loader_max_count(); rows past n_docs are untouched (mesh padding).
+void loader_fill(void* handle, uint64_t seed, int64_t vocab_size,
+                 int64_t truncate_at, int32_t* out_ids, int64_t stride,
+                 int32_t* out_lengths, int n_threads) {
+  FillImpl(static_cast<Loader*>(handle), seed, vocab_size, truncate_at,
+           out_ids, stride, out_lengths, n_threads);
+}
+
+// uint16 variant for vocab_size <= 65536: same ids, half the bytes on
+// the host->device wire (the batch upcasts to int32 on device for free).
+void loader_fill_u16(void* handle, uint64_t seed, int64_t vocab_size,
+                     int64_t truncate_at, uint16_t* out_ids, int64_t stride,
+                     int32_t* out_lengths, int n_threads) {
+  FillImpl(static_cast<Loader*>(handle), seed, vocab_size, truncate_at,
+           out_ids, stride, out_lengths, n_threads);
+}
+
+void loader_close(void* handle) { delete static_cast<Loader*>(handle); }
+
+}  // extern "C"

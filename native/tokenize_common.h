@@ -1,0 +1,55 @@
+// Shared tokenize+hash contract for the native runtime.
+//
+// Single source of truth for whitespace semantics and the FNV-1a64 ->
+// xor-fold -> mod-vocab id function, used by fast_tokenizer.cc (per-doc
+// ctypes kernels) and loader.cc (parallel corpus loader). The Python
+// path (tfidf_tpu/ops/tokenize.py + hashing.py) is contract-identical;
+// tests/test_native.py pins all of them against each other.
+
+#ifndef TFIDF_NATIVE_TOKENIZE_COMMON_H_
+#define TFIDF_NATIVE_TOKENIZE_COMMON_H_
+
+#include <cstdint>
+
+namespace tfidf {
+
+constexpr uint64_t kFnvOffset = 14695981039346656037ull;
+constexpr uint64_t kFnvPrime = 1099511628211ull;
+
+// Fixed ASCII whitespace set — the C-locale isspace set and exactly what
+// Python bytes.split() uses. Deliberately NOT std::isspace, which is
+// locale-dependent (CPython calls setlocale at startup, so the host
+// locale could silently change token boundaries vs the Python path).
+inline bool IsSpace(uint8_t c) {
+  return c == ' ' || c == '\t' || c == '\n' || c == '\v' || c == '\f' ||
+         c == '\r';
+}
+
+// Tokenize data[0..len), hash each token (truncated to truncate_at bytes
+// when truncate_at > 0) and write ids of integral type T into out
+// (capacity max_out; excess tokens are dropped). Returns tokens written.
+template <typename T>
+inline int64_t TokenizeHashInto(const uint8_t* data, int64_t len,
+                                uint64_t seed, int64_t vocab_size,
+                                int64_t truncate_at, T* out,
+                                int64_t max_out) {
+  int64_t n = 0, i = 0;
+  while (i < len && n < max_out) {
+    while (i < len && IsSpace(data[i])) ++i;
+    int64_t start = i;
+    while (i < len && !IsSpace(data[i])) ++i;
+    if (i == start) break;
+    int64_t end = i;
+    if (truncate_at > 0 && end - start > truncate_at)
+      end = start + truncate_at;
+    uint64_t h = kFnvOffset ^ seed;
+    for (int64_t j = start; j < end; ++j) h = (h ^ data[j]) * kFnvPrime;
+    h ^= h >> 32;
+    out[n++] = (T)(h % (uint64_t)vocab_size);
+  }
+  return n;
+}
+
+}  // namespace tfidf
+
+#endif  // TFIDF_NATIVE_TOKENIZE_COMMON_H_

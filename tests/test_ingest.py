@@ -1,0 +1,80 @@
+"""Overlapped chunked ingest (tfidf_tpu/ingest.py) vs the single-batch
+pipeline: same DF, same top-k scores, on both the native and Python
+pack paths."""
+
+import os
+
+import numpy as np
+import pytest
+
+from tfidf_tpu import PipelineConfig, discover_corpus
+from tfidf_tpu.config import VocabMode
+from tfidf_tpu.ingest import run_overlapped
+from tfidf_tpu.io.corpus import pack_corpus
+from tfidf_tpu.pipeline import TfidfPipeline
+
+
+@pytest.fixture
+def corpus_dir(tmp_path):
+    rng = np.random.default_rng(11)
+    for i in range(1, 41):
+        words = [f"w{rng.integers(0, 60)}" for _ in range(int(rng.integers(1, 40)))]
+        (tmp_path / f"doc{i}").write_text(" ".join(words))
+    return str(tmp_path)
+
+
+def _cfg(**kw):
+    base = dict(vocab_mode=VocabMode.HASHED, vocab_size=1 << 10,
+                max_doc_len=64, doc_chunk=64, topk=5, engine="sparse")
+    base.update(kw)
+    return PipelineConfig(**base)
+
+
+class TestOverlappedIngest:
+    def test_matches_single_batch(self, corpus_dir):
+        cfg = _cfg()
+        ref = TfidfPipeline(cfg).run_packed(
+            pack_corpus(discover_corpus(corpus_dir), cfg, want_words=False))
+        got = run_overlapped(corpus_dir, cfg, chunk_docs=16, doc_len=64)
+        assert got.num_docs == 40
+        assert (got.df == ref.df).all()
+        np.testing.assert_allclose(got.topk_vals, ref.topk_vals, rtol=1e-6)
+        assert (got.lengths == ref.lengths[:40]).all()
+
+    def test_single_chunk_covers_all(self, corpus_dir):
+        cfg = _cfg()
+        a = run_overlapped(corpus_dir, cfg, chunk_docs=64, doc_len=64)
+        b = run_overlapped(corpus_dir, cfg, chunk_docs=7, doc_len=64)
+        assert (a.df == b.df).all()
+        np.testing.assert_allclose(a.topk_vals, b.topk_vals, rtol=1e-6)
+
+    def test_python_fallback_matches_native(self, corpus_dir):
+        import tfidf_tpu.io.fast_tokenizer as ft
+
+        if not ft.loader_available():
+            pytest.skip("native loader not built")  # else both runs = python
+        cfg = _cfg()
+        native = run_overlapped(corpus_dir, cfg, chunk_docs=16, doc_len=64)
+        os.environ["TFIDF_TPU_NO_NATIVE"] = "1"
+        try:
+            ft._load_failed, ft._lib, ft._has_loader = False, None, False
+            python = run_overlapped(corpus_dir, cfg, chunk_docs=16,
+                                    doc_len=64)
+        finally:
+            del os.environ["TFIDF_TPU_NO_NATIVE"]
+            ft._load_failed, ft._lib, ft._has_loader = False, None, False
+        assert (native.df == python.df).all()
+        np.testing.assert_allclose(native.topk_vals, python.topk_vals,
+                                   rtol=1e-6)
+
+    def test_truncation_is_explicit(self, tmp_path):
+        (tmp_path / "doc1").write_text(" ".join(["a"] * 100))
+        cfg = _cfg(topk=1)
+        got = run_overlapped(str(tmp_path), cfg, chunk_docs=4, doc_len=16)
+        assert got.lengths[0] == 16  # truncated to the static L
+
+    def test_requires_hashed_and_topk(self, corpus_dir):
+        with pytest.raises(ValueError):
+            run_overlapped(corpus_dir, _cfg(vocab_mode=VocabMode.EXACT))
+        with pytest.raises(ValueError):
+            run_overlapped(corpus_dir, _cfg(topk=None))

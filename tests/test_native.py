@@ -122,3 +122,62 @@ class TestFastTokenizer:
             ft._lib = None
         assert (fast.token_ids == slow.token_ids).all()
         assert (fast.lengths == slow.lengths).all()
+
+
+class TestParallelLoader:
+    """native/loader.cc: thread-pool read+tokenize+hash+pack."""
+
+    def _cfg(self):
+        from tfidf_tpu import PipelineConfig
+        from tfidf_tpu.config import VocabMode
+        return PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=1 << 12,
+                              max_doc_len=8, doc_chunk=8)
+
+    def test_matches_python_pack(self, toy_corpus_dir):
+        from tfidf_tpu import discover_corpus
+        from tfidf_tpu.io.corpus import load_and_pack, pack_corpus
+        from tfidf_tpu.io.fast_tokenizer import loader_available
+
+        if not loader_available():
+            pytest.skip("native loader not built")
+        cfg = self._cfg()
+        a = load_and_pack(toy_corpus_dir, cfg)
+        b = pack_corpus(discover_corpus(toy_corpus_dir), cfg,
+                        want_words=False)
+        assert a.token_ids.shape == b.token_ids.shape
+        assert (a.token_ids == b.token_ids).all()
+        assert (a.lengths == b.lengths).all()
+        assert a.names == b.names and a.num_docs == b.num_docs
+
+    def test_mesh_padding(self, toy_corpus_dir):
+        from tfidf_tpu.io.corpus import load_and_pack
+        from tfidf_tpu.io.fast_tokenizer import loader_available
+
+        if not loader_available():
+            pytest.skip("native loader not built")
+        batch = load_and_pack(toy_corpus_dir, self._cfg(), pad_docs_to=16)
+        assert batch.token_ids.shape[0] == 16
+        assert (batch.lengths[batch.num_docs:] == 0).all()
+        assert batch.names[-1] == ""
+
+    def test_missing_doc_raises(self, tmp_path):
+        from tfidf_tpu.io.corpus import load_and_pack
+        from tfidf_tpu.io.fast_tokenizer import loader_available
+
+        if not loader_available():
+            pytest.skip("native loader not built")
+        (tmp_path / "doc1").write_text("a b c")
+        (tmp_path / "doc3").write_text("d")  # strict names doc1,doc2 -> doc2 missing
+        with pytest.raises(FileNotFoundError):
+            load_and_pack(str(tmp_path), self._cfg())
+
+    def test_fallback_configs_use_python_path(self, toy_corpus_dir):
+        from tfidf_tpu import PipelineConfig, discover_corpus
+        from tfidf_tpu.config import VocabMode
+        from tfidf_tpu.io.corpus import load_and_pack, pack_corpus
+
+        cfg = PipelineConfig(vocab_mode=VocabMode.EXACT)
+        a = load_and_pack(toy_corpus_dir, cfg)
+        b = pack_corpus(discover_corpus(toy_corpus_dir), cfg,
+                        want_words=False)
+        assert (a.token_ids == b.token_ids).all()

@@ -131,3 +131,31 @@ class TestTopK:
         assert gd.tolist() == [0, 1] and gi.tolist() == [1, 0]
         tv, ti = topk_terms(s, 1)
         assert ti.tolist() == [1] or ti.tolist() == [0]
+
+
+class TestUint16WireFormat:
+    """uint16-packed batches (native loader, vocab <= 2^16) must behave
+    identically to int32 through every histogram/sparse entry point —
+    in particular at vocab_size == 65536, where the padding sentinel V
+    is unrepresentable in uint16 unless ops upcast first."""
+
+    def test_tf_counts_sentinel_at_full_uint16_vocab(self):
+        from tfidf_tpu.ops.histogram import tf_counts
+
+        v = 1 << 16
+        toks = jnp.asarray(np.array([[1, 2, 7, 7]], np.uint16))
+        lens = jnp.asarray(np.array([2], np.int32))
+        counts = tf_counts(toks, lens, v)
+        assert int(counts.sum()) == 2  # padding really dropped
+        assert int(counts[0, 1]) == 1 and int(counts[0, 2]) == 1
+
+    def test_sparse_matches_int32(self):
+        from tfidf_tpu.ops.sparse import sorted_term_counts
+
+        rng = np.random.default_rng(5)
+        t32 = rng.integers(0, 1 << 16, (4, 16)).astype(np.int32)
+        lens = jnp.asarray(rng.integers(0, 17, 4).astype(np.int32))
+        a = sorted_term_counts(jnp.asarray(t32), lens)
+        b = sorted_term_counts(jnp.asarray(t32.astype(np.uint16)), lens)
+        for x, y in zip(a, b):
+            assert (np.asarray(x) == np.asarray(y)).all()

@@ -1,0 +1,160 @@
+"""Overlapped corpus ingest: host packing pipelined against device compute.
+
+The reference interleaves file IO and compute on the same rank, serially
+per document (``TFIDF.c:130-205``) — every byte of IO stalls compute.
+Here ingest is a two-phase chunked pipeline built on JAX's async
+dispatch: the host thread packs chunk ``i+1`` (native parallel loader)
+while the device is still executing chunk ``i``'s program — ``device_put``
+and jitted calls return before the work completes, so the Python loop
+runs ahead of the device and the transfer/compute of one chunk hides the
+host tokenize/hash of the next.
+
+Because DF is corpus-global but chunks stream, the run is two device
+phases (same shape as classic out-of-core TF-IDF, and of the reference's
+own reduce-then-rebroadcast choreography, ``TFIDF.c:215-220``):
+
+  A. per chunk: sort + run-length term triples, partial DF — triples
+     stay resident on device; only the [V] partial DF accumulates.
+  B. per chunk: score the resident triples against the final corpus-wide
+     IDF and select per-doc top-k.
+
+All chunks share one compiled program per phase (static [chunk, L]
+shapes; the last chunk is padded with empty docs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tfidf_tpu.config import PipelineConfig, TokenizerKind, VocabMode
+from tfidf_tpu.io import fast_tokenizer
+from tfidf_tpu.io.corpus import discover_names, pack_corpus
+from tfidf_tpu.ops.scoring import idf_from_df
+from tfidf_tpu.ops.sparse import (sorted_term_counts, sparse_df,
+                                  sparse_scores, sparse_topk)
+
+
+@functools.partial(jax.jit, static_argnames=("vocab_size",))
+def _phase_a(token_ids, lengths, *, vocab_size: int):
+    """Chunk -> (row-sparse triples, partial DF). Triples stay on device."""
+    ids, counts, head = sorted_term_counts(token_ids, lengths)
+    return ids, counts, head, sparse_df(ids, head, vocab_size)
+
+
+@functools.partial(jax.jit, static_argnames=("score_dtype", "topk"))
+def _phase_b(ids, counts, head, lengths, df_total, num_docs, *,
+             score_dtype, topk: int):
+    idf = idf_from_df(df_total, num_docs, score_dtype)
+    scores = sparse_scores(ids, counts, head, lengths, idf)
+    return sparse_topk(scores, ids, head, topk)
+
+
+@dataclasses.dataclass
+class IngestResult:
+    """Corpus-wide outputs of an overlapped ingest run."""
+
+    df: np.ndarray            # [V] corpus document frequencies
+    topk_vals: np.ndarray     # [D, K] per-doc top-k TF-IDF scores
+    topk_ids: np.ndarray      # [D, K] matching vocab ids (-1 = no term)
+    lengths: np.ndarray       # [D] docSize per document
+    names: List[str]
+    num_docs: int
+
+
+def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
+                   chunk_docs: int = 8192, doc_len: Optional[int] = None,
+                   strict: bool = True) -> IngestResult:
+    """Stream a directory through the overlapped two-phase pipeline.
+
+    ``doc_len`` fixes the static token length L for every chunk (defaults
+    to ``config.max_doc_len``); documents longer than L are truncated to
+    L tokens — the fixed-shape tradeoff for never recompiling. Use
+    ``TfidfPipeline`` (single batch, L grows to the longest doc) when
+    truncation is unacceptable, or ``parallel.longdoc`` for documents
+    beyond any single chip.
+
+    Requires HASHED vocab (fixed id space across chunks) and a top-k
+    selection (full per-term output would defeat the resident-triple
+    design). Works with or without the native loader; the native path
+    keeps document bytes out of Python entirely.
+    """
+    cfg = config or PipelineConfig(vocab_mode=VocabMode.HASHED, topk=16)
+    if cfg.vocab_mode is not VocabMode.HASHED:
+        raise ValueError("overlapped ingest requires VocabMode.HASHED")
+    if cfg.topk is None:
+        raise ValueError("overlapped ingest requires a topk selection")
+    length = doc_len or cfg.max_doc_len
+    names = discover_names(input_dir, strict)
+    num_docs = len(names)
+    if num_docs == 0:
+        raise ValueError(f"no documents in {input_dir}")
+
+    use_native = (cfg.tokenizer is TokenizerKind.WHITESPACE
+                  and fast_tokenizer.loader_available())
+    score_dtype = jnp.dtype(cfg.score_dtype)
+    k = min(cfg.topk, length)
+
+    def pack_chunk_native(chunk_names: List[str]
+                          ) -> Tuple[np.ndarray, np.ndarray]:
+        packed = fast_tokenizer.load_pack_paths(
+            [os.path.join(input_dir, n) for n in chunk_names],
+            cfg.vocab_size, cfg.hash_seed, cfg.truncate_tokens_at,
+            min_len=length, chunk=length, fixed_len=length,
+            pad_docs_to=chunk_docs)
+        assert packed is not None  # loader_available() checked above
+        return packed
+
+    def pack_chunk_python(chunk_names: List[str]
+                          ) -> Tuple[np.ndarray, np.ndarray]:
+        from tfidf_tpu.io.corpus import Corpus
+        docs = []
+        for n in chunk_names:
+            with open(os.path.join(input_dir, n), "rb") as f:
+                docs.append(f.read())
+        batch = pack_corpus(Corpus(names=list(chunk_names), docs=docs),
+                            cfg, pad_docs_to=chunk_docs, want_words=False)
+        ids = batch.token_ids[:, :length]
+        if batch.token_ids.shape[1] < length:
+            pad = np.zeros((ids.shape[0], length - ids.shape[1]), ids.dtype)
+            ids = np.concatenate([ids, pad], axis=1)
+        return ids, np.minimum(batch.lengths, length).astype(np.int32)
+
+    pack_chunk = pack_chunk_native if use_native else pack_chunk_python
+
+    # Phase A: launch every chunk; the loop packs chunk i+1 while the
+    # device still runs chunk i (async dispatch — no block in the loop).
+    resident = []
+    df_parts = []
+    all_lengths: List[np.ndarray] = []
+    for start in range(0, num_docs, chunk_docs):
+        chunk_names = names[start:start + chunk_docs]
+        token_ids, lengths = pack_chunk(chunk_names)
+        all_lengths.append(lengths[:len(chunk_names)])
+        toks = jax.device_put(token_ids)
+        lens = jax.device_put(lengths)
+        ids, counts, head, df_part = _phase_a(toks, lens,
+                                              vocab_size=cfg.vocab_size)
+        resident.append((ids, counts, head, lens))
+        df_parts.append(df_part)
+
+    df_total = functools.reduce(jnp.add, df_parts)
+    nd = jnp.int32(num_docs)
+
+    # Phase B: rescore resident triples against corpus-wide IDF.
+    outs = [_phase_b(ids, counts, head, lens, df_total, nd,
+                     score_dtype=score_dtype, topk=k)
+            for ids, counts, head, lens in resident]
+    fetched = jax.device_get((df_total, outs))  # one transfer round trip
+    df_host, outs_host = fetched
+    vals = np.concatenate([v for v, _ in outs_host])[:num_docs]
+    tids = np.concatenate([t for _, t in outs_host])[:num_docs]
+    return IngestResult(df=df_host, topk_vals=vals, topk_ids=tids,
+                        lengths=np.concatenate(all_lengths), names=names,
+                        num_docs=num_docs)

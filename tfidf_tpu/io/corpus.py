@@ -45,7 +45,11 @@ class Corpus:
 class PackedBatch:
     """Static-shape device input.
 
-    token_ids: int32 [D, L] vocab ids, padded past each doc's length.
+    token_ids: [D, L] vocab ids, padded past each doc's length. int32,
+      or uint16 when packed by the native loader with vocab <= 2^16
+      (half the host->device bytes); device ops normalize to int32 at
+      their entry points (``ops.histogram.tf_counts_masked``,
+      ``ops.sparse.sorted_term_counts``).
     lengths: int32 [D] live token counts (== the reference's ``docSize``,
       ``TFIDF.c:141-143``).
     num_docs: real document count (D may exceed it via mesh padding).
@@ -95,26 +99,69 @@ def pack_bytes(corpus: Corpus, pad_docs_to: Optional[int] = None,
                        num_docs=d, names=names)
 
 
-def discover_corpus(input_dir: str, strict: bool = True) -> Corpus:
-    """Enumerate and load a document directory.
+def discover_names(input_dir: str, strict: bool = True) -> List[str]:
+    """The reference's corpus-discovery contract, names only.
 
-    strict=True: reference contract — count entries, then open
-    ``doc1..docN`` (``TFIDF.c:98-110,132-138``); raises FileNotFoundError
-    if any ``doc<i>`` is missing, matching the reference's hard exit.
-    strict=False: load every regular file, sorted by name.
+    strict=True: count the directory's regular files, then *derive* the
+    names ``doc1..docN`` (``TFIDF.c:98-110,132-133`` — the reference
+    never reads the listing's names, only its count). strict=False:
+    every regular file, sorted by name. Single source of truth for
+    :func:`discover_corpus`, :func:`load_and_pack`, and chunked ingest.
     """
     entries = sorted(e for e in os.listdir(input_dir)
                      if os.path.isfile(os.path.join(input_dir, e)))
     if strict:
-        names = [f"doc{i}" for i in range(1, len(entries) + 1)]
-    else:
-        names = entries
+        return [f"doc{i}" for i in range(1, len(entries) + 1)]
+    return entries
+
+
+def discover_corpus(input_dir: str, strict: bool = True) -> Corpus:
+    """Enumerate and load a document directory.
+
+    Names per :func:`discover_names`; raises FileNotFoundError if a
+    strict-mode ``doc<i>`` is missing, matching the reference's hard
+    exit (``TFIDF.c:137``).
+    """
+    names = discover_names(input_dir, strict)
     docs = []
     for name in names:
         path = os.path.join(input_dir, name)
         with open(path, "rb") as f:  # raises like the reference's exit(2)
             docs.append(f.read())
     return Corpus(names=names, docs=docs)
+
+
+def load_and_pack(input_dir: str, config: PipelineConfig,
+                  strict: bool = True,
+                  pad_docs_to: Optional[int] = None) -> PackedBatch:
+    """Directory -> device-ready batch, bypassing Python per-doc loops.
+
+    The big-corpus ingest path: for HASHED + WHITESPACE configs the
+    native parallel loader (``native/loader.cc``) reads, tokenizes,
+    hashes, and packs with a thread pool — document bytes never enter
+    Python. Other configs fall back to :func:`discover_corpus` +
+    :func:`pack_corpus` (identical output, pinned by tests).
+    """
+    native_ok = (
+        config.vocab_mode is VocabMode.HASHED
+        and config.tokenizer is TokenizerKind.WHITESPACE
+        and fast_tokenizer.loader_available())
+    if not native_ok:
+        return pack_corpus(discover_corpus(input_dir, strict=strict), config,
+                           pad_docs_to=pad_docs_to, want_words=False)
+
+    names = discover_names(input_dir, strict)
+    paths = [os.path.join(input_dir, n) for n in names]
+    packed = fast_tokenizer.load_pack_paths(
+        paths, config.vocab_size, config.hash_seed,
+        config.truncate_tokens_at, min_len=config.max_doc_len,
+        chunk=config.doc_chunk, pad_docs_to=pad_docs_to)
+    assert packed is not None  # loader_available() checked above
+    token_ids, lengths = packed
+    return PackedBatch(
+        token_ids=token_ids, lengths=lengths, num_docs=len(names),
+        names=names + [""] * (token_ids.shape[0] - len(names)),
+        vocab_size=config.vocab_size, id_to_word={})
 
 
 def _tokens_for(doc: bytes, config: PipelineConfig) -> List[bytes]:

@@ -23,10 +23,11 @@ _LIB_PATH = os.path.join(
 
 _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
+_has_loader = False
 
 
 def _load() -> Optional[ctypes.CDLL]:
-    global _lib, _load_failed
+    global _lib, _load_failed, _has_loader
     if _lib is not None or _load_failed:
         return _lib
     if os.environ.get("TFIDF_TPU_NO_NATIVE") or not os.path.exists(_LIB_PATH):
@@ -47,6 +48,31 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.tok_spans.argtypes = [
         ctypes.c_char_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
         ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
+    try:
+        lib.loader_open.restype = ctypes.c_void_p
+        lib.loader_open.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int]
+        lib.loader_error.restype = ctypes.c_int64
+        lib.loader_error.argtypes = [ctypes.c_void_p]
+        lib.loader_token_count.restype = ctypes.c_int64
+        lib.loader_token_count.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.loader_max_count.restype = ctypes.c_int64
+        lib.loader_max_count.argtypes = [ctypes.c_void_p]
+        lib.loader_fill.restype = None
+        lib.loader_fill.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int]
+        lib.loader_fill_u16.restype = None
+        lib.loader_fill_u16.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint16), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int]
+        lib.loader_close.restype = None
+        lib.loader_close.argtypes = [ctypes.c_void_p]
+        _has_loader = True
+    except AttributeError:  # stale .so predating the loader
+        pass
     _lib = lib
     return _lib
 
@@ -74,6 +100,71 @@ def tokenize_hash_ids(data: bytes, vocab_size: int, seed: int = 0,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), n)
     assert wrote == n, f"tokenizer wrote {wrote} of {n} tokens"
     return out
+
+
+def loader_available() -> bool:
+    """True when the native parallel loader symbols are present."""
+    return _load() is not None and _has_loader
+
+
+def load_pack_paths(paths: List[str], vocab_size: int, seed: int = 0,
+                    truncate_at: Optional[int] = None,
+                    min_len: int = 1, chunk: int = 1,
+                    pad_docs_to: Optional[int] = None,
+                    n_threads: Optional[int] = None,
+                    fixed_len: Optional[int] = None):
+    """Native parallel read+tokenize+hash+pack (``native/loader.cc``).
+
+    Reads every file with a work-stealing thread pool, then fills a
+    padded ``[D, L]`` int32 id batch and a lengths vector with zero
+    Python in the per-token loop. ``L`` = max(min_len, longest doc)
+    rounded up to a ``chunk`` multiple — same shape rule as
+    :func:`tfidf_tpu.io.corpus.pack_corpus`.
+
+    ``fixed_len`` pins ``L`` exactly (documents beyond it are truncated
+    to ``fixed_len`` tokens) — the static-shape mode for chunked ingest,
+    where every chunk must share one compiled program.
+
+    Returns ``(token_ids, lengths)`` or ``None`` when the native loader
+    is unavailable. Raises FileNotFoundError on an unreadable file (the
+    reference's hard exit, ``TFIDF.c:137``).
+    """
+    lib = _load()
+    if lib is None or not _has_loader:
+        return None
+    n_threads = n_threads or min(os.cpu_count() or 1, 16)
+    blob = b"\0".join(p.encode() for p in paths) + b"\0"
+    handle = lib.loader_open(blob, len(paths), n_threads)
+    try:
+        err = lib.loader_error(handle)
+        if err >= 0:
+            raise FileNotFoundError(paths[err])
+        if fixed_len is not None:
+            padded_len = fixed_len  # loader_fill truncates rows at stride
+        else:
+            max_count = lib.loader_max_count(handle)
+            padded_len = max(min_len, max_count, 1)
+            padded_len = ((padded_len + chunk - 1) // chunk) * chunk
+        d_padded = max(pad_docs_to or len(paths), len(paths))
+        lengths = np.zeros((d_padded,), dtype=np.int32)
+        lens_ptr = lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        # vocab <= 2^16: pack ids as uint16 — half the bytes on the
+        # host->device wire; device kernels upcast to int32 for free.
+        if vocab_size <= (1 << 16):
+            ids = np.zeros((d_padded, padded_len), dtype=np.uint16)
+            lib.loader_fill_u16(
+                handle, ctypes.c_uint64(seed), vocab_size, truncate_at or 0,
+                ids.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+                padded_len, lens_ptr, n_threads)
+        else:
+            ids = np.zeros((d_padded, padded_len), dtype=np.int32)
+            lib.loader_fill(
+                handle, ctypes.c_uint64(seed), vocab_size, truncate_at or 0,
+                ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                padded_len, lens_ptr, n_threads)
+        return ids, lengths
+    finally:
+        lib.loader_close(handle)
 
 
 def tokenize_spans(data: bytes) -> Optional[List[bytes]]:

@@ -29,7 +29,10 @@ def tf_counts_masked(token_ids: jax.Array, valid: jax.Array,
     both fall into the sentinel bucket and are sliced off.
     """
     d, _ = token_ids.shape
-    local = token_ids - id_offset
+    # Normalize the wire format here, the one entry point every histogram
+    # path funnels through: uint16-packed batches cannot represent the
+    # sentinel bucket V when V == 2^16, and id - id_offset must not wrap.
+    local = token_ids.astype(jnp.int32) - id_offset
     in_range = valid & (local >= 0) & (local < vocab_size)
     safe = jnp.where(in_range, local, vocab_size)
     counts = jnp.zeros((d, vocab_size + 1), jnp.int32)

@@ -92,7 +92,8 @@ def tf_df_pallas(token_ids: jax.Array, lengths: jax.Array, *,
     d, length = token_ids.shape
     dp, lp, vp = _pad_to(d, TILE_D), _pad_to(length, CHUNK_L), _pad_to(
         vocab_size, TILE_V)
-    toks = jnp.zeros((dp, lp), jnp.int32).at[:d, :length].set(token_ids)
+    toks = jnp.zeros((dp, lp), jnp.int32).at[:d, :length].set(
+        token_ids.astype(jnp.int32))
     lens = jnp.zeros((dp, 1), jnp.int32).at[:d, 0].set(lengths)
 
     counts, df = pl.pallas_call(

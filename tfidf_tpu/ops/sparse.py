@@ -45,7 +45,8 @@ def sorted_term_counts(token_ids: jax.Array, lengths: jax.Array
       consumers. Padding sorts to the row tail as id ``INT32_MAX``.
     """
     d, length = token_ids.shape
-    pos = jnp.arange(length, dtype=lengths.dtype)[None, :]
+    token_ids = token_ids.astype(jnp.int32)  # ids may arrive as uint16
+    pos = jnp.arange(length, dtype=jnp.int32)[None, :]
     valid = pos < lengths[:, None]
     sentinel = jnp.iinfo(jnp.int32).max
     sorted_ids = jnp.sort(jnp.where(valid, token_ids, sentinel), axis=1)
@@ -54,12 +55,15 @@ def sorted_term_counts(token_ids: jax.Array, lengths: jax.Array
     prev = jnp.concatenate(
         [jnp.full((d, 1), -1, sorted_ids.dtype), sorted_ids[:, :-1]], axis=1)
     head = valid & (sorted_ids != prev)
-    # Run-length via segment ids: run[d, i] = index of the run slot i is in.
-    run = jnp.cumsum(head.astype(jnp.int32), axis=1) - 1  # -1 before 1st head
-    run_safe = jnp.clip(run, 0, length - 1)
-    run_sizes = jnp.zeros((d, length), jnp.int32).at[
-        jnp.arange(d)[:, None], run_safe].add(valid.astype(jnp.int32))
-    counts = jnp.take_along_axis(run_sizes, run_safe, axis=1)
+    # Run length at a head slot = (next head position, clipped to the
+    # live prefix) - own position: an exclusive suffix-min over head
+    # positions. Pure cumulative/elementwise ops — no scatter, which on
+    # TPU serializes (counts at non-head slots are garbage by contract).
+    hpos = jnp.where(head, pos, length)
+    suffix_min = lax.cummin(hpos[:, ::-1], axis=1)[:, ::-1]
+    next_head = jnp.concatenate(
+        [suffix_min[:, 1:], jnp.full((d, 1), length, jnp.int32)], axis=1)
+    counts = jnp.minimum(next_head, lengths[:, None]) - pos
     return sorted_ids, counts, head
 
 

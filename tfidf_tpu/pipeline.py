@@ -191,20 +191,23 @@ class TfidfPipeline:
             topk=cfg.topk, use_pallas=cfg.use_pallas,
             pallas_interpret=interpret)
         # topk mode: neither counts nor scores cross the host boundary —
-        # only DF [V] and the [D, K] selection do.
+        # only DF [V] and the [D, K] selection do. One device_get for all
+        # outputs: transfers pipeline into a single round trip, which
+        # matters when the device link is latency-bound.
+        out = jax.device_get(out)
         result = PipelineResult(
-            counts=None if cfg.topk is not None else np.asarray(out[0]),
+            counts=None if cfg.topk is not None else out[0],
             lengths=np.asarray(batch.lengths),
-            df=np.asarray(out[0 if cfg.topk is not None else 1]),
+            df=out[0 if cfg.topk is not None else 1],
             num_docs=batch.num_docs,
             names=batch.names,
             id_to_word=batch.id_to_word or {},
         )
         if cfg.topk is not None:
-            result.topk_vals = np.asarray(out[1])
-            result.topk_ids = np.asarray(out[2])
+            result.topk_vals = out[1]
+            result.topk_ids = out[2]
         else:
-            result.scores = np.asarray(out[2])
+            result.scores = out[2]
         return result
 
     def _run_sparse(self, batch: PackedBatch) -> PipelineResult:
@@ -214,21 +217,22 @@ class TfidfPipeline:
             jnp.asarray(batch.token_ids), jnp.asarray(batch.lengths),
             jnp.int32(batch.num_docs), vocab_size=batch.vocab_size,
             score_dtype=jnp.dtype(cfg.score_dtype), topk=cfg.topk)
+        out = jax.device_get(out)  # all outputs in one transfer round trip
         result = PipelineResult(
             counts=None,
             lengths=np.asarray(batch.lengths),
-            df=np.asarray(out[0]),
+            df=out[0],
             num_docs=batch.num_docs,
             names=batch.names,
             id_to_word=batch.id_to_word or {},
         )
         if cfg.topk is not None:
-            result.topk_vals = np.asarray(out[1])
-            result.topk_ids = np.asarray(out[2])
+            result.topk_vals = out[1]
+            result.topk_ids = out[2]
         else:
-            result.sparse_ids = np.asarray(out[1])
-            result.sparse_counts = np.asarray(out[2])
-            result.sparse_head = np.asarray(out[3])
+            result.sparse_ids = out[1]
+            result.sparse_counts = out[2]
+            result.sparse_head = out[3]
             result.scores = None  # dense scores deliberately not built
         return result
 
@@ -251,15 +255,16 @@ class TfidfPipeline:
             jnp.int32(packed.num_docs), vocab_size=cfg.vocab_size,
             ngram_lo=lo, ngram_hi=hi, seed=cfg.hash_seed,
             score_dtype=jnp.dtype(cfg.score_dtype), topk=cfg.topk)
+        out = jax.device_get(out)  # single transfer round trip
         if cfg.topk is not None:
             return PipelineResult(
-                counts=None, lengths=np.asarray(out[1]), df=np.asarray(out[0]),
+                counts=None, lengths=out[1], df=out[0],
                 num_docs=packed.num_docs, names=packed.names, id_to_word={},
-                topk_vals=np.asarray(out[2]), topk_ids=np.asarray(out[3]))
+                topk_vals=out[2], topk_ids=out[3])
         return PipelineResult(
-            counts=np.asarray(out[0]), lengths=np.asarray(out[2]),
-            df=np.asarray(out[1]), num_docs=packed.num_docs,
-            names=packed.names, id_to_word={}, scores=np.asarray(out[3]))
+            counts=out[0], lengths=out[2],
+            df=out[1], num_docs=packed.num_docs,
+            names=packed.names, id_to_word={}, scores=out[3])
 
     def run(self, corpus: Corpus) -> PipelineResult:
         from tfidf_tpu.config import TokenizerKind, VocabMode
