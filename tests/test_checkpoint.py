@@ -1,0 +1,138 @@
+"""Checkpoint/resume of streaming DF state (tfidf_tpu/checkpoint.py +
+cli stream): a killed-and-restarted stream must converge to the same
+state as an uninterrupted one."""
+
+import numpy as np
+import pytest
+
+from tfidf_tpu import PipelineConfig
+from tfidf_tpu import checkpoint as ckpt
+from tfidf_tpu.config import VocabMode
+from tfidf_tpu.io.corpus import Corpus
+from tfidf_tpu.streaming import StreamingTfidf
+
+
+def _corpus(lo: int, hi: int) -> Corpus:
+    rng = np.random.default_rng(lo)
+    names, docs = [], []
+    for i in range(lo, hi):
+        names.append(f"doc{i}")
+        docs.append(" ".join(
+            f"w{rng.integers(0, 50)}" for _ in range(20)).encode())
+    return Corpus(names=names, docs=docs)
+
+
+def _cfg():
+    return PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=256,
+                          topk=4)
+
+
+class TestSaveRestore:
+    @pytest.mark.parametrize("force_npz", [True, False])
+    def test_roundtrip(self, tmp_path, force_npz):
+        path = str(tmp_path / "ck")
+        state = {"df": np.arange(256, dtype=np.int32),
+                 "docs_seen": np.asarray(12)}
+        backend = ckpt.save_state(path, state, force_npz=force_npz)
+        assert backend == (
+            "npz" if force_npz or not ckpt._HAVE_ORBAX else "orbax")
+        assert ckpt.exists(path)
+        back = ckpt.restore_state(path)
+        assert (back["df"] == state["df"]).all()
+        assert int(back["docs_seen"]) == 12
+
+    def test_overwrite_is_atomic_latest_wins(self, tmp_path):
+        path = str(tmp_path / "ck")
+        ckpt.save_state(path, {"df": np.zeros(4, np.int32),
+                               "docs_seen": np.asarray(1)}, force_npz=True)
+        ckpt.save_state(path, {"df": np.ones(4, np.int32),
+                               "docs_seen": np.asarray(2)}, force_npz=True)
+        assert int(ckpt.restore_state(path)["docs_seen"]) == 2
+
+    def test_missing_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            ckpt.restore_state(str(tmp_path / "nowhere"))
+
+
+class TestStreamResume:
+    def test_interrupted_stream_converges(self, tmp_path):
+        path = str(tmp_path / "ck")
+        full = StreamingTfidf(_cfg())
+        for lo in (0, 30, 60):
+            full.update(full.pack(_corpus(lo, lo + 30)))
+
+        # "Crash" after two minibatches...
+        first = StreamingTfidf(_cfg())
+        for lo in (0, 30):
+            first.update(first.pack(_corpus(lo, lo + 30)))
+            ckpt.save_state(path, first.state_dict(), force_npz=True)
+        del first
+
+        # ...resume in a fresh engine, finish the stream.
+        resumed = StreamingTfidf(_cfg())
+        resumed.load_state(ckpt.restore_state(path))
+        assert resumed.docs_seen == 60
+        resumed.update(resumed.pack(_corpus(60, 90)))
+
+        assert resumed.docs_seen == full.docs_seen == 90
+        assert (resumed.df() == full.df()).all()
+
+    def test_cli_stream_resume(self, tmp_path):
+        from tfidf_tpu.cli import main
+
+        ind = tmp_path / "input"
+        ind.mkdir()
+        rng = np.random.default_rng(0)
+        for i in range(1, 21):
+            (ind / f"doc{i}").write_text(
+                " ".join(f"w{rng.integers(0, 30)}" for _ in range(15)))
+        ck = str(tmp_path / "ck")
+        out1, out2 = str(tmp_path / "a.txt"), str(tmp_path / "b.txt")
+
+        base = ["stream", "--input", str(ind), "--batch-docs", "8",
+                "--vocab-size", "256", "--topk", "3"]
+        assert main(base + ["--output", out1, "--checkpoint", ck]) == 0
+        # Second invocation resumes at EOF (nothing left to fold) but
+        # must still score the whole corpus identically.
+        assert main(base + ["--output", out2, "--checkpoint", ck,
+                            "--resume"]) == 0
+        assert open(out1, "rb").read() == open(out2, "rb").read()
+
+
+class TestCrashWindows:
+    """The LATEST-pointer protocol: a crash at any point leaves a
+    restorable checkpoint (old or new), and debris self-heals."""
+
+    def _save(self, path, n):
+        return ckpt.save_state(path, {"df": np.full(4, n, np.int32),
+                                      "docs_seen": np.asarray(n)},
+                               force_npz=True)
+
+    def test_uncommitted_payload_debris_ignored_then_reclaimed(self, tmp_path):
+        import os
+        path = str(tmp_path / "ck")
+        self._save(path, 1)  # commits ckpt-0
+        # Simulate a crash mid-save: the next payload dir (ckpt-1) was
+        # written but LATEST never repointed. Committed state must still
+        # be generation 0's.
+        os.makedirs(os.path.join(path, "ckpt-1"))
+        assert int(ckpt.restore_state(path)["docs_seen"]) == 1
+        # The next save reclaims the debris name and commits over it.
+        self._save(path, 2)
+        assert int(ckpt.restore_state(path)["docs_seen"]) == 2
+
+    def test_dangling_latest_is_not_a_checkpoint(self, tmp_path):
+        path = str(tmp_path / "ck")
+        (tmp_path / "ck").mkdir()
+        (tmp_path / "ck" / "LATEST").write_text("ckpt-7")  # dir never made
+        assert not ckpt.exists(path)
+        with pytest.raises(FileNotFoundError):
+            ckpt.restore_state(path)
+
+    def test_old_payload_gone_after_commit(self, tmp_path):
+        import os
+        path = str(tmp_path / "ck")
+        self._save(path, 1)
+        self._save(path, 2)
+        entries = sorted(os.listdir(path))
+        assert entries == ["LATEST", "ckpt-1"]  # superseded ckpt-0 gone

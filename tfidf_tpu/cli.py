@@ -48,6 +48,28 @@ def _build_parser() -> argparse.ArgumentParser:
                      help="accept any filenames, not just doc<i>")
     run.add_argument("--nranks", type=int, default=4,
                      help="ranks for --backend=mpi (thread backend)")
+
+    st = sub.add_parser(
+        "stream",
+        help="stream the corpus in minibatches with checkpoint/resume")
+    st.add_argument("--input", required=True, help="document directory")
+    st.add_argument("--output", default="output.txt",
+                    help="top-k output file")
+    st.add_argument("--batch-docs", type=int, default=256,
+                    help="documents per minibatch")
+    st.add_argument("--doc-len", type=int, default=256,
+                    help="static tokens per document (longer docs are "
+                         "truncated; one compiled program for the whole "
+                         "stream)")
+    st.add_argument("--vocab-size", type=int, default=1 << 16)
+    st.add_argument("--topk", type=int, default=8)
+    st.add_argument("--checkpoint", default=None,
+                    help="checkpoint directory; state is saved after "
+                         "every minibatch")
+    st.add_argument("--resume", action="store_true",
+                    help="restore from --checkpoint and skip the "
+                         "documents already folded into the DF state")
+    st.add_argument("--no-strict", action="store_true")
     return p
 
 
@@ -114,12 +136,80 @@ def _write_topk(path: str, result) -> None:
         f.write(b"".join(l + b"\n" for l in lines))
 
 
+def _run_stream(args) -> int:
+    """Two-pass streaming job: fold DF per minibatch (checkpointing as it
+    goes), then score every minibatch against the final corpus-wide DF.
+
+    Resume contract: documents stream in the deterministic discovery
+    order, so ``docs_seen`` from a restored checkpoint identifies the
+    exact restart position — the capability the single-shot reference
+    lacks entirely (SURVEY §5: any failure = full rerun).
+    """
+    import numpy as np
+
+    from tfidf_tpu import checkpoint as ckpt
+    from tfidf_tpu.config import PipelineConfig, VocabMode
+    from tfidf_tpu.io.corpus import Corpus, discover_names
+    from tfidf_tpu.streaming import StreamingTfidf
+
+    cfg = PipelineConfig(vocab_mode=VocabMode.HASHED,
+                         vocab_size=args.vocab_size, topk=args.topk,
+                         max_doc_len=args.doc_len, doc_chunk=args.doc_len)
+    stream = StreamingTfidf(cfg)
+    names = discover_names(args.input, strict=not args.no_strict)
+    if not names:
+        sys.stderr.write(f"error: no documents in {args.input}\n")
+        return 1
+
+    start = 0
+    if args.resume and args.checkpoint and ckpt.exists(args.checkpoint):
+        stream.load_state(ckpt.restore_state(args.checkpoint))
+        start = stream.docs_seen
+        print(f"resumed at doc {start} ({args.checkpoint})")
+
+    def batches(from_doc: int):
+        for lo in range(from_doc, len(names), args.batch_docs):
+            batch_names = names[lo:lo + args.batch_docs]
+            docs = []
+            for n in batch_names:
+                with open(os.path.join(args.input, n), "rb") as f:
+                    docs.append(f.read())
+            yield Corpus(names=batch_names, docs=docs)
+
+    # Pass 1: fold DF, checkpoint after every minibatch. fixed_len pins
+    # the batch shape so the whole stream reuses one compiled program.
+    for corpus in batches(start):
+        stream.update(stream.pack(corpus, fixed_len=args.doc_len))
+        if args.checkpoint:
+            ckpt.save_state(args.checkpoint, stream.state_dict())
+    print(f"df folded over {stream.docs_seen} docs")
+
+    # Pass 2: score all minibatches against the final DF snapshot.
+    import types
+    all_names: List[str] = []
+    all_vals, all_ids = [], []
+    for corpus in batches(0):
+        vals, ids = stream.score(stream.pack(corpus, fixed_len=args.doc_len))
+        all_names.extend(corpus.names)
+        all_vals.append(np.asarray(vals)[:len(corpus.names)])
+        all_ids.append(np.asarray(ids)[:len(corpus.names)])
+    report = types.SimpleNamespace(
+        num_docs=len(all_names), names=all_names,
+        topk_vals=np.concatenate(all_vals), topk_ids=np.concatenate(all_ids),
+        id_to_word={})
+    _write_topk(args.output, report)  # same format as `run --topk`
+    print(f"wrote {args.output} ({stream.docs_seen} docs)")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = _build_parser().parse_args(argv)
     if args.cmd == "run":
         if args.backend == "mpi":
             return _run_mpi(args)
         return _run_tpu(args)
+    if args.cmd == "stream":
+        return _run_stream(args)
     return 2
 
 

@@ -99,10 +99,26 @@ class StreamingTfidf:
         self._docs_seen = int(state["docs_seen"])
 
     # --- packing ---
-    def pack(self, corpus: Corpus) -> PackedBatch:
+    def pack(self, corpus: Corpus,
+             fixed_len: Optional[int] = None) -> PackedBatch:
+        """Pack a minibatch. ``fixed_len`` pins the token axis to one
+        static L (truncating longer docs) so every minibatch of a stream
+        shares a single compiled update/score program — without it, L
+        grows to the batch's longest doc and each new shape recompiles.
+        """
         pad = (self.plan.pad_docs(len(corpus)) if self.plan else None)
-        return pack_corpus(corpus, self.config, pad_docs_to=pad,
-                           want_words=False)
+        batch = pack_corpus(corpus, self.config, pad_docs_to=pad,
+                            want_words=False)
+        if fixed_len is None or batch.token_ids.shape[1] == fixed_len:
+            return batch
+        ids = batch.token_ids[:, :fixed_len]
+        if ids.shape[1] < fixed_len:
+            ids = np.pad(ids, ((0, 0), (0, fixed_len - ids.shape[1])))
+        return PackedBatch(
+            token_ids=ids,
+            lengths=np.minimum(batch.lengths, fixed_len).astype(np.int32),
+            num_docs=batch.num_docs, names=batch.names,
+            vocab_size=batch.vocab_size, id_to_word=batch.id_to_word)
 
     def _place(self, batch: PackedBatch):
         toks, lens = jnp.asarray(batch.token_ids), jnp.asarray(batch.lengths)
