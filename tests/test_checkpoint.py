@@ -136,3 +136,19 @@ class TestCrashWindows:
         self._save(path, 2)
         entries = sorted(os.listdir(path))
         assert entries == ["LATEST", "ckpt-1"]  # superseded ckpt-0 gone
+
+    def test_orphaned_superseded_payload_reclaimed(self, tmp_path):
+        # Crash window: LATEST repointed at ckpt-1 but the rmtree of
+        # ckpt-0 never ran. ckpt-0's name is behind the committed seq so
+        # no future save reuses it — the debris sweep must catch it.
+        import os
+        path = str(tmp_path / "ck")
+        self._save(path, 1)   # commits ckpt-0
+        self._save(path, 2)   # commits ckpt-1, normally removes ckpt-0
+        os.makedirs(os.path.join(path, "ckpt-0"))  # ...but the crash kept it
+        (tmp_path / "ck" / "stale.latest.tmp").write_text("ckpt-9")
+        os.makedirs(os.path.join(
+            path, "ckpt-2.orbax-checkpoint-tmp-123"))  # crashed orbax stage
+        self._save(path, 3)
+        assert sorted(os.listdir(path)) == ["LATEST", "ckpt-2"]
+        assert int(ckpt.restore_state(path)["docs_seen"]) == 3

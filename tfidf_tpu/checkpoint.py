@@ -42,6 +42,41 @@ _NPZ_NAME = "state.npz"
 _LATEST = "LATEST"
 
 
+def _fsync_dir(path: str) -> None:
+    """Make directory-entry changes (create/rename/unlink) durable."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # pragma: no cover — e.g. platforms without dir fds
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _reclaim_debris(path: str, keep: str | None) -> None:
+    """Remove every payload/tmp entry except ``keep`` (the committed one).
+
+    Covers uncommitted ``ckpt-<n>`` dirs from a save that crashed before
+    the LATEST repoint, orphaned superseded payloads from a crash
+    *after* the repoint but before their rmtree, orbax's
+    ``*.orbax-checkpoint-tmp-*`` staging dirs, and stale
+    ``*.latest.tmp`` pointer files.
+    """
+    for entry in os.listdir(path):
+        if entry == _LATEST or entry == keep:
+            continue
+        if entry.startswith("ckpt-") or entry.endswith(".latest.tmp"):
+            full = os.path.join(path, entry)
+            if os.path.isdir(full):
+                shutil.rmtree(full, ignore_errors=True)
+            else:
+                try:
+                    os.unlink(full)
+                except OSError:  # pragma: no cover
+                    pass
+
+
 def _committed_payload(path: str):
     """(payload_dir, seq) of the committed checkpoint, or (None, -1)."""
     latest = os.path.join(path, _LATEST)
@@ -70,10 +105,9 @@ def save_state(path: str, state: Dict[str, np.ndarray],
     state = {k: np.asarray(v) for k, v in state.items()}
     os.makedirs(path, exist_ok=True)
     old_payload, seq = _committed_payload(path)
+    _reclaim_debris(path, os.path.basename(old_payload) if old_payload else None)
     name = f"ckpt-{seq + 1}"
     payload = os.path.join(path, name)
-    if os.path.exists(payload):  # uncommitted debris from a crashed save
-        shutil.rmtree(payload)
 
     if _HAVE_ORBAX and not force_npz:
         _ocp.PyTreeCheckpointer().save(os.path.abspath(payload), state)
@@ -85,6 +119,7 @@ def save_state(path: str, state: Dict[str, np.ndarray],
             f.flush()
             os.fsync(f.fileno())
         backend = "npz"
+    _fsync_dir(path)  # make the new payload's dirent durable pre-commit
 
     # Commit: atomically repoint LATEST, then drop the superseded payload.
     fd, tmp = tempfile.mkstemp(dir=path, suffix=".latest.tmp")
@@ -93,6 +128,7 @@ def save_state(path: str, state: Dict[str, np.ndarray],
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, os.path.join(path, _LATEST))
+    _fsync_dir(path)  # rename must hit disk before the old payload goes
     if old_payload and os.path.isdir(old_payload):
         shutil.rmtree(old_payload, ignore_errors=True)
     return backend

@@ -30,8 +30,9 @@ def _load() -> Optional[ctypes.CDLL]:
     global _lib, _load_failed, _has_loader
     if _lib is not None or _load_failed:
         return _lib
+    # Not sticky: the library may be built later in the process lifetime
+    # (tests build it on demand), and the env kill-switch may be toggled.
     if os.environ.get("TFIDF_TPU_NO_NATIVE") or not os.path.exists(_LIB_PATH):
-        _load_failed = True
         return None
     try:
         lib = ctypes.CDLL(_LIB_PATH)
