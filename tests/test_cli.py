@@ -56,3 +56,20 @@ class TestCli:
         rc = main(["run", "--input", toy_corpus_dir, "--output", str(out),
                    "--backend", "tpu", "--topk", "50"])
         assert rc == 0
+
+    def test_query_subcommand(self, toy_corpus_dir, capsys):
+        rc = main(["query", "--input", toy_corpus_dir,
+                   "--query", "the quick", "--query", "zzz_nohit", "-k", "2"])
+        assert rc == 0
+        lines = capsys.readouterr().out.splitlines()
+        assert lines[0] == "query: the quick"
+        hits = [l for l in lines[1:lines.index("query: zzz_nohit")] if l]
+        assert hits, "expected at least one retrieval hit"
+        assert all("\t" in h for h in hits)
+        assert lines[-1] == "query: zzz_nohit"  # no hits printed after
+
+    def test_query_sharded(self, toy_corpus_dir, capsys):
+        rc = main(["query", "--input", toy_corpus_dir,
+                   "--query", "the quick", "-k", "2", "--mesh-docs", "4"])
+        assert rc == 0
+        assert "query: the quick" in capsys.readouterr().out

@@ -1,0 +1,102 @@
+"""TfidfRetriever: cosine ranking vs a numpy oracle, BCOO vs sharded."""
+
+import numpy as np
+import pytest
+
+from tfidf_tpu import PipelineConfig
+from tfidf_tpu.config import VocabMode
+from tfidf_tpu.io.corpus import Corpus
+from tfidf_tpu.models import TfidfRetriever
+from tfidf_tpu.ops.hashing import words_to_ids
+from tfidf_tpu.parallel.mesh import MeshPlan
+
+CFG = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=512,
+                     max_doc_len=16, doc_chunk=16)
+CORPUS = Corpus(
+    names=["doc1", "doc2", "doc3", "doc4", "doc5"],
+    docs=[b"apple banana apple cherry",
+          b"banana banana date",
+          b"cherry date elder fig",
+          b"apple fig fig fig",
+          b"grape grape grape grape"])
+
+
+def numpy_cosine_oracle(corpus, queries, vocab=512):
+    """Dense float64 TF-IDF cosine ranking, straight from the formulas."""
+    docs = [d.split() for d in corpus.docs]
+    n = len(docs)
+    tf = np.zeros((n, vocab))
+    for i, words in enumerate(docs):
+        ids = words_to_ids(words, vocab)
+        for v in ids:
+            tf[i, v] += 1
+        tf[i] /= max(len(words), 1)
+    df = (np.array([np.bincount(np.unique(words_to_ids(w, vocab)),
+                                minlength=vocab) for w in docs])).sum(0)
+    idf = np.where(df > 0, np.log(n / np.maximum(df, 1)), 0.0)
+    mat = tf * idf
+    mat /= np.maximum(np.linalg.norm(mat, axis=1, keepdims=True), 1e-30)
+    sims = []
+    for q in queries:
+        ids = words_to_ids(q.split(), vocab)
+        vec = np.bincount(ids, minlength=vocab) / max(len(ids), 1) * idf
+        nrm = np.linalg.norm(vec)
+        sims.append(mat @ (vec / nrm if nrm > 0 else vec))
+    return np.stack(sims)
+
+
+class TestSingleDevice:
+    def test_matches_numpy_oracle(self):
+        r = TfidfRetriever(CFG).index(CORPUS)
+        queries = [b"apple cherry", b"banana", b"grape date"]
+        vals, idx = r.search([q.decode() for q in queries], k=5)
+        want = numpy_cosine_oracle(CORPUS, queries)
+        for qi in range(len(queries)):
+            got = {int(d): float(v) for v, d in zip(vals[qi], idx[qi])
+                   if d >= 0}
+            for d, v in got.items():
+                assert v == pytest.approx(want[qi, d], rel=1e-5)
+            # ranking order matches the oracle's descending sims
+            ranked = [d for d in np.argsort(-want[qi]) if want[qi, d] > 0]
+            assert [d for d in idx[qi] if d >= 0] == ranked[:len(got)]
+
+    def test_self_retrieval_top1(self):
+        r = TfidfRetriever(CFG).index(CORPUS)
+        vals, idx = r.search([d.decode() for d in CORPUS.docs], k=1)
+        assert idx[:, 0].tolist() == list(range(len(CORPUS.docs)))
+        # a doc against itself is cosine 1
+        np.testing.assert_allclose(vals[:, 0], 1.0, rtol=1e-5)
+
+    def test_no_match_and_empty_query(self):
+        r = TfidfRetriever(CFG).index(CORPUS)
+        vals, idx = r.search(["zzz_unseen_token", "   "], k=3)
+        assert (idx == -1).all()
+        assert (vals == 0).all()
+
+    def test_unindexed_raises(self):
+        with pytest.raises(RuntimeError):
+            TfidfRetriever(CFG).search(["x"])
+
+    def test_index_dir(self, toy_corpus_dir):
+        r = TfidfRetriever(CFG).index_dir(toy_corpus_dir)
+        assert r.indexed
+        vals, idx = r.search(["the"], k=2)
+        assert idx.shape == (1, 2)
+
+
+class TestSharded:
+    def test_matches_single_device(self):
+        import jax
+        plan = MeshPlan.create(docs=4, devices=jax.devices()[:4])
+        single = TfidfRetriever(CFG).index(CORPUS)
+        sharded = TfidfRetriever(CFG, plan=plan).index(CORPUS)
+        queries = ["apple cherry", "banana date fig"]
+        v1, i1 = single.search(queries, k=4)
+        v2, i2 = sharded.search(queries, k=4)
+        np.testing.assert_allclose(v1, v2, rtol=1e-5)
+        assert (i1 == i2).all()
+
+    def test_requires_docs_only_mesh(self):
+        plan = MeshPlan.create(docs=4, vocab=2)  # 4*2 = all 8 devices
+        with pytest.raises(ValueError):
+            TfidfRetriever(CFG, plan=plan)

@@ -36,6 +36,19 @@ class TestSortedTermCounts:
         dense_df = df_from_counts(tf_counts(toks, lens, 50))
         assert (np.asarray(sparse_df(ids, head, 50)) == np.asarray(dense_df)).all()
 
+    def test_df_methods_agree(self):
+        # The TPU-friendly sort+searchsorted lowering and the scatter
+        # lowering are interchangeable by contract.
+        rng = np.random.default_rng(7)
+        toks = jnp.asarray(rng.integers(0, 97, (16, 40)), jnp.int32)
+        lens = jnp.asarray(rng.integers(0, 41, (16,)), jnp.int32)
+        ids, _, head = sorted_term_counts(toks, lens)
+        a = sparse_df(ids, head, 97, method="scatter")
+        b = sparse_df(ids, head, 97, method="sort")
+        assert (np.asarray(a) == np.asarray(b)).all()
+        with pytest.raises(ValueError):
+            sparse_df(ids, head, 97, method="bogus")
+
 
 class TestSparsePipeline:
     def test_golden_bytes_equal_dense_engine(self, toy_corpus_dir):

@@ -70,6 +70,17 @@ def _build_parser() -> argparse.ArgumentParser:
                     help="restore from --checkpoint and skip the "
                          "documents already folded into the DF state")
     st.add_argument("--no-strict", action="store_true")
+
+    q = sub.add_parser(
+        "query", help="index a corpus and run ranked cosine retrieval")
+    q.add_argument("--input", required=True, help="document directory")
+    q.add_argument("--query", action="append", required=True,
+                   help="query text (repeatable)")
+    q.add_argument("-k", type=int, default=5, help="results per query")
+    q.add_argument("--vocab-size", type=int, default=1 << 16)
+    q.add_argument("--mesh-docs", type=int, default=None,
+                   help="shard the index over this many devices")
+    q.add_argument("--no-strict", action="store_true")
     return p
 
 
@@ -202,6 +213,34 @@ def _run_stream(args) -> int:
     return 0
 
 
+def _run_query(args) -> int:
+    """Index + search: `doc<i>\\tscore` per result line, tab-separated."""
+    from tfidf_tpu.config import PipelineConfig, VocabMode
+    from tfidf_tpu.models import TfidfRetriever
+
+    cfg = PipelineConfig(vocab_mode=VocabMode.HASHED,
+                         vocab_size=args.vocab_size)
+    plan = None
+    if args.mesh_docs is not None:
+        import jax
+
+        from tfidf_tpu.parallel import MeshPlan
+        # 0 = all devices (MeshPlan.create's docs=0 contract); else take
+        # the first N so a sub-mesh works on any device count.
+        devs = jax.devices()[:args.mesh_docs] if args.mesh_docs else None
+        plan = MeshPlan.create(docs=args.mesh_docs, devices=devs)
+    r = TfidfRetriever(cfg, plan=plan).index_dir(
+        args.input, strict=not args.no_strict)
+    vals, idx = r.search(args.query, k=args.k)
+    for qi, text in enumerate(args.query):
+        print(f"query: {text}")
+        for v, d in zip(vals[qi], idx[qi]):
+            if d < 0:
+                continue
+            print(f"  {r.names[int(d)]}\t{float(v):.6f}")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = _build_parser().parse_args(argv)
     if args.cmd == "run":
@@ -210,6 +249,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _run_tpu(args)
     if args.cmd == "stream":
         return _run_stream(args)
+    if args.cmd == "query":
+        return _run_query(args)
     return 2
 
 

@@ -28,11 +28,13 @@ _has_loader = False
 
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _load_failed, _has_loader
+    # The kill-switch wins even over an already-loaded library, and a
+    # missing .so is not sticky (tests build it on demand mid-process).
+    if os.environ.get("TFIDF_TPU_NO_NATIVE"):
+        return None
     if _lib is not None or _load_failed:
         return _lib
-    # Not sticky: the library may be built later in the process lifetime
-    # (tests build it on demand), and the env kill-switch may be toggled.
-    if os.environ.get("TFIDF_TPU_NO_NATIVE") or not os.path.exists(_LIB_PATH):
+    if not os.path.exists(_LIB_PATH):
         return None
     try:
         lib = ctypes.CDLL(_LIB_PATH)

@@ -1,12 +1,14 @@
-"""Model-level APIs built on the pipeline: the TF-IDF vectorizer.
+"""Model-level APIs built on the pipeline.
 
 The reference's "model" is the TF-IDF statistic itself (SURVEY §1:
-"no model layer"). This package gives it the standard estimator shape —
-fit (learn DF over a corpus), transform (score documents against it) —
-so the framework slots into feature-extraction workflows, not just the
-batch job the reference hardcodes.
+"no model layer"). This package gives it the standard shapes built on
+that statistic: the estimator (fit DF over a corpus / transform new
+documents) and ranked cosine retrieval over the indexed term-document
+matrix — feature-extraction and search workflows, not just the batch
+job the reference hardcodes.
 """
 
+from tfidf_tpu.models.retrieval import TfidfRetriever
 from tfidf_tpu.models.vectorizer import TfidfVectorizer
 
-__all__ = ["TfidfVectorizer"]
+__all__ = ["TfidfRetriever", "TfidfVectorizer"]

@@ -1,0 +1,193 @@
+"""TF-IDF document retrieval: cosine search over the term-doc matrix.
+
+The reference stops at emitting per-(word, doc) scores
+(``TFIDF.c:274-282``); the canonical *use* of those scores is ranked
+document retrieval, and on TPU that is exactly the BCOO sparse
+term-document matmul the BASELINE north star names: the indexed corpus
+is a row-sparse TF-IDF matrix, a query becomes a dense [V] vector, and
+similarity = one sparse x dense matmul on the MXU.
+
+Two execution paths, same results (pinned by tests):
+
+* single device — ``jax.experimental.sparse.bcoo_dot_general`` of the
+  indexed [D, V] BCOO against the [V, Q] query block;
+* docs-sharded — the row-sparse triples stay block-sharded over the
+  mesh's ``docs`` axis (``shard_map``); each shard scores its rows by
+  gathering query weights at its term ids, takes a *local* top-k, and
+  one ``all_gather`` of k x shards candidates per query replaces any
+  full [D, Q] materialization — the same serial-gather fix as the
+  pipeline's top-k (SURVEY §7 "hard parts").
+
+Scores are cosine similarities in [0, 1]: document rows and query
+columns are both L2-normalized at build time.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import sparse as jsparse
+from jax.sharding import PartitionSpec as P
+
+from tfidf_tpu.config import PipelineConfig, VocabMode
+from tfidf_tpu.io.corpus import Corpus, discover_corpus, pack_corpus
+from tfidf_tpu.ops.hashing import words_to_ids
+from tfidf_tpu.ops.scoring import idf_from_df
+from tfidf_tpu.ops.sparse import (sorted_term_counts, sparse_df,
+                                  sparse_scores)
+from tfidf_tpu.ops.tokenize import whitespace_tokenize
+from tfidf_tpu.parallel.mesh import DOCS_AXIS, MeshPlan
+
+
+@functools.partial(jax.jit, static_argnames=("vocab_size",))
+def _build_index(token_ids, lengths, num_docs, *, vocab_size: int):
+    """Tokens -> (ids, weights, head, idf): L2-normalized row-sparse TF-IDF."""
+    ids, counts, head = sorted_term_counts(token_ids, lengths)
+    df = sparse_df(ids, head, vocab_size)
+    idf = idf_from_df(df, num_docs, jnp.float32)
+    scores = sparse_scores(ids, counts, head, lengths, idf)
+    norm = jnp.sqrt(jnp.sum(scores * scores, axis=1, keepdims=True))
+    weights = scores / jnp.maximum(norm, 1e-30)
+    return ids, weights, head, idf
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _search_bcoo(data, cols, qmat, *, k: int):
+    """[D, V] BCOO x [V, Q] dense on the MXU -> per-query top-k docs."""
+    d = data.shape[0]
+    mat = jsparse.BCOO((data, cols), shape=(d, qmat.shape[0]))
+    sims = jsparse.bcoo_dot_general(
+        mat, qmat, dimension_numbers=(((1,), (0,)), ((), ())))  # [D, Q]
+    vals, idx = lax.top_k(sims.T, k)                            # [Q, k]
+    return vals, idx
+
+
+def _make_search_sharded(plan: MeshPlan, k: int):
+    """Docs-sharded search: local gather-score + local top-k + all_gather."""
+    mesh = plan.mesh
+    n_shards = plan.n_docs_shards
+
+    def body(ids, weights, head, qmat):
+        # ids/weights/head: [D/s, L] local rows; qmat: [V, Q] replicated.
+        safe = jnp.where(head, ids, 0)
+        contrib = jnp.where(head[..., None], weights[..., None]
+                            * qmat[safe], 0.0)           # [D/s, L, Q]
+        sims = jnp.sum(contrib, axis=1)                  # [D/s, Q]
+        local_k = min(k, sims.shape[0])
+        vals, idx = lax.top_k(sims.T, local_k)           # [Q, local_k]
+        base = lax.axis_index(DOCS_AXIS) * sims.shape[0]
+        idx = idx + base                                 # globalize
+        vals = lax.all_gather(vals, DOCS_AXIS, axis=1, tiled=True)
+        idx = lax.all_gather(idx, DOCS_AXIS, axis=1, tiled=True)
+        best, sel = lax.top_k(vals, min(k, local_k * n_shards))
+        return best, jnp.take_along_axis(idx, sel, axis=1)
+
+    return jax.jit(jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(DOCS_AXIS, None), P(DOCS_AXIS, None), P(DOCS_AXIS, None),
+                  P(None, None)),
+        out_specs=(P(None, None), P(None, None)),
+        check_vma=False))
+
+
+class TfidfRetriever:
+    """Index a corpus once, answer ranked cosine queries from device.
+
+    Args:
+      config: HASHED-vocab pipeline config (default 2^16 vocab).
+      plan: optional docs-sharded MeshPlan; the index then lives
+        block-sharded across the mesh and queries run SPMD.
+    """
+
+    def __init__(self, config: Optional[PipelineConfig] = None,
+                 plan: Optional[MeshPlan] = None):
+        self.config = config or PipelineConfig(vocab_mode=VocabMode.HASHED)
+        if self.config.vocab_mode is not VocabMode.HASHED:
+            raise ValueError("TfidfRetriever requires HASHED vocab")
+        if plan is not None and (plan.n_vocab_shards != 1
+                                 or plan.n_seq_shards != 1):
+            raise ValueError("retrieval shards the docs axis only")
+        self.plan = plan
+        self.names: List[str] = []
+        self._idf: Optional[jax.Array] = None
+        self._ids = self._weights = self._head = None
+        self._num_docs = 0
+        self._sharded_cache: dict = {}
+
+    # --- indexing ---
+    def index(self, corpus: Corpus) -> "TfidfRetriever":
+        cfg = self.config
+        pad = self.plan.pad_docs(len(corpus)) if self.plan else None
+        batch = pack_corpus(corpus, cfg, pad_docs_to=pad, want_words=False)
+        toks, lens = batch.token_ids, batch.lengths
+        if self.plan is not None:
+            toks = jax.device_put(
+                toks, self.plan.sharding(P(DOCS_AXIS, None)))
+            lens = jax.device_put(lens, self.plan.sharding(P(DOCS_AXIS)))
+        ids, weights, head, idf = _build_index(
+            toks, lens, jnp.int32(len(corpus)), vocab_size=cfg.vocab_size)
+        self._ids, self._weights, self._head = ids, weights, head
+        self._idf = idf
+        self.names = list(corpus.names)
+        self._num_docs = len(corpus)
+        return self
+
+    def index_dir(self, input_dir: str,
+                  strict: bool = True) -> "TfidfRetriever":
+        return self.index(discover_corpus(input_dir, strict))
+
+    @property
+    def indexed(self) -> bool:
+        return self._num_docs > 0
+
+    # --- querying ---
+    def _query_matrix(self, queries: Sequence[Union[str, bytes]]) -> np.ndarray:
+        """Host-side packing of queries into a dense [V, Q] cosine block."""
+        cfg = self.config
+        idf = np.asarray(self._idf)
+        q = np.zeros((cfg.vocab_size, len(queries)), np.float32)
+        for j, text in enumerate(queries):
+            data = text.encode() if isinstance(text, str) else text
+            words = whitespace_tokenize(data, cfg.truncate_tokens_at)
+            if not words:
+                continue
+            ids = words_to_ids(words, cfg.vocab_size, cfg.hash_seed)
+            counts = np.bincount(ids, minlength=cfg.vocab_size)
+            vec = counts.astype(np.float32) / len(words) * idf
+            norm = float(np.sqrt((vec * vec).sum()))
+            if norm > 0:
+                q[:, j] = vec / norm
+        return q
+
+    def search(self, queries: Sequence[Union[str, bytes]], k: int = 10
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        """Ranked retrieval: (scores, doc_indices), each [Q, k'].
+
+        ``doc_indices`` index into :attr:`names`; -1 marks padding when
+        fewer than k documents score (or exist). Scores are cosine
+        similarities; padded/empty matches score 0.
+        """
+        if not self.indexed:
+            raise RuntimeError("index() a corpus before search()")
+        qmat = jnp.asarray(self._query_matrix(queries))
+        if self.plan is not None:
+            fn = self._sharded_fn(k)
+            vals, idx = fn(self._ids, self._weights, self._head, qmat)
+        else:
+            data = jnp.where(self._head, self._weights, 0.0)
+            cols = jnp.where(self._head, self._ids, 0)[..., None]
+            vals, idx = _search_bcoo(data, cols, qmat,
+                                     k=min(k, self._ids.shape[0]))
+        vals, idx = np.asarray(vals), np.asarray(idx)
+        ok = (vals > 0) & (idx < self._num_docs)
+        return np.where(ok, vals, 0.0), np.where(ok, idx, -1)
+
+    def _sharded_fn(self, k: int):
+        if k not in self._sharded_cache:
+            self._sharded_cache[k] = _make_search_sharded(self.plan, k)
+        return self._sharded_cache[k]

@@ -21,6 +21,7 @@ document axis shards exactly like the dense path (``parallel``).
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -67,14 +68,41 @@ def sorted_term_counts(token_ids: jax.Array, lengths: jax.Array
     return sorted_ids, counts, head
 
 
-def sparse_df(ids: jax.Array, head: jax.Array, vocab_size: int) -> jax.Array:
-    """Document-frequency vector from row-sparse terms: one scatter-add
-    of the head mask — the ``currDoc`` dedup (``TFIDF.c:171-188``) is
-    already encoded in ``head`` (one head per distinct term per doc)."""
-    safe = jnp.where(head, ids, vocab_size)
-    df = jnp.zeros((vocab_size + 1,), jnp.int32)
-    df = df.at[safe.reshape(-1)].add(head.reshape(-1).astype(jnp.int32))
-    return df[:vocab_size]
+def sparse_df(ids: jax.Array, head: jax.Array, vocab_size: int,
+              method: Optional[str] = None) -> jax.Array:
+    """Document-frequency vector from row-sparse terms.
+
+    The ``currDoc`` dedup (``TFIDF.c:171-188``) is already encoded in
+    ``head`` (one head per distinct term per doc), so DF is a histogram
+    of the head-masked ids. Two lowerings:
+
+    * ``"scatter"`` — one scatter-add. Fine on CPU; on TPU a
+      non-unique-index scatter serializes into sorted runs.
+    * ``"sort"`` — globally sort the masked ids and take the difference
+      of ``searchsorted`` bin edges: only sort + vectorized binary
+      search, the ops the TPU backend is actually good at.
+
+    ``method=None`` picks by backend (sort on TPU, scatter elsewhere),
+    overridable via ``TFIDF_TPU_DF_METHOD``; both produce identical
+    counts (pinned by tests). The choice is resolved at *trace* time:
+    callers that jit this (ingest, retrieval) bake it into their cached
+    executable, so set the env var before the first call of a shape.
+    """
+    if method is None:
+        method = os.environ.get("TFIDF_TPU_DF_METHOD") or (
+            "sort" if jax.default_backend() == "tpu" else "scatter")
+    if method == "scatter":
+        safe = jnp.where(head, ids, vocab_size)
+        df = jnp.zeros((vocab_size + 1,), jnp.int32)
+        df = df.at[safe.reshape(-1)].add(head.reshape(-1).astype(jnp.int32))
+        return df[:vocab_size]
+    if method != "sort":
+        raise ValueError(f"unknown sparse_df method {method!r}")
+    masked = jnp.where(head, ids, jnp.iinfo(jnp.int32).max).reshape(-1)
+    srt = jnp.sort(masked)
+    edges = jnp.arange(vocab_size + 1, dtype=jnp.int32)
+    pos = jnp.searchsorted(srt, edges)
+    return (pos[1:] - pos[:-1]).astype(jnp.int32)
 
 
 def sparse_scores(ids: jax.Array, counts: jax.Array, head: jax.Array,
