@@ -78,3 +78,31 @@ class TestOverlappedIngest:
             run_overlapped(corpus_dir, _cfg(vocab_mode=VocabMode.EXACT))
         with pytest.raises(ValueError):
             run_overlapped(corpus_dir, _cfg(topk=None))
+        with pytest.raises(ValueError):
+            run_overlapped(corpus_dir, _cfg(), spill="bogus")
+
+    def test_spill_modes_agree(self, corpus_dir):
+        cfg = _cfg()
+        host = run_overlapped(corpus_dir, cfg, chunk_docs=16, doc_len=64,
+                              spill="host")
+        reread = run_overlapped(corpus_dir, cfg, chunk_docs=16, doc_len=64,
+                                spill="reread")
+        assert (host.df == reread.df).all()
+        np.testing.assert_array_equal(host.topk_vals, reread.topk_vals)
+        np.testing.assert_array_equal(host.topk_ids, reread.topk_ids)
+
+    def test_compile_flat_in_chunk_count(self, corpus_dir):
+        """More chunks must not mean more compiled programs: both phases
+        are one executable each, keyed only on the [chunk, L] shape."""
+        from tfidf_tpu import ingest as mod
+
+        if not hasattr(mod._phase_a, "_cache_size"):
+            pytest.skip("jit cache-size introspection unavailable")
+        cfg = _cfg()
+        run_overlapped(corpus_dir, cfg, chunk_docs=8, doc_len=64)  # 5 chunks
+        a0 = mod._phase_a._cache_size()
+        b0 = mod._phase_b._cache_size()
+        run_overlapped(corpus_dir, cfg, chunk_docs=2, doc_len=64)  # 20 chunks
+        # One new entry per phase at most (the new [2, L] chunk shape).
+        assert mod._phase_a._cache_size() <= a0 + 1
+        assert mod._phase_b._cache_size() <= b0 + 1

@@ -10,16 +10,27 @@ runs ahead of the device and the transfer/compute of one chunk hides the
 host tokenize/hash of the next.
 
 Because DF is corpus-global but chunks stream, the run is two device
-phases (same shape as classic out-of-core TF-IDF, and of the reference's
+passes (same shape as classic out-of-core TF-IDF, and of the reference's
 own reduce-then-rebroadcast choreography, ``TFIDF.c:215-220``):
 
-  A. per chunk: sort + run-length term triples, partial DF — triples
-     stay resident on device; only the [V] partial DF accumulates.
-  B. per chunk: score the resident triples against the final corpus-wide
-     IDF and select per-doc top-k.
+  A. per chunk: partial DF, folded into a single device-resident [V]
+     accumulator. Nothing else survives the chunk.
+  B. per chunk: re-derive the row-sparse triples and score them against
+     the final corpus-wide IDF; keep only the [chunk, K] top-k.
 
-All chunks share one compiled program per phase (static [chunk, L]
-shapes; the last chunk is padded with empty docs).
+Both passes run ONE compiled program each, reused for every chunk
+(static [chunk, L] shapes; the last chunk is padded with empty docs), so
+compile time and device memory are FLAT in the number of chunks: device
+residency is one [chunk, L] batch + the [V] DF + the accumulated
+[D, K] top-k. Pass B re-sorts each chunk instead of keeping pass-A
+triples resident — sort is cheap on device next to the transfer it
+would take to spill triples, and it is what makes 1M-doc corpora fit.
+
+Between passes the packed host arrays are either kept in host RAM
+(``spill="host"``) or re-packed from disk in pass B (``spill="reread"``,
+the reference's own two-scan idiom, ``TFIDF.c:141-147`` — it fseeks and
+re-reads every doc). ``spill="auto"`` keeps chunks in RAM up to a byte
+budget and re-reads beyond it.
 """
 
 from __future__ import annotations
@@ -40,43 +51,35 @@ from tfidf_tpu.ops.scoring import idf_from_df
 from tfidf_tpu.ops.sparse import (sorted_term_counts, sparse_df,
                                   sparse_scores, sparse_topk)
 
+# spill="auto": keep packed chunks in host RAM up to this many bytes,
+# re-read from disk beyond. Read at call time (TFIDF_TPU_SPILL_BYTES)
+# so tests/tuning can override after import, like TFIDF_TPU_DF_METHOD.
+_DEFAULT_SPILL_BYTES = 1 << 30
+
+# Host-ahead bound: how many chunks the dispatch loops may run ahead of
+# the device before blocking. Keeps HBM residency at O(lookahead) chunk
+# buffers even when host packing outpaces device compute.
+_LOOKAHEAD = 2
+
 
 @functools.partial(jax.jit, static_argnames=("vocab_size",))
-def _phase_a(token_ids, lengths, *, vocab_size: int):
-    """Chunk -> (row-sparse triples, partial DF). Triples stay on device."""
+def _phase_a(token_ids, lengths, df_acc, *, vocab_size: int):
+    """Fold one chunk's partial DF into the device-resident accumulator."""
+    ids, _, head = sorted_term_counts(token_ids, lengths)
+    return df_acc + sparse_df(ids, head, vocab_size)
+
+
+@functools.partial(jax.jit, static_argnames=("topk",))
+def _phase_b(token_ids, lengths, idf, *, topk: int):
+    """Score one chunk against the final corpus-wide IDF -> top-k."""
     ids, counts, head = sorted_term_counts(token_ids, lengths)
-    return ids, counts, head, sparse_df(ids, head, vocab_size)
-
-
-@functools.partial(jax.jit, static_argnames=("score_dtype", "topk"))
-def _phase_b(ids, counts, head, lengths, df_total, num_docs, *,
-             score_dtype, topk: int):
-    idf = idf_from_df(df_total, num_docs, score_dtype)
     scores = sparse_scores(ids, counts, head, lengths, idf)
     return sparse_topk(scores, ids, head, topk)
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("score_dtype", "topk", "n_chunks"))
-def _phase_b_all(flat, df_parts, num_docs, *, score_dtype, topk: int,
-                 n_chunks: int):
-    """All chunks' phase B in ONE program: df reduce + score + top-k.
-
-    ``flat`` is the per-chunk (ids, counts, head, lengths) tuples
-    flattened in order. One dispatch and one (vals, ids) result for the
-    whole corpus instead of per-chunk calls — dispatch/transfer round
-    trips, not FLOPs, dominate phase B.
-    """
-    df_total = functools.reduce(jnp.add, df_parts)
-    idf = idf_from_df(df_total, num_docs, score_dtype)
-    vals, out_ids = [], []
-    for c in range(n_chunks):
-        ids, counts, head, lengths = flat[4 * c:4 * c + 4]
-        scores = sparse_scores(ids, counts, head, lengths, idf)
-        v, t = sparse_topk(scores, ids, head, topk)
-        vals.append(v)
-        out_ids.append(t)
-    return df_total, jnp.concatenate(vals), jnp.concatenate(out_ids)
+@functools.partial(jax.jit, static_argnames=("score_dtype",))
+def _final_idf(df_total, num_docs, *, score_dtype):
+    return idf_from_df(df_total, num_docs, score_dtype)
 
 
 @dataclasses.dataclass
@@ -93,8 +96,8 @@ class IngestResult:
 
 def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
                    chunk_docs: int = 8192, doc_len: Optional[int] = None,
-                   strict: bool = True) -> IngestResult:
-    """Stream a directory through the overlapped two-phase pipeline.
+                   strict: bool = True, spill: str = "auto") -> IngestResult:
+    """Stream a directory through the overlapped two-pass pipeline.
 
     ``doc_len`` fixes the static token length L for every chunk (defaults
     to ``config.max_doc_len``); documents longer than L are truncated to
@@ -103,16 +106,23 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
     truncation is unacceptable, or ``parallel.longdoc`` for documents
     beyond any single chip.
 
+    ``spill`` controls where packed chunks live between pass A and B:
+    ``"host"`` (RAM), ``"reread"`` (re-pack from disk), or ``"auto"``
+    (RAM up to a budget). Device memory is flat in corpus size either
+    way; see the module docstring.
+
     Requires HASHED vocab (fixed id space across chunks) and a top-k
-    selection (full per-term output would defeat the resident-triple
-    design). Works with or without the native loader; the native path
-    keeps document bytes out of Python entirely.
+    selection (full per-term output would defeat the streaming design).
+    Works with or without the native loader; the native path keeps
+    document bytes out of Python entirely.
     """
     cfg = config or PipelineConfig(vocab_mode=VocabMode.HASHED, topk=16)
     if cfg.vocab_mode is not VocabMode.HASHED:
         raise ValueError("overlapped ingest requires VocabMode.HASHED")
     if cfg.topk is None:
         raise ValueError("overlapped ingest requires a topk selection")
+    if spill not in ("auto", "host", "reread"):
+        raise ValueError(f"unknown spill policy {spill!r}")
     length = doc_len or cfg.max_doc_len
     names = discover_names(input_dir, strict)
     num_docs = len(names)
@@ -123,6 +133,12 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
                   and fast_tokenizer.loader_available())
     score_dtype = jnp.dtype(cfg.score_dtype)
     k = min(cfg.topk, length)
+    if spill == "auto":
+        itemsize = 2 if (use_native and cfg.vocab_size <= (1 << 16)) else 4
+        est = num_docs * length * itemsize
+        budget = int(os.environ.get("TFIDF_TPU_SPILL_BYTES",
+                                    _DEFAULT_SPILL_BYTES))
+        spill = "host" if est <= budget else "reread"
 
     def pack_chunk_native(chunk_names: List[str]
                           ) -> Tuple[np.ndarray, np.ndarray]:
@@ -150,30 +166,52 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
         return ids, np.minimum(batch.lengths, length).astype(np.int32)
 
     pack_chunk = pack_chunk_native if use_native else pack_chunk_python
+    starts = list(range(0, num_docs, chunk_docs))
 
-    # Phase A: launch every chunk; the loop packs chunk i+1 while the
-    # device still runs chunk i (async dispatch — no block in the loop).
-    resident = []
-    df_parts = []
+    # Pass A: fold every chunk's partial DF into one device accumulator.
+    # The loop packs chunk i+1 while the device still runs chunk i
+    # (async dispatch), but never runs more than _LOOKAHEAD chunks
+    # ahead — blocking on chunk i-_LOOKAHEAD's result bounds HBM
+    # residency at O(lookahead) [chunk, L] buffers even when host
+    # packing outpaces the device.
+    df_acc = jnp.zeros((cfg.vocab_size,), jnp.int32)
+    cached: List[Tuple[np.ndarray, np.ndarray]] = []
     all_lengths: List[np.ndarray] = []
-    for start in range(0, num_docs, chunk_docs):
+    in_flight: List[jax.Array] = []
+    for start in starts:
         chunk_names = names[start:start + chunk_docs]
         token_ids, lengths = pack_chunk(chunk_names)
         all_lengths.append(lengths[:len(chunk_names)])
+        if spill == "host":
+            cached.append((token_ids, lengths))
         toks = jax.device_put(token_ids)
         lens = jax.device_put(lengths)
-        ids, counts, head, df_part = _phase_a(toks, lens,
-                                              vocab_size=cfg.vocab_size)
-        resident.append((ids, counts, head, lens))
-        df_parts.append(df_part)
+        df_acc = _phase_a(toks, lens, df_acc, vocab_size=cfg.vocab_size)
+        in_flight.append(df_acc)
+        if len(in_flight) > _LOOKAHEAD:
+            in_flight.pop(0).block_until_ready()
 
-    # Phase B: rescore all resident triples against corpus-wide IDF in
-    # one program — a single dispatch and one fetched result.
-    flat = tuple(a for chunk in resident for a in chunk)
-    df_total, vals_d, tids_d = _phase_b_all(
-        flat, tuple(df_parts), jnp.int32(num_docs),
-        score_dtype=score_dtype, topk=k, n_chunks=len(resident))
-    df_host, vals, tids = jax.device_get((df_total, vals_d, tids_d))
+    idf = _final_idf(df_acc, jnp.int32(num_docs), score_dtype=score_dtype)
+
+    # Pass B: rescore each chunk against the corpus-wide IDF. Same
+    # overlap structure; only the [chunk, K] selections accumulate on
+    # device, fetched in one transfer at the end.
+    vals_parts, ids_parts = [], []
+    for ci, start in enumerate(starts):
+        if spill == "host":
+            token_ids, lengths = cached[ci]
+        else:
+            token_ids, lengths = pack_chunk(names[start:start + chunk_docs])
+        toks = jax.device_put(token_ids)
+        lens = jax.device_put(lengths)
+        v, t = _phase_b(toks, lens, idf, topk=k)
+        vals_parts.append(v)
+        ids_parts.append(t)
+        if ci >= _LOOKAHEAD:  # same bounded lookahead as pass A
+            vals_parts[ci - _LOOKAHEAD].block_until_ready()
+
+    df_host, vals, tids = jax.device_get(
+        (df_acc, jnp.concatenate(vals_parts), jnp.concatenate(ids_parts)))
     return IngestResult(df=df_host, topk_vals=vals[:num_docs],
                         topk_ids=tids[:num_docs],
                         lengths=np.concatenate(all_lengths), names=names,
