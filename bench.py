@@ -1,7 +1,11 @@
 """Headline benchmark: docs/sec on TPU vs the 8-rank CPU oracle.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "docs/sec", "vs_baseline": N}
+Prints ONE JSON line on stdout, ALWAYS — success or failure:
+  {"metric": ..., "value": N, "unit": "docs/sec", "vs_baseline": N, ...}
+plus diagnostic fields: "backend", "recall_at_k", "cpu_docs_per_sec",
+"pack_s", "tpu_s", and "error" when something went wrong. All other
+chatter goes to stderr, so the driver's JSON parse cannot be broken by
+progress output.
 
 Method (BASELINE.json north star, scaled to fit a CI budget): generate a
 synthetic Zipf-distributed corpus on disk, run the native bit-reference
@@ -9,6 +13,16 @@ with 8 worker ranks (the "8-rank MPI CPU baseline" — measured, since the
 reference publishes no numbers, BASELINE.md), then run the TPU path
 end-to-end (read + native tokenize/hash + pack + device histogram/DF/
 score/top-k) and report TPU docs/sec with vs_baseline = tpu/cpu ratio.
+The same oracle run's output feeds the top-k recall metric
+(tfidf_tpu/recall.py) on a sampled doc subset — both halves of the
+north star in one line.
+
+Hardening (VERDICT round 1 item 1): the TPU backend (axon tunnel) can
+hang at init, so the backend is pre-flighted in a SUBPROCESS with a hard
+timeout and bounded retries before jax is ever imported in-process; on
+exhaustion the bench still runs (CPU backend) and the JSON carries
+"backend" + "error" so a degraded environment produces a parseable,
+honestly-labeled line instead of rc=1.
 """
 
 from __future__ import annotations
@@ -20,6 +34,7 @@ import subprocess
 import sys
 import tempfile
 import time
+import traceback
 
 import numpy as np
 
@@ -28,9 +43,56 @@ sys.path.insert(0, REPO)
 
 N_DOCS = int(os.environ.get("BENCH_DOCS", 32768))
 DOC_LEN = int(os.environ.get("BENCH_DOC_LEN", 256))
+REPEATS = int(os.environ.get("BENCH_REPEATS", 2))  # SAME for both sides
+RECALL_DOCS = int(os.environ.get("BENCH_RECALL_DOCS", 512))
+PREFLIGHT_S = float(os.environ.get("BENCH_PREFLIGHT_S", 120))
 N_WORDS = 8192
 VOCAB = 1 << 16
 TOPK = 16
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def preflight_backend(retries: int = 2) -> str:
+    """Probe jax's default backend in a subprocess with a hard timeout.
+
+    The axon TPU tunnel has been observed to hang jax.devices() past
+    90 s (VERDICT r1); a subprocess probe is killable, an in-process
+    import is not. Returns the backend name the in-process import can
+    expect ("tpu"/"cpu"/...), or "none" if every probe failed.
+    """
+    probe = "import jax; print(jax.default_backend())"
+
+    def attempt_probe(tag: str, env) -> str:
+        try:
+            t0 = time.perf_counter()
+            out = subprocess.run(
+                [sys.executable, "-c", probe], capture_output=True,
+                timeout=PREFLIGHT_S, text=True, env=env)
+            backend = out.stdout.strip().splitlines()[-1] if out.stdout else ""
+            if out.returncode == 0 and backend:
+                log(f"preflight[{tag}]: backend={backend} "
+                    f"({time.perf_counter() - t0:.1f}s)")
+                return backend
+            log(f"preflight[{tag}] rc={out.returncode}: "
+                f"{out.stderr.strip()[-300:]}")
+        except subprocess.TimeoutExpired:
+            log(f"preflight[{tag}]: timed out after {PREFLIGHT_S:.0f}s")
+        return ""
+
+    for attempt in range(retries + 1):
+        backend = attempt_probe(str(attempt), None)
+        if backend:
+            return backend
+    # Accelerator init hangs/fails: a CPU-only jax still measures the
+    # pipeline (labeled degraded via the JSON "backend"/"error" fields).
+    cpu_env = dict(os.environ, JAX_PLATFORMS="cpu")
+    if attempt_probe("cpu-fallback", cpu_env) == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"  # in-process import follows suit
+        return "cpu"
+    return "none"
 
 
 def make_corpus(root: str) -> str:
@@ -53,10 +115,12 @@ def make_corpus(root: str) -> str:
 def bench_native(input_dir: str, out: str) -> float:
     binary = os.path.join(REPO, "native", "tfidf_ref")
     if not os.path.exists(binary):
-        subprocess.run(["make", "-C", os.path.join(REPO, "native")],
-                       check=True, capture_output=True)
+        built = subprocess.run(["make", "-C", os.path.join(REPO, "native")],
+                               capture_output=True, text=True)
+        if built.returncode != 0:
+            raise RuntimeError(f"native build failed:\n{built.stderr[-2000:]}")
     best = float("inf")
-    for _ in range(2):  # best-of-2: host-side timing noise (see bench_tpu)
+    for _ in range(REPEATS):
         t0 = time.perf_counter()
         subprocess.run([binary, input_dir, out, "9"], check=True,
                        stdout=subprocess.DEVNULL)
@@ -64,53 +128,102 @@ def bench_native(input_dir: str, out: str) -> float:
     return best
 
 
-def bench_tpu(input_dir: str) -> float:
+def bench_tpu(input_dir: str):
     from tfidf_tpu.config import PipelineConfig, VocabMode
-    from tfidf_tpu.ingest import run_overlapped
+    from tfidf_tpu.ingest import make_chunk_packer, run_overlapped
+    from tfidf_tpu.io.corpus import discover_names
 
     # Overlapped chunked ingest on the row-sparse engine: the native
     # parallel loader packs chunk i+1 while the device runs chunk i
-    # (async dispatch), DF accumulates across chunks, and resident
-    # triples are rescored against the final corpus-wide IDF. O(D x L)
-    # device memory — no [D, V] materialization at any point.
+    # (async dispatch), DF folds into one device accumulator, and pass B
+    # rescoreds each chunk against the corpus-wide IDF. Device memory is
+    # O(chunk x L) — flat in corpus size.
     cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=VOCAB,
                          max_doc_len=DOC_LEN, doc_chunk=DOC_LEN, topk=TOPK,
                          engine="sparse")
     chunk = min(N_DOCS, 8192)
 
-    # Untimed warmup compiles both phases at the chunk shape; the timed
-    # runs re-ingest from raw bytes and hit the jit cache. Best-of-3:
-    # single-core host contention with the device tunnel makes
-    # individual runs noisy; the minimum is the honest steady state.
-    run_overlapped(input_dir, cfg, chunk_docs=chunk, doc_len=DOC_LEN)
+    # Host pack cost alone (one pass over the corpus with the exact
+    # packer run_overlapped uses — native loader or Python fallback) so
+    # the breakdown shows where the wall-clock goes.
+    names = discover_names(input_dir, strict=True)
+    packer = make_chunk_packer(input_dir, cfg, chunk, DOC_LEN)
+    t0 = time.perf_counter()
+    for s in range(0, len(names), chunk):
+        packer(names[s:s + chunk])
+    pack_s = time.perf_counter() - t0
 
+    # Untimed warmup compiles both phases at the chunk shape; the timed
+    # runs re-ingest from raw bytes and hit the jit cache. Best-of-N
+    # with the SAME N as the native side (min is the honest steady state
+    # on a noisy single-core host; asymmetric N would bias the ratio).
+    result = run_overlapped(input_dir, cfg, chunk_docs=chunk,
+                            doc_len=DOC_LEN)
     best = float("inf")
-    for _ in range(3):
+    for _ in range(REPEATS):
         t0 = time.perf_counter()
         result = run_overlapped(input_dir, cfg, chunk_docs=chunk,
                                 doc_len=DOC_LEN)
         best = min(best, time.perf_counter() - t0)
         assert result.topk_vals.shape == (N_DOCS, TOPK)
-    return best
+    return best, pack_s, result
+
+
+def measure_recall(result, oracle_out: str) -> float:
+    from tfidf_tpu.recall import corpus_recall, parse_oracle_output
+
+    sample = [f"doc{i}" for i in range(1, min(RECALL_DOCS, N_DOCS) + 1)]
+    per_doc = parse_oracle_output(oracle_out, docs=sample)
+    return corpus_recall(per_doc, result.names, result.topk_ids,
+                         result.topk_vals, TOPK, VOCAB)
 
 
 def main() -> None:
+    record = {
+        "metric": f"docs/sec, {N_DOCS}-doc Zipf corpus, hashed 2^16 "
+                  f"vocab, top-{TOPK} (vs 8-worker native CPU oracle)",
+        "value": 0.0,
+        "unit": "docs/sec",
+        "vs_baseline": 0.0,
+    }
     tmp = tempfile.mkdtemp(prefix="tfidf_bench_")
     try:
+        backend = preflight_backend()
+        record["backend"] = backend
+        if backend == "none":
+            record["error"] = ("jax backend init failed/hung in all "
+                               "preflight attempts; no compute backend")
+            return
+        if backend != "tpu":
+            record["error"] = f"TPU unavailable; measured on {backend}"
+
+        log(f"generating {N_DOCS}-doc corpus...")
         input_dir = make_corpus(tmp)
-        cpu_s = bench_native(input_dir, os.path.join(tmp, "ref_out.txt"))
-        tpu_s = bench_tpu(input_dir)
+        oracle_out = os.path.join(tmp, "ref_out.txt")
+        log("native oracle runs...")
+        cpu_s = bench_native(input_dir, oracle_out)
+        log(f"native: {cpu_s:.2f}s; TPU runs...")
+        tpu_s, pack_s, result = bench_tpu(input_dir)
+        log(f"tpu: {tpu_s:.2f}s (pack-only {pack_s:.2f}s); recall...")
+        recall = measure_recall(result, oracle_out)
+
         cpu_dps = N_DOCS / cpu_s
         tpu_dps = N_DOCS / tpu_s
-        print(json.dumps({
-            "metric": f"docs/sec, {N_DOCS}-doc Zipf corpus, hashed 2^16 "
-                      f"vocab, top-{TOPK} (vs 8-worker native CPU oracle)",
-            "value": round(tpu_dps, 1),
-            "unit": "docs/sec",
-            "vs_baseline": round(tpu_dps / cpu_dps, 2),
-        }))
+        record.update(
+            value=round(tpu_dps, 1),
+            vs_baseline=round(tpu_dps / cpu_dps, 2),
+            cpu_docs_per_sec=round(cpu_dps, 1),
+            tpu_s=round(tpu_s, 3),
+            cpu_s=round(cpu_s, 3),
+            pack_s=round(pack_s, 3),
+            recall_at_k=round(recall, 4),
+            n_docs=N_DOCS,
+        )
+    except Exception:
+        record["error"] = traceback.format_exc(limit=20)[-2000:]
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
+        print(json.dumps(record), flush=True)
 
 
 if __name__ == "__main__":

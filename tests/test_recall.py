@@ -1,0 +1,104 @@
+"""Top-k recall harness (tfidf_tpu/recall.py) vs the native oracle.
+
+Pins the north star's second half: on a collision-free corpus the
+hashed-vocab TPU top-k recalls the oracle's exact-string top-k at 1.0.
+"""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+from tfidf_tpu.config import PipelineConfig, VocabMode
+from tfidf_tpu.ingest import run_overlapped
+from tfidf_tpu.ops.hashing import words_to_ids
+from tfidf_tpu.recall import corpus_recall, doc_recall, parse_oracle_output
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "native", "tfidf_ref")
+
+
+def _ensure_native():
+    if not os.path.exists(NATIVE):
+        subprocess.run(["make", "-C", os.path.join(REPO, "native")],
+                       check=True, capture_output=True)
+
+
+class TestParse:
+    def test_parse_and_filter(self, tmp_path):
+        p = tmp_path / "out.txt"
+        p.write_bytes(b"doc1@apple\t0.5000000000000000\n"
+                      b"doc1@pear\t0.2500000000000000\n"
+                      b"doc2@plum\t0.1000000000000000\n")
+        full = parse_oracle_output(str(p))
+        assert full["doc1"] == [(b"apple", 0.5), (b"pear", 0.25)]
+        only2 = parse_oracle_output(str(p), docs=["doc2"])
+        assert list(only2) == ["doc2"]
+
+
+class TestDocRecall:
+    def test_perfect(self):
+        ref = [(b"a", 0.9), (b"b", 0.5), (b"c", 0.1)]
+        ids = words_to_ids([b"a", b"b"], 1 << 20)
+        assert doc_recall(ref, ids, [0.9, 0.5], 2, 1 << 20) == 1.0
+
+    def test_miss(self):
+        ref = [(b"a", 0.9), (b"b", 0.5)]
+        ids = words_to_ids([b"a", b"zzz"], 1 << 20)
+        assert doc_recall(ref, ids, [0.9, 0.5], 2, 1 << 20) == 0.5
+
+    def test_ties_at_k_are_acceptable(self):
+        # b and c tie at the k=2 boundary: either pick scores 1.0.
+        ref = [(b"a", 0.9), (b"b", 0.5), (b"c", 0.5)]
+        for pick in (b"b", b"c"):
+            ids = words_to_ids([b"a", pick], 1 << 20)
+            assert doc_recall(ref, ids, [0.9, 0.5], 2, 1 << 20) == 1.0
+
+    def test_collisions_count_once(self):
+        # vocab 1: every word folds to bucket 0; one hit covers all.
+        ref = [(b"a", 0.9), (b"b", 0.5)]
+        assert doc_recall(ref, [0], [0.9], 2, 1) == 1.0
+
+    def test_undefined_when_all_zero(self):
+        assert doc_recall([(b"a", 0.0)], [3], [0.1], 2, 16) is None
+
+    def test_padding_ignored(self):
+        ref = [(b"a", 0.9)]
+        ids = list(words_to_ids([b"a"], 1 << 20)) + [-1]
+        assert doc_recall(ref, ids, [0.9, 0.0], 2, 1 << 20) == 1.0
+
+
+class TestEndToEndRecall:
+    @pytest.fixture
+    def corpus_dir(self, tmp_path):
+        rng = np.random.default_rng(7)
+        words = [f"term{i}" for i in range(120)]
+        input_dir = tmp_path / "input"
+        input_dir.mkdir()
+        for i in range(1, 33):
+            n = int(rng.integers(5, 40))
+            picks = rng.choice(words, size=n)
+            (input_dir / f"doc{i}").write_text(" ".join(picks))
+        return str(input_dir), words
+
+    def test_recall_is_one_collision_free(self, corpus_dir, tmp_path):
+        input_dir, words = corpus_dir
+        vocab = 1 << 20
+        ids = words_to_ids([w.encode() for w in words], vocab)
+        assert len(set(ids.tolist())) == len(words), "pick a bigger vocab"
+
+        _ensure_native()
+        out = str(tmp_path / "oracle.txt")
+        subprocess.run([NATIVE, input_dir, out, "4"], check=True,
+                       stdout=subprocess.DEVNULL)
+        per_doc = parse_oracle_output(out)
+
+        k = 8
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=vocab,
+                             max_doc_len=64, doc_chunk=64, topk=k,
+                             engine="sparse")
+        got = run_overlapped(input_dir, cfg, chunk_docs=16, doc_len=64)
+        r = corpus_recall(per_doc, got.names, got.topk_ids, got.topk_vals,
+                          k, vocab)
+        assert r == 1.0

@@ -94,6 +94,46 @@ class IngestResult:
     num_docs: int
 
 
+def make_chunk_packer(input_dir: str, cfg: PipelineConfig, chunk_docs: int,
+                      length: int):
+    """The host packing path of one chunk: names -> (token_ids, lengths).
+
+    Native parallel loader when built (document bytes never enter
+    Python), else the Python pack path — the exact code
+    :func:`run_overlapped` runs, exposed so benchmarks/diagnostics time
+    the same workload instead of re-implementing it.
+    """
+    use_native = (cfg.tokenizer is TokenizerKind.WHITESPACE
+                  and fast_tokenizer.loader_available())
+
+    def pack_chunk_native(chunk_names: List[str]
+                          ) -> Tuple[np.ndarray, np.ndarray]:
+        packed = fast_tokenizer.load_pack_paths(
+            [os.path.join(input_dir, n) for n in chunk_names],
+            cfg.vocab_size, cfg.hash_seed, cfg.truncate_tokens_at,
+            min_len=length, chunk=length, fixed_len=length,
+            pad_docs_to=chunk_docs)
+        assert packed is not None  # loader_available() checked above
+        return packed
+
+    def pack_chunk_python(chunk_names: List[str]
+                          ) -> Tuple[np.ndarray, np.ndarray]:
+        from tfidf_tpu.io.corpus import Corpus
+        docs = []
+        for n in chunk_names:
+            with open(os.path.join(input_dir, n), "rb") as f:
+                docs.append(f.read())
+        batch = pack_corpus(Corpus(names=list(chunk_names), docs=docs),
+                            cfg, pad_docs_to=chunk_docs, want_words=False)
+        ids = batch.token_ids[:, :length]
+        if batch.token_ids.shape[1] < length:
+            pad = np.zeros((ids.shape[0], length - ids.shape[1]), ids.dtype)
+            ids = np.concatenate([ids, pad], axis=1)
+        return ids, np.minimum(batch.lengths, length).astype(np.int32)
+
+    return pack_chunk_native if use_native else pack_chunk_python
+
+
 def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
                    chunk_docs: int = 8192, doc_len: Optional[int] = None,
                    strict: bool = True, spill: str = "auto") -> IngestResult:
@@ -140,32 +180,7 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
                                     _DEFAULT_SPILL_BYTES))
         spill = "host" if est <= budget else "reread"
 
-    def pack_chunk_native(chunk_names: List[str]
-                          ) -> Tuple[np.ndarray, np.ndarray]:
-        packed = fast_tokenizer.load_pack_paths(
-            [os.path.join(input_dir, n) for n in chunk_names],
-            cfg.vocab_size, cfg.hash_seed, cfg.truncate_tokens_at,
-            min_len=length, chunk=length, fixed_len=length,
-            pad_docs_to=chunk_docs)
-        assert packed is not None  # loader_available() checked above
-        return packed
-
-    def pack_chunk_python(chunk_names: List[str]
-                          ) -> Tuple[np.ndarray, np.ndarray]:
-        from tfidf_tpu.io.corpus import Corpus
-        docs = []
-        for n in chunk_names:
-            with open(os.path.join(input_dir, n), "rb") as f:
-                docs.append(f.read())
-        batch = pack_corpus(Corpus(names=list(chunk_names), docs=docs),
-                            cfg, pad_docs_to=chunk_docs, want_words=False)
-        ids = batch.token_ids[:, :length]
-        if batch.token_ids.shape[1] < length:
-            pad = np.zeros((ids.shape[0], length - ids.shape[1]), ids.dtype)
-            ids = np.concatenate([ids, pad], axis=1)
-        return ids, np.minimum(batch.lengths, length).astype(np.int32)
-
-    pack_chunk = pack_chunk_native if use_native else pack_chunk_python
+    pack_chunk = make_chunk_packer(input_dir, cfg, chunk_docs, length)
     starts = list(range(0, num_docs, chunk_docs))
 
     # Pass A: fold every chunk's partial DF into one device accumulator.

@@ -1,0 +1,100 @@
+"""Top-k term recall vs the exact-string oracle — the second half of the
+north-star metric (BASELINE.md: "identical top-k terms").
+
+The native bit-reference (``native/tfidf_ref.cc``) emits the reference's
+exact per-(doc, word) score lines (``doc@word\\t%.16f``, ``TFIDF.c:245,
+274-282``) with string-keyed exact vocabulary. The TPU path hashes words
+into a fixed vocab (``ops.hashing``), so its top-k is a set of *bucket*
+ids. Recall here is therefore computed collision-aware, in bucket space
+(SURVEY §7 "hard parts"):
+
+* the oracle's positive-score top-k words are mapped through the same
+  FNV-1a + fold hash the TPU path used;
+* ties at the k-th score are all *acceptable* (either side's ordering
+  among equal scores is arbitrary — the reference itself breaks ties by
+  insertion order, ``TFIDF.c:303-317``);
+* two oracle words that collide into one bucket count once in the
+  denominator — the TPU path cannot distinguish them by construction.
+
+``recall == 1.0`` on a collision-free corpus is pinned by
+``tests/test_recall.py``; the benchmark reports the measured value on
+its Zipf corpus alongside docs/sec.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tfidf_tpu.ops.hashing import words_to_ids
+
+DocTerms = List[Tuple[bytes, float]]
+
+
+def parse_oracle_output(path: str, docs: Optional[Iterable[str]] = None
+                        ) -> Dict[str, DocTerms]:
+    """Parse reference-format output into per-doc (word, score) lists.
+
+    ``docs``: optional doc-name filter — with a 1M-doc corpus the file
+    has one line per (doc, word) record, so recall is usually sampled on
+    a subset without holding the full parse in memory.
+    """
+    want = set(docs) if docs is not None else None
+    per: Dict[str, DocTerms] = {}
+    with open(path, "rb") as f:
+        for line in f:
+            line = line.rstrip(b"\n")
+            if not line:
+                continue
+            key, score = line.rsplit(b"\t", 1)
+            doc, word = key.split(b"@", 1)  # strict names hold no '@'
+            name = doc.decode()
+            if want is not None and name not in want:
+                continue
+            per.setdefault(name, []).append((word, float(score)))
+    return per
+
+
+def doc_recall(ref_terms: DocTerms, got_ids: Sequence[int],
+               got_vals: Sequence[float], k: int, vocab_size: int,
+               seed: int = 0) -> Optional[float]:
+    """Collision-aware recall@k of hashed top-k ids vs exact oracle terms.
+
+    Returns None when the oracle has no positive-score terms for the doc
+    (every term appears in all docs -> IDF 0; recall is undefined, and
+    both sides agree nothing is informative).
+    """
+    pos = sorted((t for t in ref_terms if t[1] > 0.0), key=lambda t: -t[1])
+    if not pos:
+        return None
+    kk = min(k, len(pos))
+    thresh = pos[kk - 1][1]
+    buckets = words_to_ids([w for w, _ in pos], vocab_size, seed)
+    required = {int(b) for b, (_, s) in zip(buckets[:kk], pos[:kk])}
+    # Everything tied with the k-th score is acceptable on either side.
+    acceptable = {int(b) for b, (_, s) in zip(buckets, pos) if s >= thresh}
+    got = {int(i) for i, v in zip(got_ids, got_vals) if i >= 0 and v > 0.0}
+    hit = len(got & acceptable)
+    return min(1.0, hit / len(required))
+
+
+def corpus_recall(per_doc_ref: Dict[str, DocTerms], names: Sequence[str],
+                  topk_ids: np.ndarray, topk_vals: np.ndarray, k: int,
+                  vocab_size: int, seed: int = 0) -> float:
+    """Mean doc_recall over every doc present in ``per_doc_ref``.
+
+    ``names[d]`` aligns row d of ``topk_ids``/``topk_vals`` with its
+    oracle terms; docs with undefined recall are excluded from the mean.
+    """
+    scores = []
+    for d, name in enumerate(names):
+        ref = per_doc_ref.get(name)
+        if ref is None:
+            continue
+        r = doc_recall(ref, topk_ids[d], topk_vals[d], k, vocab_size, seed)
+        if r is not None:
+            scores.append(r)
+    if not scores:
+        raise ValueError("no overlapping docs with defined recall")
+    return float(np.mean(scores))
