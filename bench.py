@@ -71,7 +71,8 @@ def preflight_backend(retries: int = 2) -> str:
             out = subprocess.run(
                 [sys.executable, "-c", probe], capture_output=True,
                 timeout=PREFLIGHT_S, text=True, env=env)
-            backend = out.stdout.strip().splitlines()[-1] if out.stdout else ""
+            lines = out.stdout.strip().splitlines() if out.stdout else []
+            backend = lines[-1].strip() if lines else ""
             if out.returncode == 0 and backend:
                 log(f"preflight[{tag}]: backend={backend} "
                     f"({time.perf_counter() - t0:.1f}s)")

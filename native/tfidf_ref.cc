@@ -158,8 +158,12 @@ int PipelineMain(Comm& comm, const std::string& input_dir,
   std::vector<uint8_t> meta(8, 0);
   if (rank == 0) {
     uint64_t count = 0;
-    for (auto& e : std::filesystem::directory_iterator(input_dir))
-      if (e.is_regular_file()) ++count;
+    // Count every entry except '.'/'..' — subdirectories included —
+    // exactly like the reference's readdir loop (TFIDF.c:104-109).
+    // directory_iterator already skips the two dot entries.
+    for ([[maybe_unused]] auto& e :
+         std::filesystem::directory_iterator(input_dir))
+      ++count;
     std::memcpy(meta.data(), &count, 8);
   }
   comm.Broadcast(meta, 0);

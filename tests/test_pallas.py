@@ -38,6 +38,20 @@ class TestPallasHistogram:
         assert (np.asarray(pc) == np.asarray(rc)).all()
         assert (np.asarray(pdf) == np.asarray(rdf)).all()
 
+    @pytest.mark.parametrize("offset,width", [(0, 64), (64, 64), (96, 32)])
+    def test_id_offset_matches_masked_shard(self, offset, width):
+        # Vocab-sharding contract: id_offset histograms only the shard's
+        # id range, exactly like tf_counts_masked's offset/width.
+        from tfidf_tpu.ops.histogram import tf_counts_masked
+        rng = np.random.default_rng(7)
+        toks = jnp.asarray(rng.integers(0, 128, (8, 128)), jnp.int32)
+        lens = jnp.asarray(rng.integers(0, 129, 8), jnp.int32)
+        pc, _ = tf_df_pallas(toks, lens, vocab_size=width, id_offset=offset,
+                             interpret=True)
+        live = jnp.arange(128)[None, :] < lens[:, None]
+        rc = tf_counts_masked(toks, live, width, id_offset=offset)
+        assert (np.asarray(pc) == np.asarray(rc)).all()
+
     def test_all_padding_docs(self):
         toks = jnp.zeros((4, 128), jnp.int32)
         lens = jnp.zeros((4,), jnp.int32)

@@ -58,6 +58,58 @@ class TestShardedMatchesSingleDevice:
         assert ShardedPipeline(plan, cfg).run(corpus).output_bytes() == \
             golden_output(corpus)
 
+    @pytest.mark.parametrize("mesh_kw", MESH_CASES)
+    def test_pallas_shard_body_equals_xla(self, toy_corpus_dir, mesh_kw):
+        # The Pallas kernel under shard_map (interpret mode on the CPU
+        # mesh) must agree exactly with the XLA scatter lowering for
+        # every mesh shape, vocab offsets and seq residuals included.
+        corpus = discover_corpus(toy_corpus_dir)
+        base = dict(vocab_mode=VocabMode.HASHED, vocab_size=256,
+                    max_doc_len=64, doc_chunk=64)
+        plan = MeshPlan.create(**mesh_kw)
+        xla = ShardedPipeline(plan, PipelineConfig(**base)).run(corpus)
+        pallas = ShardedPipeline(
+            plan, PipelineConfig(use_pallas=True, **base)).run(corpus)
+        assert (pallas.counts == xla.counts).all()
+        assert (pallas.df == xla.df).all()
+        np.testing.assert_allclose(pallas.scores, xla.scores,
+                                   rtol=1e-6, atol=1e-7)
+
+    def test_mesh_shape_config_dispatch(self, toy_corpus_dir):
+        # config.mesh_shape routes TfidfPipeline onto the mesh: results
+        # must equal both the explicit ShardedPipeline and (modulo doc
+        # padding) the single-device run.
+        corpus = discover_corpus(toy_corpus_dir)
+        base = dict(vocab_mode=VocabMode.HASHED, vocab_size=64,
+                    max_doc_len=64, doc_chunk=64)
+        meshed = TfidfPipeline(PipelineConfig(
+            mesh_shape={"docs": 4, "vocab": 2}, **base)).run(corpus)
+        single = TfidfPipeline(PipelineConfig(**base)).run(corpus)
+        d = single.counts.shape[0]
+        assert (meshed.counts[:d] == single.counts).all()
+        assert (meshed.df == single.df).all()
+
+    def test_mesh_shape_unknown_axis_raises(self, toy_corpus_dir):
+        corpus = discover_corpus(toy_corpus_dir)
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED,
+                             mesh_shape={"ranks": 8})
+        with pytest.raises(ValueError, match="ranks"):
+            TfidfPipeline(cfg).run(corpus)
+
+    def test_run_packed_pads_unplanned_batch(self, toy_corpus_dir):
+        # A batch packed without a plan (e.g. via TfidfPipeline.pack)
+        # must be grown to mesh-divisible shape, not rejected.
+        corpus = discover_corpus(toy_corpus_dir)
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=64,
+                             max_doc_len=64, doc_chunk=64)
+        batch = TfidfPipeline(cfg).pack(corpus)
+        plan = MeshPlan.create(docs=8, seq=1, vocab=1)
+        sharded = ShardedPipeline(plan, cfg).run_packed(batch)
+        single = TfidfPipeline(cfg).run_packed(batch)
+        d = single.counts.shape[0]
+        assert (sharded.counts[:d] == single.counts).all()
+        assert (sharded.df == single.df).all()
+
     def test_sharded_topk_matches_dense(self, toy_corpus_dir):
         corpus = discover_corpus(toy_corpus_dir)
         cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=64,

@@ -96,6 +96,20 @@ class TestDiscovery:
         with pytest.raises(FileNotFoundError):
             discover_corpus(str(d))  # doc2 missing -> hard error (TFIDF.c:137)
 
+    def test_strict_counts_subdirs_like_readdir(self, tmp_path):
+        # The reference counts *every* readdir entry except '.'/'..' —
+        # a stray subdir inflates numDocs (TFIDF.c:104-109) and the
+        # derived name list then demands a doc<count> that may not exist.
+        from tfidf_tpu.io.corpus import discover_names
+        d = tmp_path / "input"
+        d.mkdir()
+        (d / "doc1").write_bytes(b"x")
+        (d / "doc2").write_bytes(b"y")
+        (d / "stray").mkdir()  # directory, not a file
+        assert discover_names(str(d)) == ["doc1", "doc2", "doc3"]
+        with pytest.raises((FileNotFoundError, IsADirectoryError)):
+            discover_corpus(str(d))  # doc3 missing -> hard error
+
     def test_nonstrict_loads_any_files(self, tmp_path):
         d = tmp_path / "input"
         d.mkdir()

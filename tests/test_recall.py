@@ -55,6 +55,14 @@ class TestDocRecall:
             ids = words_to_ids([b"a", pick], 1 << 20)
             assert doc_recall(ref, ids, [0.9, 0.5], 2, 1 << 20) == 1.0
 
+    def test_tie_cannot_substitute_for_missed_mandatory(self):
+        # b/c tie at the k=2 boundary, but a (strictly above) is
+        # mandatory: a top-2 of {b, c} that drops the argmax term must
+        # NOT score 1.0 — tie hits only fill tie slots.
+        ref = [(b"a", 0.9), (b"b", 0.5), (b"c", 0.5)]
+        ids = words_to_ids([b"b", b"c"], 1 << 20)
+        assert doc_recall(ref, ids, [0.5, 0.5], 2, 1 << 20) == 0.5
+
     def test_collisions_count_once(self):
         # vocab 1: every word folds to bucket 0; one hit covers all.
         ref = [(b"a", 0.9), (b"b", 0.5)]

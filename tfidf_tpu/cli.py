@@ -106,6 +106,10 @@ def _run_tpu(args) -> int:
     from tfidf_tpu.pipeline import TfidfPipeline
 
     lo, hi = (int(x) for x in args.ngram.split(","))
+    mesh_shape = {}
+    if args.mesh:
+        docs, seq, vocab = (int(x) for x in args.mesh.split(","))
+        mesh_shape = {"docs": docs, "seq": seq, "vocab": vocab}
     cfg = PipelineConfig(
         vocab_mode=VocabMode(args.vocab_mode),
         vocab_size=args.vocab_size,
@@ -114,16 +118,12 @@ def _run_tpu(args) -> int:
         topk=args.topk,
         engine=args.engine,
         use_pallas=args.pallas,
+        mesh_shape=mesh_shape,
     )
     corpus = discover_corpus(args.input, strict=not args.no_strict)
-
-    if args.mesh:
-        from tfidf_tpu.parallel import MeshPlan, ShardedPipeline
-        docs, seq, vocab = (int(x) for x in args.mesh.split(","))
-        plan = MeshPlan.create(docs=docs, seq=seq, vocab=vocab)
-        result = ShardedPipeline(plan, cfg).run(corpus)
-    else:
-        result = TfidfPipeline(cfg).run(corpus)
+    # --mesh flows through config.mesh_shape: TfidfPipeline dispatches to
+    # ShardedPipeline over the described device mesh.
+    result = TfidfPipeline(cfg).run(corpus)
 
     if args.topk is None:
         write_output(args.output, result.output_lines())

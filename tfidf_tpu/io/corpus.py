@@ -102,17 +102,21 @@ def pack_bytes(corpus: Corpus, pad_docs_to: Optional[int] = None,
 def discover_names(input_dir: str, strict: bool = True) -> List[str]:
     """The reference's corpus-discovery contract, names only.
 
-    strict=True: count the directory's regular files, then *derive* the
-    names ``doc1..docN`` (``TFIDF.c:98-110,132-133`` — the reference
-    never reads the listing's names, only its count). strict=False:
-    every regular file, sorted by name. Single source of truth for
-    :func:`discover_corpus`, :func:`load_and_pack`, and chunked ingest.
+    strict=True: count *every* directory entry except ``.``/``..``
+    (subdirectories included — the reference's readdir loop skips only
+    those two names, ``TFIDF.c:104-109``), then *derive* the names
+    ``doc1..docN`` (``TFIDF.c:132-133`` — the reference never reads the
+    listing's names, only its count). strict=False: every regular file,
+    sorted by name. Single source of truth for :func:`discover_corpus`,
+    :func:`load_and_pack`, and chunked ingest.
     """
-    entries = sorted(e for e in os.listdir(input_dir)
-                     if os.path.isfile(os.path.join(input_dir, e)))
     if strict:
-        return [f"doc{i}" for i in range(1, len(entries) + 1)]
-    return entries
+        # os.listdir already omits '.' and '..', so the raw count is the
+        # reference's numDocs — a stray subdir in input/ inflates it and
+        # shifts IDF exactly as it would for the reference.
+        return [f"doc{i}" for i in range(1, len(os.listdir(input_dir)) + 1)]
+    return sorted(e for e in os.listdir(input_dir)
+                  if os.path.isfile(os.path.join(input_dir, e)))
 
 
 def discover_corpus(input_dir: str, strict: bool = True) -> Corpus:

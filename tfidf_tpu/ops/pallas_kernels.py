@@ -22,7 +22,6 @@ vocab axis rides the 128-wide lane dimension, docs ride sublanes.
 from __future__ import annotations
 
 import functools
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -33,17 +32,9 @@ TILE_V = 128    # vocab lanes per program (lane dimension)
 CHUNK_L = 128   # token-axis VMEM streaming chunk
 
 
-def _hist_kernel(tokens_ref, len_ref, counts_ref, df_ref):
-    """One (vocab-tile, doc-tile) program: counts block + df accumulation.
-
-    Grid order is (vocab major, docs MINOR): Pallas TPU keeps an output
-    block resident only across *consecutive* grid steps, and the df
-    block (0, j) must accumulate across all doc tiles — so the doc
-    dimension has to be innermost for the revisits to be back-to-back.
-    """
-    i = pl.program_id(1)                       # doc tile (minor)
+def _tile_counts(tokens_ref, len_ref):
+    """Compare-and-reduce counts for one (vocab-tile, doc-tile) program."""
     v_start = pl.program_id(0) * TILE_V        # vocab tile (major)
-
     lens = len_ref[:]                          # [TILE_D, 1]
     length = tokens_ref.shape[1]
 
@@ -62,8 +53,20 @@ def _hist_kernel(tokens_ref, len_ref, counts_ref, df_ref):
         eq = toks_c[:, :, None] == vids
         return acc + jnp.sum(eq.astype(jnp.int32), axis=1)
 
-    counts = jax.lax.fori_loop(0, length // CHUNK_L, body,
-                               jnp.zeros((TILE_D, TILE_V), jnp.int32))
+    return jax.lax.fori_loop(0, length // CHUNK_L, body,
+                             jnp.zeros((TILE_D, TILE_V), jnp.int32))
+
+
+def _hist_kernel(tokens_ref, len_ref, counts_ref, df_ref):
+    """One (vocab-tile, doc-tile) program: counts block + df accumulation.
+
+    Grid order is (vocab major, docs MINOR): Pallas TPU keeps an output
+    block resident only across *consecutive* grid steps, and the df
+    block (0, j) must accumulate across all doc tiles — so the doc
+    dimension has to be innermost for the revisits to be back-to-back.
+    """
+    i = pl.program_id(1)                       # doc tile (minor)
+    counts = _tile_counts(tokens_ref, len_ref)
     counts_ref[:] = counts
 
     # DF: the same (0, j) df block is revisited by every doc-tile step i;
@@ -75,34 +78,69 @@ def _hist_kernel(tokens_ref, len_ref, counts_ref, df_ref):
                          keepdims=True)
 
 
+def _hist_kernel_counts_only(tokens_ref, len_ref, counts_ref):
+    """Counts-only variant: no df output block, no accumulate revisits.
+
+    Used where presence must be taken after a cross-shard psum anyway
+    (the seq-sharded path) — the fused df would be dead device work.
+    """
+    counts_ref[:] = _tile_counts(tokens_ref, len_ref)
+
+
 def _pad_to(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
-@functools.partial(jax.jit, static_argnames=("vocab_size", "interpret"))
+@functools.partial(jax.jit,
+                   static_argnames=("vocab_size", "interpret", "with_df"))
 def tf_df_pallas(token_ids: jax.Array, lengths: jax.Array, *,
-                 vocab_size: int, interpret: bool = False
-                 ) -> Tuple[jax.Array, jax.Array]:
+                 vocab_size: int, id_offset=0, interpret: bool = False,
+                 with_df: bool = True):
     """Fused TF histogram + DF via the Pallas kernel.
 
     Drop-in equivalent of ``tf_counts`` + ``df_from_counts`` (tests pin
     exact equality). Pads D/L/V up to tile multiples and slices back.
     ``interpret=True`` runs the kernel in interpreter mode (CPU tests).
+
+    ``id_offset`` makes the kernel vocab-shardable (mirroring
+    ``tf_counts_masked``): ids are shifted so this call histograms only
+    ``[id_offset, id_offset + vocab_size)``; out-of-range ids match no
+    vocab lane (negative) or a sliced-off padding lane (>= vocab_size).
+    It may be a traced scalar (``lax.axis_index`` under ``shard_map``).
+
+    ``with_df=False`` returns ``(counts, None)`` via the counts-only
+    kernel — callers that re-derive presence after a cross-shard psum
+    skip the fused df's accumulate work entirely.
     """
     d, length = token_ids.shape
     dp, lp, vp = _pad_to(d, TILE_D), _pad_to(length, CHUNK_L), _pad_to(
         vocab_size, TILE_V)
-    toks = jnp.zeros((dp, lp), jnp.int32).at[:d, :length].set(
-        token_ids.astype(jnp.int32))
+    # Shift BEFORE padding; padding slots (0 - id_offset) are masked by
+    # the in-kernel length test regardless of value. Padding *vocab*
+    # lanes [vocab_size, vp) can collect out-of-shard ids — they are
+    # sliced off below, counts and df both.
+    local = token_ids.astype(jnp.int32) - id_offset
+    toks = jnp.zeros((dp, lp), jnp.int32).at[:d, :length].set(local)
     lens = jnp.zeros((dp, 1), jnp.int32).at[:d, 0].set(lengths)
 
+    in_specs = [
+        pl.BlockSpec((TILE_D, lp), lambda j, i: (i, 0)),
+        pl.BlockSpec((TILE_D, 1), lambda j, i: (i, 0)),
+    ]
+    grid = (vp // TILE_V, dp // TILE_D)  # docs minor: see _hist_kernel
+    if not with_df:
+        counts = pl.pallas_call(
+            _hist_kernel_counts_only,
+            grid=grid, in_specs=in_specs,
+            out_specs=pl.BlockSpec((TILE_D, TILE_V), lambda j, i: (i, j)),
+            out_shape=jax.ShapeDtypeStruct((dp, vp), jnp.int32),
+            interpret=interpret,
+        )(toks, lens)
+        return counts[:d, :vocab_size], None
     counts, df = pl.pallas_call(
         _hist_kernel,
-        grid=(vp // TILE_V, dp // TILE_D),  # docs minor: see _hist_kernel
-        in_specs=[
-            pl.BlockSpec((TILE_D, lp), lambda j, i: (i, 0)),
-            pl.BlockSpec((TILE_D, 1), lambda j, i: (i, 0)),
-        ],
+        grid=grid,
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((TILE_D, TILE_V), lambda j, i: (i, j)),
             pl.BlockSpec((1, TILE_V), lambda j, i: (0, j)),

@@ -33,7 +33,8 @@ from tfidf_tpu.parallel.mesh import DOCS_AXIS, MeshPlan, SEQ_AXIS, VOCAB_AXIS
 
 
 def _shard_body(tokens, lengths, num_docs, *, vocab_size: int,
-                score_dtype, topk: Optional[int]):
+                score_dtype, topk: Optional[int],
+                use_pallas: bool = False, pallas_interpret: bool = False):
     """Per-shard program. Blocks: tokens [Dl, Ll], lengths [Dl].
 
     vocab_size here is the *global* (padded) V; each shard owns
@@ -46,13 +47,26 @@ def _shard_body(tokens, lengths, num_docs, *, vocab_size: int,
     # Sequence shard: this block holds global token positions
     # [seq_idx*Ll, (seq_idx+1)*Ll) of each document.
     ll = tokens.shape[1]
-    pos = lax.axis_index(SEQ_AXIS) * ll + jnp.arange(ll, dtype=lengths.dtype)
-    live = pos[None, :] < lengths[:, None]
+    seq_start = lax.axis_index(SEQ_AXIS) * ll
 
     # TF histogram of this shard's vocab range over its token chunk,
     # then combine chunks: the long-document psum (SURVEY §5
     # long-context — a >chip doc's histogram is assembled over ICI).
-    counts = tf_counts_masked(tokens, live, v_shard, id_offset=v_start)
+    if use_pallas:
+        # The Pallas kernel masks by remaining length, so translate the
+        # global positions into per-shard residual lengths. Counts-only
+        # variant: presence must be taken AFTER the seq psum (a chunk's
+        # partial counts can undercount it), so the fused df would be
+        # dead device work.
+        from tfidf_tpu.ops.pallas_kernels import tf_df_pallas
+        rem = jnp.clip(lengths - seq_start, 0, ll)
+        counts, _ = tf_df_pallas(tokens, rem, vocab_size=v_shard,
+                                 id_offset=v_start, with_df=False,
+                                 interpret=pallas_interpret)
+    else:
+        pos = seq_start + jnp.arange(ll, dtype=lengths.dtype)
+        live = pos[None, :] < lengths[:, None]
+        counts = tf_counts_masked(tokens, live, v_shard, id_offset=v_start)
     counts = lax.psum(counts, SEQ_AXIS)
 
     # DF: local docs' presence, summed over the docs axis. This single
@@ -81,20 +95,24 @@ def _shard_body(tokens, lengths, num_docs, *, vocab_size: int,
 
 @functools.lru_cache(maxsize=64)
 def make_sharded_forward(plan: MeshPlan, vocab_size: int, score_dtype,
-                         topk: Optional[int]):
+                         topk: Optional[int], use_pallas: bool = False,
+                         pallas_interpret: bool = False):
     """Build the jitted sharded forward for a mesh plan.
 
     Returns f(tokens [D, L], lengths [D], num_docs) with D a
     docs-shard multiple, L a seq-shard multiple, vocab_size a
     vocab-shard multiple (use plan.pad_*). LRU-cached so repeat runs
     with the same (plan, vocab, dtype, topk) reuse the jitted program
-    instead of re-tracing.
+    instead of re-tracing. ``use_pallas`` swaps each shard's histogram
+    for the Pallas kernel (``pallas_interpret`` for CPU-mesh tests).
     """
     if vocab_size % plan.n_vocab_shards:
         raise ValueError(f"vocab_size {vocab_size} not divisible by "
                          f"{plan.n_vocab_shards} vocab shards")
     body = functools.partial(_shard_body, vocab_size=vocab_size,
-                             score_dtype=score_dtype, topk=topk)
+                             score_dtype=score_dtype, topk=topk,
+                             use_pallas=use_pallas,
+                             pallas_interpret=pallas_interpret)
     if topk is None:
         out_specs = (plan.counts_spec(), plan.df_spec(), plan.counts_spec())
     else:

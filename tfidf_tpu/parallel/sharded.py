@@ -10,6 +10,7 @@ all further movement.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Optional
 
 import jax
@@ -36,25 +37,36 @@ class ShardedPipeline:
         self.config = config or PipelineConfig(vocab_mode=VocabMode.HASHED)
 
     def pack(self, corpus: Corpus, want_words: bool = True) -> PackedBatch:
-        batch = pack_corpus(corpus, self.config,
-                            pad_docs_to=self.plan.pad_docs(len(corpus)),
-                            want_words=want_words)
-        # Token axis must also split evenly across seq shards.
-        lcm_target = self.plan.pad_tokens(batch.token_ids.shape[1])
-        if lcm_target != batch.token_ids.shape[1]:
-            pad = lcm_target - batch.token_ids.shape[1]
-            batch.token_ids = np.pad(batch.token_ids, ((0, 0), (0, pad)))
-        return batch
+        # Doc and token axes must split evenly across the mesh;
+        # _pad_to_mesh is the single place that knows how.
+        return self._pad_to_mesh(
+            pack_corpus(corpus, self.config, want_words=want_words))
+
+    def _pad_to_mesh(self, batch: PackedBatch) -> PackedBatch:
+        """Grow a batch to mesh-divisible [D, L] (no-op when already so).
+
+        Lets :class:`~tfidf_tpu.pipeline.TfidfPipeline`'s mesh dispatch
+        hand over batches packed without a plan; padding docs are empty
+        (length 0) and the masked histogram ignores them by construction.
+        """
+        d, length = batch.token_ids.shape
+        d_t, l_t = self.plan.pad_docs(d), self.plan.pad_tokens(length)
+        if (d_t, l_t) == (d, length):
+            return batch
+        return dataclasses.replace(
+            batch,
+            token_ids=np.pad(batch.token_ids, ((0, d_t - d), (0, l_t - length))),
+            lengths=np.pad(batch.lengths, (0, d_t - d)),
+            names=list(batch.names) + [""] * (d_t - d))
 
     def run_packed(self, batch: PackedBatch) -> PipelineResult:
         cfg = self.config
-        if cfg.use_pallas:
-            raise NotImplementedError(
-                "use_pallas: Pallas histogram kernel not wired up yet")
         if cfg.mesh_shape:
             raise ValueError(
                 "config.mesh_shape is ignored by ShardedPipeline — the "
-                "MeshPlan passed to the constructor is authoritative")
+                "MeshPlan passed to the constructor is authoritative "
+                "(use TfidfPipeline for config-driven mesh dispatch)")
+        batch = self._pad_to_mesh(batch)
         vocab_padded = self.plan.pad_vocab(batch.vocab_size)
         tokens = jax.device_put(batch.token_ids,
                                 self.plan.sharding(self.plan.batch_spec()))
@@ -62,8 +74,15 @@ class ShardedPipeline:
                                  self.plan.sharding(self.plan.lengths_spec()))
         if cfg.engine == "sparse":
             return self._run_sparse(batch, tokens, lengths)
+        if cfg.use_pallas:
+            from tfidf_tpu.ops.pallas_kernels import default_interpret
+            interpret = default_interpret()
+        else:
+            interpret = False
         fwd = make_sharded_forward(self.plan, vocab_padded,
-                                   jnp.dtype(cfg.score_dtype), cfg.topk)
+                                   jnp.dtype(cfg.score_dtype), cfg.topk,
+                                   use_pallas=cfg.use_pallas,
+                                   pallas_interpret=interpret)
         out = fwd(tokens, lengths, jnp.int32(batch.num_docs))
         # topk mode: dense per-shard counts/scores never leave the devices.
         if cfg.topk is not None:

@@ -167,16 +167,33 @@ class TfidfPipeline:
     def pack(self, corpus: Corpus, pad_docs_to: Optional[int] = None) -> PackedBatch:
         return pack_corpus(corpus, self.config, pad_docs_to)
 
-    def _check_config(self) -> None:
-        cfg = self.config
-        if cfg.mesh_shape:
-            raise NotImplementedError(
-                "mesh_shape on TfidfPipeline: use tfidf_tpu.parallel for "
-                "sharded execution")
+    def _mesh_pipeline(self):
+        """Build the ShardedPipeline described by ``config.mesh_shape``.
+
+        The config-driven mesh entry point: ``mesh_shape={"docs": 4,
+        "vocab": 2}`` dispatches the run onto a device mesh with those
+        axis sizes (missing axes default to docs=all-remaining, seq=1,
+        vocab=1). The handed-off config has ``mesh_shape`` cleared — the
+        MeshPlan is authoritative from there down.
+        """
+        from tfidf_tpu.parallel.mesh import MeshPlan
+        from tfidf_tpu.parallel.sharded import ShardedPipeline
+
+        shape = dict(self.config.mesh_shape)
+        unknown = set(shape) - {"docs", "seq", "vocab"}
+        if unknown:
+            raise ValueError(f"mesh_shape axes {sorted(unknown)} unknown; "
+                             "valid axes: docs, seq, vocab")
+        plan = MeshPlan.create(docs=shape.get("docs", 0),
+                               seq=shape.get("seq", 1),
+                               vocab=shape.get("vocab", 1))
+        return ShardedPipeline(
+            plan, dataclasses.replace(self.config, mesh_shape={}))
 
     def run_packed(self, batch: PackedBatch) -> PipelineResult:
         cfg = self.config
-        self._check_config()
+        if cfg.mesh_shape:
+            return self._mesh_pipeline().run_packed(batch)
         if cfg.engine == "sparse":
             return self._run_sparse(batch)
         if cfg.use_pallas:
@@ -242,7 +259,13 @@ class TfidfPipeline:
         from tfidf_tpu.io.corpus import pack_bytes
 
         cfg = self.config
-        self._check_config()
+        if cfg.mesh_shape:
+            # No sharded device-chargram exists; silently running
+            # single-device would misreport a mesh run. run() routes
+            # mesh chargram through the host tokenizer instead.
+            raise ValueError(
+                "run_bytes is single-device; clear mesh_shape or call "
+                "run(), which shards chargram via the host tokenizer")
         if cfg.tokenizer is not TokenizerKind.CHARGRAM:
             raise ValueError("run_bytes is the chargram device path")
         if cfg.vocab_mode is not VocabMode.HASHED:
@@ -270,6 +293,8 @@ class TfidfPipeline:
         from tfidf_tpu.config import TokenizerKind, VocabMode
 
         cfg = self.config
+        if cfg.mesh_shape:
+            return self._mesh_pipeline().run(corpus)
         # Device chargram only serves topk+dense runs: it has no word
         # strings (id_to_word stays empty -> no full output lines) and
         # its dense [D, V] histogram defeats engine="sparse". Everything

@@ -71,11 +71,17 @@ def doc_recall(ref_terms: DocTerms, got_ids: Sequence[int],
     kk = min(k, len(pos))
     thresh = pos[kk - 1][1]
     buckets = words_to_ids([w for w, _ in pos], vocab_size, seed)
-    required = {int(b) for b, (_, s) in zip(buckets[:kk], pos[:kk])}
-    # Everything tied with the k-th score is acceptable on either side.
-    acceptable = {int(b) for b, (_, s) in zip(buckets, pos) if s >= thresh}
+    required = {int(b) for b in buckets[:kk]}
+    # Buckets strictly above the k-th score are mandatory; buckets tied
+    # AT the k-th score are interchangeable (either side's ordering among
+    # equal scores is arbitrary — the reference itself breaks ties by
+    # insertion order, TFIDF.c:303-317). A hit on a tied bucket may only
+    # fill a tie slot, never substitute for a missed mandatory bucket.
+    above = {int(b) for b, (_, s) in zip(buckets, pos) if s > thresh}
+    tied = {int(b) for b, (_, s) in zip(buckets, pos) if s == thresh}
     got = {int(i) for i, v in zip(got_ids, got_vals) if i >= 0 and v > 0.0}
-    hit = len(got & acceptable)
+    tie_slots = len(required) - len(required & above)
+    hit = len(got & above & required) + min(tie_slots, len(got & tied))
     return min(1.0, hit / len(required))
 
 
