@@ -134,7 +134,7 @@ class TestCrashWindows:
         path = str(tmp_path / "ck")
         self._save(path, 1)
         self._save(path, 2)
-        entries = sorted(os.listdir(path))
+        entries = sorted(e for e in os.listdir(path) if e != "LOCK")
         assert entries == ["LATEST", "ckpt-1"]  # superseded ckpt-0 gone
 
     def test_orphaned_superseded_payload_reclaimed(self, tmp_path):
@@ -150,5 +150,28 @@ class TestCrashWindows:
         os.makedirs(os.path.join(
             path, "ckpt-2.orbax-checkpoint-tmp-123"))  # crashed orbax stage
         self._save(path, 3)
-        assert sorted(os.listdir(path)) == ["LATEST", "ckpt-2"]
+        assert sorted(e for e in os.listdir(path)
+                      if e != "LOCK") == ["LATEST", "ckpt-2"]
         assert int(ckpt.restore_state(path)["docs_seen"]) == 3
+
+
+class TestWriterLock:
+    def test_concurrent_saver_fails_loudly(self, tmp_path):
+        # save_state is single-writer per root: while one writer holds
+        # the flock, a second save must raise instead of racing the
+        # debris sweep (advisor finding: the sweep deletes any other
+        # writer's uncommitted payload mid-write).
+        import fcntl
+        import os
+        root = str(tmp_path / "ck")
+        os.makedirs(root)
+        fd = os.open(os.path.join(root, "LOCK"), os.O_CREAT | os.O_RDWR)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            with pytest.raises(RuntimeError, match="single-writer"):
+                ckpt.save_state(root, {"df": np.zeros(4)})
+        finally:
+            os.close(fd)
+        # lock released -> saving works again
+        assert ckpt.save_state(root, {"df": np.zeros(4)}) in ("orbax", "npz")
+        assert ckpt.exists(root)

@@ -49,6 +49,16 @@ class TestCli:
         assert rc == 0
         assert out.read_bytes() == golden_output(discover_corpus(toy_corpus_dir))
 
+    def test_timing_flag(self, toy_corpus_dir, tmp_path, capsys):
+        out = tmp_path / "out.txt"
+        rc = main(["run", "--input", toy_corpus_dir, "--output", str(out),
+                   "--backend", "tpu", "--timing"])
+        assert rc == 0
+        err = capsys.readouterr().err
+        for phase in ("discover", "pack", "transfer", "compute", "fetch",
+                      "emit", "docs/sec"):
+            assert phase in err, f"missing {phase} in timing report"
+
     def test_topk_larger_than_vocab_clamped(self, toy_corpus_dir, tmp_path):
         # EXACT mode: V derived from corpus (16 words) < topk=50 — must
         # clamp, not crash (review finding).

@@ -96,6 +96,20 @@ class TestSharded:
         np.testing.assert_allclose(v1, v2, rtol=1e-5)
         assert (i1 == i2).all()
 
+    def test_width_path_independent(self):
+        # k > num_docs: both paths must return min(k, num_docs) columns
+        # (the sharded mesh pads docs to 8, the single path has 5; the
+        # caller-visible width must not depend on the path).
+        import jax
+        plan = MeshPlan.create(docs=4, devices=jax.devices()[:4])
+        single = TfidfRetriever(CFG).index(CORPUS)
+        sharded = TfidfRetriever(CFG, plan=plan).index(CORPUS)
+        v1, i1 = single.search(["apple banana"], k=10)
+        v2, i2 = sharded.search(["apple banana"], k=10)
+        assert v1.shape == v2.shape == (1, len(CORPUS.docs))
+        np.testing.assert_allclose(v1, v2, rtol=1e-5)
+        assert (i1 == i2).all()
+
     def test_requires_docs_only_mesh(self):
         plan = MeshPlan.create(docs=4, vocab=2)  # 4*2 = all 8 devices
         with pytest.raises(ValueError):

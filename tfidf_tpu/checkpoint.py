@@ -24,10 +24,12 @@ same logical state dict.
 
 from __future__ import annotations
 
+import contextlib
+import fcntl
 import os
 import shutil
 import tempfile
-from typing import Dict
+from typing import Dict, Iterator
 
 import numpy as np
 
@@ -40,6 +42,30 @@ except Exception:  # pragma: no cover
 
 _NPZ_NAME = "state.npz"
 _LATEST = "LATEST"
+_LOCK = "LOCK"
+
+
+@contextlib.contextmanager
+def _writer_lock(path: str) -> Iterator[None]:
+    """Advisory single-writer lock on the checkpoint root.
+
+    ``save_state`` assumes one writer per root: its debris sweep deletes
+    every uncommitted ``ckpt-*`` entry, so a second concurrent saver's
+    in-flight payload would be destroyed mid-write. The flock makes that
+    contract enforced — a concurrent save raises instead of corrupting —
+    and cannot go stale (the kernel drops flocks when the holder dies).
+    """
+    fd = os.open(os.path.join(path, _LOCK), os.O_CREAT | os.O_RDWR, 0o644)
+    try:
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except BlockingIOError:
+            raise RuntimeError(
+                f"another process is saving a checkpoint under {path}; "
+                "save_state is single-writer per checkpoint root")
+        yield
+    finally:
+        os.close(fd)  # releases the flock
 
 
 def _fsync_dir(path: str) -> None:
@@ -101,36 +127,43 @@ def save_state(path: str, state: Dict[str, np.ndarray],
 
     Returns the payload backend used ("orbax" or "npz"). The previous
     checkpoint stays restorable until the new one is committed.
+
+    Single-writer per checkpoint root (enforced): a concurrent
+    ``save_state`` on the same ``path`` raises ``RuntimeError`` rather
+    than racing the debris sweep. Concurrent *readers* are always safe —
+    ``restore_state`` only follows the committed ``LATEST`` pointer.
     """
     state = {k: np.asarray(v) for k, v in state.items()}
     os.makedirs(path, exist_ok=True)
-    old_payload, seq = _committed_payload(path)
-    _reclaim_debris(path, os.path.basename(old_payload) if old_payload else None)
-    name = f"ckpt-{seq + 1}"
-    payload = os.path.join(path, name)
+    with _writer_lock(path):
+        old_payload, seq = _committed_payload(path)
+        _reclaim_debris(path,
+                        os.path.basename(old_payload) if old_payload else None)
+        name = f"ckpt-{seq + 1}"
+        payload = os.path.join(path, name)
 
-    if _HAVE_ORBAX and not force_npz:
-        _ocp.PyTreeCheckpointer().save(os.path.abspath(payload), state)
-        backend = "orbax"
-    else:
-        os.makedirs(payload)
-        with open(os.path.join(payload, _NPZ_NAME), "wb") as f:
-            np.savez(f, **state)
+        if _HAVE_ORBAX and not force_npz:
+            _ocp.PyTreeCheckpointer().save(os.path.abspath(payload), state)
+            backend = "orbax"
+        else:
+            os.makedirs(payload)
+            with open(os.path.join(payload, _NPZ_NAME), "wb") as f:
+                np.savez(f, **state)
+                f.flush()
+                os.fsync(f.fileno())
+            backend = "npz"
+        _fsync_dir(path)  # make the new payload's dirent durable pre-commit
+
+        # Commit: atomically repoint LATEST, then drop superseded payload.
+        fd, tmp = tempfile.mkstemp(dir=path, suffix=".latest.tmp")
+        with os.fdopen(fd, "w") as f:
+            f.write(name)
             f.flush()
             os.fsync(f.fileno())
-        backend = "npz"
-    _fsync_dir(path)  # make the new payload's dirent durable pre-commit
-
-    # Commit: atomically repoint LATEST, then drop the superseded payload.
-    fd, tmp = tempfile.mkstemp(dir=path, suffix=".latest.tmp")
-    with os.fdopen(fd, "w") as f:
-        f.write(name)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, os.path.join(path, _LATEST))
-    _fsync_dir(path)  # rename must hit disk before the old payload goes
-    if old_payload and os.path.isdir(old_payload):
-        shutil.rmtree(old_payload, ignore_errors=True)
+        os.replace(tmp, os.path.join(path, _LATEST))
+        _fsync_dir(path)  # rename must hit disk before old payload goes
+        if old_payload and os.path.isdir(old_payload):
+            shutil.rmtree(old_payload, ignore_errors=True)
     return backend
 
 

@@ -48,6 +48,10 @@ def _build_parser() -> argparse.ArgumentParser:
                      help="accept any filenames, not just doc<i>")
     run.add_argument("--nranks", type=int, default=4,
                      help="ranks for --backend=mpi (thread backend)")
+    run.add_argument("--timing", action="store_true",
+                     help="print per-phase wall-clock (discover/pack/"
+                          "transfer/compute/fetch/emit) and docs/sec "
+                          "to stderr")
 
     st = sub.add_parser(
         "stream",
@@ -120,15 +124,28 @@ def _run_tpu(args) -> int:
         use_pallas=args.pallas,
         mesh_shape=mesh_shape,
     )
-    corpus = discover_corpus(args.input, strict=not args.no_strict)
+    timer = None
+    if args.timing:
+        from tfidf_tpu.utils.timing import PhaseTimer
+        timer = PhaseTimer()
+    from tfidf_tpu.utils.timing import phase_or_null
+    with phase_or_null(timer, "discover"):
+        corpus = discover_corpus(args.input, strict=not args.no_strict)
     # --mesh flows through config.mesh_shape: TfidfPipeline dispatches to
     # ShardedPipeline over the described device mesh.
-    result = TfidfPipeline(cfg).run(corpus)
+    import time
+    t0 = time.perf_counter()
+    result = TfidfPipeline(cfg, timer=timer).run(corpus)
 
-    if args.topk is None:
-        write_output(args.output, result.output_lines())
-    else:
-        _write_topk(args.output, result)
+    with phase_or_null(timer, "emit"):
+        if args.topk is None:
+            write_output(args.output, result.output_lines())
+        else:
+            _write_topk(args.output, result)
+    if timer is not None:
+        dps = result.num_docs / max(time.perf_counter() - t0, 1e-9)
+        sys.stderr.write(timer.report() + "\n"
+                         f"{'docs/sec':>12}: {dps:9.1f}\n")
     print(f"wrote {args.output} ({result.num_docs} docs)")
     return 0
 

@@ -75,9 +75,24 @@ def _make_search_sharded(plan: MeshPlan, k: int):
     def body(ids, weights, head, qmat):
         # ids/weights/head: [D/s, L] local rows; qmat: [V, Q] replicated.
         safe = jnp.where(head, ids, 0)
-        contrib = jnp.where(head[..., None], weights[..., None]
-                            * qmat[safe], 0.0)           # [D/s, L, Q]
-        sims = jnp.sum(contrib, axis=1)                  # [D/s, Q]
+        w = jnp.where(head, weights, 0.0)
+        # Gather+dot over fixed L-chunks: the peak intermediate is the
+        # [D/s, chunk, Q] gather of one chunk, not the full [D/s, L, Q]
+        # contribution tensor (L/chunk x smaller at scale).
+        d, length = safe.shape
+        chunk = min(length, 128)
+        pad = -length % chunk
+        safe_c = jnp.pad(safe, ((0, 0), (0, pad)))
+        w_c = jnp.pad(w, ((0, 0), (0, pad)))
+        safe_c = safe_c.reshape(d, -1, chunk).transpose(1, 0, 2)
+        w_c = w_c.reshape(d, -1, chunk).transpose(1, 0, 2)
+
+        def step(acc, xs):
+            ids_k, w_k = xs                              # [D/s, chunk]
+            return acc + jnp.einsum("dc,dcq->dq", w_k, qmat[ids_k]), None
+
+        sims0 = jnp.zeros((d, qmat.shape[1]), qmat.dtype)
+        sims, _ = lax.scan(step, sims0, (safe_c, w_c))   # [D/s, Q]
         local_k = min(k, sims.shape[0])
         vals, idx = lax.top_k(sims.T, local_k)           # [Q, local_k]
         base = lax.axis_index(DOCS_AXIS) * sims.shape[0]
@@ -166,11 +181,12 @@ class TfidfRetriever:
 
     def search(self, queries: Sequence[Union[str, bytes]], k: int = 10
                ) -> Tuple[np.ndarray, np.ndarray]:
-        """Ranked retrieval: (scores, doc_indices), each [Q, k'].
+        """Ranked retrieval: (scores, doc_indices), each [Q, k'] with
+        k' = min(k, num_docs) — the same width on both execution paths.
 
         ``doc_indices`` index into :attr:`names`; -1 marks padding when
-        fewer than k documents score (or exist). Scores are cosine
-        similarities; padded/empty matches score 0.
+        fewer than k documents score. Scores are cosine similarities;
+        padded/empty matches score 0.
         """
         if not self.indexed:
             raise RuntimeError("index() a corpus before search()")
@@ -183,7 +199,12 @@ class TfidfRetriever:
             cols = jnp.where(self._head, self._ids, 0)[..., None]
             vals, idx = _search_bcoo(data, cols, qmat,
                                      k=min(k, self._ids.shape[0]))
-        vals, idx = np.asarray(vals), np.asarray(idx)
+        # Both paths produce >= min(k, num_docs) sorted columns (the
+        # sharded one up to min(k, local_k * n_shards)); trim to the
+        # path-independent width so callers see the same shape.
+        width = min(k, self._num_docs)
+        vals = np.asarray(vals)[:, :width]
+        idx = np.asarray(idx)[:, :width]
         ok = (vals > 0) & (idx < self._num_docs)
         return np.where(ok, vals, 0.0), np.where(ok, idx, -1)
 

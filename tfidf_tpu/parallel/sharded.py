@@ -23,24 +23,28 @@ from tfidf_tpu.parallel.collectives import (make_sharded_forward,
                                             make_sparse_sharded_forward)
 from tfidf_tpu.parallel.mesh import MeshPlan
 from tfidf_tpu.pipeline import PipelineResult
+from tfidf_tpu.utils.timing import PhaseTimedMixin
 
 
-class ShardedPipeline:
+class ShardedPipeline(PhaseTimedMixin):
     """TF-IDF over a device mesh.
 
     EXACT vocab mode is supported but sized from the corpus; HASHED is
     the intended mode at scale (vocab padded to a shard multiple).
     """
 
-    def __init__(self, plan: MeshPlan, config: Optional[PipelineConfig] = None):
+    def __init__(self, plan: MeshPlan, config: Optional[PipelineConfig] = None,
+                 timer=None):
         self.plan = plan
         self.config = config or PipelineConfig(vocab_mode=VocabMode.HASHED)
+        self.timer = timer  # PhaseTimer; see TfidfPipeline docstring
 
     def pack(self, corpus: Corpus, want_words: bool = True) -> PackedBatch:
         # Doc and token axes must split evenly across the mesh;
         # _pad_to_mesh is the single place that knows how.
-        return self._pad_to_mesh(
-            pack_corpus(corpus, self.config, want_words=want_words))
+        with self._phase("pack"):
+            return self._pad_to_mesh(
+                pack_corpus(corpus, self.config, want_words=want_words))
 
     def _pad_to_mesh(self, batch: PackedBatch) -> PackedBatch:
         """Grow a batch to mesh-divisible [D, L] (no-op when already so).
@@ -68,10 +72,12 @@ class ShardedPipeline:
                 "(use TfidfPipeline for config-driven mesh dispatch)")
         batch = self._pad_to_mesh(batch)
         vocab_padded = self.plan.pad_vocab(batch.vocab_size)
-        tokens = jax.device_put(batch.token_ids,
-                                self.plan.sharding(self.plan.batch_spec()))
-        lengths = jax.device_put(batch.lengths,
-                                 self.plan.sharding(self.plan.lengths_spec()))
+        with self._phase("transfer"):
+            tokens = jax.device_put(batch.token_ids,
+                                    self.plan.sharding(self.plan.batch_spec()))
+            lengths = jax.device_put(batch.lengths,
+                                     self.plan.sharding(self.plan.lengths_spec()))
+            self._fence((tokens, lengths))
         if cfg.engine == "sparse":
             return self._run_sparse(batch, tokens, lengths)
         if cfg.use_pallas:
@@ -83,49 +89,55 @@ class ShardedPipeline:
                                    jnp.dtype(cfg.score_dtype), cfg.topk,
                                    use_pallas=cfg.use_pallas,
                                    pallas_interpret=interpret)
-        out = fwd(tokens, lengths, jnp.int32(batch.num_docs))
+        with self._phase("compute"):
+            out = fwd(tokens, lengths, jnp.int32(batch.num_docs))
+            self._fence(out)
         # topk mode: dense per-shard counts/scores never leave the devices.
-        if cfg.topk is not None:
-            counts = None
-            df = np.asarray(out[0])[:batch.vocab_size]
-        else:
-            counts = np.asarray(out[0])[:, :batch.vocab_size]
-            df = np.asarray(out[1])[:batch.vocab_size]
-        result = PipelineResult(
-            counts=counts,
-            lengths=np.asarray(batch.lengths),
-            df=df,
-            num_docs=batch.num_docs,
-            names=batch.names,
-            id_to_word=batch.id_to_word or {},
-        )
-        if cfg.topk is not None:
-            result.topk_vals = np.asarray(out[1])
-            result.topk_ids = np.asarray(out[2])
-        else:
-            result.scores = np.asarray(out[2])[:, :batch.vocab_size]
+        with self._phase("fetch"):
+            if cfg.topk is not None:
+                counts = None
+                df = np.asarray(out[0])[:batch.vocab_size]
+            else:
+                counts = np.asarray(out[0])[:, :batch.vocab_size]
+                df = np.asarray(out[1])[:batch.vocab_size]
+            result = PipelineResult(
+                counts=counts,
+                lengths=np.asarray(batch.lengths),
+                df=df,
+                num_docs=batch.num_docs,
+                names=batch.names,
+                id_to_word=batch.id_to_word or {},
+            )
+            if cfg.topk is not None:
+                result.topk_vals = np.asarray(out[1])
+                result.topk_ids = np.asarray(out[2])
+            else:
+                result.scores = np.asarray(out[2])[:, :batch.vocab_size]
         return result
 
     def _run_sparse(self, batch: PackedBatch, tokens, lengths) -> PipelineResult:
         cfg = self.config
         fwd = make_sparse_sharded_forward(
             self.plan, batch.vocab_size, jnp.dtype(cfg.score_dtype), cfg.topk)
-        out = fwd(tokens, lengths, jnp.int32(batch.num_docs))
-        result = PipelineResult(
-            counts=None,
-            lengths=np.asarray(batch.lengths),
-            df=np.asarray(out[0]),
-            num_docs=batch.num_docs,
-            names=batch.names,
-            id_to_word=batch.id_to_word or {},
-        )
-        if cfg.topk is not None:
-            result.topk_vals = np.asarray(out[1])
-            result.topk_ids = np.asarray(out[2])
-        else:
-            result.sparse_ids = np.asarray(out[1])
-            result.sparse_counts = np.asarray(out[2])
-            result.sparse_head = np.asarray(out[3])
+        with self._phase("compute"):
+            out = fwd(tokens, lengths, jnp.int32(batch.num_docs))
+            self._fence(out)
+        with self._phase("fetch"):
+            result = PipelineResult(
+                counts=None,
+                lengths=np.asarray(batch.lengths),
+                df=np.asarray(out[0]),
+                num_docs=batch.num_docs,
+                names=batch.names,
+                id_to_word=batch.id_to_word or {},
+            )
+            if cfg.topk is not None:
+                result.topk_vals = np.asarray(out[1])
+                result.topk_ids = np.asarray(out[2])
+            else:
+                result.sparse_ids = np.asarray(out[1])
+                result.sparse_counts = np.asarray(out[2])
+                result.sparse_head = np.asarray(out[3])
         return result
 
     def run(self, corpus: Corpus) -> PipelineResult:

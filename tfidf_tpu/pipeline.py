@@ -30,6 +30,7 @@ from tfidf_tpu.ops.histogram import df_from_counts, tf_counts, tf_counts_chunked
 from tfidf_tpu.ops.scoring import tfidf_dense
 from tfidf_tpu.ops.sparse import sparse_forward
 from tfidf_tpu.ops.topk import topk_per_doc
+from tfidf_tpu.utils.timing import PhaseTimedMixin, PhaseTimer
 
 
 @dataclasses.dataclass
@@ -158,14 +159,25 @@ _chargram_forward_jit = jax.jit(
 )
 
 
-class TfidfPipeline:
-    """Configured TF-IDF runner: corpus in, scored records out."""
+class TfidfPipeline(PhaseTimedMixin):
+    """Configured TF-IDF runner: corpus in, scored records out.
 
-    def __init__(self, config: Optional[PipelineConfig] = None):
+    ``timer`` (a :class:`~tfidf_tpu.utils.timing.PhaseTimer`) attaches
+    phase observability to the product path — pack / transfer / compute /
+    fetch wall-clock accumulate into it. When timing, device work is
+    fenced with ``block_until_ready`` so phases measure real completion,
+    not dispatch; without a timer no fence is added and XLA's async
+    dispatch overlaps freely.
+    """
+
+    def __init__(self, config: Optional[PipelineConfig] = None,
+                 timer: Optional["PhaseTimer"] = None):
         self.config = config or PipelineConfig()
+        self.timer = timer
 
     def pack(self, corpus: Corpus, pad_docs_to: Optional[int] = None) -> PackedBatch:
-        return pack_corpus(corpus, self.config, pad_docs_to)
+        with self._phase("pack"):
+            return pack_corpus(corpus, self.config, pad_docs_to)
 
     def _mesh_pipeline(self):
         """Build the ShardedPipeline described by ``config.mesh_shape``.
@@ -188,7 +200,8 @@ class TfidfPipeline:
                                seq=shape.get("seq", 1),
                                vocab=shape.get("vocab", 1))
         return ShardedPipeline(
-            plan, dataclasses.replace(self.config, mesh_shape={}))
+            plan, dataclasses.replace(self.config, mesh_shape={}),
+            timer=self.timer)
 
     def run_packed(self, batch: PackedBatch) -> PipelineResult:
         cfg = self.config
@@ -201,17 +214,23 @@ class TfidfPipeline:
             interpret = default_interpret()
         else:
             interpret = False
-        out = _forward_jit(
-            jnp.asarray(batch.token_ids), jnp.asarray(batch.lengths),
-            jnp.int32(batch.num_docs), vocab_size=batch.vocab_size,
-            chunk=cfg.doc_chunk, score_dtype=jnp.dtype(cfg.score_dtype),
-            topk=cfg.topk, use_pallas=cfg.use_pallas,
-            pallas_interpret=interpret)
+        with self._phase("transfer"):
+            toks, lens = jnp.asarray(batch.token_ids), jnp.asarray(batch.lengths)
+            self._fence((toks, lens))
+        with self._phase("compute"):
+            out = _forward_jit(
+                toks, lens,
+                jnp.int32(batch.num_docs), vocab_size=batch.vocab_size,
+                chunk=cfg.doc_chunk, score_dtype=jnp.dtype(cfg.score_dtype),
+                topk=cfg.topk, use_pallas=cfg.use_pallas,
+                pallas_interpret=interpret)
+            self._fence(out)
         # topk mode: neither counts nor scores cross the host boundary —
         # only DF [V] and the [D, K] selection do. One device_get for all
         # outputs: transfers pipeline into a single round trip, which
         # matters when the device link is latency-bound.
-        out = jax.device_get(out)
+        with self._phase("fetch"):
+            out = jax.device_get(out)
         result = PipelineResult(
             counts=None if cfg.topk is not None else out[0],
             lengths=np.asarray(batch.lengths),
@@ -230,11 +249,17 @@ class TfidfPipeline:
     def _run_sparse(self, batch: PackedBatch) -> PipelineResult:
         """Row-sparse engine: O(D x L) memory, no [D, V] materialization."""
         cfg = self.config
-        out = _sparse_forward_jit(
-            jnp.asarray(batch.token_ids), jnp.asarray(batch.lengths),
-            jnp.int32(batch.num_docs), vocab_size=batch.vocab_size,
-            score_dtype=jnp.dtype(cfg.score_dtype), topk=cfg.topk)
-        out = jax.device_get(out)  # all outputs in one transfer round trip
+        with self._phase("transfer"):
+            toks, lens = jnp.asarray(batch.token_ids), jnp.asarray(batch.lengths)
+            self._fence((toks, lens))
+        with self._phase("compute"):
+            out = _sparse_forward_jit(
+                toks, lens,
+                jnp.int32(batch.num_docs), vocab_size=batch.vocab_size,
+                score_dtype=jnp.dtype(cfg.score_dtype), topk=cfg.topk)
+            self._fence(out)
+        with self._phase("fetch"):
+            out = jax.device_get(out)  # all outputs in one round trip
         result = PipelineResult(
             counts=None,
             lengths=np.asarray(batch.lengths),
@@ -271,14 +296,22 @@ class TfidfPipeline:
         if cfg.vocab_mode is not VocabMode.HASHED:
             raise ValueError("device chargram requires HASHED vocab "
                              "(EXACT needs host-side n-gram strings)")
-        packed = pack_bytes(corpus)
+        with self._phase("pack"):
+            packed = pack_bytes(corpus)
         lo, hi = cfg.ngram_range
-        out = _chargram_forward_jit(
-            jnp.asarray(packed.byte_ids), jnp.asarray(packed.byte_lengths),
-            jnp.int32(packed.num_docs), vocab_size=cfg.vocab_size,
-            ngram_lo=lo, ngram_hi=hi, seed=cfg.hash_seed,
-            score_dtype=jnp.dtype(cfg.score_dtype), topk=cfg.topk)
-        out = jax.device_get(out)  # single transfer round trip
+        with self._phase("transfer"):
+            byte_ids = jnp.asarray(packed.byte_ids)
+            byte_lens = jnp.asarray(packed.byte_lengths)
+            self._fence((byte_ids, byte_lens))
+        with self._phase("compute"):
+            out = _chargram_forward_jit(
+                byte_ids, byte_lens,
+                jnp.int32(packed.num_docs), vocab_size=cfg.vocab_size,
+                ngram_lo=lo, ngram_hi=hi, seed=cfg.hash_seed,
+                score_dtype=jnp.dtype(cfg.score_dtype), topk=cfg.topk)
+            self._fence(out)
+        with self._phase("fetch"):
+            out = jax.device_get(out)  # single transfer round trip
         if cfg.topk is not None:
             return PipelineResult(
                 counts=None, lengths=out[1], df=out[0],

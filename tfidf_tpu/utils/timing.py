@@ -75,6 +75,35 @@ class Throughput:
         return self._docs
 
 
+def phase_or_null(timer: Optional["PhaseTimer"], name: str):
+    """``timer.phase(name)`` when a timer is attached, else a no-op.
+
+    Lets product code sprinkle phase markers unconditionally; without a
+    timer the only cost is a nullcontext enter/exit.
+    """
+    return timer.phase(name) if timer is not None else contextlib.nullcontext()
+
+
+class PhaseTimedMixin:
+    """Shared phase/fence plumbing for pipeline classes with a ``timer``.
+
+    ``_phase`` marks a named phase on the attached :class:`PhaseTimer`
+    (no-op without one); ``_fence`` blocks on device work only when
+    timing, so phases measure completion, not dispatch — and untimed
+    runs keep XLA's async overlap.
+    """
+
+    timer: Optional["PhaseTimer"] = None
+
+    def _phase(self, name: str):
+        return phase_or_null(self.timer, name)
+
+    def _fence(self, tree) -> None:
+        if self.timer is not None:
+            import jax
+            jax.block_until_ready(tree)
+
+
 @contextlib.contextmanager
 def trace_region(name: str, enabled: bool = True) -> Iterator[None]:
     """jax.profiler TraceAnnotation wrapper (no-op when disabled).
