@@ -187,11 +187,27 @@ int PipelineMain(Comm& comm, const std::string& input_dir,
   }
 
   // Phase 1: map/TF on workers over the round-robin shard (TFIDF.c:130).
+  // The hybrid (-fopenmp) build adds intra-rank thread fan-out over the
+  // rank's documents — the reference's OpenMP intent (TFIDF_extra.c:131)
+  // done race-free: every document fills its own pre-sized slot and the
+  // fold below is serial in document order, so hybrid and plain builds
+  // are byte-identical (unlike the reference, whose shared-counter races
+  // make its hybrid variant undefined, SURVEY §2.5-8).
   std::vector<Record> records;
   DfTable local_df;
   if (rank > 0) {
-    for (uint64_t i = rank; i <= num_docs; i += size - 1) {
-      std::string name = "doc" + std::to_string(i);
+    std::vector<uint64_t> my_docs;
+    for (uint64_t i = rank; i <= num_docs; i += size - 1) my_docs.push_back(i);
+    struct DocResult {
+      std::vector<Record> recs;
+      std::vector<std::string> order;  // first-appearance word order
+    };
+    std::vector<DocResult> results(my_docs.size());
+#ifdef _OPENMP
+#pragma omp parallel for schedule(dynamic)
+#endif
+    for (long long di = 0; di < (long long)my_docs.size(); ++di) {
+      std::string name = "doc" + std::to_string(my_docs[di]);
       std::ifstream f(input_dir + "/" + name, std::ios::binary);
       if (!f) {
         // Hard exit like the reference (TFIDF.c:137). A plain return
@@ -207,21 +223,25 @@ int PipelineMain(Comm& comm, const std::string& input_dir,
 
       // First-appearance-ordered TF counts (the reference's linear-probe
       // append table, TFIDF.c:150-167, replaced by a hash index).
-      std::vector<std::string> order;
       std::unordered_map<std::string, int64_t> counts;
       for (auto& w : toks) {
         auto it = counts.find(w);
         if (it == counts.end()) {
           counts.emplace(w, 1);
-          order.push_back(w);
+          results[di].order.push_back(w);
         } else {
           ++it->second;
         }
       }
-      for (auto& w : order)
-        records.push_back(Record{name, w, counts[w], doc_size});
+      for (auto& w : results[di].order)
+        results[di].recs.push_back(Record{name, w, counts[w], doc_size});
+    }
+    // Serial fold in document order: record order and DF insertion
+    // order come out exactly as the serial loop would produce them.
+    for (auto& dr : results) {
+      records.insert(records.end(), dr.recs.begin(), dr.recs.end());
       // DF: one per word per doc — the currDoc dedup (TFIDF.c:171-188).
-      for (auto& w : order) local_df.Add(w, 1);
+      for (auto& w : dr.order) local_df.Add(w, 1);
     }
   }
 
@@ -234,18 +254,26 @@ int PipelineMain(Comm& comm, const std::string& input_dir,
 
   // Phase 3: join + score (TFIDF.c:227-246). Same double ops, same order:
   // TF = 1.0*count/docSize; IDF = log(1.0*numDocs/df); score = TF*IDF.
-  std::vector<uint8_t> lines_wire;
-  PutU32(lines_wire, (uint32_t)records.size());
-  for (auto& r : records) {
+  // Hybrid build: per-record slots (the reference's scoring pragma,
+  // TFIDF_extra.c:230, made race-free); serialization stays serial so
+  // the wire bytes are order-identical.
+  std::vector<std::string> lines(records.size());
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+  for (long long ri = 0; ri < (long long)records.size(); ++ri) {
+    const Record& r = records[ri];
     double tf = 1.0 * (double)r.count / (double)r.doc_size;
     int64_t df = global_df.doc_counts[global_df.index.at(r.word)];
     double idf = std::log(1.0 * (double)num_docs / (double)df);
     double score = tf * idf;
     char buf[64];
     int n = std::snprintf(buf, sizeof buf, "%.16f", score);
-    std::string line = r.doc + "@" + r.word + "\t" + std::string(buf, n);
-    PutStr(lines_wire, line);
+    lines[ri] = r.doc + "@" + r.word + "\t" + std::string(buf, n);
   }
+  std::vector<uint8_t> lines_wire;
+  PutU32(lines_wire, (uint32_t)records.size());
+  for (auto& line : lines) PutStr(lines_wire, line);
 
   // Phase 4: gather -> sort -> emit (TFIDF.c:253-283).
   std::vector<std::vector<uint8_t>> gathered;

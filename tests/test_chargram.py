@@ -84,7 +84,7 @@ class TestDeviceChargram:
         assert b"ab" in set(r.id_to_word.values())
 
     def test_host_fallback_flag(self):
-        base = dict(tokenizer=TokenizerKind.CHARGRAM,
+        base = dict(engine="dense", tokenizer=TokenizerKind.CHARGRAM,
                     vocab_mode=VocabMode.HASHED, vocab_size=256,
                     ngram_range=(2, 3))
         dev = TfidfPipeline(PipelineConfig(**base)).run_bytes(CORPUS)

@@ -1,0 +1,120 @@
+"""Failure injection: a streaming job SIGKILLed mid-corpus must resume
+from its checkpoint and produce byte-identical output to a clean run.
+
+The reference has no failure story at all — every error path is
+``exit()`` and a lost rank hangs the barriers (SURVEY §5, failure row).
+Here the crash window is real: a subprocess is killed with SIGKILL (no
+atexit, no flush) partway through pass 1, and a fresh process must pick
+up from the last committed checkpoint.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The victim streams with a per-batch sleep so the parent can kill it
+# mid-corpus deterministically-enough: it prints BATCH after each
+# checkpointed minibatch and the parent kills after seeing >= 2.
+_VICTIM = r"""
+import sys, time
+import tfidf_tpu.streaming as streaming
+from tfidf_tpu import checkpoint as ckpt
+from tfidf_tpu.config import PipelineConfig, VocabMode
+from tfidf_tpu.io.corpus import Corpus, discover_names
+import os
+
+input_dir, ck = sys.argv[1], sys.argv[2]
+cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=256,
+                     topk=3, max_doc_len=32, doc_chunk=32)
+stream = streaming.StreamingTfidf(cfg)
+names = discover_names(input_dir, strict=True)
+start = 0
+if ckpt.exists(ck):
+    stream.load_state(ckpt.restore_state(ck))
+    start = stream.docs_seen
+for lo in range(start, len(names), 8):
+    docs = []
+    for n in names[lo:lo + 8]:
+        with open(os.path.join(input_dir, n), "rb") as f:
+            docs.append(f.read())
+    stream.update(stream.pack(Corpus(names=names[lo:lo + 8], docs=docs),
+                              fixed_len=32))
+    ckpt.save_state(ck, stream.state_dict(), force_npz=True)
+    print("BATCH", stream.docs_seen, flush=True)
+    time.sleep(0.3)
+print("DONE", stream.docs_seen, flush=True)
+"""
+
+
+@pytest.fixture()
+def stream_corpus(tmp_path):
+    ind = tmp_path / "input"
+    ind.mkdir()
+    rng = np.random.default_rng(3)
+    for i in range(1, 41):
+        (ind / f"doc{i}").write_text(
+            " ".join(f"w{rng.integers(0, 40)}" for _ in range(12)))
+    return str(ind)
+
+
+def _run_victim(input_dir, ck, kill_after_batches=None, timeout=180):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _VICTIM, input_dir, ck],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True, env=env)
+    seen = []
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        seen.append(line.strip())
+        if line.startswith("DONE"):
+            break
+        if (kill_after_batches is not None
+                and len([s for s in seen if s.startswith("BATCH")])
+                >= kill_after_batches):
+            proc.send_signal(signal.SIGKILL)  # no cleanup, no flush
+            break
+    proc.wait(timeout=30)
+    return proc.returncode, seen
+
+
+class TestCrashResume:
+    def test_sigkill_mid_stream_resumes_identically(self, stream_corpus,
+                                                    tmp_path):
+        ck_crash = str(tmp_path / "ck_crash")
+        ck_clean = str(tmp_path / "ck_clean")
+
+        # Clean run: 40 docs in 5 batches, DF state checkpointed at end.
+        rc, seen = _run_victim(stream_corpus, ck_clean)
+        assert rc == 0 and seen[-1] == "DONE 40", seen
+
+        # Crashed run: SIGKILL after the 2nd committed batch.
+        rc, seen = _run_victim(stream_corpus, ck_crash, kill_after_batches=2)
+        assert rc == -signal.SIGKILL, (rc, seen)
+        assert seen[-1].startswith("BATCH"), seen
+
+        # The checkpoint left behind must be committed and restorable.
+        from tfidf_tpu import checkpoint as ckpt
+        state = ckpt.restore_state(ck_crash)
+        assert 0 < int(state["docs_seen"]) < 40
+
+        # Resume in a fresh process: finishes the stream...
+        rc, seen = _run_victim(stream_corpus, ck_crash)
+        assert rc == 0 and seen[-1] == "DONE 40", seen
+
+        # ...and the final DF state equals the never-crashed run's.
+        a = ckpt.restore_state(ck_crash)
+        b = ckpt.restore_state(ck_clean)
+        assert set(a) == set(b)
+        for key in a:
+            np.testing.assert_array_equal(a[key], b[key], err_msg=key)

@@ -12,7 +12,8 @@ from tfidf_tpu.io.corpus import Corpus
 from tfidf_tpu.models import TfidfVectorizer
 from tfidf_tpu.utils import PhaseTimer, Throughput, trace_region
 
-CFG = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=256,
+CFG = PipelineConfig(engine="dense", vocab_mode=VocabMode.HASHED,
+                     vocab_size=256,
                      max_doc_len=8, doc_chunk=8)
 CORPUS = Corpus(names=["doc1", "doc2", "doc3", "doc4"],
                 docs=[b"a b c", b"a a d", b"b d e", b"a c"])

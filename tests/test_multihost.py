@@ -63,14 +63,25 @@ print("OK", topo.process_id)
 class TestTwoProcess:
     def test_distributed_smoke_localhost(self, tmp_path):
         """2-process jax.distributed bring-up + one cross-process psum."""
+        import socket
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         env.pop("XLA_FLAGS", None)  # 1 CPU device per process
-        addr = "localhost:12421"
+        # Ask the kernel for a free port instead of hardcoding one: a
+        # concurrent run (or a TIME_WAIT socket from the last one) on a
+        # fixed port would flake.
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            addr = f"localhost:{s.getsockname()[1]}"
         procs = [subprocess.Popen(
             [sys.executable, "-c", _WORKER, addr, str(pid)],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
             text=True, env=env) for pid in range(2)]
-        outs = [p.communicate(timeout=120) for p in procs]
+        try:
+            outs = [p.communicate(timeout=120) for p in procs]
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
         for p, (out, err) in zip(procs, outs):
             assert p.returncode == 0, f"rc={p.returncode}\n{out}\n{err}"
         # gloo prints connection chatter on stdout; the verdict is the

@@ -181,3 +181,25 @@ class TestParallelLoader:
         b = pack_corpus(discover_corpus(toy_corpus_dir), cfg,
                         want_words=False)
         assert (a.token_ids == b.token_ids).all()
+
+
+class TestHybridOpenMP:
+    """The reference's MPI+OpenMP hybrid (TFIDF_extra.c) rebuilt race-free:
+    `make tfidf_ref_omp` adds intra-rank thread fan-out over each rank's
+    documents and the scoring loop; output must be byte-identical to the
+    plain build (the reference's own hybrid races on its shared counters,
+    SURVEY §2.5-8 — ours is pinned deterministic here)."""
+
+    def test_omp_build_byte_identical(self, toy_corpus_dir, tmp_path):
+        omp_bin = os.path.join(NATIVE_DIR, "tfidf_ref_omp")
+        built = subprocess.run(["make", "-C", NATIVE_DIR, "tfidf_ref_omp"],
+                               capture_output=True, text=True)
+        assert built.returncode == 0, built.stderr
+        plain, hybrid = tmp_path / "plain.txt", tmp_path / "omp.txt"
+        assert run_ref(toy_corpus_dir, plain, 4).returncode == 0
+        env = dict(os.environ, OMP_NUM_THREADS="3")
+        proc = subprocess.run(
+            [omp_bin, toy_corpus_dir, str(hybrid), "4"],
+            capture_output=True, env=env)
+        assert proc.returncode == 0, proc.stderr
+        assert plain.read_bytes() == hybrid.read_bytes()

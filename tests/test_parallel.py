@@ -36,8 +36,8 @@ class TestShardedMatchesSingleDevice:
     @pytest.mark.parametrize("mesh_kw", MESH_CASES)
     def test_counts_df_scores_equal(self, toy_corpus_dir, mesh_kw):
         corpus = discover_corpus(toy_corpus_dir)
-        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=64,
-                             max_doc_len=64, doc_chunk=64)
+        cfg = PipelineConfig(engine="dense", vocab_mode=VocabMode.HASHED,
+                             vocab_size=64, max_doc_len=64, doc_chunk=64)
         single = TfidfPipeline(cfg).run(corpus)
         plan = MeshPlan.create(**mesh_kw)
         sharded = ShardedPipeline(plan, cfg).run(corpus)
@@ -64,8 +64,8 @@ class TestShardedMatchesSingleDevice:
         # mesh) must agree exactly with the XLA scatter lowering for
         # every mesh shape, vocab offsets and seq residuals included.
         corpus = discover_corpus(toy_corpus_dir)
-        base = dict(vocab_mode=VocabMode.HASHED, vocab_size=256,
-                    max_doc_len=64, doc_chunk=64)
+        base = dict(engine="dense", vocab_mode=VocabMode.HASHED,
+                    vocab_size=256, max_doc_len=64, doc_chunk=64)
         plan = MeshPlan.create(**mesh_kw)
         xla = ShardedPipeline(plan, PipelineConfig(**base)).run(corpus)
         pallas = ShardedPipeline(
@@ -80,8 +80,8 @@ class TestShardedMatchesSingleDevice:
         # must equal both the explicit ShardedPipeline and (modulo doc
         # padding) the single-device run.
         corpus = discover_corpus(toy_corpus_dir)
-        base = dict(vocab_mode=VocabMode.HASHED, vocab_size=64,
-                    max_doc_len=64, doc_chunk=64)
+        base = dict(engine="dense", vocab_mode=VocabMode.HASHED,
+                    vocab_size=64, max_doc_len=64, doc_chunk=64)
         meshed = TfidfPipeline(PipelineConfig(
             mesh_shape={"docs": 4, "vocab": 2}, **base)).run(corpus)
         single = TfidfPipeline(PipelineConfig(**base)).run(corpus)
@@ -100,7 +100,7 @@ class TestShardedMatchesSingleDevice:
         # A batch packed without a plan (e.g. via TfidfPipeline.pack)
         # must be grown to mesh-divisible shape, not rejected.
         corpus = discover_corpus(toy_corpus_dir)
-        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=64,
+        cfg = PipelineConfig(engine="dense", vocab_mode=VocabMode.HASHED, vocab_size=64,
                              max_doc_len=64, doc_chunk=64)
         batch = TfidfPipeline(cfg).pack(corpus)
         plan = MeshPlan.create(docs=8, seq=1, vocab=1)
@@ -117,7 +117,7 @@ class TestShardedMatchesSingleDevice:
         plan = MeshPlan.create(docs=2, seq=1, vocab=4)
         sharded = ShardedPipeline(plan, cfg).run(corpus)
         dense = TfidfPipeline(
-            PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=64,
+            PipelineConfig(engine="dense", vocab_mode=VocabMode.HASHED, vocab_size=64,
                            max_doc_len=64, doc_chunk=64)).run(corpus)
         d = dense.counts.shape[0]
         # top-1 id agrees; top-k values agree as sorted sets

@@ -117,3 +117,23 @@ class TestDiscovery:
         (d / "a.txt").write_bytes(b"y")
         c = discover_corpus(str(d), strict=False)
         assert c.names == ["a.txt", "b.txt"]
+
+
+class TestEngineDefault:
+    """Measured engine default (docs/ENGINES.md): sparse for hashed,
+    dense for exact — and never silently dropping an explicit --pallas."""
+
+    def test_hashed_defaults_sparse(self):
+        from tfidf_tpu.config import PipelineConfig, VocabMode
+        assert PipelineConfig(vocab_mode=VocabMode.HASHED).engine == "sparse"
+        assert PipelineConfig(vocab_mode=VocabMode.EXACT).engine == "dense"
+
+    def test_use_pallas_defaults_dense(self):
+        from tfidf_tpu.config import PipelineConfig, VocabMode
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, use_pallas=True)
+        assert cfg.engine == "dense"  # pallas is a dense-engine feature
+
+    def test_explicit_engine_wins(self):
+        from tfidf_tpu.config import PipelineConfig, VocabMode
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, engine="dense")
+        assert cfg.engine == "dense" and not cfg._engine_defaulted

@@ -20,7 +20,7 @@ def corpus_batches():
     return full, batches
 
 
-CFG = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=256,
+CFG = PipelineConfig(engine="dense", vocab_mode=VocabMode.HASHED, vocab_size=256,
                      max_doc_len=8, doc_chunk=8)
 
 

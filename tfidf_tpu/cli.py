@@ -27,8 +27,10 @@ def _build_parser() -> argparse.ArgumentParser:
     run.add_argument("--output", default="output.txt",
                      help="output file (reference format)")
     run.add_argument("--backend", choices=["tpu", "mpi"], default="tpu")
-    run.add_argument("--engine", choices=["dense", "sparse"], default="dense",
-                     help="dense [D,V] histograms or row-sparse O(D*L)")
+    run.add_argument("--engine", choices=["dense", "sparse"], default=None,
+                     help="dense [D,V] histograms or row-sparse O(D*L); "
+                          "default: sparse for hashed vocab, dense for "
+                          "exact (measured choice, docs/ENGINES.md)")
     run.add_argument("--pallas", action="store_true",
                      help="use the Pallas TPU histogram kernel")
     run.add_argument("--vocab-mode", choices=["exact", "hashed"],
@@ -124,18 +126,15 @@ def _run_tpu(args) -> int:
         use_pallas=args.pallas,
         mesh_shape=mesh_shape,
     )
-    timer = None
-    if args.timing:
-        from tfidf_tpu.utils.timing import PhaseTimer
-        timer = PhaseTimer()
-    from tfidf_tpu.utils.timing import phase_or_null
+    from tfidf_tpu.utils.timing import PhaseTimer, Throughput, phase_or_null
+    timer = PhaseTimer() if args.timing else None
+    throughput = Throughput()
     with phase_or_null(timer, "discover"):
         corpus = discover_corpus(args.input, strict=not args.no_strict)
     # --mesh flows through config.mesh_shape: TfidfPipeline dispatches to
     # ShardedPipeline over the described device mesh.
-    import time
-    t0 = time.perf_counter()
-    result = TfidfPipeline(cfg, timer=timer).run(corpus)
+    with throughput.measure(len(corpus)):
+        result = TfidfPipeline(cfg, timer=timer).run(corpus)
 
     with phase_or_null(timer, "emit"):
         if args.topk is None:
@@ -143,9 +142,8 @@ def _run_tpu(args) -> int:
         else:
             _write_topk(args.output, result)
     if timer is not None:
-        dps = result.num_docs / max(time.perf_counter() - t0, 1e-9)
         sys.stderr.write(timer.report() + "\n"
-                         f"{'docs/sec':>12}: {dps:9.1f}\n")
+                         f"{'docs/sec':>12}: {throughput.docs_per_sec:9.1f}\n")
     print(f"wrote {args.output} ({result.num_docs} docs)")
     return 0
 

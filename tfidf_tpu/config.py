@@ -84,7 +84,13 @@ class PipelineConfig:
 
     vocab_mode: VocabMode = VocabMode.EXACT
     vocab_size: int = 1 << 16
-    engine: str = "dense"  # "dense" ([D,V] histograms) | "sparse" (row-sparse)
+    # "dense" ([D,V] histograms) | "sparse" (row-sparse) | None = choose
+    # by vocab mode from the measured engine bench (docs/ENGINES.md):
+    # sort+RLE wins every cell and its margin grows with vocab, so
+    # HASHED (large-vocab) runs default to "sparse"; EXACT golden-parity
+    # runs keep "dense" (tiny corpus-derived V, dense counts for byte-
+    # exact full output).
+    engine: Optional[str] = None
     hash_seed: int = 0
     tokenizer: TokenizerKind = TokenizerKind.WHITESPACE
     ngram_range: Tuple[int, int] = (3, 5)
@@ -105,6 +111,18 @@ class PipelineConfig:
             raise ValueError(f"bad ngram_range {self.ngram_range}")
         if self.max_doc_len <= 0 or self.doc_chunk <= 0:
             raise ValueError("max_doc_len/doc_chunk must be positive")
+        # _engine_defaulted: True when the engine came from the measured
+        # default rather than the caller. A defaulted "sparse" may be
+        # swapped for "dense" by capability (the sparse lowering shards
+        # the docs axis only); an explicit "sparse" never is.
+        object.__setattr__(self, "_engine_defaulted", self.engine is None)
+        if self.engine is None:
+            # use_pallas is a dense-engine feature: an explicit --pallas
+            # must not be silently discarded by the measured default.
+            object.__setattr__(
+                self, "engine",
+                "sparse" if (self.vocab_mode is VocabMode.HASHED
+                             and not self.use_pallas) else "dense")
         if self.engine not in ("dense", "sparse"):
             raise ValueError(f"unknown engine {self.engine!r}")
 

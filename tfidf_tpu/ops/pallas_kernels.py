@@ -17,6 +17,15 @@ the revisit-and-accumulate idiom.
 
 Lane/sublane shapes follow the TPU tiling table (pallas_guide.md): the
 vocab axis rides the 128-wide lane dimension, docs ride sublanes.
+
+MEASURED SCOPE (docs/ENGINES.md, real-TPU engine bench): the compare-
+and-reduce work is O(L*V) per doc, so this kernel is competitive only
+at small vocab — it ties the scatter lowering at 2^10 and is ~58x
+slower than the sort+RLE engine at the BASELINE 2^16 vocab. It exists
+as the in-tree Mosaic histogram demonstration and the small-vocab
+option; large-vocab production runs use ``engine="sparse"``.
+``tf_df_pallas`` warns when called above TFIDF_TPU_PALLAS_MAX_VOCAB
+(default 4096).
 """
 
 from __future__ import annotations
@@ -112,6 +121,16 @@ def tf_df_pallas(token_ids: jax.Array, lengths: jax.Array, *,
     kernel — callers that re-derive presence after a cross-shard psum
     skip the fused df's accumulate work entirely.
     """
+    import os
+    import warnings
+    max_vocab = int(os.environ.get("TFIDF_TPU_PALLAS_MAX_VOCAB", 4096))
+    if vocab_size > max_vocab:
+        warnings.warn(
+            f"tf_df_pallas at vocab_size={vocab_size}: the compare-and-"
+            f"reduce kernel is O(L*V) and measured ~58x slower than "
+            f"engine='sparse' at 2^16 vocab (docs/ENGINES.md); prefer the "
+            f"sort+RLE engine above {max_vocab} vocab",
+            RuntimeWarning, stacklevel=2)
     d, length = token_ids.shape
     dp, lp, vp = _pad_to(d, TILE_D), _pad_to(length, CHUNK_L), _pad_to(
         vocab_size, TILE_V)

@@ -72,13 +72,23 @@ class ShardedPipeline(PhaseTimedMixin):
                 "(use TfidfPipeline for config-driven mesh dispatch)")
         batch = self._pad_to_mesh(batch)
         vocab_padded = self.plan.pad_vocab(batch.vocab_size)
+        engine = cfg.engine
+        if (engine == "sparse"
+                and getattr(cfg, "_engine_defaulted", False)
+                and (self.plan.n_seq_shards != 1
+                     or self.plan.n_vocab_shards != 1)):
+            # The measured default picked sparse, but the sparse lowering
+            # shards the docs axis only — fall back to the dense lowering
+            # for vocab/seq-sharded meshes. Explicit engine="sparse"
+            # still errors below (capability, not preference).
+            engine = "dense"
         with self._phase("transfer"):
             tokens = jax.device_put(batch.token_ids,
                                     self.plan.sharding(self.plan.batch_spec()))
             lengths = jax.device_put(batch.lengths,
                                      self.plan.sharding(self.plan.lengths_spec()))
             self._fence((tokens, lengths))
-        if cfg.engine == "sparse":
+        if engine == "sparse":
             return self._run_sparse(batch, tokens, lengths)
         if cfg.use_pallas:
             from tfidf_tpu.ops.pallas_kernels import default_interpret

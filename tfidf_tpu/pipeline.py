@@ -199,9 +199,13 @@ class TfidfPipeline(PhaseTimedMixin):
         plan = MeshPlan.create(docs=shape.get("docs", 0),
                                seq=shape.get("seq", 1),
                                vocab=shape.get("vocab", 1))
-        return ShardedPipeline(
-            plan, dataclasses.replace(self.config, mesh_shape={}),
-            timer=self.timer)
+        cfg = dataclasses.replace(self.config, mesh_shape={})
+        # replace() re-runs __post_init__ with the resolved engine, which
+        # would mark a measured default as explicit — carry the flag so
+        # ShardedPipeline can still apply its capability fallback.
+        object.__setattr__(cfg, "_engine_defaulted",
+                           getattr(self.config, "_engine_defaulted", False))
+        return ShardedPipeline(plan, cfg, timer=self.timer)
 
     def run_packed(self, batch: PackedBatch) -> PipelineResult:
         cfg = self.config
@@ -336,6 +340,7 @@ class TfidfPipeline(PhaseTimedMixin):
                 and cfg.vocab_mode is VocabMode.HASHED
                 and cfg.chargram_on_device
                 and cfg.topk is not None
-                and cfg.engine == "dense"):
+                and (cfg.engine == "dense"
+                     or getattr(cfg, "_engine_defaulted", False))):
             return self.run_bytes(corpus)
         return self.run_packed(self.pack(corpus))

@@ -1,0 +1,145 @@
+"""Microbenchmark: the three TF(+DF) engines head-to-head on device.
+
+VERDICT r1 item 3: the default engine must be chosen by measurement, not
+docstring. Times, per (vocab, doc_len) cell:
+
+  scatter — masked scatter-add dense histogram (ops/histogram.tf_counts,
+            chunked scan for doc_len > chunk)
+  sort    — sort+RLE row-sparse triples + dual-lowering DF
+            (ops/sparse.sorted_term_counts + sparse_df)
+  pallas  — fused compare-and-reduce TF+DF kernel
+            (ops/pallas_kernels.tf_df_pallas) — O(L*V) work per doc,
+            expected to lose at large vocab
+
+Each engine's timed unit is "token ids on device -> (TF representation +
+DF [V] on device)" — the common subproblem all three solve. Run on the
+real TPU; writes a markdown table to stdout (paste into docs/ENGINES.md)
+plus one JSON line per cell to stderr.
+
+Usage: python tools/engine_bench.py [--docs 4096] [--repeats 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import sys
+import time
+
+REPO = __file__.rsplit("/", 2)[0]
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from tfidf_tpu.ops.histogram import (df_from_counts, tf_counts,  # noqa: E402
+                                     tf_counts_chunked)
+from tfidf_tpu.ops.pallas_kernels import tf_df_pallas  # noqa: E402
+from tfidf_tpu.ops.sparse import sorted_term_counts, sparse_df  # noqa: E402
+
+CHUNK = 512  # doc_len above this takes the chunked-scan scatter path
+
+
+@functools.partial(jax.jit, static_argnames=("vocab_size", "chunk"))
+def _scatter(token_ids, lengths, *, vocab_size, chunk):
+    if token_ids.shape[1] > chunk:
+        counts = tf_counts_chunked(token_ids, lengths, vocab_size, chunk)
+    else:
+        counts = tf_counts(token_ids, lengths, vocab_size)
+    return counts, df_from_counts(counts)
+
+
+@functools.partial(jax.jit, static_argnames=("vocab_size",))
+def _sort(token_ids, lengths, *, vocab_size):
+    ids, counts, head = sorted_term_counts(token_ids, lengths)
+    return (ids, counts, head), sparse_df(ids, head, vocab_size)
+
+
+@functools.partial(jax.jit, static_argnames=("vocab_size",))
+def _pallas(token_ids, lengths, *, vocab_size):
+    return tf_df_pallas(token_ids, lengths, vocab_size=vocab_size)
+
+
+def time_engine(fn, token_ids, lengths, repeats: int) -> float:
+    """Best-of-N wall-clock of one engine call, fenced by a real fetch.
+
+    block_until_ready alone under-reports on the tunneled axon backend
+    (observed: "completion" in 33 us for 16M tokens); device_get of the
+    [V] DF vector — identical across engines — forces actual execution.
+    """
+    out = fn(token_ids, lengths)  # compile + warmup
+    jax.device_get(out[1])
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.device_get(fn(token_ids, lengths)[1])
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--docs", type=int, default=4096)
+    ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--zipf", type=float, default=1.3)
+    args = ap.parse_args()
+
+    backend = jax.default_backend()
+    dev = jax.devices()[0]
+    print(f"backend={backend} device={dev.device_kind} docs={args.docs} "
+          f"best-of-{args.repeats}", file=sys.stderr)
+
+    engines = {
+        "scatter": lambda v: (lambda t, l: _scatter(t, l, vocab_size=v,
+                                                    chunk=CHUNK)),
+        "sort": lambda v: (lambda t, l: _sort(t, l, vocab_size=v)),
+        "pallas": lambda v: (lambda t, l: _pallas(t, l, vocab_size=v)),
+    }
+    cells = []
+    rng = np.random.default_rng(0)
+    for vocab in (1 << 10, 1 << 16):
+        for doc_len in (256, 4096):
+            # Zipf-distributed ids mirror bench.py's corpus shape; pad
+            # tail tokens past each doc's length with zeros like the
+            # packer does.
+            ids = np.clip(rng.zipf(args.zipf, (args.docs, doc_len)),
+                          1, vocab) - 1
+            lens = rng.integers(doc_len // 2, doc_len + 1,
+                                args.docs).astype(np.int32)
+            mask = np.arange(doc_len)[None, :] < lens[:, None]
+            ids = jnp.asarray(np.where(mask, ids, 0).astype(np.int32))
+            lens = jnp.asarray(lens)
+            row = {"vocab": vocab, "doc_len": doc_len}
+            for name, make in engines.items():
+                try:
+                    s = time_engine(make(vocab), ids, lens, args.repeats)
+                    row[name] = s
+                except Exception as e:  # OOM / Mosaic limits: record it
+                    row[name] = None
+                    row[f"{name}_error"] = type(e).__name__
+                    print(f"{name} v={vocab} L={doc_len}: "
+                          f"{str(e)[:200]}", file=sys.stderr)
+            print(json.dumps(row), file=sys.stderr)
+            cells.append(row)
+
+    def fmt(row, name):
+        s = row.get(name)
+        if s is None:
+            return row.get(f"{name}_error", "fail")
+        mtoks = args.docs * row["doc_len"] / s / 1e6
+        return f"{s * 1e3:.2f} ms ({mtoks:.0f} Mtok/s)"
+
+    print(f"\n| vocab | doc_len | scatter | sort+RLE | pallas | winner |")
+    print("|---|---|---|---|---|---|")
+    for row in cells:
+        timed = {n: row[n] for n in engines if row.get(n) is not None}
+        win = min(timed, key=timed.get) if timed else "-"
+        print(f"| 2^{int(np.log2(row['vocab']))} | {row['doc_len']} "
+              f"| {fmt(row, 'scatter')} | {fmt(row, 'sort')} "
+              f"| {fmt(row, 'pallas')} | {win} |")
+
+
+if __name__ == "__main__":
+    main()
