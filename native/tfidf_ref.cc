@@ -33,6 +33,10 @@
 
 #include "comm.h"
 
+#ifdef TFIDF_HAVE_MPI
+#include <mpi.h>  // main() owns MPI_Init/Finalize in the MPI build
+#endif
+
 namespace tfidf {
 namespace {
 

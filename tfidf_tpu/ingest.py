@@ -49,16 +49,18 @@ from tfidf_tpu.io import fast_tokenizer
 from tfidf_tpu.io.corpus import discover_names, pack_corpus
 from tfidf_tpu.ops.scoring import idf_from_df
 from tfidf_tpu.ops.sparse import (sorted_term_counts, sparse_df,
-                                  sparse_scores, sparse_topk)
+                                  sparse_forward, sparse_scores, sparse_topk)
 
 # spill="auto": keep packed chunks in host RAM up to this many bytes,
 # re-read from disk beyond. Read at call time (TFIDF_TPU_SPILL_BYTES)
 # so tests/tuning can override after import, like TFIDF_TPU_DF_METHOD.
 _DEFAULT_SPILL_BYTES = 1 << 30
 
-# Host-ahead bound: how many chunks the dispatch loops may run ahead of
-# the device before blocking. Keeps HBM residency at O(lookahead) chunk
-# buffers even when host packing outpaces device compute.
+# Host-ahead floor: the dispatch loops may always run at least this many
+# chunks ahead of the device. The effective bound is byte-budgeted
+# (TFIDF_TPU_INFLIGHT_BYTES / chunk bytes — see max_ahead in
+# run_overlapped): each sync costs a full link round trip on the
+# tunneled backend, so throttling should be rare, not per-chunk.
 _LOOKAHEAD = 2
 
 
@@ -67,6 +69,45 @@ def _phase_a(token_ids, lengths, df_acc, *, vocab_size: int):
     """Fold one chunk's partial DF into the device-resident accumulator."""
     ids, _, head = sorted_term_counts(token_ids, lengths)
     return df_acc + sparse_df(ids, head, vocab_size)
+
+
+# The fused one-program path (used whenever the packed corpus fits on
+# device, see _RESIDENT_ELEMS): sort once, score once — the two-pass
+# choreography re-sorts every chunk in each pass. Chunked host packing
+# and async chunk uploads still overlap in front of it.
+@functools.partial(jax.jit,
+                   static_argnames=("vocab_size", "score_dtype", "topk"))
+def _fused_compact(token_ids, lengths, num_docs, *, vocab_size: int,
+                   score_dtype, topk: int):
+    """Fused forward with a compact wire format for the result fetch.
+
+    The tunneled single-chip link runs ~60 MB/s, so the [D, K] result
+    transfer is material: scores travel as bfloat16 (same exponent range
+    as float32 — sign and zero are preserved, which is all the recall
+    accounting reads) and ids as uint16 when the vocab fits. Scoring
+    itself stays in ``score_dtype``; only the fetched bytes shrink.
+    """
+    df, vals, ids = sparse_forward(token_ids, lengths, num_docs,
+                                   vocab_size=vocab_size,
+                                   score_dtype=score_dtype, topk=topk)
+    if vocab_size < (1 << 16):
+        # Strictly-less: 65535 is then reserved as the -1 sentinel's
+        # two's-complement image, so host decode is unambiguous.
+        ids = ids.astype(jnp.uint16)
+    return df, vals.astype(jnp.bfloat16), ids
+
+
+@jax.jit
+def _concat_rows(parts):
+    """Device-side concat of uploaded chunks along the doc axis."""
+    return jnp.concatenate(parts, axis=0)
+
+
+# Largest packed corpus (doc slots x token length) the fused resident
+# path will hold on device; beyond it the two-pass streaming pipeline
+# takes over. ~134M tokens ~ a few GB with sort workspace — comfortable
+# in one chip's HBM, overridable for smaller parts.
+_RESIDENT_ELEMS = 1 << 27
 
 
 @functools.partial(jax.jit, static_argnames=("topk",))
@@ -84,7 +125,14 @@ def _final_idf(df_total, num_docs, *, score_dtype):
 
 @dataclasses.dataclass
 class IngestResult:
-    """Corpus-wide outputs of an overlapped ingest run."""
+    """Corpus-wide outputs of an overlapped ingest run.
+
+    On the resident fused path, ``topk_vals`` crossed the wire as
+    bfloat16 (~2^-8 relative precision; sign/zero exact) — the selection
+    itself was computed in ``config.score_dtype``. The streaming path
+    returns full-precision scores. Exact-value consumers should use
+    :class:`~tfidf_tpu.pipeline.TfidfPipeline`.
+    """
 
     df: np.ndarray            # [V] corpus document frequencies
     topk_vals: np.ndarray     # [D, K] per-doc top-k TF-IDF scores
@@ -173,8 +221,11 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
                   and fast_tokenizer.loader_available())
     score_dtype = jnp.dtype(cfg.score_dtype)
     k = min(cfg.topk, length)
+    # Wire bytes per token id: the native loader packs uint16 when the
+    # vocab fits (fast_tokenizer), else int32. Drives both the spill
+    # estimate and the in-flight upload budget.
+    itemsize = 2 if (use_native and cfg.vocab_size <= (1 << 16)) else 4
     if spill == "auto":
-        itemsize = 2 if (use_native and cfg.vocab_size <= (1 << 16)) else 4
         est = num_docs * length * itemsize
         budget = int(os.environ.get("TFIDF_TPU_SPILL_BYTES",
                                     _DEFAULT_SPILL_BYTES))
@@ -183,12 +234,54 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
     pack_chunk = make_chunk_packer(input_dir, cfg, chunk_docs, length)
     starts = list(range(0, num_docs, chunk_docs))
 
+    resident = int(os.environ.get("TFIDF_TPU_RESIDENT_ELEMS",
+                                  _RESIDENT_ELEMS))
+    if num_docs * length <= resident:
+        # Resident fused path: the host packs chunk i+1 while chunk i's
+        # upload is still in flight (device_put is async — on the
+        # tunneled backend the link runs ~60 MB/s, so hiding uploads
+        # behind packing matters more than anything else). The device
+        # concats the chunks, runs ONE fused program (a single sort,
+        # where the two-pass pipeline sorts every chunk twice), and the
+        # host pays a single synchronizing fetch. Only the final chunk
+        # carries padding rows, so real documents are rows [0, num_docs).
+        tok_parts, len_parts, all_lengths = [], [], []
+        for start in starts:
+            chunk_names = names[start:start + chunk_docs]
+            token_ids, lengths = pack_chunk(chunk_names)
+            all_lengths.append(lengths[:len(chunk_names)])
+            tok_parts.append(jax.device_put(token_ids))
+            len_parts.append(jax.device_put(lengths))
+        toks = tok_parts[0] if len(tok_parts) == 1 else _concat_rows(tok_parts)
+        lens = len_parts[0] if len(len_parts) == 1 else _concat_rows(len_parts)
+        out = _fused_compact(toks, lens, jnp.int32(num_docs),
+                             vocab_size=cfg.vocab_size,
+                             score_dtype=score_dtype, topk=k)
+        df_host, vals, tids = jax.device_get(out)
+        # Decode the compact wire: bf16 scores widen losslessly in sign/
+        # zero (what downstream reads); uint16 65535 is the -1 sentinel.
+        vals = np.asarray(vals).astype(np.float32)
+        tids = np.asarray(tids)
+        if tids.dtype == np.uint16:
+            tids = np.where(tids == np.uint16(0xFFFF), -1,
+                            tids.astype(np.int32)).astype(np.int32)
+        return IngestResult(df=df_host, topk_vals=vals[:num_docs],
+                            topk_ids=tids[:num_docs],
+                            lengths=np.concatenate(all_lengths),
+                            names=names, num_docs=num_docs)
+
     # Pass A: fold every chunk's partial DF into one device accumulator.
     # The loop packs chunk i+1 while the device still runs chunk i
-    # (async dispatch), but never runs more than _LOOKAHEAD chunks
-    # ahead — blocking on chunk i-_LOOKAHEAD's result bounds HBM
-    # residency at O(lookahead) [chunk, L] buffers even when host
-    # packing outpaces the device.
+    # (async dispatch), but never runs more than max_ahead chunks
+    # ahead — blocking on the oldest in-flight result bounds HBM
+    # residency even when host packing outpaces the device. The bound is
+    # byte-budgeted (TFIDF_TPU_INFLIGHT_BYTES, default 512 MB): each
+    # sync costs a full link round trip on the tunneled backend, so it
+    # should trigger rarely, not per chunk.
+    chunk_bytes = max(chunk_docs * length * itemsize, 1)
+    max_ahead = max(_LOOKAHEAD,
+                    int(os.environ.get("TFIDF_TPU_INFLIGHT_BYTES", 1 << 29))
+                    // chunk_bytes)
     df_acc = jnp.zeros((cfg.vocab_size,), jnp.int32)
     cached: List[Tuple[np.ndarray, np.ndarray]] = []
     all_lengths: List[np.ndarray] = []
@@ -203,7 +296,7 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
         lens = jax.device_put(lengths)
         df_acc = _phase_a(toks, lens, df_acc, vocab_size=cfg.vocab_size)
         in_flight.append(df_acc)
-        if len(in_flight) > _LOOKAHEAD:
+        if len(in_flight) > max_ahead:
             in_flight.pop(0).block_until_ready()
 
     idf = _final_idf(df_acc, jnp.int32(num_docs), score_dtype=score_dtype)
@@ -222,8 +315,8 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
         v, t = _phase_b(toks, lens, idf, topk=k)
         vals_parts.append(v)
         ids_parts.append(t)
-        if ci >= _LOOKAHEAD:  # same bounded lookahead as pass A
-            vals_parts[ci - _LOOKAHEAD].block_until_ready()
+        if ci >= max_ahead:  # same byte-budgeted lookahead as pass A
+            vals_parts[ci - max_ahead].block_until_ready()
 
     df_host, vals, tids = jax.device_get(
         (df_acc, jnp.concatenate(vals_parts), jnp.concatenate(ids_parts)))
