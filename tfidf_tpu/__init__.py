@@ -27,6 +27,7 @@ IDF, lexicographic ordering) are preserved by the golden path
 from tfidf_tpu.config import PipelineConfig, VocabMode, TokenizerKind
 from tfidf_tpu.pipeline import TfidfPipeline, PipelineResult
 from tfidf_tpu.io.corpus import Corpus, discover_corpus, PackedBatch
+from tfidf_tpu.ingest import IngestResult, run_overlapped
 
 __version__ = "0.1.0"
 
@@ -39,5 +40,7 @@ __all__ = [
     "Corpus",
     "discover_corpus",
     "PackedBatch",
+    "IngestResult",
+    "run_overlapped",
     "__version__",
 ]
