@@ -222,6 +222,9 @@ def main() -> None:
             pack_s=round(pack_s, 3),
             recall_at_k=round(recall, 4),
             n_docs=N_DOCS,
+            engine="sparse",
+            ingest_path=result.path,  # reported by run_overlapped itself
+            repeats=REPEATS,
         )
     except Exception:
         record["error"] = traceback.format_exc(limit=20)[-2000:]

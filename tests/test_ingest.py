@@ -153,3 +153,13 @@ class TestResidentFusedPath:
             assert (fused.topk_ids == streamed.topk_ids).all()
             assert fused.names == streamed.names
             np.testing.assert_array_equal(fused.lengths, streamed.lengths)
+
+
+class TestPathReporting:
+    def test_result_reports_regime(self, corpus_dir, monkeypatch):
+        cfg = _cfg()
+        assert run_overlapped(corpus_dir, cfg, chunk_docs=16,
+                              doc_len=64).path == "resident"
+        monkeypatch.setenv("TFIDF_TPU_RESIDENT_ELEMS", "0")
+        assert run_overlapped(corpus_dir, cfg, chunk_docs=16,
+                              doc_len=64).path == "streaming"

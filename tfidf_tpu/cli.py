@@ -175,7 +175,8 @@ def _run_stream(args) -> int:
 
     from tfidf_tpu import checkpoint as ckpt
     from tfidf_tpu.config import PipelineConfig, VocabMode
-    from tfidf_tpu.io.corpus import Corpus, discover_names
+    from tfidf_tpu.ingest import make_chunk_packer
+    from tfidf_tpu.io.corpus import PackedBatch, discover_names
     from tfidf_tpu.streaming import StreamingTfidf
 
     cfg = PipelineConfig(vocab_mode=VocabMode.HASHED,
@@ -193,19 +194,29 @@ def _run_stream(args) -> int:
         start = stream.docs_seen
         print(f"resumed at doc {start} ({args.checkpoint})")
 
+    # Minibatches come off the native parallel loader when built (bytes
+    # never enter Python; uint16 wire), else the Python pack path — the
+    # same packer the ingest pipeline uses. Every batch is padded to
+    # batch_docs x doc_len, so the whole stream reuses one compiled
+    # update program and one score program.
+    packer = make_chunk_packer(args.input, cfg, args.batch_docs,
+                               args.doc_len)
+
     def batches(from_doc: int):
         for lo in range(from_doc, len(names), args.batch_docs):
             batch_names = names[lo:lo + args.batch_docs]
-            docs = []
-            for n in batch_names:
-                with open(os.path.join(args.input, n), "rb") as f:
-                    docs.append(f.read())
-            yield Corpus(names=batch_names, docs=docs)
+            token_ids, lengths = packer(batch_names)
+            # PackedBatch invariant: one name per row, '' for padding.
+            padded = batch_names + [""] * (token_ids.shape[0]
+                                           - len(batch_names))
+            yield PackedBatch(
+                token_ids=token_ids, lengths=lengths,
+                num_docs=len(batch_names), names=padded,
+                vocab_size=cfg.vocab_size, id_to_word=None)
 
-    # Pass 1: fold DF, checkpoint after every minibatch. fixed_len pins
-    # the batch shape so the whole stream reuses one compiled program.
-    for corpus in batches(start):
-        stream.update(stream.pack(corpus, fixed_len=args.doc_len))
+    # Pass 1: fold DF, checkpoint after every minibatch.
+    for batch in batches(start):
+        stream.update(batch)
         if args.checkpoint:
             ckpt.save_state(args.checkpoint, stream.state_dict())
     print(f"df folded over {stream.docs_seen} docs")
@@ -214,11 +225,11 @@ def _run_stream(args) -> int:
     import types
     all_names: List[str] = []
     all_vals, all_ids = [], []
-    for corpus in batches(0):
-        vals, ids = stream.score(stream.pack(corpus, fixed_len=args.doc_len))
-        all_names.extend(corpus.names)
-        all_vals.append(np.asarray(vals)[:len(corpus.names)])
-        all_ids.append(np.asarray(ids)[:len(corpus.names)])
+    for batch in batches(0):
+        vals, ids = stream.score(batch)
+        all_names.extend(batch.names[:batch.num_docs])
+        all_vals.append(np.asarray(vals)[:batch.num_docs])
+        all_ids.append(np.asarray(ids)[:batch.num_docs])
     report = types.SimpleNamespace(
         num_docs=len(all_names), names=all_names,
         topk_vals=np.concatenate(all_vals), topk_ids=np.concatenate(all_ids),

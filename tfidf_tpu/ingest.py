@@ -105,9 +105,10 @@ def _concat_rows(parts):
 
 # Largest packed corpus (doc slots x token length) the fused resident
 # path will hold on device; beyond it the two-pass streaming pipeline
-# takes over. ~134M tokens ~ a few GB with sort workspace — comfortable
-# in one chip's HBM, overridable for smaller parts.
-_RESIDENT_ELEMS = 1 << 27
+# takes over. 268M tokens measured working on one v5e chip (1M x 256
+# docs: 31.8 s warm, the [1M, 256] sort + workspace fit 16 GB HBM with
+# room; docs/SCALING.md). Override down for smaller parts.
+_RESIDENT_ELEMS = 1 << 28
 
 
 @functools.partial(jax.jit, static_argnames=("topk",))
@@ -140,6 +141,7 @@ class IngestResult:
     lengths: np.ndarray       # [D] docSize per document
     names: List[str]
     num_docs: int
+    path: str = ""            # which regime ran: "resident" | "streaming"
 
 
 def make_chunk_packer(input_dir: str, cfg: PipelineConfig, chunk_docs: int,
@@ -268,7 +270,8 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
         return IngestResult(df=df_host, topk_vals=vals[:num_docs],
                             topk_ids=tids[:num_docs],
                             lengths=np.concatenate(all_lengths),
-                            names=names, num_docs=num_docs)
+                            names=names, num_docs=num_docs,
+                            path="resident")
 
     # Pass A: fold every chunk's partial DF into one device accumulator.
     # The loop packs chunk i+1 while the device still runs chunk i
@@ -323,4 +326,4 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
     return IngestResult(df=df_host, topk_vals=vals[:num_docs],
                         topk_ids=tids[:num_docs],
                         lengths=np.concatenate(all_lengths), names=names,
-                        num_docs=num_docs)
+                        num_docs=num_docs, path="streaming")
