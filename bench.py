@@ -49,6 +49,10 @@ PREFLIGHT_S = float(os.environ.get("BENCH_PREFLIGHT_S", 120))
 N_WORDS = 8192
 VOCAB = 1 << 16
 TOPK = 16
+# Device margin for the exact-terms mode: the chip keeps 2k candidate
+# buckets so the exact-string re-rank can recover words whose bucket a
+# collision partner pushed below rank k (rerank.py docstring).
+MARGIN = 2 * TOPK
 
 
 def log(msg: str) -> None:
@@ -173,13 +177,53 @@ def bench_tpu(input_dir: str):
     return best, pack_s, result
 
 
-def measure_recall(result, oracle_out: str) -> float:
-    from tfidf_tpu.recall import corpus_recall, parse_oracle_output
+def bench_exact(input_dir: str):
+    """One timed end-to-end run of the exact-terms mode: device margin
+    selection + full-corpus host re-rank (what `cli run --exact-terms`
+    does). This is the apples-to-apples comparison against the CPU
+    oracle, whose output is exact strings too.
+    """
+    from tfidf_tpu.config import PipelineConfig, VocabMode
+    from tfidf_tpu.ingest import run_overlapped
+    from tfidf_tpu.rerank import exact_topk
+
+    cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=VOCAB,
+                         max_doc_len=DOC_LEN, doc_chunk=DOC_LEN,
+                         topk=MARGIN, engine="sparse")
+    chunk = min(N_DOCS, 2048)
+    run_overlapped(input_dir, cfg, chunk_docs=chunk, doc_len=DOC_LEN)  # warm
+    t0 = time.perf_counter()
+    result = run_overlapped(input_dir, cfg, chunk_docs=chunk,
+                            doc_len=DOC_LEN)
+    reranked = exact_topk(input_dir, result.names, result.topk_ids,
+                          result.num_docs, cfg, k=TOPK,
+                          max_tokens=DOC_LEN)
+    return time.perf_counter() - t0, reranked
+
+
+def measure_recall(result, reranked, oracle_out: str):
+    """(bucket_recall, exact_recall) on the sampled docs.
+
+    bucket_recall: collision-aware recall of the raw hashed top-k
+    (the headline artifact). exact_recall: string-level recall of the
+    exact-terms mode's output — the north star's "identical top-k
+    terms", measured with no collision forgiveness.
+    """
+    import numpy as np
+
+    from tfidf_tpu.recall import (corpus_recall, exact_doc_recall,
+                                  parse_oracle_output)
 
     sample = [f"doc{i}" for i in range(1, min(RECALL_DOCS, N_DOCS) + 1)]
     per_doc = parse_oracle_output(oracle_out, docs=sample)
-    return corpus_recall(per_doc, result.names, result.topk_ids,
-                         result.topk_vals, TOPK, VOCAB)
+    bucket = corpus_recall(per_doc, result.names, result.topk_ids,
+                           result.topk_vals, TOPK, VOCAB)
+    scores = []
+    for name, ref in per_doc.items():
+        r = exact_doc_recall(ref, [w for w, _ in reranked[name]], TOPK)
+        if r is not None:
+            scores.append(r)
+    return bucket, float(np.mean(scores))
 
 
 def main() -> None:
@@ -208,8 +252,10 @@ def main() -> None:
         cpu_s = bench_native(input_dir, oracle_out)
         log(f"native: {cpu_s:.2f}s; TPU runs...")
         tpu_s, pack_s, result = bench_tpu(input_dir)
-        log(f"tpu: {tpu_s:.2f}s (pack-only {pack_s:.2f}s); recall...")
-        recall = measure_recall(result, oracle_out)
+        log(f"tpu: {tpu_s:.2f}s (pack-only {pack_s:.2f}s); exact mode...")
+        exact_s, reranked = bench_exact(input_dir)
+        log(f"exact-terms: {exact_s:.2f}s; recall...")
+        recall, recall_exact = measure_recall(result, reranked, oracle_out)
 
         cpu_dps = N_DOCS / cpu_s
         tpu_dps = N_DOCS / tpu_s
@@ -221,6 +267,9 @@ def main() -> None:
             cpu_s=round(cpu_s, 3),
             pack_s=round(pack_s, 3),
             recall_at_k=round(recall, 4),
+            recall_exact_rerank=round(recall_exact, 4),
+            exact_docs_per_sec=round(N_DOCS / exact_s, 1),
+            exact_vs_baseline=round((N_DOCS / exact_s) / cpu_dps, 2),
             n_docs=N_DOCS,
             engine="sparse",
             ingest_path=result.path,  # reported by run_overlapped itself

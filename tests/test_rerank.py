@@ -1,0 +1,119 @@
+"""Exact-string re-rank (tfidf_tpu/rerank.py) vs a pure-Python exact
+oracle, under forced hash collisions."""
+
+import math
+
+import numpy as np
+import pytest
+
+from tfidf_tpu.config import PipelineConfig, VocabMode
+from tfidf_tpu.ingest import run_overlapped
+from tfidf_tpu.ops.hashing import words_to_ids
+from tfidf_tpu.rerank import exact_topk
+
+VOCAB = 32  # tiny on purpose: ~60 distinct words -> heavy collisions
+
+
+@pytest.fixture
+def collide_dir(tmp_path):
+    corpus = tmp_path / "corpus"  # own dir: tmp_path also holds outputs
+    corpus.mkdir()
+    rng = np.random.default_rng(23)
+    words = [f"word{i}".encode() for i in range(60)]
+    for i in range(1, 17):
+        picks = rng.choice(60, size=rng.integers(6, 40))
+        (corpus / f"doc{i}").write_bytes(
+            b" ".join(words[int(p)] for p in picks))
+    return str(corpus)
+
+
+def exact_oracle(input_dir, k):
+    """Float64 exact TF-IDF top-k per doc, straight from the strings."""
+    import os
+    names = sorted(os.listdir(input_dir), key=lambda n: int(n[3:]))
+    docs = {n: open(os.path.join(input_dir, n), "rb").read().split()
+            for n in names}
+    n = len(names)
+    df = {}
+    for words in docs.values():
+        for w in set(words):
+            df[w] = df.get(w, 0) + 1
+    out = {}
+    for name, words in docs.items():
+        counts = {}
+        for w in words:
+            counts[w] = counts.get(w, 0) + 1
+        scored = [(w, (c / len(words)) * math.log(n / df[w]))
+                  for w, c in counts.items()]
+        scored = [(w, s) for w, s in scored if s > 0]
+        scored.sort(key=lambda t: (-t[1], t[0]))
+        out[name] = scored[:k]
+    return out
+
+
+class TestExactRerank:
+    def test_collisions_present(self, collide_dir):
+        # The fixture must actually force collisions, else the test
+        # proves nothing.
+        words = [f"word{i}".encode() for i in range(60)]
+        ids = words_to_ids(words, VOCAB)
+        assert len(set(int(i) for i in ids)) < len(words)
+
+    def test_rerank_recovers_exact_topk(self, collide_dir):
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=VOCAB,
+                             max_doc_len=64, doc_chunk=64, topk=16,
+                             engine="sparse")
+        r = run_overlapped(collide_dir, cfg, chunk_docs=8, doc_len=64)
+        got = exact_topk(collide_dir, r.names, r.topk_ids, r.num_docs,
+                         cfg, k=3, max_tokens=64)
+        want = exact_oracle(collide_dir, k=3)
+        for name in want:
+            got_words = [w for w, _ in got[name]]
+            want_words = [w for w, _ in want[name]]
+            assert got_words == want_words, (name, got[name], want[name])
+            for (gw, gs), (ww, ws) in zip(got[name], want[name]):
+                assert gs == pytest.approx(ws, rel=1e-12)
+
+    def test_subset_and_empty_doc(self, tmp_path):
+        (tmp_path / "doc1").write_bytes(b"alpha beta alpha")
+        (tmp_path / "doc2").write_bytes(b"   ")  # whitespace-only
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=64,
+                             max_doc_len=16, doc_chunk=16, topk=4,
+                             engine="sparse")
+        r = run_overlapped(str(tmp_path), cfg, chunk_docs=4, doc_len=16)
+        got = exact_topk(str(tmp_path), r.names, r.topk_ids, r.num_docs,
+                         cfg, k=2, docs=["doc2"], max_tokens=16)
+        assert got == {"doc2": []}
+
+
+class TestCliExactTerms:
+    def test_exact_terms_report(self, collide_dir, tmp_path):
+        # This corpus packs ~60 words into 32 buckets (extreme collision
+        # pressure), so the default 2x margin genuinely misses — the
+        # documented residual failure mode (rerank.py docstring). A
+        # margin covering the whole vocab (11*3 > 32) must be exact.
+        from tfidf_tpu.cli import main
+        out = tmp_path / "exact.txt"
+        rc = main(["run", "--input", collide_dir, "--output", str(out),
+                   "--vocab-mode", "hashed", "--vocab-size", str(VOCAB),
+                   "--topk", "3", "--exact-terms", "--exact-margin", "11"])
+        assert rc == 0
+        lines = out.read_bytes().splitlines()
+        # Exact words, not bucket representatives or id:N fallbacks.
+        assert lines and all(b"@word" in l for l in lines), lines[:3]
+        # Per-doc terms must equal the exact oracle's.
+        got = {}
+        for l in lines:
+            key, score = l.rsplit(b"\t", 1)
+            doc, word = key.split(b"@", 1)
+            got.setdefault(doc.decode(), []).append(word)
+        want = exact_oracle(collide_dir, k=3)
+        for name, terms in want.items():
+            if terms:
+                assert got[name] == [w for w, _ in terms], name
+
+    def test_exact_terms_requires_hashed_topk(self, collide_dir, tmp_path):
+        from tfidf_tpu.cli import main
+        rc = main(["run", "--input", collide_dir,
+                   "--output", str(tmp_path / "x.txt"), "--exact-terms"])
+        assert rc == 2

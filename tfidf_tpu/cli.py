@@ -43,6 +43,15 @@ def _build_parser() -> argparse.ArgumentParser:
                      help="chargram n range, e.g. 3,5")
     run.add_argument("--topk", type=int, default=None,
                      help="emit only top-k terms per document")
+    run.add_argument("--exact-terms", action="store_true",
+                     help="hashed+topk mode: re-rank the device top-k "
+                          "on host with exact strings and DF, emitting "
+                          "exact words instead of bucket representatives")
+    run.add_argument("--exact-margin", type=int, default=2,
+                     help="candidate margin multiplier for --exact-terms: "
+                          "the chip keeps margin*k buckets so collisions "
+                          "cannot push true top-k words out of reach "
+                          "(raise under heavy collision pressure)")
     run.add_argument("--mesh", type=str, default=None,
                      help="mesh shape docs,seq,vocab (e.g. 4,1,2); "
                           "default: single device")
@@ -116,12 +125,24 @@ def _run_tpu(args) -> int:
     if args.mesh:
         docs, seq, vocab = (int(x) for x in args.mesh.split(","))
         mesh_shape = {"docs": docs, "seq": seq, "vocab": vocab}
+    exact_terms = getattr(args, "exact_terms", False)
+    if exact_terms:
+        if args.topk is None or args.vocab_mode != "hashed" \
+                or args.tokenizer != "whitespace":
+            sys.stderr.write("error: --exact-terms needs --topk, "
+                             "--vocab-mode hashed, and the whitespace "
+                             "tokenizer\n")
+            return 2
     cfg = PipelineConfig(
         vocab_mode=VocabMode(args.vocab_mode),
         vocab_size=args.vocab_size,
         tokenizer=TokenizerKind(args.tokenizer),
         ngram_range=(lo, hi),
-        topk=args.topk,
+        # exact-terms re-rank: the device keeps a margin*k candidate
+        # selection so a collision partner cannot push a true top-k
+        # word's bucket out of reach (tfidf_tpu/rerank.py docstring).
+        topk=(max(2, args.exact_margin) * args.topk if exact_terms
+              else args.topk),
         engine=args.engine,
         use_pallas=args.pallas,
         mesh_shape=mesh_shape,
@@ -139,6 +160,16 @@ def _run_tpu(args) -> int:
     with phase_or_null(timer, "emit"):
         if args.topk is None:
             write_output(args.output, result.output_lines())
+        elif exact_terms:
+            from tfidf_tpu.rerank import exact_topk
+            reranked = exact_topk(args.input, result.names,
+                                  result.topk_ids, result.num_docs, cfg,
+                                  k=args.topk)
+            lines = [b"%s@%s\t%.16f" % (name.encode(), w, s)
+                     for name in result.names if name
+                     for w, s in reranked[name]]
+            with open(args.output, "wb") as f:
+                f.write(b"".join(l + b"\n" for l in lines))
         else:
             _write_topk(args.output, result)
     if timer is not None:

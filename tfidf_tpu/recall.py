@@ -85,6 +85,24 @@ def doc_recall(ref_terms: DocTerms, got_ids: Sequence[int],
     return min(1.0, hit / len(required))
 
 
+def exact_doc_recall(ref_terms: DocTerms, got_words: Sequence[bytes],
+                     k: int) -> Optional[float]:
+    """Recall@k of exact-string terms (rerank.exact_topk output) vs the
+    oracle — same tie semantics as :func:`doc_recall`, no bucketing."""
+    pos = sorted((t for t in ref_terms if t[1] > 0.0), key=lambda t: -t[1])
+    if not pos:
+        return None
+    kk = min(k, len(pos))
+    thresh = pos[kk - 1][1]
+    required = {w for w, _ in pos[:kk]}
+    above = {w for w, s in pos if s > thresh}
+    tied = {w for w, s in pos if s == thresh}
+    got = set(got_words)
+    tie_slots = len(required) - len(required & above)
+    hit = len(got & above & required) + min(tie_slots, len(got & tied))
+    return min(1.0, hit / len(required))
+
+
 def corpus_recall(per_doc_ref: Dict[str, DocTerms], names: Sequence[str],
                   topk_ids: np.ndarray, topk_vals: np.ndarray, k: int,
                   vocab_size: int, seed: int = 0) -> float:

@@ -1,0 +1,130 @@
+"""Exact-string re-rank of the hashed top-k (SURVEY §7 "hard parts").
+
+The scalable TPU path hashes words into a fixed vocab, so its per-doc
+top-k is a set of *bucket* ids: two words colliding into one bucket are
+scored on their merged counts and DF, and the emitted "term" is only a
+bucket representative. The reference keys everything by exact strings
+(``TFIDF.c:26-42``), so its top-k is exact — the north-star metric asks
+for *identical top-k terms* (BASELINE.md).
+
+This module closes the gap with a host-side post-pass over the TPU
+selection, the design SURVEY §7 sketches ("a host-side exact-string
+re-rank of the top-k"):
+
+1. Re-tokenize the selected documents and keep, per doc, the exact
+   words whose hash bucket landed in that doc's TPU top-k. Hashing
+   restricts the candidate set to ~k buckets per doc — the pass stays
+   O(tokens) with tiny constant state, never O(V) strings.
+2. One pass over the *whole* corpus counts exact document frequencies
+   for the global candidate-word set only.
+3. Exact TF-IDF (float64, the reference's op order) re-scores each
+   doc's candidates and re-ranks.
+
+What it can and cannot fix: bucket *merging* (the dominant hashed-vocab
+error — wrong DF, wrong ordering, wrong representative word) is fully
+undone for every word whose bucket made the device top-k. A word whose
+bucket was pushed *out* of the device top-k by a collision partner
+stays lost; widening the device k (`margin`) shrinks that window.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tfidf_tpu.config import PipelineConfig
+from tfidf_tpu.ops.hashing import words_to_ids
+from tfidf_tpu.ops.tokenize import whitespace_tokenize
+
+DocTerms = List[Tuple[bytes, float]]
+
+
+def _doc_words(input_dir: str, name: str, cfg: PipelineConfig,
+               max_tokens: Optional[int]) -> Tuple[List[bytes], int]:
+    """Exact host tokenization of one document, mirroring the packer:
+    tokens past ``max_tokens`` are truncated away (count and content),
+    matching the fixed-L device batch the TPU selection came from."""
+    with open(os.path.join(input_dir, name), "rb") as f:
+        data = f.read()
+    if cfg.truncate_tokens_at is None:
+        from tfidf_tpu.io import fast_tokenizer
+        words = fast_tokenizer.tokenize_spans(data)  # native when built
+        if words is None:
+            words = whitespace_tokenize(data, None)
+    else:
+        words = whitespace_tokenize(data, cfg.truncate_tokens_at)
+    if max_tokens is not None:
+        words = words[:max_tokens]
+    return words, len(words)
+
+
+def exact_topk(input_dir: str, names: Sequence[str], topk_ids: np.ndarray,
+               num_docs: int, cfg: PipelineConfig, k: int,
+               docs: Optional[Iterable[str]] = None,
+               max_tokens: Optional[int] = None) -> Dict[str, DocTerms]:
+    """Exact-string top-k for ``docs`` from a hashed TPU selection.
+
+    Args:
+      input_dir: the corpus directory the selection was computed from.
+      names: row order of ``topk_ids`` (e.g. ``IngestResult.names``).
+      topk_ids: [D, K'] device top-k bucket ids (-1 = padding).
+      num_docs: corpus document count (drives exact IDF).
+      cfg: the pipeline config the selection used (hash seed/vocab).
+      k: how many exact terms to return per doc (k <= K' margin).
+      docs: optional doc-name subset (default: all rows of ``names``).
+      max_tokens: the static L of the device batch, when one was used
+        (e.g. ``run_overlapped(doc_len=...)``) — keeps TF/docSize parity
+        with what the device scored.
+
+    Returns:
+      name -> [(word, score), ...] exact float64 TF-IDF, score-desc then
+      word-asc, at most k entries, only positive-scoring words.
+    """
+    want = list(docs) if docs is not None else list(names)
+    rows = {n: i for i, n in enumerate(names)}
+
+    # Pass 1 (selected docs): exact counts of candidate words — words
+    # whose bucket made that doc's device top-k.
+    per_doc: Dict[str, Tuple[Dict[bytes, int], int]] = {}
+    candidates: set = set()
+    for name in want:
+        words, size = _doc_words(input_dir, name, cfg, max_tokens)
+        buckets = set(int(b) for b in topk_ids[rows[name]] if b >= 0)
+        if not words or not buckets:
+            per_doc[name] = ({}, size)
+            continue
+        uniq = sorted(set(words))
+        ids = words_to_ids(uniq, cfg.vocab_size, cfg.hash_seed)
+        keep = {w for w, b in zip(uniq, ids) if int(b) in buckets}
+        counts: Dict[bytes, int] = {}
+        for w in words:
+            if w in keep:
+                counts[w] = counts.get(w, 0) + 1
+        per_doc[name] = (counts, size)
+        candidates.update(keep)
+
+    # Pass 2 (whole corpus): exact DF for the candidate set only.
+    df: Dict[bytes, int] = {w: 0 for w in candidates}
+    if candidates:
+        for name in names:
+            if not name:
+                continue  # padding rows
+            words, _ = _doc_words(input_dir, name, cfg, max_tokens)
+            for w in set(words) & candidates:
+                df[w] += 1
+
+    # Exact scoring in the reference's op order (float64, natural log).
+    out: Dict[str, DocTerms] = {}
+    for name in want:
+        counts, size = per_doc[name]
+        scored = []
+        for w, c in counts.items():
+            tf = 1.0 * c / size
+            idf = np.log(1.0 * num_docs / df[w])
+            if tf * idf > 0.0:
+                scored.append((w, float(tf * idf)))
+        scored.sort(key=lambda t: (-t[1], t[0]))
+        out[name] = scored[:k]
+    return out
