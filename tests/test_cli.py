@@ -40,6 +40,9 @@ class TestCli:
         data = out.read_bytes().splitlines()
         assert data, "topk report should be non-empty"
         assert all(b"@" in l and b"\t" in l for l in data)
+        # Ordering contract: every emit path is raw-line strcmp-sorted
+        # (TFIDF.c:273) so output never depends on discovery order.
+        assert data == sorted(data)
 
     def test_sharded_mesh_flag(self, toy_corpus_dir, tmp_path):
         out = tmp_path / "out.txt"

@@ -168,6 +168,9 @@ def _run_tpu(args) -> int:
             lines = [b"%s@%s\t%.16f" % (name.encode(), w, s)
                      for name in result.names if name
                      for w, s in reranked[name]]
+            # Reference ordering contract: raw-line strcmp sort
+            # (TFIDF.c:273) — every emit path is diff-stable.
+            lines.sort()
             with open(args.output, "wb") as f:
                 f.write(b"".join(l + b"\n" for l in lines))
         else:
@@ -180,7 +183,9 @@ def _run_tpu(args) -> int:
 
 
 def _write_topk(path: str, result) -> None:
-    """Top-k report: doc@word\\tscore, k lines per doc, score-descending."""
+    """Top-k report: doc@word\\tscore lines in raw-line strcmp order —
+    the reference's global ordering contract (``TFIDF.c:273``), so two
+    runs (or two backends) diff cleanly regardless of discovery order."""
     lines: List[bytes] = []
     for d in range(result.num_docs):
         name = result.names[d].encode()
@@ -189,6 +194,7 @@ def _write_topk(path: str, result) -> None:
                 continue  # padding / sub-k docs
             word = result.id_to_word.get(int(v), b"id:%d" % int(v))
             lines.append(b"%s@%s\t%.16f" % (name, word, float(s)))
+    lines.sort()
     with open(path, "wb") as f:
         f.write(b"".join(l + b"\n" for l in lines))
 

@@ -82,7 +82,10 @@ def exact_topk(input_dir: str, names: Sequence[str], topk_ids: np.ndarray,
       name -> [(word, score), ...] exact float64 TF-IDF, score-desc then
       word-asc, at most k entries, only positive-scoring words.
     """
-    want = list(docs) if docs is not None else list(names)
+    # Padding rows (mesh/chunk pad_docs_to) carry '' names and all -1
+    # topk ids — skip them everywhere, like pass 2 always did; opening
+    # os.path.join(input_dir, '') is the directory itself.
+    want = [n for n in (docs if docs is not None else names) if n]
     rows = {n: i for i, n in enumerate(names)}
 
     # Pass 1 (selected docs): exact counts of candidate words — words
