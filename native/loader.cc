@@ -63,31 +63,7 @@ bool ReadFile(const std::string& path, std::string* out) {
   return got == (size_t)sz;
 }
 
-// Work-stealing parallel-for over [0, n): threads pop the next index
-// from a shared atomic — dynamic scheduling, so a few huge documents
-// don't stall a static stripe (the reference's static round-robin
-// schedule, TFIDF.c:130, has exactly that imbalance failure mode).
-template <typename Fn>
-void ParallelFor(int64_t n, int n_threads, Fn fn) {
-  if (n_threads <= 1 || n <= 1) {
-    for (int64_t i = 0; i < n; ++i) fn(i);
-    return;
-  }
-  std::atomic<int64_t> next{0};
-  auto worker = [&] {
-    for (;;) {
-      int64_t i = next.fetch_add(1, std::memory_order_relaxed);
-      if (i >= n) return;
-      fn(i);
-    }
-  };
-  std::vector<std::thread> pool;
-  int spawn = (int)std::min<int64_t>(n_threads, n) - 1;
-  pool.reserve(spawn);
-  for (int t = 0; t < spawn; ++t) pool.emplace_back(worker);
-  worker();
-  for (auto& th : pool) th.join();
-}
+using tfidf::ParallelFor;  // shared with rerank.cc (tokenize_common.h)
 
 // Tokenize+hash every loaded doc into the caller's padded [D, stride]
 // batch of T-typed ids (shared contract: tokenize_common.h).
@@ -109,9 +85,12 @@ void FillImpl(Loader* L, uint64_t seed, int64_t vocab_size,
 extern "C" {
 
 // paths: n_docs NUL-terminated strings, back to back. Reads every file
-// and counts its tokens in parallel. Returns a handle (never null);
-// check loader_error() before trusting the data.
-void* loader_open(const char* paths, int64_t n_docs, int n_threads) {
+// in parallel; counts tokens per file only when want_counts != 0 — the
+// count is a whole extra scan of every byte, and callers that pin the
+// batch shape (fixed_len chunked ingest) never read it. Returns a
+// handle (never null); check loader_error() before trusting the data.
+void* loader_open2(const char* paths, int64_t n_docs, int n_threads,
+                   int want_counts) {
   Loader* L = new Loader;
   L->paths.reserve(n_docs);
   const char* p = paths;
@@ -121,17 +100,34 @@ void* loader_open(const char* paths, int64_t n_docs, int n_threads) {
   }
   L->docs.resize(n_docs);
   L->counts.assign(n_docs, 0);
-  ParallelFor(n_docs, n_threads, [L](int64_t i) {
+  ParallelFor(n_docs, n_threads, [L, want_counts](int64_t i) {
     if (!ReadFile(L->paths[i], &L->docs[i])) {
       int64_t expect = -1;
       L->failed.compare_exchange_strong(expect, i);
       return;
     }
-    L->counts[i] = CountTokens(
-        reinterpret_cast<const uint8_t*>(L->docs[i].data()),
-        (int64_t)L->docs[i].size());
+    if (want_counts)
+      L->counts[i] = CountTokens(
+          reinterpret_cast<const uint8_t*>(L->docs[i].data()),
+          (int64_t)L->docs[i].size());
   });
   return L;
+}
+
+void* loader_open(const char* paths, int64_t n_docs, int n_threads) {
+  return loader_open2(paths, n_docs, n_threads, /*want_counts=*/1);
+}
+
+// Read-only views for sibling engines (rerank.cc): doc count and the
+// raw bytes of doc d. The handle must outlive every returned pointer.
+int64_t loader_doc_count(void* handle) {
+  return (int64_t)static_cast<Loader*>(handle)->docs.size();
+}
+
+const char* loader_doc_data(void* handle, int64_t d, int64_t* len) {
+  const std::string& s = static_cast<Loader*>(handle)->docs[d];
+  *len = (int64_t)s.size();
+  return s.data();
 }
 
 // Index of the first unreadable file, or -1. (The reference hard-exits
@@ -168,6 +164,32 @@ void loader_fill_u16(void* handle, uint64_t seed, int64_t vocab_size,
                      int32_t* out_lengths, int n_threads) {
   FillImpl(static_cast<Loader*>(handle), seed, vocab_size, truncate_at,
            out_ids, stride, out_lengths, n_threads);
+}
+
+// Ragged (flat) variant: every doc's ids back to back with NO padding —
+// the host->device wire for the resident ingest path, where zero-fill
+// padding averaged ~25% of the bytes on the measured corpus and the
+// tunneled link is the pipeline floor. Each doc is truncated to
+// max_per_doc tokens; out must hold n_docs * max_per_doc ids (worst
+// case). Returns total ids written. Serial by design: each doc's
+// offset depends on every prior doc's count, and the deployment host
+// has a single core anyway (a count prepass + parallel fill would cost
+// the very scan loader_open2(want_counts=0) exists to skip).
+int64_t loader_fill_flat_u16(void* handle, uint64_t seed,
+                             int64_t vocab_size, int64_t truncate_at,
+                             int64_t max_per_doc, uint16_t* out,
+                             int32_t* out_lengths) {
+  Loader* L = static_cast<Loader*>(handle);
+  int64_t pos = 0;
+  for (size_t d = 0; d < L->docs.size(); ++d) {
+    int64_t n = tfidf::TokenizeHashInto(
+        reinterpret_cast<const uint8_t*>(L->docs[d].data()),
+        (int64_t)L->docs[d].size(), seed, vocab_size, truncate_at,
+        out + pos, max_per_doc);
+    out_lengths[d] = (int32_t)n;
+    pos += n;
+  }
+  return pos;
 }
 
 void loader_close(void* handle) { delete static_cast<Loader*>(handle); }

@@ -9,9 +9,39 @@
 #ifndef TFIDF_NATIVE_TOKENIZE_COMMON_H_
 #define TFIDF_NATIVE_TOKENIZE_COMMON_H_
 
+#include <atomic>
 #include <cstdint>
+#include <thread>
+#include <vector>
 
 namespace tfidf {
+
+// Work-stealing parallel-for over [0, n): threads pop the next index
+// from a shared atomic — dynamic scheduling, so a few huge documents
+// don't stall a static stripe (the reference's static round-robin
+// schedule, TFIDF.c:130, has exactly that imbalance failure mode).
+// Shared by loader.cc and rerank.cc.
+template <typename Fn>
+inline void ParallelFor(int64_t n, int n_threads, Fn fn) {
+  if (n_threads <= 1 || n <= 1) {
+    for (int64_t i = 0; i < n; ++i) fn(i);
+    return;
+  }
+  std::atomic<int64_t> next{0};
+  auto worker = [&] {
+    for (;;) {
+      int64_t i = next.fetch_add(1, std::memory_order_relaxed);
+      if (i >= n) return;
+      fn(i);
+    }
+  };
+  std::vector<std::thread> pool;
+  int spawn = (int)(n_threads < n ? n_threads : n) - 1;
+  pool.reserve(spawn);
+  for (int t = 0; t < spawn; ++t) pool.emplace_back(worker);
+  worker();
+  for (auto& th : pool) th.join();
+}
 
 constexpr uint64_t kFnvOffset = 14695981039346656037ull;
 constexpr uint64_t kFnvPrime = 1099511628211ull;

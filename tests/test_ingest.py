@@ -48,10 +48,9 @@ class TestOverlappedIngest:
         got = run_overlapped(corpus_dir, cfg, chunk_docs=16, doc_len=64)
         assert got.num_docs == 40
         assert (got.df == ref.df).all()
-        # resident path ships scores as bfloat16 (~2^-8 relative wire
-        # precision); the streaming path stays exact float32
-        rtol = 5e-3 if ingest_path == "resident" else 1e-6
-        np.testing.assert_allclose(got.topk_vals, ref.topk_vals, rtol=rtol)
+        # both paths ship full float32 scores (the round-2 bf16 wire
+        # compaction is gone — the link is latency-bound)
+        np.testing.assert_allclose(got.topk_vals, ref.topk_vals, rtol=1e-6)
         assert (got.lengths == ref.lengths[:40]).all()
 
     def test_single_chunk_covers_all(self, corpus_dir, ingest_path):
@@ -147,9 +146,8 @@ class TestResidentFusedPath:
                                       doc_len=32)
             monkeypatch.delenv("TFIDF_TPU_RESIDENT_ELEMS")
             np.testing.assert_array_equal(fused.df, streamed.df)
-            # same selection (ids exact); values carry bf16 wire rounding
             np.testing.assert_allclose(fused.topk_vals, streamed.topk_vals,
-                                       rtol=5e-3)
+                                       rtol=1e-6)
             assert (fused.topk_ids == streamed.topk_ids).all()
             assert fused.names == streamed.names
             np.testing.assert_array_equal(fused.lengths, streamed.lengths)

@@ -2,6 +2,7 @@
 oracle, under forced hash collisions."""
 
 import math
+import os
 
 import numpy as np
 import pytest
@@ -73,6 +74,32 @@ class TestExactRerank:
             assert got_words == want_words, (name, got[name], want[name])
             for (gw, gs), (ww, ws) in zip(got[name], want[name]):
                 assert gs == pytest.approx(ws, rel=1e-12)
+
+    def test_native_matches_python(self, collide_dir, monkeypatch):
+        # native/rerank.cc vs the Python implementation (the semantics
+        # oracle): identical words AND bit-identical float64 scores on
+        # a heavy-collision corpus.
+        import subprocess
+
+        from tfidf_tpu.io import fast_tokenizer
+        if not fast_tokenizer.rerank_available():
+            subprocess.run(["make", "-C", "native", "fast_tokenizer.so"],
+                           cwd=os.path.dirname(os.path.dirname(
+                               os.path.abspath(__file__))), check=True)
+        if not fast_tokenizer.rerank_available():
+            pytest.skip("native rerank engine unavailable")
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=VOCAB,
+                             max_doc_len=64, doc_chunk=64, topk=16,
+                             engine="sparse")
+        r = run_overlapped(collide_dir, cfg, chunk_docs=8, doc_len=64)
+        native = exact_topk(collide_dir, r.names, r.topk_ids, r.num_docs,
+                            cfg, k=5, max_tokens=64)
+        monkeypatch.setenv("TFIDF_TPU_NO_NATIVE", "1")
+        python = exact_topk(collide_dir, r.names, r.topk_ids, r.num_docs,
+                            cfg, k=5, max_tokens=64)
+        assert set(native) == set(python)
+        for name in python:
+            assert native[name] == python[name], name  # incl. exact scores
 
     def test_subset_and_empty_doc(self, tmp_path):
         (tmp_path / "doc1").write_bytes(b"alpha beta alpha")

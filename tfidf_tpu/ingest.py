@@ -38,11 +38,13 @@ from __future__ import annotations
 import dataclasses
 import functools
 import os
-from typing import List, Optional, Tuple
+import time
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from tfidf_tpu.config import PipelineConfig, TokenizerKind, VocabMode
 from tfidf_tpu.io import fast_tokenizer
@@ -71,30 +73,121 @@ def _phase_a(token_ids, lengths, df_acc, *, vocab_size: int):
     return df_acc + sparse_df(ids, head, vocab_size)
 
 
-# The fused one-program path (used whenever the packed corpus fits on
-# device, see _RESIDENT_ELEMS): sort once, score once — the two-pass
-# choreography re-sorts every chunk in each pass. Chunked host packing
-# and async chunk uploads still overlap in front of it.
-@functools.partial(jax.jit,
-                   static_argnames=("vocab_size", "score_dtype", "topk"))
-def _fused_compact(token_ids, lengths, num_docs, *, vocab_size: int,
-                   score_dtype, topk: int):
-    """Fused forward with a compact wire format for the result fetch.
+# Per-chunk kernel of the resident path: row-sort into sparse triples
+# and fold the chunk's partial DF into the accumulator. Dispatched as
+# each chunk's upload lands, so the transfer+sort of chunk i runs while
+# the host is still packing chunk i+1 (the lazily-staged tunnel link
+# only moves bytes when a consuming program executes — tools/ab probes).
+@functools.partial(jax.jit, static_argnames=("vocab_size",))
+def _chunk_sort_fold(token_ids, lengths, df_acc, *, vocab_size: int):
+    ids, counts, head = sorted_term_counts(token_ids, lengths)
+    return ids, counts, head, df_acc + sparse_df(ids, head, vocab_size)
 
-    The tunneled single-chip link runs ~60 MB/s, so the [D, K] result
-    transfer is material: scores travel as bfloat16 (same exponent range
-    as float32 — sign and zero are preserved, which is all the recall
-    accounting reads) and ids as uint16 when the vocab fits. Scoring
-    itself stays in ``score_dtype``; only the fetched bytes shrink.
+
+# Ragged variant: the chunk arrives as a FLAT id stream (no padding —
+# ~25% fewer bytes through the link on the measured corpus) and the
+# padded [chunk, L] batch is rebuilt on device with one gather before
+# the same sort+fold. Gather cost is noise next to the sort.
+@functools.partial(jax.jit, static_argnames=("length", "vocab_size"))
+def _chunk_ragged(flat, lengths, df_acc, *, length: int, vocab_size: int):
+    off = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                           jnp.cumsum(lengths[:-1], dtype=jnp.int32)])
+    idx = off[:, None] + jnp.arange(length, dtype=jnp.int32)[None, :]
+    # Clamp: out-of-range slots are masked by lengths in the sort.
+    tok = flat[jnp.minimum(idx, flat.shape[0] - 1)].astype(jnp.int32)
+    ids, counts, head = sorted_term_counts(tok, lengths)
+    return ids, counts, head, df_acc + sparse_df(ids, head, vocab_size)
+
+
+# Flat-stream padding granularity: chunks' flat sizes are rounded up to
+# this many ids so XLA sees a handful of shapes (compile cache), not one
+# per chunk. 2^19 u16 ids = 1 MB on the wire.
+_FLAT_BUCKET = 1 << 19
+
+
+def make_flat_packer(input_dir: str, cfg: PipelineConfig, chunk_docs: int,
+                     length: int):
+    """Ragged host packing: names -> (flat ids, lengths, total).
+
+    The flat stream is bucket-padded (``_FLAT_BUCKET``) so repeated
+    chunks reuse compiled programs. Native single-pass packer when
+    built; Python fallback flattens the padded batch (mask-select keeps
+    row-major token order). Only valid for vocab <= 2^16 (uint16 wire).
     """
-    df, vals, ids = sparse_forward(token_ids, lengths, num_docs,
-                                   vocab_size=vocab_size,
-                                   score_dtype=score_dtype, topk=topk)
-    if vocab_size < (1 << 16):
-        # Strictly-less: 65535 is then reserved as the -1 sentinel's
-        # two's-complement image, so host decode is unambiguous.
-        ids = ids.astype(jnp.uint16)
-    return df, vals.astype(jnp.bfloat16), ids
+    use_native = (cfg.tokenizer is TokenizerKind.WHITESPACE
+                  and fast_tokenizer.flat_available())
+    padded = make_chunk_packer(input_dir, cfg, chunk_docs, length)
+
+    def pack_native(chunk_names: List[str]):
+        out = fast_tokenizer.load_pack_flat(
+            [os.path.join(input_dir, n) for n in chunk_names],
+            cfg.vocab_size, cfg.hash_seed, cfg.truncate_tokens_at,
+            max_per_doc=length, pad_docs_to=chunk_docs)
+        assert out is not None
+        flat, lengths, total = out
+        pad = -total % _FLAT_BUCKET
+        if total + pad <= flat.size:
+            flat[total:total + pad] = 0  # never ship np.empty garbage
+            return flat[:total + pad], lengths, total
+        return np.pad(flat[:total], (0, pad)), lengths, total
+
+    def pack_python(chunk_names: List[str]):
+        ids, lengths = padded(chunk_names)
+        mask = (np.arange(ids.shape[1])[None, :] < lengths[:, None])
+        flat = np.ascontiguousarray(ids[mask], dtype=np.uint16)
+        total = flat.size
+        flat = np.pad(flat, (0, -total % _FLAT_BUCKET))
+        return flat, lengths, total
+
+    return pack_native if use_native else pack_python
+
+
+# Final program of the resident path: score the cached triples against
+# the corpus-wide IDF and pack (f32 scores, topk ids) into ONE uint8
+# buffer — a single unfenced device_get is one link round trip. Scores
+# stay full float32 (the round-2 bf16 compaction cost tie precision —
+# advisor finding — and the bf16 bitcast lowering measured pathological
+# on this backend anyway). Ids travel as uint16 when the vocab fits in
+# 16 bits: validity is carried by vals > 0, so no sentinel bit is
+# needed. DF is returned as a device array — no hot-path consumer reads
+# it, so its fetch is lazy (np.asarray at the caller's leisure).
+@functools.partial(jax.jit,
+                   static_argnames=("topk", "score_dtype", "wide_ids"))
+def _score_pack_wire(ids, counts, head, lengths, df, num_docs, *,
+                     topk: int, score_dtype, wide_ids: bool):
+    cat = (lambda parts: parts[0] if len(parts) == 1
+           else jnp.concatenate(parts, axis=0))
+    ids, counts, head = cat(ids), cat(counts), cat(head)
+    lengths = cat(lengths)
+    idf = idf_from_df(df, num_docs, score_dtype)
+    scores = sparse_scores(ids, counts, head, lengths, idf)
+    vals, tids = sparse_topk(scores, ids, head, topk)
+    as_bytes = lambda a: lax.bitcast_convert_type(a, jnp.uint8).reshape(-1)
+    # Valid scores are >= 0 by construction (idf >= 0, tf > 0 — the
+    # reference's invariant, TFIDF.c:243); -1 marks invalid slots so a
+    # legitimate 0.0 score (word in every doc) survives the u16 ids.
+    ok = tids >= 0
+    vals_wire = jnp.where(ok, vals.astype(jnp.float32), jnp.float32(-1))
+    tid_wire = tids if wide_ids else jnp.maximum(tids, 0).astype(jnp.uint16)
+    return df, jnp.concatenate([as_bytes(vals_wire), as_bytes(tid_wire)])
+
+
+def _decode_wire(buf: np.ndarray, d_padded: int, k: int, wide_ids: bool):
+    """Host decode of ``_score_pack_wire``'s buffer (XLA bitcast puts
+    the least-significant byte at minor index 0 = little-endian).
+    Invalid slots (sub-k docs / padding rows) carry vals == -1 on the
+    wire; they decode back to the (0, -1) contract."""
+    s_bytes = d_padded * k * 4
+    vals = buf[:s_bytes].view("<f4").reshape(d_padded, k).copy()
+    if wide_ids:
+        tids = buf[s_bytes:].view("<i4").reshape(d_padded, k).copy()
+    else:
+        tids = buf[s_bytes:].view("<u2").reshape(d_padded, k) \
+            .astype(np.int32)
+    bad = vals < 0
+    vals[bad] = 0
+    tids[bad] = -1
+    return vals, tids
 
 
 @jax.jit
@@ -128,20 +221,26 @@ def _final_idf(df_total, num_docs, *, score_dtype):
 class IngestResult:
     """Corpus-wide outputs of an overlapped ingest run.
 
-    On the resident fused path, ``topk_vals`` crossed the wire as
-    bfloat16 (~2^-8 relative precision; sign/zero exact) — the selection
-    itself was computed in ``config.score_dtype``. The streaming path
-    returns full-precision scores. Exact-value consumers should use
-    :class:`~tfidf_tpu.pipeline.TfidfPipeline`.
+    ``topk_vals`` are full ``config.score_dtype`` precision on both the
+    resident and streaming paths (the round-2 bf16 wire compaction is
+    gone — the link is latency-bound, not bandwidth-bound, so it bought
+    nothing and cost tie precision).
     """
 
-    df: np.ndarray            # [V] corpus document frequencies
+    df: np.ndarray            # [V] corpus DF (resident path: a device-
+                              # resident jax.Array; np.asarray fetches)
     topk_vals: np.ndarray     # [D, K] per-doc top-k TF-IDF scores
     topk_ids: np.ndarray      # [D, K] matching vocab ids (-1 = no term)
     lengths: np.ndarray       # [D] docSize per document
     names: List[str]
     num_docs: int
     path: str = ""            # which regime ran: "resident" | "streaming"
+    # Wall-clock phase breakdown of the run (seconds). Overlapped phases
+    # don't sum to the wall. Resident path: "pack" (synchronous host
+    # packing), "put" (upload/dispatch staging), "fetch" (the single
+    # unfenced result round trip — transfer/compute drain included).
+    # Streaming path: pack_a/pack_b, pass_a/pass_b, fetch.
+    phases: Optional[Dict[str, float]] = None
 
 
 def make_chunk_packer(input_dir: str, cfg: PipelineConfig, chunk_docs: int,
@@ -247,31 +346,67 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
         # where the two-pass pipeline sorts every chunk twice), and the
         # host pays a single synchronizing fetch. Only the final chunk
         # carries padding rows, so real documents are rows [0, num_docs).
-        tok_parts, len_parts, all_lengths = [], [], []
+        # Chunk-count cap: every chunk costs a program dispatch through
+        # the tunnel (~8 ms each, measured) and a slot in the final
+        # program's arg list, so very large corpora re-chunk upward.
+        if len(starts) > 32:
+            chunk_docs = -(-num_docs // 32)
+            chunk_docs += -chunk_docs % 256
+            pack_chunk = make_chunk_packer(input_dir, cfg, chunk_docs,
+                                           length)
+            starts = list(range(0, num_docs, chunk_docs))
+        flat_pack = (make_flat_packer(input_dir, cfg, chunk_docs, length)
+                     if cfg.vocab_size <= (1 << 16) else None)
+
+        ph = {"pack": 0.0, "put": 0.0}
+        df_acc = jnp.zeros((cfg.vocab_size,), jnp.int32)
+        trip_i, trip_c, trip_h, len_parts, all_lengths = [], [], [], [], []
         for start in starts:
             chunk_names = names[start:start + chunk_docs]
-            token_ids, lengths = pack_chunk(chunk_names)
+            t0 = time.perf_counter()
+            if flat_pack is not None:
+                flat, lengths, _ = flat_pack(chunk_names)
+            else:
+                token_ids, lengths = pack_chunk(chunk_names)
+            ph["pack"] += time.perf_counter() - t0
             all_lengths.append(lengths[:len(chunk_names)])
-            tok_parts.append(jax.device_put(token_ids))
-            len_parts.append(jax.device_put(lengths))
-        toks = tok_parts[0] if len(tok_parts) == 1 else _concat_rows(tok_parts)
-        lens = len_parts[0] if len(len_parts) == 1 else _concat_rows(len_parts)
-        out = _fused_compact(toks, lens, jnp.int32(num_docs),
-                             vocab_size=cfg.vocab_size,
-                             score_dtype=score_dtype, topk=k)
-        df_host, vals, tids = jax.device_get(out)
-        # Decode the compact wire: bf16 scores widen losslessly in sign/
-        # zero (what downstream reads); uint16 65535 is the -1 sentinel.
-        vals = np.asarray(vals).astype(np.float32)
-        tids = np.asarray(tids)
-        if tids.dtype == np.uint16:
-            tids = np.where(tids == np.uint16(0xFFFF), -1,
-                            tids.astype(np.int32)).astype(np.int32)
-        return IngestResult(df=df_host, topk_vals=vals[:num_docs],
+            t0 = time.perf_counter()
+            lens = jax.device_put(lengths)
+            # Sort + DF-fold this chunk NOW (async dispatch): the
+            # transfer+sort runs behind the host's packing of the next
+            # chunk, and the wire buffer is dead once consumed.
+            if flat_pack is not None:
+                i_, c_, h_, df_acc = _chunk_ragged(
+                    jax.device_put(flat), lens, df_acc, length=length,
+                    vocab_size=cfg.vocab_size)
+            else:
+                i_, c_, h_, df_acc = _chunk_sort_fold(
+                    jax.device_put(token_ids), lens, df_acc,
+                    vocab_size=cfg.vocab_size)
+            trip_i.append(i_)
+            trip_c.append(c_)
+            trip_h.append(h_)
+            len_parts.append(lens)
+            ph["put"] += time.perf_counter() - t0
+        t0 = time.perf_counter()
+        wide = cfg.vocab_size > (1 << 16)
+        df_dev, wire = _score_pack_wire(
+            tuple(trip_i), tuple(trip_c), tuple(trip_h), tuple(len_parts),
+            df_acc, jnp.int32(num_docs), topk=k, score_dtype=score_dtype,
+            wide_ids=wide)
+        # ONE unfenced fetch = one link round trip: drain + transfer.
+        # DF stays on device (jax.Array acts array-like; np.asarray
+        # fetches it on first real read — no hot-path consumer does).
+        buf = np.asarray(jax.device_get(wire))
+        ph["fetch"] = time.perf_counter() - t0
+        d_padded = len(starts) * chunk_docs
+        vals, tids = _decode_wire(buf, d_padded, k, wide)
+        return IngestResult(df=df_dev,
+                            topk_vals=vals[:num_docs],
                             topk_ids=tids[:num_docs],
                             lengths=np.concatenate(all_lengths),
                             names=names, num_docs=num_docs,
-                            path="resident")
+                            path="resident", phases=ph)
 
     # Pass A: fold every chunk's partial DF into one device accumulator.
     # The loop packs chunk i+1 while the device still runs chunk i
@@ -285,13 +420,17 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
     max_ahead = max(_LOOKAHEAD,
                     int(os.environ.get("TFIDF_TPU_INFLIGHT_BYTES", 1 << 29))
                     // chunk_bytes)
+    ph = {"pack_a": 0.0, "pack_b": 0.0}
     df_acc = jnp.zeros((cfg.vocab_size,), jnp.int32)
     cached: List[Tuple[np.ndarray, np.ndarray]] = []
     all_lengths: List[np.ndarray] = []
     in_flight: List[jax.Array] = []
+    t_pass = time.perf_counter()
     for start in starts:
         chunk_names = names[start:start + chunk_docs]
+        t0 = time.perf_counter()
         token_ids, lengths = pack_chunk(chunk_names)
+        ph["pack_a"] += time.perf_counter() - t0
         all_lengths.append(lengths[:len(chunk_names)])
         if spill == "host":
             cached.append((token_ids, lengths))
@@ -301,6 +440,8 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
         in_flight.append(df_acc)
         if len(in_flight) > max_ahead:
             in_flight.pop(0).block_until_ready()
+    df_acc.block_until_ready()
+    ph["pass_a"] = time.perf_counter() - t_pass
 
     idf = _final_idf(df_acc, jnp.int32(num_docs), score_dtype=score_dtype)
 
@@ -308,11 +449,14 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
     # overlap structure; only the [chunk, K] selections accumulate on
     # device, fetched in one transfer at the end.
     vals_parts, ids_parts = [], []
+    t_pass = time.perf_counter()
     for ci, start in enumerate(starts):
         if spill == "host":
             token_ids, lengths = cached[ci]
         else:
+            t0 = time.perf_counter()
             token_ids, lengths = pack_chunk(names[start:start + chunk_docs])
+            ph["pack_b"] += time.perf_counter() - t0
         toks = jax.device_put(token_ids)
         lens = jax.device_put(lengths)
         v, t = _phase_b(toks, lens, idf, topk=k)
@@ -320,10 +464,90 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
         ids_parts.append(t)
         if ci >= max_ahead:  # same byte-budgeted lookahead as pass A
             vals_parts[ci - max_ahead].block_until_ready()
+    jax.block_until_ready((vals_parts, ids_parts))
+    ph["pass_b"] = time.perf_counter() - t_pass
 
+    t0 = time.perf_counter()
     df_host, vals, tids = jax.device_get(
         (df_acc, jnp.concatenate(vals_parts), jnp.concatenate(ids_parts)))
+    ph["fetch"] = time.perf_counter() - t0
     return IngestResult(df=df_host, topk_vals=vals[:num_docs],
                         topk_ids=tids[:num_docs],
                         lengths=np.concatenate(all_lengths), names=names,
-                        num_docs=num_docs, path="streaming")
+                        num_docs=num_docs, path="streaming", phases=ph)
+
+
+def profile_resident(input_dir: str, config: Optional[PipelineConfig] = None,
+                     chunk_docs: int = 8192, doc_len: Optional[int] = None,
+                     strict: bool = True) -> Dict[str, float]:
+    """Serialized phase profile of the resident fused path.
+
+    Every phase is fenced with ``block_until_ready`` so the numbers are
+    true per-phase costs — pack (host tokenize+hash into the wire
+    batch), upload (host->device copy alone), compute (the fused XLA
+    program alone), fetch (device->host result copy). The fenced wall
+    exceeds :func:`run_overlapped`'s overlapped wall by construction;
+    the delta is what the overlap buys. Callers must have warmed the
+    jit cache (one prior run at the same shapes) or "compute" includes
+    compilation.
+    """
+    cfg = config or PipelineConfig(vocab_mode=VocabMode.HASHED, topk=16)
+    length = doc_len or cfg.max_doc_len
+    names = discover_names(input_dir, strict)
+    num_docs = len(names)
+    score_dtype = jnp.dtype(cfg.score_dtype)
+    k = min(cfg.topk, length)
+    starts = list(range(0, num_docs, chunk_docs))
+    if len(starts) > 32:  # same re-chunk rule as run_overlapped
+        chunk_docs = -(-num_docs // 32)
+        chunk_docs += -chunk_docs % 256
+        starts = list(range(0, num_docs, chunk_docs))
+    ragged = cfg.vocab_size <= (1 << 16)
+    pack = (make_flat_packer(input_dir, cfg, chunk_docs, length) if ragged
+            else make_chunk_packer(input_dir, cfg, chunk_docs, length))
+
+    ph: Dict[str, float] = {}
+    t0 = time.perf_counter()
+    packed = [pack(names[s:s + chunk_docs]) for s in starts]
+    ph["pack"] = time.perf_counter() - t0
+
+    # The tunneled link stages device_put data and only moves it when a
+    # consuming program runs (tools/link_probe.py vs the ab probes), so
+    # "upload" here is mostly staging cost; the true transfer shows up
+    # in "compute". The split is still reported for cross-checking.
+    t0 = time.perf_counter()
+    tok_parts = [jax.device_put(p[0]) for p in packed]
+    len_parts = [jax.device_put(p[1]) for p in packed]
+    jax.block_until_ready((tok_parts, len_parts))
+    ph["upload"] = time.perf_counter() - t0
+
+    # Compute fenced as one block: the production per-chunk programs
+    # plus the final score+pack — the same executables the resident
+    # path dispatches, so "compute" is its true device cost (plus the
+    # lazy transfers, see above).
+    t0 = time.perf_counter()
+    df_acc = jnp.zeros((cfg.vocab_size,), jnp.int32)
+    trip_i, trip_c, trip_h = [], [], []
+    for toks, lens in zip(tok_parts, len_parts):
+        if ragged:
+            i_, c_, h_, df_acc = _chunk_ragged(toks, lens, df_acc,
+                                               length=length,
+                                               vocab_size=cfg.vocab_size)
+        else:
+            i_, c_, h_, df_acc = _chunk_sort_fold(toks, lens, df_acc,
+                                                  vocab_size=cfg.vocab_size)
+        trip_i.append(i_)
+        trip_c.append(c_)
+        trip_h.append(h_)
+    _, wire = _score_pack_wire(tuple(trip_i), tuple(trip_c), tuple(trip_h),
+                               tuple(len_parts), df_acc,
+                               jnp.int32(num_docs), topk=k,
+                               score_dtype=score_dtype,
+                               wide_ids=cfg.vocab_size > (1 << 16))
+    jax.block_until_ready(wire)
+    ph["compute"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    jax.device_get(wire)
+    ph["fetch"] = time.perf_counter() - t0
+    return ph

@@ -24,10 +24,14 @@ _LIB_PATH = os.path.join(
 _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
 _has_loader = False
+_has_open2 = False
+_has_rerank = False
+_has_flat = False
 
 
 def _load() -> Optional[ctypes.CDLL]:
-    global _lib, _load_failed, _has_loader
+    global _lib, _load_failed, _has_loader, _has_open2, _has_rerank, \
+        _has_flat
     # The kill-switch wins even over an already-loaded library, and a
     # missing .so is not sticky (tests build it on demand mid-process).
     if os.environ.get("TFIDF_TPU_NO_NATIVE"):
@@ -75,6 +79,44 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.loader_close.argtypes = [ctypes.c_void_p]
         _has_loader = True
     except AttributeError:  # stale .so predating the loader
+        pass
+    try:
+        lib.loader_open2.restype = ctypes.c_void_p
+        lib.loader_open2.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, ctypes.c_int]
+        _has_open2 = True
+    except AttributeError:  # stale .so predating open2
+        pass
+    try:
+        lib.loader_fill_flat_u16.restype = ctypes.c_int64
+        lib.loader_fill_flat_u16.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint16),
+            ctypes.POINTER(ctypes.c_int32)]
+        _has_flat = True
+    except AttributeError:  # stale .so predating the flat packer
+        pass
+    try:
+        lib.rerank_run.restype = ctypes.c_void_p
+        lib.rerank_run.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_uint64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int]
+        lib.rerank_total.restype = ctypes.c_int64
+        lib.rerank_total.argtypes = [ctypes.c_void_p]
+        lib.rerank_blob_bytes.restype = ctypes.c_int64
+        lib.rerank_blob_bytes.argtypes = [ctypes.c_void_p]
+        lib.rerank_fill.restype = None
+        lib.rerank_fill.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_double), ctypes.c_char_p]
+        lib.rerank_free.restype = None
+        lib.rerank_free.argtypes = [ctypes.c_void_p]
+        _has_rerank = True
+    except AttributeError:  # stale .so predating rerank
         pass
     _lib = lib
     return _lib
@@ -137,7 +179,13 @@ def load_pack_paths(paths: List[str], vocab_size: int, seed: int = 0,
         return None
     n_threads = n_threads or min(os.cpu_count() or 1, 16)
     blob = b"\0".join(p.encode() for p in paths) + b"\0"
-    handle = lib.loader_open(blob, len(paths), n_threads)
+    # fixed_len pins the batch shape, so the per-doc token counts are
+    # never read — loader_open2(want_counts=0) skips that whole extra
+    # scan of the corpus bytes (measured ~25% of pack on this host).
+    if fixed_len is not None and _has_open2:
+        handle = lib.loader_open2(blob, len(paths), n_threads, 0)
+    else:
+        handle = lib.loader_open(blob, len(paths), n_threads)
     try:
         err = lib.loader_error(handle)
         if err >= 0:
@@ -167,6 +215,125 @@ def load_pack_paths(paths: List[str], vocab_size: int, seed: int = 0,
                 padded_len, lens_ptr, n_threads)
         return ids, lengths
     finally:
+        lib.loader_close(handle)
+
+
+def flat_available() -> bool:
+    """True when the native ragged (flat) packer symbol is present."""
+    return _load() is not None and _has_flat
+
+
+def load_pack_flat(paths: List[str], vocab_size: int, seed: int = 0,
+                   truncate_at: Optional[int] = None,
+                   max_per_doc: int = 256,
+                   pad_docs_to: Optional[int] = None,
+                   n_threads: Optional[int] = None):
+    """Native ragged pack: read + tokenize + hash into a FLAT uint16
+    stream (every doc back to back, no padding) plus per-doc lengths.
+
+    The resident ingest path's wire format: the measured corpus wastes
+    ~25% of a padded [D, L] batch on zero fill, and the tunneled link
+    is the pipeline's floor, so the flat stream is what goes on the
+    wire; the device rebuilds the padded batch with one gather
+    (``ingest._chunk_ragged``). Requires vocab_size <= 2^16. Returns
+    ``(flat_ids, lengths, total)`` with ``lengths`` padded to
+    ``pad_docs_to`` rows, or None when the native packer is missing.
+    """
+    lib = _load()
+    if lib is None or not _has_flat or not _has_open2 \
+            or vocab_size > (1 << 16):
+        return None
+    n_threads = n_threads or min(os.cpu_count() or 1, 16)
+    blob = b"\0".join(p.encode() for p in paths) + b"\0"
+    handle = lib.loader_open2(blob, len(paths), n_threads, 0)
+    try:
+        err = lib.loader_error(handle)
+        if err >= 0:
+            raise FileNotFoundError(paths[err])
+        d_padded = max(pad_docs_to or len(paths), len(paths))
+        flat = np.empty((len(paths) * max_per_doc,), dtype=np.uint16)
+        lengths = np.zeros((d_padded,), dtype=np.int32)
+        total = lib.loader_fill_flat_u16(
+            handle, ctypes.c_uint64(seed), vocab_size, truncate_at or 0,
+            max_per_doc,
+            flat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+            lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        return flat, lengths, int(total)
+    finally:
+        lib.loader_close(handle)
+
+
+def rerank_available() -> bool:
+    """True when the native exact-rerank symbols are present."""
+    return _load() is not None and _has_rerank
+
+
+def exact_rerank_paths(paths: List[str], topk_ids: np.ndarray,
+                       num_docs_idf: int, vocab_size: int, seed: int = 0,
+                       truncate_at: Optional[int] = None,
+                       max_tokens: Optional[int] = None, k: int = 16,
+                       n_threads: Optional[int] = None):
+    """Native exact-string re-rank (``native/rerank.cc``).
+
+    ``paths[i]`` is the document whose device top-k margin selection is
+    ``topk_ids[i]`` (bucket ids, -1 padding). Returns a list (doc order)
+    of ``[(word, score), ...]`` — exact float64 TF-IDF over exact DF of
+    the candidate set, score-desc then word-asc, at most ``k`` entries,
+    positive scores only. Returns None when the native engine is
+    unavailable (caller falls back to the Python implementation, which
+    is the semantics oracle — parity pinned by tests/test_rerank.py).
+
+    Memory: the whole corpus is resident in the native arena for the
+    two passes, like the loader path (≈ corpus bytes of host RAM).
+    """
+    lib = _load()
+    if lib is None or not _has_rerank:
+        return None
+    n_docs = len(paths)
+    topk_ids = np.ascontiguousarray(topk_ids, dtype=np.int32)
+    assert topk_ids.shape[0] == n_docs, (topk_ids.shape, n_docs)
+    kprime = topk_ids.shape[1] if topk_ids.ndim == 2 else 0
+    n_threads = n_threads or min(os.cpu_count() or 1, 16)
+    blob = b"\0".join(p.encode() for p in paths) + b"\0"
+    handle = lib.loader_open2(blob, n_docs, n_threads, 0) \
+        if _has_open2 else lib.loader_open(blob, n_docs, n_threads)
+    res = None
+    try:
+        err = lib.loader_error(handle)
+        if err >= 0:
+            raise FileNotFoundError(paths[err])
+        res = lib.rerank_run(
+            handle, topk_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            kprime, num_docs_idf, ctypes.c_uint64(seed), vocab_size,
+            truncate_at or 0, max_tokens or 0, k, n_threads)
+        total = lib.rerank_total(res)
+        counts = np.zeros((n_docs,), dtype=np.int32)
+        offs = np.zeros((total,), dtype=np.int64)
+        lens = np.zeros((total,), dtype=np.int64)
+        scores = np.zeros((total,), dtype=np.float64)
+        blob_out = ctypes.create_string_buffer(
+            max(int(lib.rerank_blob_bytes(res)), 1))
+        lib.rerank_fill(
+            res, counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            scores.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            blob_out)
+        words_blob = blob_out.raw
+        out = []
+        pos = 0
+        off_l = offs.tolist()
+        len_l = lens.tolist()
+        sc_l = scores.tolist()
+        for d in range(n_docs):
+            c = int(counts[d])
+            out.append([(words_blob[off_l[j]:off_l[j] + len_l[j]], sc_l[j])
+                        for j in range(pos, pos + c)])
+            pos += c
+        return out
+    finally:
+        if res is not None:
+            lib.rerank_free(res)
         lib.loader_close(handle)
 
 

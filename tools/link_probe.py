@@ -1,0 +1,73 @@
+"""Probe the host<->device link: throughput vs transfer granularity.
+
+Measures device_put/device_get wall time for the bench's wire shapes at
+several chunkings, so upload/fetch optimization targets measured tunnel
+behavior instead of guesses. Run standalone on the real chip:
+    python tools/link_probe.py
+"""
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def timed(fn, n=3):
+    best = float("inf")
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    print(f"backend: {jax.default_backend()}", file=sys.stderr)
+    D, L = 32768, 256
+    rng = np.random.default_rng(0)
+    full = rng.integers(0, 1 << 16, (D, L), dtype=np.uint16)
+
+    for chunk in (32768, 8192, 2048, 512):
+        parts = [full[i:i + chunk] for i in range(0, D, chunk)]
+
+        def put_all(parts=parts):
+            jax.block_until_ready([jax.device_put(p) for p in parts])
+
+        mb = full.nbytes / 1e6
+        s = timed(put_all)
+        print(f"put  chunk={chunk:6d} ({len(parts):3d} xfers): "
+              f"{s:.3f}s  {mb / s:6.1f} MB/s")
+
+    # Fetch: three result arrays separately vs one packed byte buffer.
+    df = jnp.zeros((1 << 16,), jnp.int32)
+    vals = jnp.zeros((D, 16), jnp.bfloat16)
+    ids = jnp.zeros((D, 16), jnp.uint16)
+    jax.block_until_ready((df, vals, ids))
+    s3 = timed(lambda: jax.device_get((df, vals, ids)))
+
+    @jax.jit
+    def pack(df, vals, ids):
+        return jnp.concatenate([
+            jax.lax.bitcast_convert_type(df, jnp.uint8).reshape(-1),
+            jax.lax.bitcast_convert_type(vals, jnp.uint8).reshape(-1),
+            jax.lax.bitcast_convert_type(ids, jnp.uint8).reshape(-1)])
+
+    packed = pack(df, vals, ids)
+    jax.block_until_ready(packed)
+    s1 = timed(lambda: jax.device_get(packed))
+    mb = (df.nbytes + vals.nbytes + ids.nbytes) / 1e6
+    print(f"get  3 arrays ({mb:.1f} MB): {s3:.3f}s  {mb / s3:6.1f} MB/s")
+    print(f"get  1 packed ({packed.nbytes / 1e6:.1f} MB): {s1:.3f}s  "
+          f"{packed.nbytes / 1e6 / s1:6.1f} MB/s")
+
+    # Tiny-transfer round-trip latency (upper bound on per-xfer overhead).
+    one = np.zeros((8,), np.int32)
+    s = timed(lambda: np.asarray(jax.device_put(one)))
+    print(f"roundtrip 32B: {s * 1000:.1f} ms")
+
+
+if __name__ == "__main__":
+    main()
