@@ -47,37 +47,18 @@ extern "C" const char* loader_doc_data(void* handle, int64_t d,
 
 namespace {
 
-using tfidf::IsSpace;
 using tfidf::ParallelFor;
 
-// One tokenize pass over a doc: calls fn(word_view) for each token,
-// stopping after max_tokens (<=0: unlimited). Words are truncated to
-// truncate_at bytes when truncate_at > 0 (whitespace_tokenize parity).
+// string_view adapter over the shared tokenizer loop + hash
+// (tokenize_common.h — the single source of truth; no local copies).
 template <typename Fn>
-int64_t ForEachToken(const char* data, int64_t len, int64_t truncate_at,
-                     int64_t max_tokens, Fn fn) {
-  const uint8_t* p = reinterpret_cast<const uint8_t*>(data);
-  int64_t n = 0, i = 0;
-  while (i < len && (max_tokens <= 0 || n < max_tokens)) {
-    while (i < len && IsSpace(p[i])) ++i;
-    int64_t start = i;
-    while (i < len && !IsSpace(p[i])) ++i;
-    if (i == start) break;
-    int64_t end = i;
-    if (truncate_at > 0 && end - start > truncate_at)
-      end = start + truncate_at;
-    fn(std::string_view(data + start, (size_t)(end - start)));
-    ++n;
-  }
-  return n;
-}
-
-inline int64_t HashToBucket(std::string_view w, uint64_t seed,
-                            int64_t vocab_size) {
-  uint64_t h = tfidf::kFnvOffset ^ seed;
-  for (char c : w) h = (h ^ (uint8_t)c) * tfidf::kFnvPrime;
-  h ^= h >> 32;
-  return (int64_t)(h % (uint64_t)vocab_size);
+int64_t ForEachTokenSv(const char* data, int64_t len, int64_t truncate_at,
+                       int64_t max_tokens, Fn fn) {
+  return tfidf::ForEachToken(
+      reinterpret_cast<const uint8_t*>(data), len, truncate_at, max_tokens,
+      [&](const uint8_t* w, int64_t wl) {
+        fn(std::string_view(reinterpret_cast<const char*>(w), (size_t)wl));
+      });
 }
 
 struct Entry {
@@ -121,9 +102,11 @@ void* rerank_run(void* loader_handle, const int32_t* topk_ids,
     std::sort(buckets.begin(), buckets.end());
     int64_t len;
     const char* data = loader_doc_data(loader_handle, d, &len);
-    doc_size[d] = ForEachToken(
+    doc_size[d] = ForEachTokenSv(
         data, len, truncate_at, max_tokens, [&](std::string_view w) {
-          int32_t b = (int32_t)HashToBucket(w, seed, vocab_size);
+          int32_t b = (int32_t)tfidf::HashWord(
+              reinterpret_cast<const uint8_t*>(w.data()),
+              (int64_t)w.size(), seed, vocab_size);
           if (std::binary_search(buckets.begin(), buckets.end(), b))
             ++cand[d][w];
         });
@@ -143,7 +126,7 @@ void* rerank_run(void* loader_handle, const int32_t* topk_ids,
     std::unordered_set<std::string_view> seen;
     int64_t len;
     const char* data = loader_doc_data(loader_handle, d, &len);
-    ForEachToken(data, len, truncate_at, max_tokens,
+    ForEachTokenSv(data, len, truncate_at, max_tokens,
                  [&](std::string_view w) {
                    if (!seen.insert(w).second) return;
                    auto it = cand_idx.find(w);

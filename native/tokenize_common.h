@@ -55,6 +55,40 @@ inline bool IsSpace(uint8_t c) {
          c == '\r';
 }
 
+// FNV-1a64 -> xor-fold -> mod-vocab id of one token's bytes. THE hash;
+// every native consumer (loader pack, rerank candidate matching) calls
+// this so the contract cannot fork.
+inline int64_t HashWord(const uint8_t* w, int64_t len, uint64_t seed,
+                        int64_t vocab_size) {
+  uint64_t h = kFnvOffset ^ seed;
+  for (int64_t j = 0; j < len; ++j) h = (h ^ w[j]) * kFnvPrime;
+  h ^= h >> 32;
+  return (int64_t)(h % (uint64_t)vocab_size);
+}
+
+// Tokenize data[0..len): fn(ptr, len) per token, each truncated to
+// truncate_at bytes when truncate_at > 0 (whitespace_tokenize parity),
+// stopping after max_tokens when max_tokens > 0. Returns tokens seen.
+// THE tokenizer loop; TokenizeHashInto and rerank.cc both ride it.
+template <typename Fn>
+inline int64_t ForEachToken(const uint8_t* data, int64_t len,
+                            int64_t truncate_at, int64_t max_tokens,
+                            Fn fn) {
+  int64_t n = 0, i = 0;
+  while (i < len && (max_tokens <= 0 || n < max_tokens)) {
+    while (i < len && IsSpace(data[i])) ++i;
+    int64_t start = i;
+    while (i < len && !IsSpace(data[i])) ++i;
+    if (i == start) break;
+    int64_t end = i;
+    if (truncate_at > 0 && end - start > truncate_at)
+      end = start + truncate_at;
+    fn(data + start, end - start);
+    ++n;
+  }
+  return n;
+}
+
 // Tokenize data[0..len), hash each token (truncated to truncate_at bytes
 // when truncate_at > 0) and write ids of integral type T into out
 // (capacity max_out; excess tokens are dropped). Returns tokens written.
@@ -63,21 +97,10 @@ inline int64_t TokenizeHashInto(const uint8_t* data, int64_t len,
                                 uint64_t seed, int64_t vocab_size,
                                 int64_t truncate_at, T* out,
                                 int64_t max_out) {
-  int64_t n = 0, i = 0;
-  while (i < len && n < max_out) {
-    while (i < len && IsSpace(data[i])) ++i;
-    int64_t start = i;
-    while (i < len && !IsSpace(data[i])) ++i;
-    if (i == start) break;
-    int64_t end = i;
-    if (truncate_at > 0 && end - start > truncate_at)
-      end = start + truncate_at;
-    uint64_t h = kFnvOffset ^ seed;
-    for (int64_t j = start; j < end; ++j) h = (h ^ data[j]) * kFnvPrime;
-    h ^= h >> 32;
-    out[n++] = (T)(h % (uint64_t)vocab_size);
-  }
-  return n;
+  return ForEachToken(data, len, truncate_at, max_out,
+                      [&](const uint8_t* w, int64_t wl) {
+                        *out++ = (T)HashWord(w, wl, seed, vocab_size);
+                      });
 }
 
 }  // namespace tfidf

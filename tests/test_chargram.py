@@ -59,6 +59,49 @@ class TestDeviceChargram:
         assert r.counts is None
         assert r.id_to_word == {}  # device path: ids only
 
+    def test_mesh_chargram_stays_on_device_and_matches(self):
+        # Round-2 verdict item 9: mesh chargram used to detour through
+        # the host tokenizer. A docs-only mesh now runs the sharded
+        # device path; 11 docs on 8 devices exercises doc-axis padding.
+        names = [f"doc{i}" for i in range(1, 12)]
+        docs = [bytes(f"doc {i} body {'x' * i} tail", "ascii")
+                for i in range(1, 12)]
+        corpus = Corpus(names=names, docs=docs)
+        cfg = PipelineConfig(tokenizer=TokenizerKind.CHARGRAM,
+                             vocab_mode=VocabMode.HASHED, vocab_size=128,
+                             ngram_range=(2, 3), topk=4, hash_seed=3)
+        single = TfidfPipeline(cfg).run(corpus)
+        # Fresh construction, not dataclasses.replace: replace() re-runs
+        # __post_init__ on the resolved engine and drops the
+        # engine-defaulted flag, which (correctly) disables the device
+        # chargram route — the CLI also constructs fresh.
+        mcfg = PipelineConfig(tokenizer=TokenizerKind.CHARGRAM,
+                              vocab_mode=VocabMode.HASHED, vocab_size=128,
+                              ngram_range=(2, 3), topk=4, hash_seed=3,
+                              mesh_shape={"docs": 8})
+        mesh = TfidfPipeline(mcfg).run(corpus)
+        assert mesh.id_to_word == {}  # device path, not host tokenizer
+        n = len(names)
+        np.testing.assert_array_equal(np.asarray(mesh.df),
+                                      np.asarray(single.df))
+        np.testing.assert_array_equal(np.asarray(mesh.topk_ids)[:n],
+                                      np.asarray(single.topk_ids)[:n])
+        np.testing.assert_allclose(np.asarray(mesh.topk_vals)[:n],
+                                   np.asarray(single.topk_vals)[:n],
+                                   rtol=1e-6)
+        assert mesh.names[:n] == names
+
+    def test_mesh_chargram_seq_shards_use_host_path(self):
+        # seq/vocab meshes cannot shard the byte stream (n-gram windows
+        # need halos) — they must fall back to the host tokenizer, which
+        # carries word strings (id_to_word non-empty).
+        cfg = PipelineConfig(tokenizer=TokenizerKind.CHARGRAM,
+                             vocab_mode=VocabMode.HASHED, vocab_size=128,
+                             ngram_range=(2, 2), topk=4,
+                             mesh_shape={"docs": 4, "seq": 2})
+        r = TfidfPipeline(cfg).run(CORPUS)
+        assert r.topk_vals.shape[1] == 4
+
     def test_full_output_routes_to_host_path(self):
         # Without topk, run() must use the host tokenizer so that full
         # output lines have word strings (review regression fix).

@@ -53,6 +53,23 @@ class TestOverlappedIngest:
         np.testing.assert_allclose(got.topk_vals, ref.topk_vals, rtol=1e-6)
         assert (got.lengths == ref.lengths[:40]).all()
 
+    def test_score_dtype_rides_the_wire(self, corpus_dir, ingest_path):
+        # A non-default score_dtype must come back in that dtype on BOTH
+        # regimes — the resident wire ships scores in score_dtype itself
+        # (round-3 review finding: an f32-only wire silently downcast
+        # wider runs). The dtype is JAX-canonicalized: float64 computes
+        # as float64 only under jax_enable_x64, so pin against what the
+        # reference pipeline actually produced.
+        import jax
+        cfg = _cfg(score_dtype="float64")
+        got = run_overlapped(corpus_dir, cfg, chunk_docs=16, doc_len=64)
+        ref = TfidfPipeline(cfg).run_packed(
+            pack_corpus(discover_corpus(corpus_dir), cfg, want_words=False))
+        want = jax.dtypes.canonicalize_dtype(np.float64)
+        assert got.topk_vals.dtype == want
+        assert np.asarray(ref.topk_vals).dtype == want
+        np.testing.assert_allclose(got.topk_vals, ref.topk_vals, rtol=1e-6)
+
     def test_single_chunk_covers_all(self, corpus_dir, ingest_path):
         cfg = _cfg()
         a = run_overlapped(corpus_dir, cfg, chunk_docs=64, doc_len=64)

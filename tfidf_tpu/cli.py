@@ -47,11 +47,13 @@ def _build_parser() -> argparse.ArgumentParser:
                      help="hashed+topk mode: re-rank the device top-k "
                           "on host with exact strings and DF, emitting "
                           "exact words instead of bucket representatives")
-    run.add_argument("--exact-margin", type=int, default=2,
+    run.add_argument("--exact-margin", type=int, default=4,
                      help="candidate margin multiplier for --exact-terms: "
                           "the chip keeps margin*k buckets so collisions "
-                          "cannot push true top-k words out of reach "
-                          "(raise under heavy collision pressure)")
+                          "cannot push true top-k words out of reach. "
+                          "4 is the measured recall-1.0 knee at vocab "
+                          "load factor ~0.125 (docs/EXACT.md); the run "
+                          "warns when occupancy suggests raising it")
     run.add_argument("--mesh", type=str, default=None,
                      help="mesh shape docs,seq,vocab (e.g. 4,1,2); "
                           "default: single device")
@@ -161,7 +163,28 @@ def _run_tpu(args) -> int:
         if args.topk is None:
             write_output(args.output, result.output_lines())
         elif exact_terms:
+            import math
+
+            import numpy as np
+
             from tfidf_tpu.rerank import exact_topk
+            # Occupancy check: estimate the vocab load factor from the
+            # occupied-bucket fraction (alpha = -ln(1 - B/V) under
+            # uniform hashing) and warn when the margin is below the
+            # measured-safe level for it (docs/EXACT.md: margin 4 is
+            # the recall-1.0 knee at alpha ~0.125; heavier collision
+            # pressure wants 8).
+            df = np.asarray(result.df)
+            occ = float((df > 0).sum()) / df.size
+            alpha = -math.log(max(1.0 - min(occ, 0.999999), 1e-12))
+            suggested = 4 if alpha <= 0.25 else 8
+            if args.exact_margin < suggested:
+                sys.stderr.write(
+                    f"warning: vocab load factor ~{alpha:.2f} "
+                    f"(occupancy {occ:.2f}); --exact-margin "
+                    f"{args.exact_margin} may miss exact top-k words — "
+                    f"measured-safe margin here is {suggested} "
+                    f"(docs/EXACT.md)\n")
             reranked = exact_topk(args.input, result.names,
                                   result.topk_ids, result.num_docs, cfg,
                                   k=args.topk)

@@ -105,6 +105,20 @@ def _chunk_ragged(flat, lengths, df_acc, *, length: int, vocab_size: int):
 _FLAT_BUCKET = 1 << 19
 
 
+def _resident_chunking(num_docs: int, chunk_docs: int):
+    """Resident-path chunk rule, shared by :func:`run_overlapped` and
+    :func:`profile_resident` so the profiler always measures the same
+    program structure production dispatches. Caps the chunk count at
+    32: every chunk costs a program dispatch through the tunnel (~8 ms
+    each, measured) and a slot in the final program's arg list."""
+    starts = list(range(0, num_docs, chunk_docs))
+    if len(starts) > 32:
+        chunk_docs = -(-num_docs // 32)
+        chunk_docs += -chunk_docs % 256
+        starts = list(range(0, num_docs, chunk_docs))
+    return chunk_docs, starts
+
+
 def make_flat_packer(input_dir: str, cfg: PipelineConfig, chunk_docs: int,
                      length: int):
     """Ragged host packing: names -> (flat ids, lengths, total).
@@ -166,19 +180,23 @@ def _score_pack_wire(ids, counts, head, lengths, df, num_docs, *,
     # Valid scores are >= 0 by construction (idf >= 0, tf > 0 — the
     # reference's invariant, TFIDF.c:243); -1 marks invalid slots so a
     # legitimate 0.0 score (word in every doc) survives the u16 ids.
+    # Scores ship in score_dtype itself — full precision on every path
+    # (the IngestResult contract).
     ok = tids >= 0
-    vals_wire = jnp.where(ok, vals.astype(jnp.float32), jnp.float32(-1))
+    vals_wire = jnp.where(ok, vals, jnp.asarray(-1, vals.dtype))
     tid_wire = tids if wide_ids else jnp.maximum(tids, 0).astype(jnp.uint16)
     return df, jnp.concatenate([as_bytes(vals_wire), as_bytes(tid_wire)])
 
 
-def _decode_wire(buf: np.ndarray, d_padded: int, k: int, wide_ids: bool):
+def _decode_wire(buf: np.ndarray, d_padded: int, k: int, wide_ids: bool,
+                 score_dtype=np.float32):
     """Host decode of ``_score_pack_wire``'s buffer (XLA bitcast puts
     the least-significant byte at minor index 0 = little-endian).
     Invalid slots (sub-k docs / padding rows) carry vals == -1 on the
     wire; they decode back to the (0, -1) contract."""
-    s_bytes = d_padded * k * 4
-    vals = buf[:s_bytes].view("<f4").reshape(d_padded, k).copy()
+    sdt = np.dtype(score_dtype).newbyteorder("<")
+    s_bytes = d_padded * k * sdt.itemsize
+    vals = buf[:s_bytes].view(sdt).reshape(d_padded, k).copy()
     if wide_ids:
         tids = buf[s_bytes:].view("<i4").reshape(d_padded, k).copy()
     else:
@@ -320,7 +338,9 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
 
     use_native = (cfg.tokenizer is TokenizerKind.WHITESPACE
                   and fast_tokenizer.loader_available())
-    score_dtype = jnp.dtype(cfg.score_dtype)
+    # Canonicalized: without jax_enable_x64 a float64 request computes
+    # (and ships) float32 — decode must agree with what XLA emits.
+    score_dtype = jax.dtypes.canonicalize_dtype(jnp.dtype(cfg.score_dtype))
     k = min(cfg.topk, length)
     # Wire bytes per token id: the native loader packs uint16 when the
     # vocab fits (fast_tokenizer), else int32. Drives both the spill
@@ -346,15 +366,11 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
         # where the two-pass pipeline sorts every chunk twice), and the
         # host pays a single synchronizing fetch. Only the final chunk
         # carries padding rows, so real documents are rows [0, num_docs).
-        # Chunk-count cap: every chunk costs a program dispatch through
-        # the tunnel (~8 ms each, measured) and a slot in the final
-        # program's arg list, so very large corpora re-chunk upward.
-        if len(starts) > 32:
-            chunk_docs = -(-num_docs // 32)
-            chunk_docs += -chunk_docs % 256
+        new_chunk, starts = _resident_chunking(num_docs, chunk_docs)
+        if new_chunk != chunk_docs:
+            chunk_docs = new_chunk
             pack_chunk = make_chunk_packer(input_dir, cfg, chunk_docs,
                                            length)
-            starts = list(range(0, num_docs, chunk_docs))
         flat_pack = (make_flat_packer(input_dir, cfg, chunk_docs, length)
                      if cfg.vocab_size <= (1 << 16) else None)
 
@@ -400,7 +416,7 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
         buf = np.asarray(jax.device_get(wire))
         ph["fetch"] = time.perf_counter() - t0
         d_padded = len(starts) * chunk_docs
-        vals, tids = _decode_wire(buf, d_padded, k, wide)
+        vals, tids = _decode_wire(buf, d_padded, k, wide, score_dtype)
         return IngestResult(df=df_dev,
                             topk_vals=vals[:num_docs],
                             topk_ids=tids[:num_docs],
@@ -495,13 +511,11 @@ def profile_resident(input_dir: str, config: Optional[PipelineConfig] = None,
     length = doc_len or cfg.max_doc_len
     names = discover_names(input_dir, strict)
     num_docs = len(names)
-    score_dtype = jnp.dtype(cfg.score_dtype)
+    # Canonicalized: without jax_enable_x64 a float64 request computes
+    # (and ships) float32 — decode must agree with what XLA emits.
+    score_dtype = jax.dtypes.canonicalize_dtype(jnp.dtype(cfg.score_dtype))
     k = min(cfg.topk, length)
-    starts = list(range(0, num_docs, chunk_docs))
-    if len(starts) > 32:  # same re-chunk rule as run_overlapped
-        chunk_docs = -(-num_docs // 32)
-        chunk_docs += -chunk_docs % 256
-        starts = list(range(0, num_docs, chunk_docs))
+    chunk_docs, starts = _resident_chunking(num_docs, chunk_docs)
     ragged = cfg.vocab_size <= (1 << 16)
     pack = (make_flat_packer(input_dir, cfg, chunk_docs, length) if ragged
             else make_chunk_packer(input_dir, cfg, chunk_docs, length))

@@ -170,6 +170,47 @@ def make_sparse_sharded_forward(plan: MeshPlan, vocab_size: int, score_dtype,
     return jax.jit(mapped)
 
 
+def _chargram_df_psum(df):
+    return lax.psum(df, (DOCS_AXIS, SEQ_AXIS, VOCAB_AXIS))
+
+
+@functools.lru_cache(maxsize=64)
+def make_chargram_sharded_forward(plan: MeshPlan, vocab_size: int,
+                                  ngram_lo: int, ngram_hi: int, seed: int,
+                                  score_dtype, topk: int):
+    """Sharded device-chargram forward over the docs axis (VERDICT r2
+    item 9: mesh chargram no longer detours through the host tokenizer).
+
+    Docs axis only: an n-gram window spans adjacent bytes, so a seq
+    shard would need an (n-1)-byte halo exchange — the rolling hash is
+    row-local but not chunk-local; long byte streams route through the
+    host tokenizer or ``parallel.longdoc``. The body IS the
+    single-device ``pipeline._chargram_forward`` — only the DF
+    reduction differs (the sparse engine's sharing contract).
+    """
+    if plan.n_seq_shards != 1 or plan.n_vocab_shards != 1:
+        raise ValueError("device chargram shards the docs axis only; "
+                         "build the MeshPlan with seq=1, vocab=1")
+    if topk is None:
+        raise ValueError("sharded device chargram serves topk mode only")
+
+    def body(byte_ids, byte_lengths, num_docs):
+        from tfidf_tpu.pipeline import _chargram_forward  # cycle-free late
+        return _chargram_forward(
+            byte_ids, byte_lengths, num_docs, vocab_size=vocab_size,
+            ngram_lo=ngram_lo, ngram_hi=ngram_hi, seed=seed,
+            score_dtype=score_dtype, topk=topk,
+            df_reduce=_chargram_df_psum)
+
+    out_specs = (P(VOCAB_AXIS), P(DOCS_AXIS), P(DOCS_AXIS, None),
+                 P(DOCS_AXIS, None))
+    mapped = jax.shard_map(
+        body, mesh=plan.mesh,
+        in_specs=(plan.batch_spec(), plan.lengths_spec(), P()),
+        out_specs=out_specs, check_vma=False)
+    return jax.jit(mapped)
+
+
 def sharded_tf_df(plan: MeshPlan, tokens, lengths, vocab_size: int
                   ) -> Tuple[jax.Array, jax.Array]:
     """Counts + global DF only (no scoring) — the minimal DP+psum path."""

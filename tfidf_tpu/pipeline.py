@@ -125,13 +125,18 @@ _sparse_forward_jit = jax.jit(
 
 def _chargram_forward(byte_ids, byte_lengths, num_docs, *, vocab_size: int,
                       ngram_lo: int, ngram_hi: int, seed: int,
-                      score_dtype, topk: Optional[int]):
+                      score_dtype, topk: Optional[int], df_reduce=None):
     """On-device char n-gram pipeline: raw bytes -> (df, scores | topk).
 
     N-gram ids are computed by rolling hash on device (BASELINE config 4,
     wide-vocab stress) — a length-B doc contributes (hi-lo+1) id streams
     without any host-side n-gram materialization. docSize is the total
     n-gram count, matching the host chargram tokenizer's token count.
+
+    ``df_reduce`` (static): optional collective applied to the local DF
+    vector — identity single-device, ``lax.psum`` over the docs axis
+    inside a shard_map body (``parallel.collectives``) — the same
+    sharing contract as :func:`ops.sparse.sparse_forward`.
     """
     from tfidf_tpu.ops.hashing import device_ngram_ids
     from tfidf_tpu.ops.histogram import tf_counts_masked
@@ -145,6 +150,8 @@ def _chargram_forward(byte_ids, byte_lengths, num_docs, *, vocab_size: int,
         counts = counts + tf_counts_masked(ids, valid, vocab_size)
         total_len = total_len + jnp.maximum(byte_lengths - (n - 1), 0)
     df = df_from_counts(counts)
+    if df_reduce is not None:
+        df = df_reduce(df)
     scores = tfidf_dense(counts, total_len, df, num_docs, score_dtype)
     if topk is not None:
         tv, ti = topk_per_doc(scores, min(topk, vocab_size))
@@ -288,31 +295,56 @@ class TfidfPipeline(PhaseTimedMixin):
         from tfidf_tpu.io.corpus import pack_bytes
 
         cfg = self.config
-        if cfg.mesh_shape:
-            # No sharded device-chargram exists; silently running
-            # single-device would misreport a mesh run. run() routes
-            # mesh chargram through the host tokenizer instead.
-            raise ValueError(
-                "run_bytes is single-device; clear mesh_shape or call "
-                "run(), which shards chargram via the host tokenizer")
         if cfg.tokenizer is not TokenizerKind.CHARGRAM:
             raise ValueError("run_bytes is the chargram device path")
         if cfg.vocab_mode is not VocabMode.HASHED:
             raise ValueError("device chargram requires HASHED vocab "
                              "(EXACT needs host-side n-gram strings)")
-        with self._phase("pack"):
-            packed = pack_bytes(corpus)
         lo, hi = cfg.ngram_range
+        plan = None
+        if cfg.mesh_shape:
+            # Docs-sharded device chargram (docs axis only: n-gram
+            # windows span adjacent bytes, so a seq shard would need a
+            # halo exchange; vocab stays replicated like the sparse
+            # engine). topk mode only — enforced by the maker.
+            from tfidf_tpu.parallel.mesh import MeshPlan
+            shape = dict(cfg.mesh_shape)
+            if shape.get("seq", 1) != 1 or shape.get("vocab", 1) != 1:
+                raise ValueError("device chargram shards docs only; use "
+                                 "mesh_shape={'docs': N} (run() with the "
+                                 "host tokenizer covers other meshes)")
+            plan = MeshPlan.create(docs=shape.get("docs", 0))
+        with self._phase("pack"):
+            if plan is None:
+                packed = pack_bytes(corpus)
+            else:
+                packed = pack_bytes(
+                    corpus, pad_docs_to=plan.pad_docs(len(corpus)))
         with self._phase("transfer"):
-            byte_ids = jnp.asarray(packed.byte_ids)
-            byte_lens = jnp.asarray(packed.byte_lengths)
+            if plan is None:
+                byte_ids = jnp.asarray(packed.byte_ids)
+                byte_lens = jnp.asarray(packed.byte_lengths)
+            else:
+                byte_ids = jax.device_put(
+                    packed.byte_ids, plan.sharding(plan.batch_spec()))
+                byte_lens = jax.device_put(
+                    packed.byte_lengths,
+                    plan.sharding(plan.lengths_spec()))
             self._fence((byte_ids, byte_lens))
         with self._phase("compute"):
-            out = _chargram_forward_jit(
-                byte_ids, byte_lens,
-                jnp.int32(packed.num_docs), vocab_size=cfg.vocab_size,
-                ngram_lo=lo, ngram_hi=hi, seed=cfg.hash_seed,
-                score_dtype=jnp.dtype(cfg.score_dtype), topk=cfg.topk)
+            if plan is None:
+                out = _chargram_forward_jit(
+                    byte_ids, byte_lens,
+                    jnp.int32(packed.num_docs), vocab_size=cfg.vocab_size,
+                    ngram_lo=lo, ngram_hi=hi, seed=cfg.hash_seed,
+                    score_dtype=jnp.dtype(cfg.score_dtype), topk=cfg.topk)
+            else:
+                from tfidf_tpu.parallel.collectives import \
+                    make_chargram_sharded_forward
+                fwd = make_chargram_sharded_forward(
+                    plan, cfg.vocab_size, lo, hi, cfg.hash_seed,
+                    jnp.dtype(cfg.score_dtype), cfg.topk)
+                out = fwd(byte_ids, byte_lens, jnp.int32(packed.num_docs))
             self._fence(out)
         with self._phase("fetch"):
             out = jax.device_get(out)  # single transfer round trip
@@ -330,17 +362,26 @@ class TfidfPipeline(PhaseTimedMixin):
         from tfidf_tpu.config import TokenizerKind, VocabMode
 
         cfg = self.config
-        if cfg.mesh_shape:
-            return self._mesh_pipeline().run(corpus)
         # Device chargram only serves topk+dense runs: it has no word
         # strings (id_to_word stays empty -> no full output lines) and
         # its dense [D, V] histogram defeats engine="sparse". Everything
         # else takes the host tokenizer path, which can serve both.
-        if (cfg.tokenizer is TokenizerKind.CHARGRAM
-                and cfg.vocab_mode is VocabMode.HASHED
-                and cfg.chargram_on_device
-                and cfg.topk is not None
-                and (cfg.engine == "dense"
-                     or getattr(cfg, "_engine_defaulted", False))):
+        chargram_device = (
+            cfg.tokenizer is TokenizerKind.CHARGRAM
+            and cfg.vocab_mode is VocabMode.HASHED
+            and cfg.chargram_on_device
+            and cfg.topk is not None
+            and (cfg.engine == "dense"
+                 or getattr(cfg, "_engine_defaulted", False)))
+        if cfg.mesh_shape:
+            # Docs-only meshes keep the device chargram path (sharded
+            # via shard_map, collectives.make_chargram_sharded_forward);
+            # seq/vocab meshes fall back to the host tokenizer.
+            shape = dict(cfg.mesh_shape)
+            if (chargram_device and shape.get("seq", 1) == 1
+                    and shape.get("vocab", 1) == 1):
+                return self.run_bytes(corpus)
+            return self._mesh_pipeline().run(corpus)
+        if chargram_device:
             return self.run_bytes(corpus)
         return self.run_packed(self.pack(corpus))
