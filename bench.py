@@ -216,7 +216,7 @@ def bench_exact(input_dir: str):
                             doc_len=DOC_LEN)
     reranked = exact_topk(input_dir, result.names, result.topk_ids,
                           result.num_docs, cfg, k=TOPK,
-                          max_tokens=DOC_LEN)
+                          max_tokens=DOC_LEN, df=result.df)
     return time.perf_counter() - t0, reranked
 
 

@@ -97,6 +97,8 @@ inline int64_t TokenizeHashInto(const uint8_t* data, int64_t len,
                                 uint64_t seed, int64_t vocab_size,
                                 int64_t truncate_at, T* out,
                                 int64_t max_out) {
+  if (max_out <= 0) return 0;  // capacity contract: write nothing
+  // (ForEachToken's max_tokens <= 0 means UNLIMITED — do not forward).
   return ForEachToken(data, len, truncate_at, max_out,
                       [&](const uint8_t* w, int64_t wl) {
                         *out++ = (T)HashWord(w, wl, seed, vocab_size);

@@ -163,31 +163,12 @@ def _run_tpu(args) -> int:
         if args.topk is None:
             write_output(args.output, result.output_lines())
         elif exact_terms:
-            import math
-
-            import numpy as np
-
             from tfidf_tpu.rerank import exact_topk
-            # Occupancy check: estimate the vocab load factor from the
-            # occupied-bucket fraction (alpha = -ln(1 - B/V) under
-            # uniform hashing) and warn when the margin is below the
-            # measured-safe level for it (docs/EXACT.md: margin 4 is
-            # the recall-1.0 knee at alpha ~0.125; heavier collision
-            # pressure wants 8).
-            df = np.asarray(result.df)
-            occ = float((df > 0).sum()) / df.size
-            alpha = -math.log(max(1.0 - min(occ, 0.999999), 1e-12))
-            suggested = 4 if alpha <= 0.25 else 8
-            if args.exact_margin < suggested:
-                sys.stderr.write(
-                    f"warning: vocab load factor ~{alpha:.2f} "
-                    f"(occupancy {occ:.2f}); --exact-margin "
-                    f"{args.exact_margin} may miss exact top-k words — "
-                    f"measured-safe margin here is {suggested} "
-                    f"(docs/EXACT.md)\n")
+            # Passing df arms the library-level collision-pressure
+            # warning (rerank.margin_check, docs/EXACT.md).
             reranked = exact_topk(args.input, result.names,
                                   result.topk_ids, result.num_docs, cfg,
-                                  k=args.topk)
+                                  k=args.topk, df=result.df)
             lines = [b"%s@%s\t%.16f" % (name.encode(), w, s)
                      for name in result.names if name
                      for w, s in reranked[name]]

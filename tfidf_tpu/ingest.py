@@ -2,35 +2,33 @@
 
 The reference interleaves file IO and compute on the same rank, serially
 per document (``TFIDF.c:130-205``) — every byte of IO stalls compute.
-Here ingest is a two-phase chunked pipeline built on JAX's async
-dispatch: the host thread packs chunk ``i+1`` (native parallel loader)
-while the device is still executing chunk ``i``'s program — ``device_put``
-and jitted calls return before the work completes, so the Python loop
-runs ahead of the device and the transfer/compute of one chunk hides the
-host tokenize/hash of the next.
+Here ingest is chunked and overlapped, shaped by the *measured* behavior
+of the link (tools/link_probe.py + the A/B sweeps behind BENCH_r03):
+``device_put`` stages bytes and only moves them when a consuming program
+executes, and every D2H fetch costs ~100 ms of latency — so each chunk's
+program is dispatched the moment its wire buffer is staged (transfer +
+sort run behind the host's packing of the next chunk), and results
+travel as ONE packed buffer in one unfenced fetch. When the vocab fits
+uint16, the wire is a ragged FLAT id stream (no padding bytes;
+~25% smaller on the measured corpus) rebuilt into the padded batch by a
+single device gather.
 
-Because DF is corpus-global but chunks stream, the run is two device
-passes (same shape as classic out-of-core TF-IDF, and of the reference's
-own reduce-then-rebroadcast choreography, ``TFIDF.c:215-220``):
+Two regimes, chosen by corpus size vs ``TFIDF_TPU_RESIDENT_ELEMS``:
 
-  A. per chunk: partial DF, folded into a single device-resident [V]
-     accumulator. Nothing else survives the chunk.
-  B. per chunk: re-derive the row-sparse triples and score them against
-     the final corpus-wide IDF; keep only the [chunk, K] top-k.
-
-Both passes run ONE compiled program each, reused for every chunk
-(static [chunk, L] shapes; the last chunk is padded with empty docs), so
-compile time and device memory are FLAT in the number of chunks: device
-residency is one [chunk, L] batch + the [V] DF + the accumulated
-[D, K] top-k. Pass B re-sorts each chunk instead of keeping pass-A
-triples resident — sort is cheap on device next to the transfer it
-would take to spill triples, and it is what makes 1M-doc corpora fit.
-
-Between passes the packed host arrays are either kept in host RAM
-(``spill="host"``) or re-packed from disk in pass B (``spill="reread"``,
-the reference's own two-scan idiom, ``TFIDF.c:141-147`` — it fseeks and
-re-reads every doc). ``spill="auto"`` keeps chunks in RAM up to a byte
-budget and re-reads beyond it.
+* **Resident** (fits on device): per chunk, one program sorts the rows
+  into sparse triples and folds partial DF into a [V] accumulator; the
+  triples stay device-resident. A final program scores everything
+  against the corpus-wide IDF and packs (scores, topk ids) for the
+  single fetch. Nothing is ever re-read or re-sorted.
+* **Streaming** (arbitrarily large): two passes, the reference's own
+  reduce-then-rebroadcast choreography (``TFIDF.c:215-220``) —
+  pass A folds each chunk's partial DF and keeps NOTHING else (device
+  memory flat in corpus size); pass B re-derives triples and scores
+  against the final IDF, accumulating only [chunk, K] selections.
+  Between passes the packed flat chunks either stay in host RAM
+  (``spill="host"`` — pass B re-packs nothing) or are re-read from
+  disk (``spill="reread"``, the reference's two-scan idiom,
+  ``TFIDF.c:141-147``); ``spill="auto"`` picks by a byte budget.
 """
 
 from __future__ import annotations
@@ -84,19 +82,43 @@ def _chunk_sort_fold(token_ids, lengths, df_acc, *, vocab_size: int):
     return ids, counts, head, df_acc + sparse_df(ids, head, vocab_size)
 
 
-# Ragged variant: the chunk arrives as a FLAT id stream (no padding —
-# ~25% fewer bytes through the link on the measured corpus) and the
-# padded [chunk, L] batch is rebuilt on device with one gather before
-# the same sort+fold. Gather cost is noise next to the sort.
-@functools.partial(jax.jit, static_argnames=("length", "vocab_size"))
-def _chunk_ragged(flat, lengths, df_acc, *, length: int, vocab_size: int):
+def _ragged_to_padded(flat, lengths, length: int):
+    """Rebuild the padded [D, L] batch from a flat id stream with one
+    gather. Out-of-range slots are clamped — their values are masked by
+    ``lengths`` in every consumer (sorted_term_counts contract)."""
     off = jnp.concatenate([jnp.zeros((1,), jnp.int32),
                            jnp.cumsum(lengths[:-1], dtype=jnp.int32)])
     idx = off[:, None] + jnp.arange(length, dtype=jnp.int32)[None, :]
-    # Clamp: out-of-range slots are masked by lengths in the sort.
-    tok = flat[jnp.minimum(idx, flat.shape[0] - 1)].astype(jnp.int32)
+    return flat[jnp.minimum(idx, flat.shape[0] - 1)].astype(jnp.int32)
+
+
+# Ragged variant: the chunk arrives as a FLAT id stream (no padding —
+# ~25% fewer bytes through the link on the measured corpus) and the
+# padded [chunk, L] batch is rebuilt on device before the same
+# sort+fold. Gather cost is noise next to the sort.
+@functools.partial(jax.jit, static_argnames=("length", "vocab_size"))
+def _chunk_ragged(flat, lengths, df_acc, *, length: int, vocab_size: int):
+    tok = _ragged_to_padded(flat, lengths, length)
     ids, counts, head = sorted_term_counts(tok, lengths)
     return ids, counts, head, df_acc + sparse_df(ids, head, vocab_size)
+
+
+# Streaming (two-pass) ragged kernels: pass A keeps NOTHING but the DF
+# accumulator (memory flat in corpus size); pass B re-derives triples
+# and scores against the final IDF. Same flat wire as the resident path.
+@functools.partial(jax.jit, static_argnames=("length", "vocab_size"))
+def _phase_a_ragged(flat, lengths, df_acc, *, length: int, vocab_size: int):
+    tok = _ragged_to_padded(flat, lengths, length)
+    ids, _, head = sorted_term_counts(tok, lengths)
+    return df_acc + sparse_df(ids, head, vocab_size)
+
+
+@functools.partial(jax.jit, static_argnames=("length", "topk"))
+def _phase_b_ragged(flat, lengths, idf, *, length: int, topk: int):
+    tok = _ragged_to_padded(flat, lengths, length)
+    ids, counts, head = sorted_term_counts(tok, lengths)
+    scores = sparse_scores(ids, counts, head, lengths, idf)
+    return sparse_topk(scores, ids, head, topk)
 
 
 # Flat-stream padding granularity: chunks' flat sizes are rounded up to
@@ -157,14 +179,16 @@ def make_flat_packer(input_dir: str, cfg: PipelineConfig, chunk_docs: int,
 
 
 # Final program of the resident path: score the cached triples against
-# the corpus-wide IDF and pack (f32 scores, topk ids) into ONE uint8
+# the corpus-wide IDF and pack (scores, topk ids) into ONE uint8
 # buffer — a single unfenced device_get is one link round trip. Scores
-# stay full float32 (the round-2 bf16 compaction cost tie precision —
-# advisor finding — and the bf16 bitcast lowering measured pathological
-# on this backend anyway). Ids travel as uint16 when the vocab fits in
-# 16 bits: validity is carried by vals > 0, so no sentinel bit is
-# needed. DF is returned as a device array — no hot-path consumer reads
-# it, so its fetch is lazy (np.asarray at the caller's leisure).
+# ship in score_dtype itself, full precision (the round-2 bf16
+# compaction cost tie precision — advisor finding — and the bf16
+# bitcast lowering measured pathological on this backend anyway). Ids
+# travel as uint16 when the vocab fits 16 bits; invalid slots carry
+# score -1 on the wire (valid scores are >= 0 by construction), so a
+# legitimate 0.0 score survives. DF is returned as a device array — no
+# hot-path consumer reads it, so its fetch is lazy (np.asarray at the
+# caller's leisure).
 @functools.partial(jax.jit,
                    static_argnames=("topk", "score_dtype", "wide_ids"))
 def _score_pack_wire(ids, counts, head, lengths, df, num_docs, *,
@@ -436,23 +460,47 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
     max_ahead = max(_LOOKAHEAD,
                     int(os.environ.get("TFIDF_TPU_INFLIGHT_BYTES", 1 << 29))
                     // chunk_bytes)
+    # Ragged flat wire whenever the vocab fits uint16 — same ~25% byte
+    # saving as the resident path, and spill="host" then caches the
+    # FLAT arrays, so pass B never re-packs at all (round-2 streaming
+    # paid a full second pack+pad per chunk even from RAM).
+    flat_pack = (make_flat_packer(input_dir, cfg, chunk_docs, length)
+                 if cfg.vocab_size <= (1 << 16) else None)
     ph = {"pack_a": 0.0, "pack_b": 0.0}
     df_acc = jnp.zeros((cfg.vocab_size,), jnp.int32)
     cached: List[Tuple[np.ndarray, np.ndarray]] = []
     all_lengths: List[np.ndarray] = []
     in_flight: List[jax.Array] = []
+
+    def pack_any(chunk_names):
+        if flat_pack is not None:
+            flat, lengths, _ = flat_pack(chunk_names)
+            return flat, lengths
+        return pack_chunk(chunk_names)
+
+    def phase_a_any(wire_arr, lens, df_acc):
+        if flat_pack is not None:
+            return _phase_a_ragged(wire_arr, lens, df_acc, length=length,
+                                   vocab_size=cfg.vocab_size)
+        return _phase_a(wire_arr, lens, df_acc, vocab_size=cfg.vocab_size)
+
+    def phase_b_any(wire_arr, lens, idf):
+        if flat_pack is not None:
+            return _phase_b_ragged(wire_arr, lens, idf, length=length,
+                                   topk=k)
+        return _phase_b(wire_arr, lens, idf, topk=k)
+
     t_pass = time.perf_counter()
     for start in starts:
         chunk_names = names[start:start + chunk_docs]
         t0 = time.perf_counter()
-        token_ids, lengths = pack_chunk(chunk_names)
+        wire_arr, lengths = pack_any(chunk_names)
         ph["pack_a"] += time.perf_counter() - t0
         all_lengths.append(lengths[:len(chunk_names)])
         if spill == "host":
-            cached.append((token_ids, lengths))
-        toks = jax.device_put(token_ids)
-        lens = jax.device_put(lengths)
-        df_acc = _phase_a(toks, lens, df_acc, vocab_size=cfg.vocab_size)
+            cached.append((wire_arr, lengths))
+        df_acc = phase_a_any(jax.device_put(wire_arr),
+                             jax.device_put(lengths), df_acc)
         in_flight.append(df_acc)
         if len(in_flight) > max_ahead:
             in_flight.pop(0).block_until_ready()
@@ -468,14 +516,13 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
     t_pass = time.perf_counter()
     for ci, start in enumerate(starts):
         if spill == "host":
-            token_ids, lengths = cached[ci]
+            wire_arr, lengths = cached[ci]
         else:
             t0 = time.perf_counter()
-            token_ids, lengths = pack_chunk(names[start:start + chunk_docs])
+            wire_arr, lengths = pack_any(names[start:start + chunk_docs])
             ph["pack_b"] += time.perf_counter() - t0
-        toks = jax.device_put(token_ids)
-        lens = jax.device_put(lengths)
-        v, t = _phase_b(toks, lens, idf, topk=k)
+        v, t = phase_b_any(jax.device_put(wire_arr),
+                           jax.device_put(lengths), idf)
         vals_parts.append(v)
         ids_parts.append(t)
         if ci >= max_ahead:  # same byte-budgeted lookahead as pass A

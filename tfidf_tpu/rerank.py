@@ -42,6 +42,30 @@ from tfidf_tpu.ops.tokenize import whitespace_tokenize
 DocTerms = List[Tuple[bytes, float]]
 
 
+def margin_check(df, margin: int) -> Optional[str]:
+    """Collision-pressure guard for the exact-terms margin.
+
+    Estimates the vocab load factor from the occupied-bucket fraction
+    of a measured DF vector (alpha = -ln(1 - B/V) under uniform
+    hashing) and returns a human-readable warning when ``margin`` is
+    below the measured-safe level for it — margin 4 up to alpha 0.25,
+    margin 8 beyond (the sweep in docs/EXACT.md). Returns None when the
+    margin is safe. Library-level so every exact-terms entry point
+    (CLI, bench, direct :func:`exact_topk` callers) shares one rule.
+    """
+    import math
+
+    df = np.asarray(df)
+    occ = float((df > 0).sum()) / df.size
+    alpha = -math.log(max(1.0 - min(occ, 0.999999), 1e-12))
+    suggested = 4 if alpha <= 0.25 else 8
+    if margin >= suggested:
+        return None
+    return (f"vocab load factor ~{alpha:.2f} (occupancy {occ:.2f}): "
+            f"exact-terms margin {margin} may miss exact top-k words — "
+            f"measured-safe margin here is {suggested} (docs/EXACT.md)")
+
+
 def _doc_words(input_dir: str, name: str, cfg: PipelineConfig,
                max_tokens: Optional[int]) -> Tuple[List[bytes], int]:
     """Exact host tokenization of one document, mirroring the packer:
@@ -64,7 +88,8 @@ def _doc_words(input_dir: str, name: str, cfg: PipelineConfig,
 def exact_topk(input_dir: str, names: Sequence[str], topk_ids: np.ndarray,
                num_docs: int, cfg: PipelineConfig, k: int,
                docs: Optional[Iterable[str]] = None,
-               max_tokens: Optional[int] = None) -> Dict[str, DocTerms]:
+               max_tokens: Optional[int] = None,
+               df: Optional[np.ndarray] = None) -> Dict[str, DocTerms]:
     """Exact-string top-k for ``docs`` from a hashed TPU selection.
 
     Args:
@@ -78,11 +103,20 @@ def exact_topk(input_dir: str, names: Sequence[str], topk_ids: np.ndarray,
       max_tokens: the static L of the device batch, when one was used
         (e.g. ``run_overlapped(doc_len=...)``) — keeps TF/docSize parity
         with what the device scored.
+      df: the run's measured DF vector, when available — enables the
+        :func:`margin_check` collision-pressure warning (stderr) for
+        every caller, not just the CLI.
 
     Returns:
       name -> [(word, score), ...] exact float64 TF-IDF, score-desc then
       word-asc, at most k entries, only positive-scoring words.
     """
+    if df is not None and np.asarray(topk_ids).ndim == 2 and k > 0:
+        warn = margin_check(df, max(np.asarray(topk_ids).shape[1] // k, 1))
+        if warn is not None:
+            import sys
+            sys.stderr.write(f"warning: {warn}\n")
+
     # Padding rows (mesh/chunk pad_docs_to) carry '' names and all -1
     # topk ids — skip them everywhere, like pass 2 always did; opening
     # os.path.join(input_dir, '') is the directory itself.

@@ -1,0 +1,99 @@
+"""Corpus-scale measurement: resident vs streaming regimes at size.
+
+Generates an N-doc Zipf corpus on disk (one file per doc, the
+reference's contract), then measures `run_overlapped` end-to-end:
+  - the resident path (default) at its actual scale ceiling, and
+  - the two-pass streaming path (forced via TFIDF_TPU_RESIDENT_ELEMS=0)
+    under both spill policies.
+Numbers land in docs/SCALING.md. Corpus generation is the slow part at
+1M docs — the corpus dir is kept between runs unless --fresh.
+
+    python tools/scale_run.py [n_docs] [--streaming-only]
+"""
+
+import json
+import os
+import resource
+import shutil
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_DOCS = int(sys.argv[1]) if len(sys.argv) > 1 and sys.argv[1].isdigit() \
+    else 1_000_000
+DOC_LEN = 256
+N_WORDS = 8192
+CHUNK = 32768
+ROOT = os.environ.get("SCALE_DIR", f"/tmp/tfidf_scale_{N_DOCS}")
+
+
+def make_corpus(input_dir: str) -> None:
+    if os.path.isdir(input_dir) and \
+            len(os.listdir(input_dir)) == N_DOCS and \
+            "--fresh" not in sys.argv:
+        print(f"reusing corpus {input_dir}", file=sys.stderr)
+        return
+    shutil.rmtree(input_dir, ignore_errors=True)
+    os.makedirs(input_dir)
+    rng = np.random.default_rng(42)
+    words = np.array([f"w{i}".encode() for i in range(N_WORDS)],
+                     dtype=object)
+    t0 = time.perf_counter()
+    step = 65536
+    for base in range(0, N_DOCS, step):
+        n_here = min(step, N_DOCS - base)
+        zipf = np.clip(rng.zipf(1.3, size=n_here * DOC_LEN), 1,
+                       N_WORDS) - 1
+        lens = rng.integers(DOC_LEN // 2, DOC_LEN + 1, n_here)
+        off = 0
+        for j in range(n_here):
+            n = int(lens[j])
+            doc = b" ".join(words[zipf[off:off + n]])
+            off += n
+            with open(os.path.join(input_dir, f"doc{base + j + 1}"),
+                      "wb") as f:
+                f.write(doc)
+        print(f"  corpus {base + n_here}/{N_DOCS} "
+              f"({time.perf_counter() - t0:.0f}s)", file=sys.stderr)
+
+
+def run_once(input_dir, tag):
+    from tfidf_tpu.config import PipelineConfig, VocabMode
+    from tfidf_tpu.ingest import run_overlapped
+
+    cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=1 << 16,
+                         max_doc_len=DOC_LEN, doc_chunk=DOC_LEN, topk=16,
+                         engine="sparse")
+    t0 = time.perf_counter()
+    r = run_overlapped(input_dir, cfg, chunk_docs=CHUNK, doc_len=DOC_LEN)
+    wall = time.perf_counter() - t0
+    rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
+    rec = {"tag": tag, "path": r.path, "n_docs": r.num_docs,
+           "wall_s": round(wall, 1),
+           "docs_per_sec": round(r.num_docs / wall, 0),
+           "host_maxrss_gb": round(rss, 2),
+           "phases": {k: round(v, 2) for k, v in (r.phases or {}).items()}}
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def main():
+    input_dir = os.path.join(ROOT, "input")
+    make_corpus(input_dir)
+    if "--streaming-only" not in sys.argv:
+        run_once(input_dir, "resident-warm0")  # includes compiles
+        run_once(input_dir, "resident")
+    os.environ["TFIDF_TPU_RESIDENT_ELEMS"] = "0"
+    for spill in ("host", "reread"):
+        os.environ["TFIDF_TPU_SPILL_BYTES"] = "0" if spill == "reread" \
+            else str(1 << 62)
+        run_once(input_dir, f"streaming-{spill}-warm0")
+        run_once(input_dir, f"streaming-{spill}")
+
+
+if __name__ == "__main__":
+    main()
