@@ -33,8 +33,6 @@
 #include <memory>
 #include <string>
 #include <string_view>
-#include <unordered_map>
-#include <unordered_set>
 #include <vector>
 
 #include "tokenize_common.h"
@@ -60,6 +58,88 @@ int64_t ForEachTokenSv(const char* data, int64_t len, int64_t truncate_at,
         fn(std::string_view(reinterpret_cast<const char*>(w), (size_t)wl));
       });
 }
+
+// Raw 64-bit FNV-1a of a token (pre-fold, tokenize_common.h): the
+// grouping/probe key everywhere below. Exactness never rests on it
+// alone — every hash-equal comparison is verified on bytes.
+inline uint64_t Fnv64(std::string_view w, uint64_t seed) {
+  return tfidf::HashWordRaw(reinterpret_cast<const uint8_t*>(w.data()),
+                            (int64_t)w.size(), seed);
+}
+
+struct Tok {
+  uint64_t h;
+  std::string_view w;
+};
+
+inline bool TokLess(const Tok& a, const Tok& b) {
+  if (a.h != b.h) return a.h < b.h;
+  return a.w < b.w;
+}
+
+struct Cand {               // one exact candidate word in one doc
+  uint64_t h;
+  std::string_view w;
+  int32_t count;
+  int64_t idx;              // global candidate index (filled after merge)
+};
+
+// Open-addressed global candidate index: h-keyed linear probing with
+// byte verification on hash hits. Grown before pass 2; read-only and
+// therefore thread-safe during the parallel passes. unordered_map
+// per-doc/per-token churn measured ~2x the whole mode's budget at
+// margin 4, which is why this table and the sort+RLE grouping below
+// replaced it.
+struct GlobalIndex {
+  std::vector<uint64_t> hs;
+  std::vector<std::string_view> ws;
+  std::vector<int64_t> idxs;
+  size_t mask = 0, live = 0;
+
+  void Rehash(size_t cap) {  // cap: power of two
+    std::vector<uint64_t> oh = std::move(hs);
+    std::vector<std::string_view> ow = std::move(ws);
+    std::vector<int64_t> oi = std::move(idxs);
+    hs.assign(cap, 0);
+    ws.assign(cap, {});
+    idxs.assign(cap, -1);
+    mask = cap - 1;
+    for (size_t s = 0; s < oh.size(); ++s)
+      if (oi[s] >= 0) Place(oh[s], ow[s], oi[s]);
+  }
+
+  void Place(uint64_t h, std::string_view w, int64_t idx) {
+    size_t s = (size_t)h & mask;
+    while (idxs[s] >= 0) s = (s + 1) & mask;
+    hs[s] = h;
+    ws[s] = w;
+    idxs[s] = idx;
+  }
+
+  // Insert-if-absent; returns the word's global index.
+  int64_t Intern(uint64_t h, std::string_view w) {
+    if ((live + 1) * 10 >= (mask + 1) * 7) Rehash((mask + 1) * 2);
+    size_t s = (size_t)h & mask;
+    while (idxs[s] >= 0) {
+      if (hs[s] == h && ws[s] == w) return idxs[s];
+      s = (s + 1) & mask;
+    }
+    hs[s] = h;
+    ws[s] = w;
+    idxs[s] = (int64_t)live;
+    return (int64_t)live++;
+  }
+
+  // Read-only probe (thread-safe after construction).
+  int64_t Find(uint64_t h, std::string_view w) const {
+    size_t s = (size_t)h & mask;
+    while (idxs[s] >= 0) {
+      if (hs[s] == h && ws[s] == w) return idxs[s];
+      s = (s + 1) & mask;
+    }
+    return -1;
+  }
+};
 
 struct Entry {
   std::string_view word;
@@ -89,8 +169,10 @@ void* rerank_run(void* loader_handle, const int32_t* topk_ids,
                  int64_t max_tokens, int64_t k, int n_threads) {
   const int64_t n_docs = loader_doc_count(loader_handle);
 
-  // Pass 1: per-doc exact counts of candidate words.
-  std::vector<std::unordered_map<std::string_view, int32_t>> cand(n_docs);
+  // Pass 1: per-doc exact counts of candidate words. Hit tokens are
+  // grouped by sort + RLE over a doc-local scratch (the device's own
+  // idiom) — no per-token map operations.
+  std::vector<std::vector<Cand>> cand(n_docs);
   std::vector<int64_t> doc_size(n_docs, 0);
   ParallelFor(n_docs, n_threads, [&](int64_t d) {
     std::vector<int32_t> buckets;
@@ -102,37 +184,55 @@ void* rerank_run(void* loader_handle, const int32_t* topk_ids,
     std::sort(buckets.begin(), buckets.end());
     int64_t len;
     const char* data = loader_doc_data(loader_handle, d, &len);
+    std::vector<Tok> hits;
     doc_size[d] = ForEachTokenSv(
         data, len, truncate_at, max_tokens, [&](std::string_view w) {
-          int32_t b = (int32_t)tfidf::HashWord(
-              reinterpret_cast<const uint8_t*>(w.data()),
-              (int64_t)w.size(), seed, vocab_size);
+          uint64_t h = Fnv64(w, seed);
+          int32_t b = (int32_t)tfidf::FoldToVocab(h, vocab_size);
           if (std::binary_search(buckets.begin(), buckets.end(), b))
-            ++cand[d][w];
+            hits.push_back({h, w});
         });
+    std::sort(hits.begin(), hits.end(), TokLess);
+    for (size_t i = 0; i < hits.size();) {
+      size_t j = i + 1;
+      while (j < hits.size() && hits[j].h == hits[i].h &&
+             hits[j].w == hits[i].w)
+        ++j;
+      cand[d].push_back({hits[i].h, hits[i].w, (int32_t)(j - i), -1});
+      i = j;
+    }
   });
 
-  // Global candidate index (serial merge; total entries ~ n_docs * k').
-  std::unordered_map<std::string_view, int64_t> cand_idx;
+  // Global candidate index (serial merge of per-doc lists).
+  GlobalIndex gidx;
+  gidx.Rehash(1 << 16);
   for (int64_t d = 0; d < n_docs; ++d)
-    for (const auto& kv : cand[d])
-      cand_idx.emplace(kv.first, (int64_t)cand_idx.size());
+    for (Cand& c : cand[d]) c.idx = gidx.Intern(c.h, c.w);
 
   // Pass 2: exact DF of the candidate set, one count per (word, doc).
+  // Per-doc dedup (the currDoc semantics) again by sort + RLE; the
+  // global index is read-only here, probed with relaxed-atomic counts.
   std::unique_ptr<std::atomic<int32_t>[]> df(
-      new std::atomic<int32_t>[cand_idx.size() ? cand_idx.size() : 1]);
-  for (size_t i = 0; i < cand_idx.size(); ++i) df[i].store(0);
+      new std::atomic<int32_t>[gidx.live ? gidx.live : 1]);
+  for (size_t i = 0; i < gidx.live; ++i) df[i].store(0);
   ParallelFor(n_docs, n_threads, [&](int64_t d) {
-    std::unordered_set<std::string_view> seen;
     int64_t len;
     const char* data = loader_doc_data(loader_handle, d, &len);
+    std::vector<Tok> toks;
     ForEachTokenSv(data, len, truncate_at, max_tokens,
-                 [&](std::string_view w) {
-                   if (!seen.insert(w).second) return;
-                   auto it = cand_idx.find(w);
-                   if (it != cand_idx.end())
-                     df[it->second].fetch_add(1, std::memory_order_relaxed);
-                 });
+                   [&](std::string_view w) {
+                     toks.push_back({Fnv64(w, seed), w});
+                   });
+    std::sort(toks.begin(), toks.end(), TokLess);
+    for (size_t i = 0; i < toks.size();) {
+      size_t j = i + 1;
+      while (j < toks.size() && toks[j].h == toks[i].h &&
+             toks[j].w == toks[i].w)
+        ++j;
+      int64_t idx = gidx.Find(toks[i].h, toks[i].w);
+      if (idx >= 0) df[idx].fetch_add(1, std::memory_order_relaxed);
+      i = j;
+    }
   });
 
   // Pass 3: exact float64 scoring, (-score, word) order, top-k.
@@ -140,13 +240,12 @@ void* rerank_run(void* loader_handle, const int32_t* topk_ids,
   ParallelFor(n_docs, n_threads, [&](int64_t d) {
     std::vector<Entry>& out = picked[d];
     out.reserve(cand[d].size());
-    for (const auto& kv : cand[d]) {
-      int32_t dfw = df[cand_idx.find(kv.first)->second]
-                        .load(std::memory_order_relaxed);
-      double tf = (double)kv.second / (double)doc_size[d];
+    for (const Cand& c : cand[d]) {
+      int32_t dfw = df[c.idx].load(std::memory_order_relaxed);
+      double tf = (double)c.count / (double)doc_size[d];
       double idf = std::log((double)num_docs_idf / (double)dfw);
       double s = tf * idf;
-      if (s > 0.0) out.push_back({kv.first, s});
+      if (s > 0.0) out.push_back({c.w, s});
     }
     std::sort(out.begin(), out.end(), [](const Entry& a, const Entry& b) {
       if (a.score != b.score) return a.score > b.score;

@@ -55,15 +55,26 @@ inline bool IsSpace(uint8_t c) {
          c == '\r';
 }
 
-// FNV-1a64 -> xor-fold -> mod-vocab id of one token's bytes. THE hash;
-// every native consumer (loader pack, rerank candidate matching) calls
-// this so the contract cannot fork.
-inline int64_t HashWord(const uint8_t* w, int64_t len, uint64_t seed,
-                        int64_t vocab_size) {
+// THE hash, in two composable halves so no consumer ever re-implements
+// either: HashWordRaw = seeded FNV-1a64 of the token bytes (a grouping
+// key in its own right — rerank.cc); FoldToVocab = xor-fold + mod.
+// HashWord = the composition; every native consumer (loader pack,
+// rerank candidate matching) goes through these, so the contract
+// cannot fork.
+inline uint64_t HashWordRaw(const uint8_t* w, int64_t len, uint64_t seed) {
   uint64_t h = kFnvOffset ^ seed;
   for (int64_t j = 0; j < len; ++j) h = (h ^ w[j]) * kFnvPrime;
+  return h;
+}
+
+inline int64_t FoldToVocab(uint64_t h, int64_t vocab_size) {
   h ^= h >> 32;
   return (int64_t)(h % (uint64_t)vocab_size);
+}
+
+inline int64_t HashWord(const uint8_t* w, int64_t len, uint64_t seed,
+                        int64_t vocab_size) {
+  return FoldToVocab(HashWordRaw(w, len, seed), vocab_size);
 }
 
 // Tokenize data[0..len): fn(ptr, len) per token, each truncated to

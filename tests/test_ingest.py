@@ -170,6 +170,52 @@ class TestResidentFusedPath:
             np.testing.assert_array_equal(fused.lengths, streamed.lengths)
 
 
+class TestFlatPacker:
+    def test_native_matches_python_flat(self, corpus_dir, monkeypatch):
+        # The ragged wire's two producers (native loader_fill_flat_u16
+        # and the Python mask-flatten fallback) must emit identical
+        # streams — they feed the same compiled program.
+        from tfidf_tpu.ingest import make_flat_packer
+        from tfidf_tpu.io import fast_tokenizer
+        from tfidf_tpu.io.corpus import discover_names
+        if not fast_tokenizer.flat_available():
+            pytest.skip("native flat packer unavailable")
+        cfg = _cfg()
+        names = discover_names(corpus_dir, strict=True)
+        nat = make_flat_packer(corpus_dir, cfg, 16, 64)(names[:13])
+        monkeypatch.setenv("TFIDF_TPU_NO_NATIVE", "1")
+        py = make_flat_packer(corpus_dir, cfg, 16, 64)(names[:13])
+        assert nat[2] == py[2]  # total live ids
+        np.testing.assert_array_equal(nat[1], py[1])  # lengths (padded)
+        np.testing.assert_array_equal(nat[0][:nat[2]], py[0][:py[2]])
+
+    def test_all_empty_chunk(self, tmp_path):
+        # A chunk of only whitespace/empty docs yields a zero-length
+        # flat stream; the wire must pad to >= one bucket or the device
+        # gather fails at trace time (round-3 review finding).
+        for i in range(1, 9):
+            (tmp_path / f"doc{i}").write_bytes(b"  \n ")
+        (tmp_path / "doc9").write_bytes(b"alpha beta")
+        cfg = _cfg()
+        got = run_overlapped(str(tmp_path), cfg, chunk_docs=4, doc_len=64)
+        assert got.num_docs == 9
+        assert (got.topk_ids[:8] == -1).all()
+        assert (got.topk_ids[8] >= 0).any()
+
+    def test_wide_vocab_uses_padded_wire(self, corpus_dir):
+        # vocab > 2^16 cannot ride the uint16 flat wire; the resident
+        # path must fall back to the padded int32 path and still match
+        # the single-batch reference.
+        cfg = _cfg(vocab_size=1 << 17)
+        got = run_overlapped(corpus_dir, cfg, chunk_docs=16, doc_len=64)
+        assert got.path == "resident"
+        ref = TfidfPipeline(cfg).run_packed(
+            pack_corpus(discover_corpus(corpus_dir), cfg, want_words=False))
+        assert (np.asarray(got.df) == ref.df).all()
+        assert (got.topk_ids == ref.topk_ids).all()
+        np.testing.assert_allclose(got.topk_vals, ref.topk_vals, rtol=1e-6)
+
+
 class TestPathReporting:
     def test_result_reports_regime(self, corpus_dir, monkeypatch):
         cfg = _cfg()

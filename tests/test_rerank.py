@@ -82,10 +82,12 @@ class TestExactRerank:
         import subprocess
 
         from tfidf_tpu.io import fast_tokenizer
-        if not fast_tokenizer.rerank_available():
-            subprocess.run(["make", "-C", "native", "fast_tokenizer.so"],
-                           cwd=os.path.dirname(os.path.dirname(
-                               os.path.abspath(__file__))), check=True)
+        # ALWAYS rebuild (no-op when fresh): gating on symbol presence
+        # would silently validate edited rerank.cc against a stale .so.
+        subprocess.run(["make", "-C", "native", "fast_tokenizer.so"],
+                       cwd=os.path.dirname(os.path.dirname(
+                           os.path.abspath(__file__))),
+                       capture_output=True)
         if not fast_tokenizer.rerank_available():
             pytest.skip("native rerank engine unavailable")
         cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=VOCAB,

@@ -161,7 +161,10 @@ def make_flat_packer(input_dir: str, cfg: PipelineConfig, chunk_docs: int,
             max_per_doc=length, pad_docs_to=chunk_docs)
         assert out is not None
         flat, lengths, total = out
-        pad = -total % _FLAT_BUCKET
+        # At least one bucket even for an all-empty chunk: a zero-size
+        # operand would fail the device gather's trace (and one bucket
+        # is the shape small chunks land on anyway).
+        pad = max(total + (-total % _FLAT_BUCKET), _FLAT_BUCKET) - total
         if total + pad <= flat.size:
             flat[total:total + pad] = 0  # never ship np.empty garbage
             return flat[:total + pad], lengths, total
@@ -172,7 +175,8 @@ def make_flat_packer(input_dir: str, cfg: PipelineConfig, chunk_docs: int,
         mask = (np.arange(ids.shape[1])[None, :] < lengths[:, None])
         flat = np.ascontiguousarray(ids[mask], dtype=np.uint16)
         total = flat.size
-        flat = np.pad(flat, (0, -total % _FLAT_BUCKET))
+        pad = max(total + (-total % _FLAT_BUCKET), _FLAT_BUCKET) - total
+        flat = np.pad(flat, (0, pad))
         return flat, lengths, total
 
     return pack_native if use_native else pack_python
