@@ -44,6 +44,38 @@ class TestCli:
         # (TFIDF.c:273) so output never depends on discovery order.
         assert data == sorted(data)
 
+    def test_hashed_topk_rides_overlapped_ingest(self, toy_corpus_dir,
+                                                 tmp_path):
+        # Round 3: --doc-len opts single-device hashed top-k CLI runs
+        # into run_overlapped (the measured scalable pipeline) instead
+        # of packing the whole corpus in Python. Output must agree with
+        # the batch TfidfPipeline on the same config (toy docs are all
+        # shorter than --doc-len, so truncation is a no-op here).
+        out = tmp_path / "ov.txt"
+        rc = main(["run", "--input", toy_corpus_dir, "--output", str(out),
+                   "--vocab-mode", "hashed", "--vocab-size", "4096",
+                   "--topk", "2", "--doc-len", "64"])
+        assert rc == 0
+        from tfidf_tpu.config import PipelineConfig, VocabMode
+        from tfidf_tpu.pipeline import TfidfPipeline
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=4096,
+                             topk=2, engine="sparse")
+        ref = TfidfPipeline(cfg).run(discover_corpus(toy_corpus_dir))
+        want = {}
+        for d in range(ref.num_docs):
+            for v, s in zip(ref.topk_ids[d], ref.topk_vals[d]):
+                if s > 0:
+                    want[(ref.names[d], int(v))] = float(s)
+        got = {}
+        for line in out.read_bytes().splitlines():
+            key, score = line.rsplit(b"\t", 1)
+            doc, word = key.split(b"@", 1)
+            assert word.startswith(b"id:")  # hashed mode: ids, no words
+            got[(doc.decode(), int(word[3:]))] = float(score)
+        assert set(got) == set(want)
+        for kk in want:
+            assert got[kk] == pytest.approx(want[kk], rel=1e-6)
+
     def test_sharded_mesh_flag(self, toy_corpus_dir, tmp_path):
         out = tmp_path / "out.txt"
         rc = main(["run", "--input", toy_corpus_dir, "--output", str(out),

@@ -84,10 +84,16 @@ class TestExactRerank:
         from tfidf_tpu.io import fast_tokenizer
         # ALWAYS rebuild (no-op when fresh): gating on symbol presence
         # would silently validate edited rerank.cc against a stale .so.
-        subprocess.run(["make", "-C", "native", "fast_tokenizer.so"],
-                       cwd=os.path.dirname(os.path.dirname(
-                           os.path.abspath(__file__))),
-                       capture_output=True)
+        built = subprocess.run(
+            ["make", "-C", "native", "fast_tokenizer.so"],
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            capture_output=True, text=True)
+        if built.returncode != 0:
+            if fast_tokenizer.rerank_available():
+                # A stale loadable .so would make a silent green run.
+                pytest.fail("native build failed with a stale .so "
+                            f"present:\n{built.stderr[-1500:]}")
+            pytest.skip("native toolchain unavailable and no prebuilt .so")
         if not fast_tokenizer.rerank_available():
             pytest.skip("native rerank engine unavailable")
         cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=VOCAB,

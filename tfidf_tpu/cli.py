@@ -43,6 +43,15 @@ def _build_parser() -> argparse.ArgumentParser:
                      help="chargram n range, e.g. 3,5")
     run.add_argument("--topk", type=int, default=None,
                      help="emit only top-k terms per document")
+    run.add_argument("--doc-len", type=int, default=None,
+                     help="static tokens per document: opts hashed top-k "
+                          "runs into the overlapped chunked ingest (native "
+                          "loader, flat memory in corpus size — the bench "
+                          "pipeline). Trades: docs longer than this are "
+                          "truncated, and terms emit as id:N (no host "
+                          "word materialization; combine with "
+                          "--exact-terms for real words). Default: no "
+                          "truncation, whole-corpus batch path")
     run.add_argument("--exact-terms", action="store_true",
                      help="hashed+topk mode: re-rank the device top-k "
                           "on host with exact strings and DF, emitting "
@@ -152,12 +161,50 @@ def _run_tpu(args) -> int:
     from tfidf_tpu.utils.timing import PhaseTimer, Throughput, phase_or_null
     timer = PhaseTimer() if args.timing else None
     throughput = Throughput()
-    with phase_or_null(timer, "discover"):
-        corpus = discover_corpus(args.input, strict=not args.no_strict)
-    # --mesh flows through config.mesh_shape: TfidfPipeline dispatches to
-    # ShardedPipeline over the described device mesh.
-    with throughput.measure(len(corpus)):
-        result = TfidfPipeline(cfg, timer=timer).run(corpus)
+
+    # Scalable route (explicit opt-in via --doc-len): hashed-vocab
+    # top-k runs on a single device go through the overlapped chunked
+    # ingest (native loader, ragged wire, flat memory in corpus size)
+    # — the same pipeline bench.py measures, instead of packing the
+    # whole corpus in Python first. Opt-in because the static doc
+    # length TRUNCATES longer documents — the fixed-shape trade the
+    # batch path (L grows to the longest doc) never makes. Everything
+    # else (golden full-output, meshes, chargram, pallas) keeps the
+    # TfidfPipeline batch path.
+    overlapped = (args.doc_len is not None
+                  and cfg.vocab_mode is VocabMode.HASHED
+                  and cfg.topk is not None
+                  and cfg.tokenizer is TokenizerKind.WHITESPACE
+                  and not mesh_shape and not args.pallas
+                  and (cfg.engine == "sparse"
+                       or getattr(cfg, "_engine_defaulted", False)))
+    if overlapped:
+        import time
+        import types
+
+        from tfidf_tpu.ingest import run_overlapped
+        t0 = time.perf_counter()
+        r = run_overlapped(args.input, cfg, doc_len=args.doc_len,
+                           strict=not args.no_strict)
+        throughput.record(r.num_docs, time.perf_counter() - t0)
+        result = types.SimpleNamespace(
+            num_docs=r.num_docs, names=r.names, df=r.df,
+            topk_vals=r.topk_vals, topk_ids=r.topk_ids, id_to_word={})
+        if timer is not None and r.phases:
+            for name, secs in r.phases.items():
+                timer.add(name, secs)
+    elif args.doc_len is not None:
+        sys.stderr.write("error: --doc-len (overlapped ingest) needs "
+                         "--vocab-mode hashed, --topk, the whitespace "
+                         "tokenizer, no --mesh, and no --pallas\n")
+        return 2
+    else:
+        with phase_or_null(timer, "discover"):
+            corpus = discover_corpus(args.input, strict=not args.no_strict)
+        # --mesh flows through config.mesh_shape: TfidfPipeline
+        # dispatches to ShardedPipeline over the described device mesh.
+        with throughput.measure(len(corpus)):
+            result = TfidfPipeline(cfg, timer=timer).run(corpus)
 
     with phase_or_null(timer, "emit"):
         if args.topk is None:
@@ -165,10 +212,15 @@ def _run_tpu(args) -> int:
         elif exact_terms:
             from tfidf_tpu.rerank import exact_topk
             # Passing df arms the library-level collision-pressure
-            # warning (rerank.margin_check, docs/EXACT.md).
+            # warning (rerank.margin_check, docs/EXACT.md). max_tokens
+            # mirrors the ingest truncation when --doc-len routed the
+            # run through it — candidate/TF parity with what the device
+            # actually scored (rerank.py docstring).
             reranked = exact_topk(args.input, result.names,
                                   result.topk_ids, result.num_docs, cfg,
-                                  k=args.topk, df=result.df)
+                                  k=args.topk, df=result.df,
+                                  max_tokens=args.doc_len if overlapped
+                                  else None)
             lines = [b"%s@%s\t%.16f" % (name.encode(), w, s)
                      for name in result.names if name
                      for w, s in reranked[name]]

@@ -31,11 +31,15 @@ class PhaseTimer:
         try:
             yield
         finally:
-            dt = time.perf_counter() - t0
-            if name not in self._acc:
-                self._order.append(name)
-                self._acc[name] = 0.0
-            self._acc[name] += dt
+            self.add(name, time.perf_counter() - t0)
+
+    def add(self, name: str, seconds: float) -> None:
+        """Fold an externally-measured duration in (e.g. the phase dict
+        an :class:`~tfidf_tpu.ingest.IngestResult` carries)."""
+        if name not in self._acc:
+            self._order.append(name)
+            self._acc[name] = 0.0
+        self._acc[name] += seconds
 
     def seconds(self, name: str) -> float:
         return self._acc.get(name, 0.0)
@@ -63,8 +67,13 @@ class Throughput:
         try:
             yield
         finally:
-            self._seconds += time.perf_counter() - t0
-            self._docs += num_docs
+            self.record(num_docs, time.perf_counter() - t0)
+
+    def record(self, num_docs: int, seconds: float) -> None:
+        """Fold an externally-measured run in (doc count unknown until
+        the run returns, e.g. overlapped ingest discovery)."""
+        self._docs += num_docs
+        self._seconds += seconds
 
     @property
     def docs_per_sec(self) -> float:
