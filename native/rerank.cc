@@ -77,11 +77,11 @@ inline bool TokLess(const Tok& a, const Tok& b) {
   return a.w < b.w;
 }
 
-struct Cand {               // one exact candidate word in one doc
+struct Cand {               // one unique word in one doc (32 bytes)
   uint64_t h;
-  std::string_view w;
+  std::string_view w;       // view into the loader arena
   int32_t count;
-  int64_t idx;              // global candidate index (filled after merge)
+  int32_t idx;              // global candidate index, -1 = non-candidate
 };
 
 // Open-addressed global candidate index: h-keyed linear probing with
@@ -169,10 +169,16 @@ void* rerank_run(void* loader_handle, const int32_t* topk_ids,
                  int64_t max_tokens, int64_t k, int n_threads) {
   const int64_t n_docs = loader_doc_count(loader_handle);
 
-  // Pass 1: per-doc exact counts of candidate words. Hit tokens are
-  // grouped by sort + RLE over a doc-local scratch (the device's own
-  // idiom) — no per-token map operations.
-  std::vector<std::vector<Cand>> cand(n_docs);
+  // Pass 1: tokenize + hash + sort + RLE ONCE per doc, caching every
+  // unique (hash, bytes, count) — later passes never touch document
+  // bytes again (the second full tokenize+sort measured ~a third of
+  // the mode's budget). Candidate entries (bucket made the device
+  // margin) are remembered by slot. Memory: 32 B per unique term per
+  // doc (views into the loader arena), held across all three passes —
+  // ~tens of MB at bench scale, ~GBs at 1M docs (the arena itself is
+  // the same order).
+  std::vector<std::vector<Cand>> uniq(n_docs);
+  std::vector<std::vector<int32_t>> cand_slots(n_docs);
   std::vector<int64_t> doc_size(n_docs, 0);
   ParallelFor(n_docs, n_threads, [&](int64_t d) {
     std::vector<int32_t> buckets;
@@ -184,54 +190,44 @@ void* rerank_run(void* loader_handle, const int32_t* topk_ids,
     std::sort(buckets.begin(), buckets.end());
     int64_t len;
     const char* data = loader_doc_data(loader_handle, d, &len);
-    std::vector<Tok> hits;
-    doc_size[d] = ForEachTokenSv(
-        data, len, truncate_at, max_tokens, [&](std::string_view w) {
-          uint64_t h = Fnv64(w, seed);
-          int32_t b = (int32_t)tfidf::FoldToVocab(h, vocab_size);
-          if (std::binary_search(buckets.begin(), buckets.end(), b))
-            hits.push_back({h, w});
-        });
-    std::sort(hits.begin(), hits.end(), TokLess);
-    for (size_t i = 0; i < hits.size();) {
-      size_t j = i + 1;
-      while (j < hits.size() && hits[j].h == hits[i].h &&
-             hits[j].w == hits[i].w)
-        ++j;
-      cand[d].push_back({hits[i].h, hits[i].w, (int32_t)(j - i), -1});
-      i = j;
-    }
-  });
-
-  // Global candidate index (serial merge of per-doc lists).
-  GlobalIndex gidx;
-  gidx.Rehash(1 << 16);
-  for (int64_t d = 0; d < n_docs; ++d)
-    for (Cand& c : cand[d]) c.idx = gidx.Intern(c.h, c.w);
-
-  // Pass 2: exact DF of the candidate set, one count per (word, doc).
-  // Per-doc dedup (the currDoc semantics) again by sort + RLE; the
-  // global index is read-only here, probed with relaxed-atomic counts.
-  std::unique_ptr<std::atomic<int32_t>[]> df(
-      new std::atomic<int32_t>[gidx.live ? gidx.live : 1]);
-  for (size_t i = 0; i < gidx.live; ++i) df[i].store(0);
-  ParallelFor(n_docs, n_threads, [&](int64_t d) {
-    int64_t len;
-    const char* data = loader_doc_data(loader_handle, d, &len);
     std::vector<Tok> toks;
-    ForEachTokenSv(data, len, truncate_at, max_tokens,
-                   [&](std::string_view w) {
-                     toks.push_back({Fnv64(w, seed), w});
-                   });
+    doc_size[d] = ForEachTokenSv(
+        data, len, truncate_at, max_tokens,
+        [&](std::string_view w) { toks.push_back({Fnv64(w, seed), w}); });
     std::sort(toks.begin(), toks.end(), TokLess);
     for (size_t i = 0; i < toks.size();) {
       size_t j = i + 1;
       while (j < toks.size() && toks[j].h == toks[i].h &&
              toks[j].w == toks[i].w)
         ++j;
-      int64_t idx = gidx.Find(toks[i].h, toks[i].w);
-      if (idx >= 0) df[idx].fetch_add(1, std::memory_order_relaxed);
+      uniq[d].push_back({toks[i].h, toks[i].w, (int32_t)(j - i), -1});
+      int32_t b = (int32_t)tfidf::FoldToVocab(toks[i].h, vocab_size);
+      if (std::binary_search(buckets.begin(), buckets.end(), b))
+        cand_slots[d].push_back((int32_t)uniq[d].size() - 1);
       i = j;
+    }
+  });
+
+  // Global candidate index (serial merge of the flagged slots).
+  GlobalIndex gidx;
+  gidx.Rehash(1 << 16);
+  for (int64_t d = 0; d < n_docs; ++d)
+    for (int32_t s : cand_slots[d]) {
+      Cand& c = uniq[d][(size_t)s];
+      c.idx = (int32_t)gidx.Intern(c.h, c.w);
+    }
+
+  // Pass 2: exact DF of the candidate set, one count per (word, doc).
+  // Dedup is already encoded in the cached unique lists (the currDoc
+  // semantics); the global index is read-only here, probed with
+  // relaxed-atomic counts.
+  std::unique_ptr<std::atomic<int32_t>[]> df(
+      new std::atomic<int32_t>[gidx.live ? gidx.live : 1]);
+  for (size_t i = 0; i < gidx.live; ++i) df[i].store(0);
+  ParallelFor(n_docs, n_threads, [&](int64_t d) {
+    for (const Cand& c : uniq[d]) {
+      int64_t idx = c.idx >= 0 ? c.idx : gidx.Find(c.h, c.w);
+      if (idx >= 0) df[idx].fetch_add(1, std::memory_order_relaxed);
     }
   });
 
@@ -239,13 +235,14 @@ void* rerank_run(void* loader_handle, const int32_t* topk_ids,
   std::vector<std::vector<Entry>> picked(n_docs);
   ParallelFor(n_docs, n_threads, [&](int64_t d) {
     std::vector<Entry>& out = picked[d];
-    out.reserve(cand[d].size());
-    for (const Cand& c : cand[d]) {
+    out.reserve(cand_slots[d].size());
+    for (int32_t s : cand_slots[d]) {
+      const Cand& c = uniq[d][(size_t)s];
       int32_t dfw = df[c.idx].load(std::memory_order_relaxed);
       double tf = (double)c.count / (double)doc_size[d];
       double idf = std::log((double)num_docs_idf / (double)dfw);
-      double s = tf * idf;
-      if (s > 0.0) out.push_back({c.w, s});
+      double ssc = tf * idf;
+      if (ssc > 0.0) out.push_back({c.w, ssc});
     }
     std::sort(out.begin(), out.end(), [](const Entry& a, const Entry& b) {
       if (a.score != b.score) return a.score > b.score;

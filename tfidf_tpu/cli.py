@@ -171,13 +171,17 @@ def _run_tpu(args) -> int:
     # batch path (L grows to the longest doc) never makes. Everything
     # else (golden full-output, meshes, chargram, pallas) keeps the
     # TfidfPipeline batch path.
+    if args.doc_len is not None and args.doc_len < 1:
+        sys.stderr.write("error: --doc-len must be >= 1\n")
+        return 2
+    # (a defaulted engine is always "sparse" under HASHED vocab, so
+    # checking the resolved value covers both spellings)
     overlapped = (args.doc_len is not None
                   and cfg.vocab_mode is VocabMode.HASHED
                   and cfg.topk is not None
                   and cfg.tokenizer is TokenizerKind.WHITESPACE
                   and not mesh_shape and not args.pallas
-                  and (cfg.engine == "sparse"
-                       or getattr(cfg, "_engine_defaulted", False)))
+                  and cfg.engine == "sparse")
     if overlapped:
         import time
         import types
@@ -196,7 +200,8 @@ def _run_tpu(args) -> int:
     elif args.doc_len is not None:
         sys.stderr.write("error: --doc-len (overlapped ingest) needs "
                          "--vocab-mode hashed, --topk, the whitespace "
-                         "tokenizer, no --mesh, and no --pallas\n")
+                         "tokenizer, the sparse engine, no --mesh, and "
+                         "no --pallas\n")
         return 2
     else:
         with phase_or_null(timer, "discover"):
