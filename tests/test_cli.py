@@ -75,6 +75,15 @@ class TestCli:
         assert set(got) == set(want)
         for kk in want:
             assert got[kk] == pytest.approx(want[kk], rel=1e-6)
+        # Knobs: a tiny chunk size and explicit spill policy must not
+        # change the output bytes (chunking is an execution detail).
+        out2 = tmp_path / "ov2.txt"
+        rc = main(["run", "--input", toy_corpus_dir, "--output", str(out2),
+                   "--vocab-mode", "hashed", "--vocab-size", "4096",
+                   "--topk", "2", "--doc-len", "64",
+                   "--chunk-docs", "4", "--spill", "reread"])
+        assert rc == 0
+        assert out2.read_bytes() == out.read_bytes()
 
     def test_sharded_mesh_flag(self, toy_corpus_dir, tmp_path):
         out = tmp_path / "out.txt"

@@ -69,6 +69,9 @@ class TestBitReference:
         assert b"workers" in proc.stderr
 
 
+@pytest.mark.skipif(bool(os.environ.get("TFIDF_TPU_NO_NATIVE")),
+                    reason="native kill-switch set: these tests assert "
+                           "the native path itself")
 class TestFastTokenizer:
     def test_available_after_build(self):
         from tfidf_tpu.io import fast_tokenizer

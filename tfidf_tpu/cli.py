@@ -52,6 +52,14 @@ def _build_parser() -> argparse.ArgumentParser:
                           "word materialization; combine with "
                           "--exact-terms for real words). Default: no "
                           "truncation, whole-corpus batch path")
+    run.add_argument("--chunk-docs", type=int, default=8192,
+                     help="documents per ingest chunk (--doc-len runs)")
+    run.add_argument("--spill", choices=["auto", "host", "reread"],
+                     default="auto",
+                     help="beyond-HBM streaming regime (--doc-len runs "
+                          "only): keep packed chunks in host RAM between "
+                          "passes, re-read from disk, or pick by byte "
+                          "budget (default)")
     run.add_argument("--exact-terms", action="store_true",
                      help="hashed+topk mode: re-rank the device top-k "
                           "on host with exact strings and DF, emitting "
@@ -174,6 +182,14 @@ def _run_tpu(args) -> int:
     if args.doc_len is not None and args.doc_len < 1:
         sys.stderr.write("error: --doc-len must be >= 1\n")
         return 2
+    if args.chunk_docs < 1:
+        sys.stderr.write("error: --chunk-docs must be >= 1\n")
+        return 2
+    if args.doc_len is None and (args.spill != "auto"
+                                 or args.chunk_docs != 8192):
+        sys.stderr.write("error: --spill/--chunk-docs only apply to "
+                         "--doc-len (overlapped ingest) runs\n")
+        return 2
     # (a defaulted engine is always "sparse" under HASHED vocab, so
     # checking the resolved value covers both spellings)
     overlapped = (args.doc_len is not None
@@ -189,7 +205,8 @@ def _run_tpu(args) -> int:
         from tfidf_tpu.ingest import run_overlapped
         t0 = time.perf_counter()
         r = run_overlapped(args.input, cfg, doc_len=args.doc_len,
-                           strict=not args.no_strict)
+                           chunk_docs=args.chunk_docs,
+                           strict=not args.no_strict, spill=args.spill)
         throughput.record(r.num_docs, time.perf_counter() - t0)
         result = types.SimpleNamespace(
             num_docs=r.num_docs, names=r.names, df=r.df,
