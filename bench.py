@@ -211,13 +211,16 @@ def bench_exact(input_dir: str):
                          topk=MARGIN, engine="sparse")
     chunk = max(2048, N_DOCS // 4)
     run_overlapped(input_dir, cfg, chunk_docs=chunk, doc_len=DOC_LEN)  # warm
-    t0 = time.perf_counter()
-    result = run_overlapped(input_dir, cfg, chunk_docs=chunk,
-                            doc_len=DOC_LEN)
-    reranked = exact_topk(input_dir, result.names, result.topk_ids,
-                          result.num_docs, cfg, k=TOPK,
-                          max_tokens=DOC_LEN, df=result.df)
-    return time.perf_counter() - t0, reranked
+    best = float("inf")
+    for _ in range(max(REPEATS, 1)):  # best-of-N, same N as other sides
+        t0 = time.perf_counter()
+        result = run_overlapped(input_dir, cfg, chunk_docs=chunk,
+                                doc_len=DOC_LEN)
+        reranked = exact_topk(input_dir, result.names, result.topk_ids,
+                              result.num_docs, cfg, k=TOPK,
+                              max_tokens=DOC_LEN, df=result.df)
+        best = min(best, time.perf_counter() - t0)
+    return best, reranked
 
 
 def measure_recall(result, reranked, oracle_out: str):

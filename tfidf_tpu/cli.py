@@ -52,14 +52,15 @@ def _build_parser() -> argparse.ArgumentParser:
                           "word materialization; combine with "
                           "--exact-terms for real words). Default: no "
                           "truncation, whole-corpus batch path")
-    run.add_argument("--chunk-docs", type=int, default=8192,
-                     help="documents per ingest chunk (--doc-len runs)")
+    run.add_argument("--chunk-docs", type=int, default=None,
+                     help="documents per ingest chunk "
+                          "(--doc-len runs; default 8192)")
     run.add_argument("--spill", choices=["auto", "host", "reread"],
-                     default="auto",
+                     default=None,
                      help="beyond-HBM streaming regime (--doc-len runs "
                           "only): keep packed chunks in host RAM between "
                           "passes, re-read from disk, or pick by byte "
-                          "budget (default)")
+                          "budget (default auto)")
     run.add_argument("--exact-terms", action="store_true",
                      help="hashed+topk mode: re-rank the device top-k "
                           "on host with exact strings and DF, emitting "
@@ -182,11 +183,11 @@ def _run_tpu(args) -> int:
     if args.doc_len is not None and args.doc_len < 1:
         sys.stderr.write("error: --doc-len must be >= 1\n")
         return 2
-    if args.chunk_docs < 1:
+    if args.chunk_docs is not None and args.chunk_docs < 1:
         sys.stderr.write("error: --chunk-docs must be >= 1\n")
         return 2
-    if args.doc_len is None and (args.spill != "auto"
-                                 or args.chunk_docs != 8192):
+    if args.doc_len is None and (args.spill is not None
+                                 or args.chunk_docs is not None):
         sys.stderr.write("error: --spill/--chunk-docs only apply to "
                          "--doc-len (overlapped ingest) runs\n")
         return 2
@@ -205,8 +206,9 @@ def _run_tpu(args) -> int:
         from tfidf_tpu.ingest import run_overlapped
         t0 = time.perf_counter()
         r = run_overlapped(args.input, cfg, doc_len=args.doc_len,
-                           chunk_docs=args.chunk_docs,
-                           strict=not args.no_strict, spill=args.spill)
+                           chunk_docs=args.chunk_docs or 8192,
+                           strict=not args.no_strict,
+                           spill=args.spill or "auto")
         throughput.record(r.num_docs, time.perf_counter() - t0)
         result = types.SimpleNamespace(
             num_docs=r.num_docs, names=r.names, df=r.df,
