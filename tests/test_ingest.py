@@ -189,6 +189,25 @@ class TestFlatPacker:
         np.testing.assert_array_equal(nat[1], py[1])  # lengths (padded)
         np.testing.assert_array_equal(nat[0][:nat[2]], py[0][:py[2]])
 
+    def test_ids_only_wire_matches(self, corpus_dir):
+        # wire_vals=False (exact-terms fetch diet): vals None, same ids
+        # except invalid slots read bucket 0 instead of -1 (harmless by
+        # construction for the rerank — see _score_pack_wire).
+        cfg = _cfg()
+        full = run_overlapped(corpus_dir, cfg, chunk_docs=16, doc_len=64)
+        slim = run_overlapped(corpus_dir, cfg, chunk_docs=16, doc_len=64,
+                              wire_vals=False)
+        assert slim.topk_vals is None
+        np.testing.assert_array_equal(np.maximum(full.topk_ids, 0),
+                                      slim.topk_ids)
+        # and the exact rerank is insensitive to the difference
+        from tfidf_tpu.rerank import exact_topk
+        a = exact_topk(corpus_dir, full.names, full.topk_ids,
+                       full.num_docs, cfg, k=3, max_tokens=64)
+        b = exact_topk(corpus_dir, slim.names, slim.topk_ids,
+                       slim.num_docs, cfg, k=3, max_tokens=64)
+        assert a == b
+
     def test_all_empty_chunk(self, tmp_path):
         # A chunk of only whitespace/empty docs yields a zero-length
         # flat stream; the wire must pad to >= one bucket or the device

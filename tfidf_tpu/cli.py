@@ -205,10 +205,13 @@ def _run_tpu(args) -> int:
 
         from tfidf_tpu.ingest import run_overlapped
         t0 = time.perf_counter()
+        # Exact-terms runs read only candidate buckets from the device,
+        # so they take the ids-only wire (no score fetch bytes).
         r = run_overlapped(args.input, cfg, doc_len=args.doc_len,
                            chunk_docs=args.chunk_docs or 8192,
                            strict=not args.no_strict,
-                           spill=args.spill or "auto")
+                           spill=args.spill or "auto",
+                           wire_vals=not exact_terms)
         throughput.record(r.num_docs, time.perf_counter() - t0)
         result = types.SimpleNamespace(
             num_docs=r.num_docs, names=r.names, df=r.df,

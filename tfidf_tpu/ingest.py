@@ -127,6 +127,29 @@ def _phase_b_ragged(flat, lengths, idf, *, length: int, topk: int):
 _FLAT_BUCKET = 1 << 19
 
 
+def _chunk_step(wire_arr, lens, df_acc, cfg: PipelineConfig, length: int,
+                ragged: bool):
+    """THE per-chunk dispatch of the resident path — the single call
+    site of the chunk kernels, shared by :func:`run_overlapped` and
+    :func:`profile_resident` so both hit one jit cache entry (two
+    textually-identical call sites measurably compiled twice)."""
+    if ragged:
+        return _chunk_ragged(wire_arr, lens, df_acc, length=length,
+                             vocab_size=cfg.vocab_size)
+    return _chunk_sort_fold(wire_arr, lens, df_acc,
+                            vocab_size=cfg.vocab_size)
+
+
+def _finish_wire(trips, len_parts, df_acc, num_docs: int, k: int,
+                 score_dtype, cfg: PipelineConfig, wire_vals: bool):
+    """THE final score+pack dispatch (single call site, as above)."""
+    trip_i, trip_c, trip_h = trips
+    return _score_pack_wire(
+        tuple(trip_i), tuple(trip_c), tuple(trip_h), tuple(len_parts),
+        df_acc, jnp.int32(num_docs), topk=k, score_dtype=score_dtype,
+        wide_ids=cfg.vocab_size > (1 << 16), include_vals=wire_vals)
+
+
 def _resident_chunking(num_docs: int, chunk_docs: int):
     """Resident-path chunk rule, shared by :func:`run_overlapped` and
     :func:`profile_resident` so the profiler always measures the same
@@ -194,9 +217,11 @@ def make_flat_packer(input_dir: str, cfg: PipelineConfig, chunk_docs: int,
 # hot-path consumer reads it, so its fetch is lazy (np.asarray at the
 # caller's leisure).
 @functools.partial(jax.jit,
-                   static_argnames=("topk", "score_dtype", "wide_ids"))
+                   static_argnames=("topk", "score_dtype", "wide_ids",
+                                    "include_vals"))
 def _score_pack_wire(ids, counts, head, lengths, df, num_docs, *,
-                     topk: int, score_dtype, wide_ids: bool):
+                     topk: int, score_dtype, wide_ids: bool,
+                     include_vals: bool = True):
     cat = (lambda parts: parts[0] if len(parts) == 1
            else jnp.concatenate(parts, axis=0))
     ids, counts, head = cat(ids), cat(counts), cat(head)
@@ -205,6 +230,16 @@ def _score_pack_wire(ids, counts, head, lengths, df, num_docs, *,
     scores = sparse_scores(ids, counts, head, lengths, idf)
     vals, tids = sparse_topk(scores, ids, head, topk)
     as_bytes = lambda a: lax.bitcast_convert_type(a, jnp.uint8).reshape(-1)
+    if not include_vals:
+        # Ids-only wire (exact-terms mode: the host re-rank reads only
+        # the candidate buckets, so scores would be dead fetch bytes —
+        # 2/3 of a [1M, 64] result). Invalid slots map to bucket 0,
+        # which is harmless by construction: a doc with fewer than k'
+        # distinct terms already has ALL its terms selected, so the
+        # spurious bucket can only add out-of-doc candidates the
+        # re-rank scores exactly and discards.
+        tids = jnp.maximum(tids, 0)
+        return df, as_bytes(tids if wide_ids else tids.astype(jnp.uint16))
     # Valid scores are >= 0 by construction (idf >= 0, tf > 0 — the
     # reference's invariant, TFIDF.c:243); -1 marks invalid slots so a
     # legitimate 0.0 score (word in every doc) survives the u16 ids.
@@ -217,18 +252,24 @@ def _score_pack_wire(ids, counts, head, lengths, df, num_docs, *,
 
 
 def _decode_wire(buf: np.ndarray, d_padded: int, k: int, wide_ids: bool,
-                 score_dtype=np.float32):
+                 score_dtype=np.float32, include_vals: bool = True):
     """Host decode of ``_score_pack_wire``'s buffer (XLA bitcast puts
     the least-significant byte at minor index 0 = little-endian).
     Invalid slots (sub-k docs / padding rows) carry vals == -1 on the
-    wire; they decode back to the (0, -1) contract."""
+    wire; they decode back to the (0, -1) contract. Ids-only wires
+    (``include_vals=False``) return vals None and leave invalid slots
+    at bucket 0 (see ``_score_pack_wire``'s harmlessness note)."""
+    id_t = "<i4" if wide_ids else "<u2"
+    if not include_vals:
+        tids = buf.view(id_t).reshape(d_padded, k).astype(np.int32)
+        return None, tids
     sdt = np.dtype(score_dtype).newbyteorder("<")
     s_bytes = d_padded * k * sdt.itemsize
     vals = buf[:s_bytes].view(sdt).reshape(d_padded, k).copy()
     if wide_ids:
-        tids = buf[s_bytes:].view("<i4").reshape(d_padded, k).copy()
+        tids = buf[s_bytes:].view(id_t).reshape(d_padded, k).copy()
     else:
-        tids = buf[s_bytes:].view("<u2").reshape(d_padded, k) \
+        tids = buf[s_bytes:].view(id_t).reshape(d_padded, k) \
             .astype(np.int32)
     bad = vals < 0
     vals[bad] = 0
@@ -270,13 +311,19 @@ class IngestResult:
     ``topk_vals`` are full ``config.score_dtype`` precision on both the
     resident and streaming paths (the round-2 bf16 wire compaction is
     gone — the link is latency-bound, not bandwidth-bound, so it bought
-    nothing and cost tie precision).
+    nothing and cost tie precision). Exception: a ``wire_vals=False``
+    run (the exact-terms fetch diet) returns ``topk_vals=None`` and its
+    ``topk_ids`` invalid slots read bucket 0 instead of -1 — only the
+    exact re-rank, which is insensitive to both (``_score_pack_wire``),
+    should consume such results.
     """
 
     df: np.ndarray            # [V] corpus DF (resident path: a device-
                               # resident jax.Array; np.asarray fetches)
-    topk_vals: np.ndarray     # [D, K] per-doc top-k TF-IDF scores
-    topk_ids: np.ndarray      # [D, K] matching vocab ids (-1 = no term)
+    topk_vals: Optional[np.ndarray]  # [D, K] top-k TF-IDF scores
+                                     # (None when wire_vals=False)
+    topk_ids: np.ndarray      # [D, K] matching vocab ids (-1 = no term;
+                              # bucket 0 stands in when wire_vals=False)
     lengths: np.ndarray       # [D] docSize per document
     names: List[str]
     num_docs: int
@@ -331,7 +378,8 @@ def make_chunk_packer(input_dir: str, cfg: PipelineConfig, chunk_docs: int,
 
 def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
                    chunk_docs: int = 8192, doc_len: Optional[int] = None,
-                   strict: bool = True, spill: str = "auto") -> IngestResult:
+                   strict: bool = True, spill: str = "auto",
+                   wire_vals: bool = True) -> IngestResult:
     """Stream a directory through the overlapped two-pass pipeline.
 
     ``doc_len`` fixes the static token length L for every chunk (defaults
@@ -345,6 +393,13 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
     ``"host"`` (RAM), ``"reread"`` (re-pack from disk), or ``"auto"``
     (RAM up to a budget). Device memory is flat in corpus size either
     way; see the module docstring.
+
+    ``wire_vals=False`` drops scores from the result wire on the
+    resident path: ``topk_vals`` comes back None and invalid id slots
+    read as bucket 0 — the exact-terms mode's fetch diet (the re-rank
+    reads only candidate buckets; see ``_score_pack_wire``). Advisory:
+    the streaming regime ignores it and returns full scores (a strict
+    superset of the contract).
 
     Requires HASHED vocab (fixed id space across chunks) and a top-k
     selection (full per-term output would defeat the streaming design).
@@ -419,14 +474,10 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
             # Sort + DF-fold this chunk NOW (async dispatch): the
             # transfer+sort runs behind the host's packing of the next
             # chunk, and the wire buffer is dead once consumed.
-            if flat_pack is not None:
-                i_, c_, h_, df_acc = _chunk_ragged(
-                    jax.device_put(flat), lens, df_acc, length=length,
-                    vocab_size=cfg.vocab_size)
-            else:
-                i_, c_, h_, df_acc = _chunk_sort_fold(
-                    jax.device_put(token_ids), lens, df_acc,
-                    vocab_size=cfg.vocab_size)
+            wire_arr = flat if flat_pack is not None else token_ids
+            i_, c_, h_, df_acc = _chunk_step(
+                jax.device_put(wire_arr), lens, df_acc, cfg, length,
+                ragged=flat_pack is not None)
             trip_i.append(i_)
             trip_c.append(c_)
             trip_h.append(h_)
@@ -434,19 +485,20 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
             ph["put"] += time.perf_counter() - t0
         t0 = time.perf_counter()
         wide = cfg.vocab_size > (1 << 16)
-        df_dev, wire = _score_pack_wire(
-            tuple(trip_i), tuple(trip_c), tuple(trip_h), tuple(len_parts),
-            df_acc, jnp.int32(num_docs), topk=k, score_dtype=score_dtype,
-            wide_ids=wide)
+        df_dev, wire = _finish_wire((trip_i, trip_c, trip_h), len_parts,
+                                    df_acc, num_docs, k, score_dtype, cfg,
+                                    wire_vals)
         # ONE unfenced fetch = one link round trip: drain + transfer.
         # DF stays on device (jax.Array acts array-like; np.asarray
         # fetches it on first real read — no hot-path consumer does).
         buf = np.asarray(jax.device_get(wire))
         ph["fetch"] = time.perf_counter() - t0
         d_padded = len(starts) * chunk_docs
-        vals, tids = _decode_wire(buf, d_padded, k, wide, score_dtype)
+        vals, tids = _decode_wire(buf, d_padded, k, wide, score_dtype,
+                                  include_vals=wire_vals)
         return IngestResult(df=df_dev,
-                            topk_vals=vals[:num_docs],
+                            topk_vals=(vals[:num_docs]
+                                       if vals is not None else None),
                             topk_ids=tids[:num_docs],
                             lengths=np.concatenate(all_lengths),
                             names=names, num_docs=num_docs,
@@ -594,21 +646,13 @@ def profile_resident(input_dir: str, config: Optional[PipelineConfig] = None,
     df_acc = jnp.zeros((cfg.vocab_size,), jnp.int32)
     trip_i, trip_c, trip_h = [], [], []
     for toks, lens in zip(tok_parts, len_parts):
-        if ragged:
-            i_, c_, h_, df_acc = _chunk_ragged(toks, lens, df_acc,
-                                               length=length,
-                                               vocab_size=cfg.vocab_size)
-        else:
-            i_, c_, h_, df_acc = _chunk_sort_fold(toks, lens, df_acc,
-                                                  vocab_size=cfg.vocab_size)
+        i_, c_, h_, df_acc = _chunk_step(toks, lens, df_acc, cfg, length,
+                                         ragged=ragged)
         trip_i.append(i_)
         trip_c.append(c_)
         trip_h.append(h_)
-    _, wire = _score_pack_wire(tuple(trip_i), tuple(trip_c), tuple(trip_h),
-                               tuple(len_parts), df_acc,
-                               jnp.int32(num_docs), topk=k,
-                               score_dtype=score_dtype,
-                               wide_ids=cfg.vocab_size > (1 << 16))
+    _, wire = _finish_wire((trip_i, trip_c, trip_h), len_parts, df_acc,
+                           num_docs, k, score_dtype, cfg, wire_vals=True)
     jax.block_until_ready(wire)
     ph["compute"] = time.perf_counter() - t0
 
