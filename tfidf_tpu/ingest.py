@@ -3,7 +3,7 @@
 The reference interleaves file IO and compute on the same rank, serially
 per document (``TFIDF.c:130-205``) — every byte of IO stalls compute.
 Here ingest is chunked and overlapped, shaped by the *measured* behavior
-of the link (tools/link_probe.py + the A/B sweeps behind BENCH_r03):
+of the link (tools/link_probe.py + tools/structure_sweep.py):
 ``device_put`` stages bytes and only moves them when a consuming program
 executes, and every D2H fetch costs ~100 ms of latency — so each chunk's
 program is dispatched the moment its wire buffer is staged (transfer +

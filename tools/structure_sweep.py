@@ -1,0 +1,101 @@
+"""A/B sweep of resident-ingest device structures on the real chip.
+
+The round-3 ingest design (per-chunk programs, ~4 chunks, ragged flat
+uint16 wire, single packed fetch) came out of this sweep; keep it
+runnable so future link/backend changes can be re-decided from
+measurements instead of lore. Variants, all computing the identical
+(df, scores, topk) result on the same synthetic batch:
+
+  fused-1xfer     one upload, one fused program      (round-2 design)
+  fused-Nxfer     chunked uploads, one fused program
+  chunked-N       per-chunk sort+fold programs + final score_pack
+                  (the round-3 production structure, via the SAME
+                  ingest call sites production uses)
+
+Interleave repeats across variants: the tunnel jitters +-20-40%, so
+sequential per-variant timing confounds drift with structure.
+
+    python tools/structure_sweep.py
+"""
+
+import functools
+import os
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tfidf_tpu.config import PipelineConfig, VocabMode
+from tfidf_tpu.ingest import _chunk_step, _finish_wire
+from tfidf_tpu.ops.sparse import sparse_forward
+
+D, L, V, K = 32768, 256, 1 << 16, 16
+REPEATS = 3
+
+
+@functools.partial(jax.jit, static_argnames=("vocab_size", "topk"))
+def _fused(token_ids, lengths, num_docs, *, vocab_size, topk):
+    df, vals, ids = sparse_forward(token_ids, lengths, num_docs,
+                                   vocab_size=vocab_size,
+                                   score_dtype=jnp.float32, topk=topk)
+    b = lambda a: jax.lax.bitcast_convert_type(a, jnp.uint8).reshape(-1)
+    return jnp.concatenate([b(df), b(vals), b(ids)])
+
+
+def run_fused(toks, lens, n_xfers):
+    chunk = D // n_xfers
+    parts = [jax.device_put(toks[s:s + chunk]) for s in range(0, D, chunk)]
+    lparts = [jax.device_put(lens[s:s + chunk]) for s in range(0, D, chunk)]
+    a = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+    b = lparts[0] if len(lparts) == 1 else jnp.concatenate(lparts, axis=0)
+    return np.asarray(jax.device_get(
+        _fused(a, b, jnp.int32(D), vocab_size=V, topk=K)))
+
+
+def run_chunked(toks, lens, n_chunks, cfg):
+    chunk = D // n_chunks
+    df = jnp.zeros((V,), jnp.int32)
+    ti, tc, th, tl = [], [], [], []
+    for s in range(0, D, chunk):
+        a = jax.device_put(toks[s:s + chunk])
+        b = jax.device_put(lens[s:s + chunk])
+        i_, c_, h_, df = _chunk_step(a, b, df, cfg, L, ragged=False)
+        ti.append(i_)
+        tc.append(c_)
+        th.append(h_)
+        tl.append(b)
+    _, wire = _finish_wire((ti, tc, th), tl, df, D, K, jnp.float32, cfg,
+                           wire_vals=True)
+    return np.asarray(jax.device_get(wire))
+
+
+def main():
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, V, (D, L)).astype(np.uint16)
+    lens = rng.integers(L // 2, L + 1, D).astype(np.int32)
+    cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=V,
+                         max_doc_len=L, doc_chunk=L, topk=K,
+                         engine="sparse")
+    variants = [("fused-1xfer", lambda: run_fused(toks, lens, 1)),
+                ("fused-16xfer", lambda: run_fused(toks, lens, 16)),
+                ("chunked-4", lambda: run_chunked(toks, lens, 4, cfg)),
+                ("chunked-16", lambda: run_chunked(toks, lens, 16, cfg))]
+    best = {name: float("inf") for name, _ in variants}
+    for name, fn in variants:
+        fn()  # compile
+    for _ in range(REPEATS):  # interleaved: drift hits all variants
+        for name, fn in variants:
+            t0 = time.perf_counter()
+            fn()
+            best[name] = min(best[name], time.perf_counter() - t0)
+    for name, _ in variants:
+        print(f"{name:>14}: {best[name]:.3f}s")
+
+
+if __name__ == "__main__":
+    main()
