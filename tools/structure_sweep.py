@@ -6,11 +6,13 @@ runnable so future link/backend changes can be re-decided from
 measurements instead of lore. Variants, all computing the identical
 (df, scores, topk) result on the same synthetic batch:
 
-  fused-1xfer     one upload, one fused program      (round-2 design)
-  fused-Nxfer     chunked uploads, one fused program
-  chunked-N       per-chunk sort+fold programs + final score_pack
-                  (the round-3 production structure, via the SAME
-                  ingest call sites production uses)
+  fused-1xfer       one upload, one fused program    (round-2 design)
+  fused-Nxfer       chunked uploads, one fused program
+  chunked-N         per-chunk sort+fold programs + final score_pack,
+                    padded [chunk, L] uploads
+  chunked-N-ragged  same programs on the ragged flat uint16 wire —
+                    the round-3 PRODUCTION structure, via the SAME
+                    ingest call sites production uses
 
 Interleave repeats across variants: the tunnel jitters +-20-40%, so
 sequential per-variant timing confounds drift with structure.
@@ -57,14 +59,25 @@ def run_fused(toks, lens, n_xfers):
         _fused(a, b, jnp.int32(D), vocab_size=V, topk=K)))
 
 
-def run_chunked(toks, lens, n_chunks, cfg):
+def run_chunked(toks, lens, n_chunks, cfg, ragged=False):
     chunk = D // n_chunks
     df = jnp.zeros((V,), jnp.int32)
     ti, tc, th, tl = [], [], [], []
+    bucket = 1 << 19  # ingest._FLAT_BUCKET
     for s in range(0, D, chunk):
-        a = jax.device_put(toks[s:s + chunk])
-        b = jax.device_put(lens[s:s + chunk])
-        i_, c_, h_, df = _chunk_step(a, b, df, cfg, L, ragged=False)
+        ctoks, clens = toks[s:s + chunk], lens[s:s + chunk]
+        if ragged:
+            # The production wire: flat stream, no padding bytes
+            # (ingest.make_flat_packer's python fallback, inlined).
+            mask = np.arange(L)[None, :] < clens[:, None]
+            flat = np.ascontiguousarray(ctoks[mask], dtype=np.uint16)
+            pad = max(flat.size + (-flat.size % bucket), bucket) - flat.size
+            wire_arr = np.pad(flat, (0, pad))
+        else:
+            wire_arr = ctoks
+        a = jax.device_put(wire_arr)
+        b = jax.device_put(clens)
+        i_, c_, h_, df = _chunk_step(a, b, df, cfg, L, ragged=ragged)
         ti.append(i_)
         tc.append(c_)
         th.append(h_)
@@ -84,7 +97,9 @@ def main():
     variants = [("fused-1xfer", lambda: run_fused(toks, lens, 1)),
                 ("fused-16xfer", lambda: run_fused(toks, lens, 16)),
                 ("chunked-4", lambda: run_chunked(toks, lens, 4, cfg)),
-                ("chunked-16", lambda: run_chunked(toks, lens, 16, cfg))]
+                ("chunked-16", lambda: run_chunked(toks, lens, 16, cfg)),
+                ("chunked-4-ragged",  # the production wire
+                 lambda: run_chunked(toks, lens, 4, cfg, ragged=True))]
     best = {name: float("inf") for name, _ in variants}
     for name, fn in variants:
         fn()  # compile
