@@ -153,12 +153,16 @@ def _finish_wire(trips, len_parts, df_acc, num_docs: int, k: int,
 def _resident_chunking(num_docs: int, chunk_docs: int):
     """Resident-path chunk rule, shared by :func:`run_overlapped` and
     :func:`profile_resident` so the profiler always measures the same
-    program structure production dispatches. Caps the chunk count at
-    32: every chunk costs a program dispatch through the tunnel (~8 ms
-    each, measured) and a slot in the final program's arg list."""
+    program structure production dispatches. Caps the chunk count
+    (default 32, ``TFIDF_TPU_MAX_CHUNKS``): every chunk costs a program
+    dispatch through the tunnel (~8 ms each, measured) and a slot in
+    the final program's arg list — but staging cost grows superlinearly
+    with chunk bytes on this link, so very large corpora may tune this
+    up."""
+    cap = max(1, int(os.environ.get("TFIDF_TPU_MAX_CHUNKS", 32)))
     starts = list(range(0, num_docs, chunk_docs))
-    if len(starts) > 32:
-        chunk_docs = -(-num_docs // 32)
+    if len(starts) > cap:
+        chunk_docs = -(-num_docs // cap)
         chunk_docs += -chunk_docs % 256
         starts = list(range(0, num_docs, chunk_docs))
     return chunk_docs, starts

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from tfidf_tpu.config import PipelineConfig, VocabMode
-from tfidf_tpu.ingest import _chunk_step, _finish_wire
+from tfidf_tpu.ingest import _FLAT_BUCKET, _chunk_step, _finish_wire
 from tfidf_tpu.ops.sparse import sparse_forward
 
 D, L, V, K = 32768, 256, 1 << 16, 16
@@ -63,7 +63,7 @@ def run_chunked(toks, lens, n_chunks, cfg, ragged=False):
     chunk = D // n_chunks
     df = jnp.zeros((V,), jnp.int32)
     ti, tc, th, tl = [], [], [], []
-    bucket = 1 << 19  # ingest._FLAT_BUCKET
+    bucket = _FLAT_BUCKET  # the production pad granularity, not a copy
     for s in range(0, D, chunk):
         ctoks, clens = toks[s:s + chunk], lens[s:s + chunk]
         if ragged:
