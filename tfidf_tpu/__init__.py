@@ -28,6 +28,7 @@ from tfidf_tpu.config import PipelineConfig, VocabMode, TokenizerKind
 from tfidf_tpu.pipeline import TfidfPipeline, PipelineResult
 from tfidf_tpu.io.corpus import Corpus, discover_corpus, PackedBatch
 from tfidf_tpu.ingest import IngestResult, run_overlapped
+from tfidf_tpu.rerank import exact_topk
 
 __version__ = "0.1.0"
 
@@ -42,5 +43,6 @@ __all__ = [
     "PackedBatch",
     "IngestResult",
     "run_overlapped",
+    "exact_topk",
     "__version__",
 ]
