@@ -237,6 +237,25 @@ class TestFlatPacker:
         np.testing.assert_allclose(got.topk_vals, ref.topk_vals, rtol=1e-6)
 
 
+class TestProfilerCacheSharing:
+    def test_profiler_adds_no_compiles(self, corpus_dir):
+        # profile_resident must dispatch the EXACT programs production
+        # compiled — a second cache entry for the final program cost
+        # ~104 s of silent XLA recompile per bench run before the
+        # shared call sites (_chunk_step/_finish_wire) fixed it.
+        import tfidf_tpu.ingest as ing
+        if not hasattr(ing._score_pack_wire, "_cache_size"):
+            pytest.skip("jax jit cache introspection unavailable")
+        cfg = _cfg()
+        ing.run_overlapped(corpus_dir, cfg, chunk_docs=16, doc_len=64)
+        before = (ing._score_pack_wire._cache_size(),
+                  ing._chunk_ragged._cache_size())
+        ing.profile_resident(corpus_dir, cfg, chunk_docs=16, doc_len=64)
+        after = (ing._score_pack_wire._cache_size(),
+                 ing._chunk_ragged._cache_size())
+        assert after == before, "profiler compiled new programs"
+
+
 class TestPathReporting:
     def test_result_reports_regime(self, corpus_dir, monkeypatch):
         cfg = _cfg()
