@@ -238,7 +238,7 @@ class TestFlatPacker:
 
 
 class TestProfilerCacheSharing:
-    def test_profiler_adds_no_compiles(self, corpus_dir):
+    def test_profiler_adds_no_compiles(self, corpus_dir, monkeypatch):
         # profile_resident must dispatch the EXACT programs production
         # compiled — a second cache entry for the final program cost
         # ~104 s of silent XLA recompile per bench run before the
@@ -246,6 +246,9 @@ class TestProfilerCacheSharing:
         import tfidf_tpu.ingest as ing
         if not hasattr(ing._score_pack_wire, "_cache_size"):
             pytest.skip("jax jit cache introspection unavailable")
+        # Pin the resident regime: an inherited TFIDF_TPU_RESIDENT_ELEMS
+        # would route run_overlapped to streaming and fail spuriously.
+        monkeypatch.delenv("TFIDF_TPU_RESIDENT_ELEMS", raising=False)
         cfg = _cfg()
         ing.run_overlapped(corpus_dir, cfg, chunk_docs=16, doc_len=64)
         before = (ing._score_pack_wire._cache_size(),
