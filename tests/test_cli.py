@@ -85,6 +85,23 @@ class TestCli:
         assert rc == 0
         assert out2.read_bytes() == out.read_bytes()
 
+    def test_mesh_composes_with_overlapped_ingest(self, toy_corpus_dir,
+                                                  tmp_path):
+        # Round 4: --mesh + --doc-len run the docs-sharded overlapped
+        # ingest (ingest._run_overlapped_mesh) — same bytes as the
+        # single-device overlapped run.
+        single, mesh = tmp_path / "single.txt", tmp_path / "mesh.txt"
+        base = ["run", "--input", toy_corpus_dir,
+                "--vocab-mode", "hashed", "--vocab-size", "4096",
+                "--topk", "2", "--doc-len", "64", "--chunk-docs", "4"]
+        assert main(base + ["--output", str(single)]) == 0
+        assert main(base + ["--output", str(mesh),
+                            "--mesh", "4,1,1"]) == 0
+        assert mesh.read_bytes() == single.read_bytes()
+        # seq/vocab meshes cannot ride the ingest path: refuse loudly.
+        assert main(base + ["--output", str(mesh),
+                            "--mesh", "2,1,2"]) == 2
+
     def test_sharded_mesh_flag(self, toy_corpus_dir, tmp_path):
         out = tmp_path / "out.txt"
         rc = main(["run", "--input", toy_corpus_dir, "--output", str(out),

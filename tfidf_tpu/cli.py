@@ -193,17 +193,34 @@ def _run_tpu(args) -> int:
         return 2
     # (a defaulted engine is always "sparse" under HASHED vocab, so
     # checking the resolved value covers both spellings)
+    # --mesh composes with --doc-len for docs-only meshes: the
+    # overlapped ingest runs docs-sharded under shard_map with the DF
+    # fold as one psum (ingest._run_overlapped_mesh). seq/vocab meshes
+    # stay on the batch path (sparse-engine doctrine).
+    mesh_ok = (not mesh_shape
+               or (mesh_shape.get("seq", 1) == 1
+                   and mesh_shape.get("vocab", 1) == 1))
     overlapped = (args.doc_len is not None
                   and cfg.vocab_mode is VocabMode.HASHED
                   and cfg.topk is not None
                   and cfg.tokenizer is TokenizerKind.WHITESPACE
-                  and not mesh_shape and not args.pallas
+                  and mesh_ok and not args.pallas
                   and cfg.engine == "sparse")
     if overlapped:
         import time
         import types
 
         from tfidf_tpu.ingest import run_overlapped
+        plan = None
+        if mesh_shape:
+            import jax
+
+            from tfidf_tpu.parallel.mesh import MeshPlan
+            # Like `query --mesh-docs`: docs=N takes the first N
+            # devices (0 = all), so a sub-mesh works on any host.
+            n = mesh_shape.get("docs", 0)
+            plan = MeshPlan.create(docs=n,
+                                   devices=jax.devices()[:n] if n else None)
         t0 = time.perf_counter()
         # Exact-terms runs read only candidate buckets from the device,
         # so they take the ids-only wire (no score fetch bytes).
@@ -211,7 +228,7 @@ def _run_tpu(args) -> int:
                            chunk_docs=args.chunk_docs or 8192,
                            strict=not args.no_strict,
                            spill=args.spill or "auto",
-                           wire_vals=not exact_terms)
+                           wire_vals=not exact_terms, plan=plan)
         throughput.record(r.num_docs, time.perf_counter() - t0)
         result = types.SimpleNamespace(
             num_docs=r.num_docs, names=r.names, df=r.df,
@@ -222,8 +239,9 @@ def _run_tpu(args) -> int:
     elif args.doc_len is not None:
         sys.stderr.write("error: --doc-len (overlapped ingest) needs "
                          "--vocab-mode hashed, --topk, the whitespace "
-                         "tokenizer, the sparse engine, no --mesh, and "
-                         "no --pallas\n")
+                         "tokenizer, the sparse engine, no --pallas, "
+                         "and a docs-only --mesh (seq=1, vocab=1) if "
+                         "any\n")
         return 2
     else:
         with phase_or_null(timer, "discover"):

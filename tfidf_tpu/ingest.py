@@ -37,7 +37,7 @@ import dataclasses
 import functools
 import os
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import TYPE_CHECKING, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -50,6 +50,9 @@ from tfidf_tpu.io.corpus import discover_names, pack_corpus
 from tfidf_tpu.ops.scoring import idf_from_df
 from tfidf_tpu.ops.sparse import (sorted_term_counts, sparse_df,
                                   sparse_forward, sparse_scores, sparse_topk)
+
+if TYPE_CHECKING:  # parallel imports stay lazy for single-device runs
+    from tfidf_tpu.parallel.mesh import MeshPlan
 
 # spill="auto": keep packed chunks in host RAM up to this many bytes,
 # re-read from disk beyond. Read at call time (TFIDF_TPU_SPILL_BYTES)
@@ -138,6 +141,176 @@ def _chunk_step(wire_arr, lens, df_acc, cfg: PipelineConfig, length: int,
                              vocab_size=cfg.vocab_size)
     return _chunk_sort_fold(wire_arr, lens, df_acc,
                             vocab_size=cfg.vocab_size)
+
+
+# --- mesh (multi-chip) resident ingest -------------------------------
+#
+# The composition of the two flagship paths (VERDICT r3 item 1): the
+# overlapped chunked ingest running over a docs-sharded device mesh —
+# the TPU-native form of the reference's distributed ingest, where
+# every rank independently processes its own document shard
+# (TFIDF.c:130-138). Docs axis only, the sparse-engine doctrine
+# (parallel/collectives.make_sparse_sharded_forward): row sorting is
+# doc-local, and the [V] DF vector is cheap to replicate.
+#
+# DF protocol: each shard folds its own partial DF into its row of a
+# [S, V] docs-sharded accumulator — the per-chunk step has NO
+# collective. The finish program performs the run's single lax.psum
+# (the reference's entire Phase 2, TFIDF.c:215-220) and scores each
+# shard's resident triples against the corpus-wide IDF.
+
+@functools.lru_cache(maxsize=32)
+def _mesh_chunk_step_fn(plan: "MeshPlan", vocab_size: int):
+    from jax.sharding import PartitionSpec as P
+
+    from tfidf_tpu.parallel.mesh import DOCS_AXIS
+
+    def body(tokens, lengths, df_part):
+        # Blocks: tokens [Dl, L], lengths [Dl], df_part [1, V] (this
+        # shard's row of the partial-DF accumulator).
+        ids, counts, head = sorted_term_counts(tokens, lengths)
+        return ids, counts, head, \
+            df_part + sparse_df(ids, head, vocab_size)[None, :]
+
+    sharded = (P(DOCS_AXIS, None), P(DOCS_AXIS), P(DOCS_AXIS, None))
+    mapped = jax.shard_map(body, mesh=plan.mesh, in_specs=sharded,
+                           out_specs=(P(DOCS_AXIS, None),) * 4)
+    return jax.jit(mapped)
+
+
+@functools.lru_cache(maxsize=32)
+def _mesh_finish_fn(plan: "MeshPlan", n_chunks: int, topk: int, score_dtype):
+    from jax.sharding import PartitionSpec as P
+
+    from tfidf_tpu.parallel.mesh import DOCS_AXIS
+
+    def body(trip_i, trip_c, trip_h, lens_parts, df_part, num_docs):
+        cat = (lambda parts: parts[0] if len(parts) == 1
+               else jnp.concatenate(parts, axis=0))
+        ids, counts, head = cat(trip_i), cat(trip_c), cat(trip_h)
+        lengths = cat(lens_parts)
+        # THE one collective of the whole run (reference Phase 2:
+        # reduce-then-rebroadcast == allreduce, TFIDF.c:215-220).
+        df_total = lax.psum(df_part[0], DOCS_AXIS)
+        idf = idf_from_df(df_total, num_docs, score_dtype)
+        scores = sparse_scores(ids, counts, head, lengths, idf)
+        vals, tids = sparse_topk(scores, ids, head, topk)
+        return df_total, vals, tids
+
+    chunks = lambda spec: (spec,) * n_chunks
+    in_specs = (chunks(P(DOCS_AXIS, None)), chunks(P(DOCS_AXIS, None)),
+                chunks(P(DOCS_AXIS, None)), chunks(P(DOCS_AXIS)),
+                P(DOCS_AXIS, None), P())
+    # df_total is replicated by the psum — out_spec P(); vals/ids stay
+    # docs-sharded. check_vma=False: the static replication checker
+    # cannot infer the psum-made replication.
+    out_specs = (P(), P(DOCS_AXIS, None), P(DOCS_AXIS, None))
+    mapped = jax.shard_map(body, mesh=plan.mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_vma=False)
+    return jax.jit(mapped)
+
+
+def _run_overlapped_mesh(input_dir: str, cfg: PipelineConfig,
+                         plan: "MeshPlan", chunk_docs: int, length: int,
+                         names: List[str],
+                         wire_vals: bool = True) -> IngestResult:
+    """Resident overlapped ingest over a docs-sharded device mesh.
+
+    Same overlap structure as the single-device resident path — the
+    host packs chunk i+1 while chunk i's sharded upload + sort is in
+    flight — but every program runs under ``shard_map``: each shard
+    sorts only its own document rows and folds only its own DF
+    partial. The wire is the PADDED [chunk, L] batch (not the ragged
+    flat stream): a block-sharded ``device_put`` sends each device
+    exactly its rows, where a flat ragged stream cannot split evenly
+    without per-shard sub-wires.
+
+    Value contract: identical outputs to the single-device resident
+    path on the same corpus (df exact, topk ids exact, scores same
+    float ops) — pinned by tests/test_ingest.py.
+    """
+    if plan.n_seq_shards != 1 or plan.n_vocab_shards != 1:
+        raise ValueError("mesh ingest shards the docs axis only; build "
+                         "the MeshPlan with seq=1, vocab=1 (sparse-engine "
+                         "doctrine)")
+    num_docs = len(names)
+    score_dtype = jax.dtypes.canonicalize_dtype(jnp.dtype(cfg.score_dtype))
+    k = min(cfg.topk, length)
+    shards = plan.n_docs_shards
+
+    chunk_docs, _ = _resident_chunking(num_docs, chunk_docs)
+    chunk_docs += -chunk_docs % shards  # rows must block-shard evenly
+    starts = list(range(0, num_docs, chunk_docs))
+    _check_chunk_fits_int32(chunk_docs, length)
+    pack_chunk = make_chunk_packer(input_dir, cfg, chunk_docs, length)
+
+    from jax.sharding import PartitionSpec as P
+
+    from tfidf_tpu.parallel.mesh import DOCS_AXIS
+
+    step = _mesh_chunk_step_fn(plan, cfg.vocab_size)
+    batch_sh = plan.sharding(P(DOCS_AXIS, None))
+    lens_sh = plan.sharding(plan.lengths_spec())
+
+    ph = {"pack": 0.0, "put": 0.0}
+    df_acc = jax.device_put(np.zeros((shards, cfg.vocab_size), np.int32),
+                            batch_sh)
+    trip_i, trip_c, trip_h, len_parts, all_lengths = [], [], [], [], []
+    for start in starts:
+        chunk_names = names[start:start + chunk_docs]
+        t0 = time.perf_counter()
+        token_ids, lengths = pack_chunk(chunk_names)
+        ph["pack"] += time.perf_counter() - t0
+        all_lengths.append(lengths[:len(chunk_names)])
+        t0 = time.perf_counter()
+        lens = jax.device_put(lengths, lens_sh)
+        i_, c_, h_, df_acc = step(
+            jax.device_put(token_ids, batch_sh), lens, df_acc)
+        trip_i.append(i_)
+        trip_c.append(c_)
+        trip_h.append(h_)
+        len_parts.append(lens)
+        ph["put"] += time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    finish = _mesh_finish_fn(plan, len(starts), k, score_dtype)
+    df_dev, vals, tids = finish(tuple(trip_i), tuple(trip_c), tuple(trip_h),
+                                tuple(len_parts), df_acc,
+                                jnp.int32(num_docs))
+    # wire_vals=False (the exact-terms fetch diet): the re-rank reads
+    # only candidate buckets, so the [D, K] float scores stay on
+    # device — same contract as _score_pack_wire's ids-only wire,
+    # except invalid slots keep their -1 (no bucket-0 stand-in).
+    if wire_vals:
+        vals, tids = jax.device_get((vals, tids))
+    else:
+        vals, tids = None, jax.device_get(tids)
+    ph["fetch"] = time.perf_counter() - t0
+
+    # The sharded outputs come back shard-major (shard s's chunks are
+    # contiguous); restore the chunk-major document order the names
+    # list uses. dl = rows per shard per chunk.
+    n_chunks, dl = len(starts), chunk_docs // shards
+    reorder = (lambda a: a.reshape(shards, n_chunks, dl, -1)
+               .transpose(1, 0, 2, 3).reshape(n_chunks * chunk_docs, -1))
+    vals = reorder(vals) if vals is not None else None
+    tids = reorder(tids)
+    return IngestResult(df=df_dev,
+                        topk_vals=(vals[:num_docs]
+                                   if vals is not None else None),
+                        topk_ids=tids[:num_docs],
+                        lengths=np.concatenate(all_lengths), names=names,
+                        num_docs=num_docs, path="resident-mesh", phases=ph)
+
+
+def _check_chunk_fits_int32(chunk_docs: int, length: int) -> None:
+    """Flat-offset overflow guard (advisor r3): ``_ragged_to_padded``
+    builds int32 offsets, so a single chunk must hold < 2^31 ids."""
+    if chunk_docs * length >= (1 << 31):
+        raise ValueError(
+            f"chunk of {chunk_docs} docs x {length} tokens overflows "
+            f"int32 flat offsets; lower --chunk-docs or raise "
+            f"TFIDF_TPU_MAX_CHUNKS")
 
 
 def _finish_wire(trips, len_parts, df_acc, num_docs: int, k: int,
@@ -331,7 +504,8 @@ class IngestResult:
     lengths: np.ndarray       # [D] docSize per document
     names: List[str]
     num_docs: int
-    path: str = ""            # which regime ran: "resident" | "streaming"
+    path: str = ""            # regime: "resident" | "streaming" |
+                              # "resident-mesh" (docs-sharded mesh)
     # Wall-clock phase breakdown of the run (seconds). Overlapped phases
     # don't sum to the wall. Resident path: "pack" (synchronous host
     # packing), "put" (upload/dispatch staging), "fetch" (the single
@@ -383,7 +557,8 @@ def make_chunk_packer(input_dir: str, cfg: PipelineConfig, chunk_docs: int,
 def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
                    chunk_docs: int = 8192, doc_len: Optional[int] = None,
                    strict: bool = True, spill: str = "auto",
-                   wire_vals: bool = True) -> IngestResult:
+                   wire_vals: bool = True,
+                   plan: Optional["MeshPlan"] = None) -> IngestResult:
     """Stream a directory through the overlapped two-pass pipeline.
 
     ``doc_len`` fixes the static token length L for every chunk (defaults
@@ -403,7 +578,14 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
     read as bucket 0 — the exact-terms mode's fetch diet (the re-rank
     reads only candidate buckets; see ``_score_pack_wire``). Advisory:
     the streaming regime ignores it and returns full scores (a strict
-    superset of the contract).
+    superset of the contract); the mesh path honors it but keeps -1
+    in invalid id slots (no bucket-0 stand-in).
+
+    ``plan`` (a ``parallel.mesh.MeshPlan``, docs axis only) runs the
+    resident path docs-sharded over the device mesh — each shard sorts
+    its own rows, DF partials fold shard-locally, and the finish
+    program's single ``lax.psum`` is the run's only collective
+    (``_run_overlapped_mesh``).
 
     Requires HASHED vocab (fixed id space across chunks) and a top-k
     selection (full per-term output would defeat the streaming design).
@@ -418,6 +600,23 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
     if spill not in ("auto", "host", "reread"):
         raise ValueError(f"unknown spill policy {spill!r}")
     length = doc_len or cfg.max_doc_len
+    if plan is not None:
+        # Multi-chip composition: route to the docs-sharded resident
+        # path. Per-shard HBM holds corpus/S, so the resident budget
+        # scales with the docs-shard count.
+        resident = int(os.environ.get("TFIDF_TPU_RESIDENT_ELEMS",
+                                      _RESIDENT_ELEMS))
+        mesh_names = discover_names(input_dir, strict)
+        if not mesh_names:
+            raise ValueError(f"no documents in {input_dir}")
+        if len(mesh_names) * length > resident * plan.n_docs_shards:
+            raise ValueError(
+                f"corpus ({len(mesh_names)} docs x {length}) exceeds the "
+                f"mesh-resident budget ({resident} elems x "
+                f"{plan.n_docs_shards} shards); stream it single-device "
+                f"or raise TFIDF_TPU_RESIDENT_ELEMS")
+        return _run_overlapped_mesh(input_dir, cfg, plan, chunk_docs,
+                                    length, mesh_names, wire_vals)
     names = discover_names(input_dir, strict)
     num_docs = len(names)
     if num_docs == 0:
@@ -439,6 +638,7 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
                                     _DEFAULT_SPILL_BYTES))
         spill = "host" if est <= budget else "reread"
 
+    _check_chunk_fits_int32(chunk_docs, length)
     pack_chunk = make_chunk_packer(input_dir, cfg, chunk_docs, length)
     starts = list(range(0, num_docs, chunk_docs))
 
@@ -458,6 +658,7 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
             chunk_docs = new_chunk
             pack_chunk = make_chunk_packer(input_dir, cfg, chunk_docs,
                                            length)
+        _check_chunk_fits_int32(chunk_docs, length)
         flat_pack = (make_flat_packer(input_dir, cfg, chunk_docs, length)
                      if cfg.vocab_size <= (1 << 16) else None)
 
