@@ -210,7 +210,11 @@ def bench_exact(input_dir: str):
                          max_doc_len=DOC_LEN, doc_chunk=DOC_LEN,
                          topk=MARGIN, engine="sparse")
     chunk = max(2048, N_DOCS // 4)
-    run_overlapped(input_dir, cfg, chunk_docs=chunk, doc_len=DOC_LEN)  # warm
+    # Warm the ids-only program specifically: include_vals is a static
+    # jit arg, so warming the full wire would leave the timed loop's
+    # first repeat paying a fresh compile.
+    run_overlapped(input_dir, cfg, chunk_docs=chunk, doc_len=DOC_LEN,
+                   wire_vals=False)
     best = float("inf")
     for _ in range(max(REPEATS, 1)):  # best-of-N, same N as other sides
         t0 = time.perf_counter()
@@ -283,6 +287,33 @@ def main() -> None:
 
         cpu_dps = N_DOCS / cpu_s
         tpu_dps = N_DOCS / tpu_s
+        # The chip-ceiling numbers, first-class in the artifact
+        # (VERDICT r3 item 2): the fenced serialized phases separate
+        # what the DEVICE does (compute) from what the tunneled link
+        # and 1-core host cost (pack/upload/fetch). device_docs_per_sec
+        # is the measured per-chip rate behind docs/SCALING.md's
+        # "50x story"; link_tax_s is the transfer cost the tunnel
+        # imposes that PCIe/DMA hardware would not.
+        ser = phases.get("serialized", {})
+        if ser.get("compute"):
+            dev_dps = N_DOCS / ser["compute"]
+            record["device_docs_per_sec"] = round(dev_dps, 1)
+            record["link_tax_s"] = round(ser.get("upload", 0.0)
+                                         + ser.get("fetch", 0.0), 3)
+            record["north_star_projection"] = {
+                # measured: one chip's fenced compute vs the measured
+                # 8-worker CPU oracle on this host
+                "per_chip_device_ratio": round(dev_dps / cpu_dps, 1),
+                # docs-axis mesh overhead measured ~1.0 on the 8-way
+                # virtual mesh (tools/mesh_overhead.py): 8 chips of a
+                # v4-8 project linearly; the oracle is generously
+                # scaled 8x too (1 core here -> 8 real cores), so the
+                # projected ratio equals the per-chip device ratio.
+                "v4_8_device_docs_per_sec": round(8 * dev_dps, 1),
+                "v4_8_ratio_vs_8core_oracle": round(dev_dps / cpu_dps, 1),
+                "basis": "serialized.compute (fenced, warm); "
+                         "docs/SCALING.md '50x story'",
+            }
         record.update(
             value=round(tpu_dps, 1),
             vs_baseline=round(tpu_dps / cpu_dps, 2),
