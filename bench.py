@@ -224,7 +224,8 @@ def bench_exact(input_dir: str):
                                 doc_len=DOC_LEN, wire_vals=False)
         reranked = exact_topk(input_dir, result.names, result.topk_ids,
                               result.num_docs, cfg, k=TOPK,
-                              max_tokens=DOC_LEN, df=result.df)
+                              max_tokens=DOC_LEN,
+                              df_occupied=result.df_occupied)
         best = min(best, time.perf_counter() - t0)
     return best, reranked
 

@@ -30,13 +30,18 @@ def _cfg(**kw):
     return PipelineConfig(**base)
 
 
-@pytest.fixture(params=["resident", "streaming"])
+@pytest.fixture(params=["resident", "streaming", "streaming-cached"])
 def ingest_path(request, monkeypatch):
-    """Run the test under both run_overlapped regimes: the fused
-    resident path (default at test sizes) and the two-pass streaming
-    path (forced by zeroing the resident threshold)."""
-    if request.param == "streaming":
+    """Run the test under the run_overlapped regimes: the fused
+    resident path (default at test sizes), the pure two-pass streaming
+    path (resident threshold zeroed, triple cache zeroed), and
+    streaming with the device triple cache (pass B scores pass A's
+    resident triples — the round-4 default)."""
+    if request.param.startswith("streaming"):
         monkeypatch.setenv("TFIDF_TPU_RESIDENT_ELEMS", "0")
+        monkeypatch.setenv(
+            "TFIDF_TPU_TRIPLE_CACHE_BYTES",
+            "0" if request.param == "streaming" else str(4 << 30))
     return request.param
 
 
@@ -112,8 +117,10 @@ class TestOverlappedIngest:
             run_overlapped(corpus_dir, _cfg(), spill="bogus")
 
     def test_spill_modes_agree(self, corpus_dir, monkeypatch):
-        # Spill only matters on the streaming path; force it.
+        # Spill only matters on the streaming path with the triple
+        # cache off (cached chunks never touch the spill store).
         monkeypatch.setenv("TFIDF_TPU_RESIDENT_ELEMS", "0")
+        monkeypatch.setenv("TFIDF_TPU_TRIPLE_CACHE_BYTES", "0")
         cfg = _cfg()
         host = run_overlapped(corpus_dir, cfg, chunk_docs=16, doc_len=64,
                               spill="host")
@@ -131,6 +138,7 @@ class TestOverlappedIngest:
         if not hasattr(mod._phase_a, "_cache_size"):
             pytest.skip("jit cache-size introspection unavailable")
         monkeypatch.setenv("TFIDF_TPU_RESIDENT_ELEMS", "0")  # streaming
+        monkeypatch.setenv("TFIDF_TPU_TRIPLE_CACHE_BYTES", "0")
         cfg = _cfg()
         run_overlapped(corpus_dir, cfg, chunk_docs=8, doc_len=64)  # 5 chunks
         a0 = mod._phase_a._cache_size()
@@ -139,6 +147,49 @@ class TestOverlappedIngest:
         # One new entry per phase at most (the new [2, L] chunk shape).
         assert mod._phase_a._cache_size() <= a0 + 1
         assert mod._phase_b._cache_size() <= b0 + 1
+
+
+class TestTripleCache:
+    """Round 4 (VERDICT r3 item 5): pass-A triples stay device-resident
+    up to TFIDF_TPU_TRIPLE_CACHE_BYTES; pass B re-sorts nothing for
+    cached chunks. Values must not depend on how many chunks fit."""
+
+    def test_partial_cache_equals_uncached(self, corpus_dir, monkeypatch):
+        monkeypatch.setenv("TFIDF_TPU_RESIDENT_ELEMS", "0")
+        cfg = _cfg()
+        monkeypatch.setenv("TFIDF_TPU_TRIPLE_CACHE_BYTES", "0")
+        plain = run_overlapped(corpus_dir, cfg, chunk_docs=16, doc_len=64)
+        assert plain.phases["triple_cached_chunks"] == 0
+        # Budget for exactly one 16x64 chunk (9 B/slot + 4 B/len):
+        # chunk 1 rides the cache, chunks 2-3 take the two-pass flow.
+        monkeypatch.setenv("TFIDF_TPU_TRIPLE_CACHE_BYTES",
+                           str(16 * 64 * 9 + 16 * 4))
+        partial = run_overlapped(corpus_dir, cfg, chunk_docs=16, doc_len=64)
+        assert partial.phases["triple_cached_chunks"] == 1
+        monkeypatch.setenv("TFIDF_TPU_TRIPLE_CACHE_BYTES", str(1 << 30))
+        full = run_overlapped(corpus_dir, cfg, chunk_docs=16, doc_len=64)
+        assert full.phases["triple_cached_chunks"] == 3
+        for got in (partial, full):
+            np.testing.assert_array_equal(plain.df, got.df)
+            np.testing.assert_array_equal(plain.topk_ids, got.topk_ids)
+            np.testing.assert_allclose(plain.topk_vals, got.topk_vals,
+                                       rtol=1e-6)
+
+    def test_cache_skips_host_spill_copy(self, corpus_dir, monkeypatch):
+        # A triple-cached chunk must not also hold a spill="host" copy
+        # (the cache replaces the host RAM cost, not adds to it) — and
+        # the spill modes must still agree when only SOME chunks cache.
+        monkeypatch.setenv("TFIDF_TPU_RESIDENT_ELEMS", "0")
+        monkeypatch.setenv("TFIDF_TPU_TRIPLE_CACHE_BYTES",
+                           str(16 * 64 * 9 + 16 * 4))
+        cfg = _cfg()
+        host = run_overlapped(corpus_dir, cfg, chunk_docs=16, doc_len=64,
+                              spill="host")
+        reread = run_overlapped(corpus_dir, cfg, chunk_docs=16, doc_len=64,
+                                spill="reread")
+        np.testing.assert_array_equal(host.topk_ids, reread.topk_ids)
+        np.testing.assert_array_equal(np.asarray(host.df),
+                                      np.asarray(reread.df))
 
 
 class TestResidentFusedPath:
@@ -229,7 +280,7 @@ class TestFlatPacker:
         # single-batch reference.
         cfg = _cfg(vocab_size=1 << 17)
         got = run_overlapped(corpus_dir, cfg, chunk_docs=16, doc_len=64)
-        assert got.path == ingest_path
+        assert got.path == ingest_path.split("-")[0]  # regime, not cache
         ref = TfidfPipeline(cfg).run_packed(
             pack_corpus(discover_corpus(corpus_dir), cfg, want_words=False))
         np.testing.assert_array_equal(np.asarray(got.df), ref.df)
@@ -344,3 +395,22 @@ class TestMeshIngest:
         with pytest.raises(ValueError, match="int32"):
             run_overlapped(corpus_dir, _cfg(), chunk_docs=1 << 22,
                            doc_len=1 << 10)
+
+
+class TestOccupancyWire:
+    def test_df_occupied_matches_df(self, corpus_dir, ingest_path):
+        # The 4-byte wire tail (margin_check's feed) must equal the
+        # true occupied-bucket count of the DF vector on every regime.
+        got = run_overlapped(corpus_dir, _cfg(), chunk_docs=16, doc_len=64)
+        assert got.df_occupied == int((np.asarray(got.df) > 0).sum())
+
+    def test_df_occupied_on_mesh(self, corpus_dir):
+        import jax
+
+        from tfidf_tpu.parallel.mesh import MeshPlan
+        plan = MeshPlan.create(docs=4, devices=jax.devices()[:4])
+        for wire_vals in (True, False):
+            got = run_overlapped(corpus_dir, _cfg(), chunk_docs=16,
+                                 doc_len=64, plan=plan,
+                                 wire_vals=wire_vals)
+            assert got.df_occupied == int((np.asarray(got.df) > 0).sum())

@@ -232,7 +232,8 @@ def _run_tpu(args) -> int:
         throughput.record(r.num_docs, time.perf_counter() - t0)
         result = types.SimpleNamespace(
             num_docs=r.num_docs, names=r.names, df=r.df,
-            topk_vals=r.topk_vals, topk_ids=r.topk_ids, id_to_word={})
+            topk_vals=r.topk_vals, topk_ids=r.topk_ids, id_to_word={},
+            df_occupied=r.df_occupied)
         if timer is not None and r.phases:
             for name, secs in r.phases.items():
                 timer.add(name, secs)
@@ -261,9 +262,14 @@ def _run_tpu(args) -> int:
             # mirrors the ingest truncation when --doc-len routed the
             # run through it — candidate/TF parity with what the device
             # actually scored (rerank.py docstring).
+            # Overlapped runs hand over the wire's occupancy scalar so
+            # the warning never fetches the device-resident DF vector.
+            occ = getattr(result, "df_occupied", None)
             reranked = exact_topk(args.input, result.names,
                                   result.topk_ids, result.num_docs, cfg,
-                                  k=args.topk, df=result.df,
+                                  k=args.topk,
+                                  df=None if occ is not None else result.df,
+                                  df_occupied=occ,
                                   max_tokens=args.doc_len if overlapped
                                   else None)
             lines = [b"%s@%s\t%.16f" % (name.encode(), w, s)

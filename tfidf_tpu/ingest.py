@@ -124,6 +124,27 @@ def _phase_b_ragged(flat, lengths, idf, *, length: int, topk: int):
     return sparse_topk(scores, ids, head, topk)
 
 
+# Pass-B kernel for triple-cached chunks: score pre-sorted triples
+# against the final IDF — no re-pack, no upload, no re-sort. The
+# device-side answer to the reference's two-scan idiom
+# (``TFIDF.c:141-147``): scan once, keep the sorted form.
+@functools.partial(jax.jit, static_argnames=("topk",))
+def _phase_b_cached(ids, counts, head, lengths, idf, *, topk: int):
+    scores = sparse_scores(ids, counts, head, lengths, idf)
+    return sparse_topk(scores, ids, head, topk)
+
+
+# Streaming triple cache budget: pass A keeps each chunk's sorted
+# triples (ids+counts int32 + head bool = 9 B/slot) device-resident up
+# to this many bytes, so pass B re-derives nothing for cached chunks.
+# Past the budget the regime degrades gracefully to the pure two-pass
+# flow — device memory stays bounded at budget + in-flight chunks.
+# Default 4 GiB: a quarter of a v4/v5e chip's HBM, leaving the wire
+# buffers and sort workspace ample room (the 1M x 256 corpus measured
+# 2.3 GB of triples, docs/SCALING.md).
+_TRIPLE_CACHE_BYTES = 4 << 30
+
+
 # Flat-stream padding granularity: chunks' flat sizes are rounded up to
 # this many ids so XLA sees a handful of shapes (compile cache), not one
 # per chunk. 2^19 u16 ids = 1 MB on the wire.
@@ -280,11 +301,13 @@ def _run_overlapped_mesh(input_dir: str, cfg: PipelineConfig,
     # wire_vals=False (the exact-terms fetch diet): the re-rank reads
     # only candidate buckets, so the [D, K] float scores stay on
     # device — same contract as _score_pack_wire's ids-only wire,
-    # except invalid slots keep their -1 (no bucket-0 stand-in).
+    # except invalid slots keep their -1 (no bucket-0 stand-in). The
+    # occupied-bucket scalar joins the same fetch (margin_check feed).
+    occ_dev = (df_dev > 0).sum(dtype=jnp.int32)
     if wire_vals:
-        vals, tids = jax.device_get((vals, tids))
+        vals, tids, occ = jax.device_get((vals, tids, occ_dev))
     else:
-        vals, tids = None, jax.device_get(tids)
+        vals, (tids, occ) = None, jax.device_get((tids, occ_dev))
     ph["fetch"] = time.perf_counter() - t0
 
     # The sharded outputs come back shard-major (shard s's chunks are
@@ -300,7 +323,8 @@ def _run_overlapped_mesh(input_dir: str, cfg: PipelineConfig,
                                    if vals is not None else None),
                         topk_ids=tids[:num_docs],
                         lengths=np.concatenate(all_lengths), names=names,
-                        num_docs=num_docs, path="resident-mesh", phases=ph)
+                        num_docs=num_docs, df_occupied=int(occ),
+                        path="resident-mesh", phases=ph)
 
 
 def _check_chunk_fits_int32(chunk_docs: int, length: int) -> None:
@@ -407,6 +431,12 @@ def _score_pack_wire(ids, counts, head, lengths, df, num_docs, *,
     scores = sparse_scores(ids, counts, head, lengths, idf)
     vals, tids = sparse_topk(scores, ids, head, topk)
     as_bytes = lambda a: lax.bitcast_convert_type(a, jnp.uint8).reshape(-1)
+    # Occupied-bucket count rides the wire as a 4-byte tail: the
+    # exact-terms margin warning (rerank.margin_check) needs only this
+    # scalar, and folding it here keeps the DF vector itself on device
+    # with NO hot-path D2H round trip (advisor r3 finding: the old
+    # np.asarray(df) inside exact_topk cost a full link latency).
+    occ = as_bytes((df > 0).sum(dtype=jnp.int32).reshape(1))
     if not include_vals:
         # Ids-only wire (exact-terms mode: the host re-rank reads only
         # the candidate buckets, so scores would be dead fetch bytes —
@@ -416,7 +446,8 @@ def _score_pack_wire(ids, counts, head, lengths, df, num_docs, *,
         # spurious bucket can only add out-of-doc candidates the
         # re-rank scores exactly and discards.
         tids = jnp.maximum(tids, 0)
-        return df, as_bytes(tids if wide_ids else tids.astype(jnp.uint16))
+        body = as_bytes(tids if wide_ids else tids.astype(jnp.uint16))
+        return df, jnp.concatenate([body, occ])
     # Valid scores are >= 0 by construction (idf >= 0, tf > 0 — the
     # reference's invariant, TFIDF.c:243); -1 marks invalid slots so a
     # legitimate 0.0 score (word in every doc) survives the u16 ids.
@@ -425,7 +456,8 @@ def _score_pack_wire(ids, counts, head, lengths, df, num_docs, *,
     ok = tids >= 0
     vals_wire = jnp.where(ok, vals, jnp.asarray(-1, vals.dtype))
     tid_wire = tids if wide_ids else jnp.maximum(tids, 0).astype(jnp.uint16)
-    return df, jnp.concatenate([as_bytes(vals_wire), as_bytes(tid_wire)])
+    return df, jnp.concatenate([as_bytes(vals_wire), as_bytes(tid_wire),
+                                occ])
 
 
 def _decode_wire(buf: np.ndarray, d_padded: int, k: int, wide_ids: bool,
@@ -435,11 +467,16 @@ def _decode_wire(buf: np.ndarray, d_padded: int, k: int, wide_ids: bool,
     Invalid slots (sub-k docs / padding rows) carry vals == -1 on the
     wire; they decode back to the (0, -1) contract. Ids-only wires
     (``include_vals=False``) return vals None and leave invalid slots
-    at bucket 0 (see ``_score_pack_wire``'s harmlessness note)."""
+    at bucket 0 (see ``_score_pack_wire``'s harmlessness note).
+
+    Returns ``(vals, tids, occupied)`` — the occupied-DF-bucket count
+    from the wire's 4-byte tail."""
+    occupied = int(buf[-4:].view("<i4")[0])
+    buf = buf[:-4]
     id_t = "<i4" if wide_ids else "<u2"
     if not include_vals:
         tids = buf.view(id_t).reshape(d_padded, k).astype(np.int32)
-        return None, tids
+        return None, tids, occupied
     sdt = np.dtype(score_dtype).newbyteorder("<")
     s_bytes = d_padded * k * sdt.itemsize
     vals = buf[:s_bytes].view(sdt).reshape(d_padded, k).copy()
@@ -451,7 +488,7 @@ def _decode_wire(buf: np.ndarray, d_padded: int, k: int, wide_ids: bool,
     bad = vals < 0
     vals[bad] = 0
     tids[bad] = -1
-    return vals, tids
+    return vals, tids, occupied
 
 
 @jax.jit
@@ -504,6 +541,10 @@ class IngestResult:
     lengths: np.ndarray       # [D] docSize per document
     names: List[str]
     num_docs: int
+    # Occupied-DF-bucket count, decoded from the wire tail (or counted
+    # host-side on the streaming path). Feeds rerank.margin_check
+    # without ever fetching the [V] DF vector from device.
+    df_occupied: Optional[int] = None
     path: str = ""            # regime: "resident" | "streaming" |
                               # "resident-mesh" (docs-sharded mesh)
     # Wall-clock phase breakdown of the run (seconds). Overlapped phases
@@ -699,14 +740,15 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
         buf = np.asarray(jax.device_get(wire))
         ph["fetch"] = time.perf_counter() - t0
         d_padded = len(starts) * chunk_docs
-        vals, tids = _decode_wire(buf, d_padded, k, wide, score_dtype,
-                                  include_vals=wire_vals)
+        vals, tids, occ = _decode_wire(buf, d_padded, k, wide, score_dtype,
+                                       include_vals=wire_vals)
         return IngestResult(df=df_dev,
                             topk_vals=(vals[:num_docs]
                                        if vals is not None else None),
                             topk_ids=tids[:num_docs],
                             lengths=np.concatenate(all_lengths),
                             names=names, num_docs=num_docs,
+                            df_occupied=occ,
                             path="resident", phases=ph)
 
     # Pass A: fold every chunk's partial DF into one device accumulator.
@@ -729,9 +771,17 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
                  if cfg.vocab_size <= (1 << 16) else None)
     ph = {"pack_a": 0.0, "pack_b": 0.0}
     df_acc = jnp.zeros((cfg.vocab_size,), jnp.int32)
-    cached: List[Tuple[np.ndarray, np.ndarray]] = []
+    cached: List[Optional[Tuple[np.ndarray, np.ndarray]]] = []
     all_lengths: List[np.ndarray] = []
     in_flight: List[jax.Array] = []
+    # Device triple cache (VERDICT r3 item 5): chunk idx -> sorted
+    # triples + device lengths, bounded by TFIDF_TPU_TRIPLE_CACHE_BYTES.
+    cache_budget = int(os.environ.get("TFIDF_TPU_TRIPLE_CACHE_BYTES",
+                                      _TRIPLE_CACHE_BYTES))
+    trip_cache: Dict[int, Tuple[jax.Array, jax.Array, jax.Array,
+                                jax.Array]] = {}
+    cache_bytes = 0
+    chunk_cache_bytes = chunk_docs * length * 9 + chunk_docs * 4
 
     def pack_any(chunk_names):
         if flat_pack is not None:
@@ -752,21 +802,35 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
         return _phase_b(wire_arr, lens, idf, topk=k)
 
     t_pass = time.perf_counter()
-    for start in starts:
+    for ci, start in enumerate(starts):
         chunk_names = names[start:start + chunk_docs]
         t0 = time.perf_counter()
         wire_arr, lengths = pack_any(chunk_names)
         ph["pack_a"] += time.perf_counter() - t0
         all_lengths.append(lengths[:len(chunk_names)])
-        if spill == "host":
-            cached.append((wire_arr, lengths))
-        df_acc = phase_a_any(jax.device_put(wire_arr),
-                             jax.device_put(lengths), df_acc)
+        if cache_bytes + chunk_cache_bytes <= cache_budget:
+            # Sort once, keep the triples: pass B scores these directly
+            # (_phase_b_cached) — no host cache, no re-pack, no re-sort
+            # for this chunk.
+            lens_dev = jax.device_put(lengths)
+            i_, c_, h_, df_acc = _chunk_step(
+                jax.device_put(wire_arr), lens_dev, df_acc, cfg, length,
+                ragged=flat_pack is not None)
+            trip_cache[ci] = (i_, c_, h_, lens_dev)
+            cache_bytes += chunk_cache_bytes
+            if spill == "host":
+                cached.append(None)  # pass B won't read the host copy
+        else:
+            if spill == "host":
+                cached.append((wire_arr, lengths))
+            df_acc = phase_a_any(jax.device_put(wire_arr),
+                                 jax.device_put(lengths), df_acc)
         in_flight.append(df_acc)
         if len(in_flight) > max_ahead:
             in_flight.pop(0).block_until_ready()
     df_acc.block_until_ready()
     ph["pass_a"] = time.perf_counter() - t_pass
+    ph["triple_cached_chunks"] = float(len(trip_cache))
 
     idf = _final_idf(df_acc, jnp.int32(num_docs), score_dtype=score_dtype)
 
@@ -776,6 +840,12 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
     vals_parts, ids_parts = [], []
     t_pass = time.perf_counter()
     for ci, start in enumerate(starts):
+        if ci in trip_cache:
+            i_, c_, h_, lens_dev = trip_cache.pop(ci)
+            v, t = _phase_b_cached(i_, c_, h_, lens_dev, idf, topk=k)
+            vals_parts.append(v)
+            ids_parts.append(t)
+            continue
         if spill == "host":
             wire_arr, lengths = cached[ci]
         else:
@@ -798,7 +868,9 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
     return IngestResult(df=df_host, topk_vals=vals[:num_docs],
                         topk_ids=tids[:num_docs],
                         lengths=np.concatenate(all_lengths), names=names,
-                        num_docs=num_docs, path="streaming", phases=ph)
+                        num_docs=num_docs,
+                        df_occupied=int((df_host > 0).sum()),
+                        path="streaming", phases=ph)
 
 
 def profile_resident(input_dir: str, config: Optional[PipelineConfig] = None,

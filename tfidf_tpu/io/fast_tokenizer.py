@@ -291,8 +291,11 @@ def exact_rerank_paths(paths: List[str], topk_ids: np.ndarray,
         return None
     n_docs = len(paths)
     topk_ids = np.ascontiguousarray(topk_ids, dtype=np.int32)
-    assert topk_ids.shape[0] == n_docs, (topk_ids.shape, n_docs)
-    kprime = topk_ids.shape[1] if topk_ids.ndim == 2 else 0
+    # A malformed selection must fail loudly, not return empty top-k
+    # lists (advisor r3: ndim != 2 silently produced kprime=0).
+    assert topk_ids.ndim == 2 and topk_ids.shape[0] == n_docs, \
+        (topk_ids.shape, n_docs)
+    kprime = topk_ids.shape[1]
     n_threads = n_threads or min(os.cpu_count() or 1, 16)
     blob = b"\0".join(p.encode() for p in paths) + b"\0"
     handle = lib.loader_open2(blob, n_docs, n_threads, 0) \

@@ -42,21 +42,29 @@ from tfidf_tpu.ops.tokenize import whitespace_tokenize
 DocTerms = List[Tuple[bytes, float]]
 
 
-def margin_check(df, margin: int) -> Optional[str]:
+def margin_check(df, margin: int, *, occupied: Optional[int] = None,
+                 vocab_size: Optional[int] = None) -> Optional[str]:
     """Collision-pressure guard for the exact-terms margin.
 
     Estimates the vocab load factor from the occupied-bucket fraction
-    of a measured DF vector (alpha = -ln(1 - B/V) under uniform
-    hashing) and returns a human-readable warning when ``margin`` is
-    below the measured-safe level for it — margin 4 up to alpha 0.25,
-    margin 8 beyond (the sweep in docs/EXACT.md). Returns None when the
-    margin is safe. Library-level so every exact-terms entry point
-    (CLI, bench, direct :func:`exact_topk` callers) shares one rule.
+    (alpha = -ln(1 - B/V) under uniform hashing) and returns a
+    human-readable warning when ``margin`` is below the measured-safe
+    level for it — margin 4 up to alpha 0.25, margin 8 beyond (the
+    sweep in docs/EXACT.md). Returns None when the margin is safe.
+    Library-level so every exact-terms entry point (CLI, bench, direct
+    :func:`exact_topk` callers) shares one rule.
+
+    Pass either a DF vector (``df``) or the precomputed
+    ``occupied``/``vocab_size`` scalar pair — the ingest wire carries
+    the scalar (``IngestResult.df_occupied``) precisely so this check
+    never forces a D2H fetch of a device-resident DF (advisor r3).
     """
     import math
 
-    df = np.asarray(df)
-    occ = float((df > 0).sum()) / df.size
+    if df is not None:
+        df = np.asarray(df)
+        occupied, vocab_size = int((df > 0).sum()), df.size
+    occ = float(occupied) / vocab_size
     alpha = -math.log(max(1.0 - min(occ, 0.999999), 1e-12))
     suggested = 4 if alpha <= 0.25 else 8
     if margin >= suggested:
@@ -89,7 +97,8 @@ def exact_topk(input_dir: str, names: Sequence[str], topk_ids: np.ndarray,
                num_docs: int, cfg: PipelineConfig, k: int,
                docs: Optional[Iterable[str]] = None,
                max_tokens: Optional[int] = None,
-               df: Optional[np.ndarray] = None) -> Dict[str, DocTerms]:
+               df: Optional[np.ndarray] = None,
+               df_occupied: Optional[int] = None) -> Dict[str, DocTerms]:
     """Exact-string top-k for ``docs`` from a hashed TPU selection.
 
     Args:
@@ -106,13 +115,22 @@ def exact_topk(input_dir: str, names: Sequence[str], topk_ids: np.ndarray,
       df: the run's measured DF vector, when available — enables the
         :func:`margin_check` collision-pressure warning (stderr) for
         every caller, not just the CLI.
+      df_occupied: the occupied-bucket count instead of the vector
+        (``IngestResult.df_occupied``) — same warning, no DF fetch
+        from a device-resident run.
 
     Returns:
       name -> [(word, score), ...] exact float64 TF-IDF, score-desc then
       word-asc, at most k entries, only positive-scoring words.
     """
-    if df is not None and np.asarray(topk_ids).ndim == 2 and k > 0:
-        warn = margin_check(df, max(np.asarray(topk_ids).shape[1] // k, 1))
+    if (df is not None or df_occupied is not None) \
+            and np.asarray(topk_ids).ndim == 2 and k > 0:
+        m = max(np.asarray(topk_ids).shape[1] // k, 1)
+        if df_occupied is not None:
+            warn = margin_check(None, m, occupied=df_occupied,
+                                vocab_size=cfg.vocab_size)
+        else:
+            warn = margin_check(df, m)
         if warn is not None:
             import sys
             sys.stderr.write(f"warning: {warn}\n")
