@@ -1,0 +1,436 @@
+// intern — global exact word-id table for the exact-terms fast path.
+//
+// The hashed pipeline's exact-terms mode pays a full host re-pass over
+// the corpus (native/rerank.cc) because hash buckets merge words. This
+// table removes the merging instead: during ingest the packer assigns
+// every distinct token a dense EXACT id (first-seen order) shared
+// across all chunks of a run, so the device's integer counts, DF, and
+// top-k selection are word-exact by construction — the reference's
+// string-keyed table semantics (TFIDF.c:26-42) with O(1) interning
+// instead of its O(V_doc) linear probes (TFIDF.c:150-167). The host
+// then rescores the selected candidates in float64 from integers alone
+// and never touches document bytes again (tfidf_tpu/rerank.py).
+//
+// Capacity contract: at most `cap` distinct words (the device vocab);
+// one past it sets the overflow flag and the fill aborts — the caller
+// falls back to the hashed+margin+rerank engine. Concurrency: lock-free
+// reads (acquire loads on the slot array; entries are preallocated so
+// addresses never move), appends under a mutex — inserts are rare after
+// the first few thousand tokens of a corpus.
+//
+// C ABI (ctypes from tfidf_tpu/io/fast_tokenizer.py):
+//   intern_open(cap) -> handle
+//   intern_fill_flat_u16(loader_h, intern_h, seed, trunc, max_per_doc,
+//                        out, out_lengths) -> total ids or -1 overflow
+//   intern_count(h) / intern_overflow(h)
+//   intern_blob_bytes(h) / intern_dump(h, offs, lens, blob)
+//   intern_close(h)
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <string_view>
+#include <utility>
+#include <vector>
+
+#include "tokenize_common.h"
+
+// Defined in loader.cc: borrow read-only views of the loaded docs.
+extern "C" int64_t loader_doc_count(void* handle);
+extern "C" const char* loader_doc_data(void* handle, int64_t d,
+                                       int64_t* len);
+
+namespace {
+
+struct InternTable {
+  struct Entry {
+    uint64_t h;
+    const char* w;
+    int32_t len;
+  };
+  std::vector<Entry> entries;  // resized to cap once — stable addresses
+  std::unique_ptr<std::atomic<int64_t>[]> slots;  // entry idx+1; 0=empty
+  size_t mask = 0;
+  int64_t cap = 0;
+  std::mutex mu;               // guards arena + entry append
+  std::deque<std::string> arena;  // owns word bytes (deque: stable)
+  std::atomic<int64_t> live{0};
+  std::atomic<int> overflow{0};
+};
+
+// Find-or-insert; returns the word's dense id, or -1 on overflow.
+int64_t FindOrInsert(InternTable* T, uint64_t h, const uint8_t* w,
+                     int64_t wl) {
+  size_t s = (size_t)h & T->mask;
+  for (;;) {
+    int64_t e = T->slots[s].load(std::memory_order_acquire);
+    if (e == 0) {
+      std::lock_guard<std::mutex> lk(T->mu);
+      e = T->slots[s].load(std::memory_order_relaxed);
+      if (e == 0) {
+        int64_t id = T->live.load(std::memory_order_relaxed);
+        if (id >= T->cap) {
+          T->overflow.store(1, std::memory_order_relaxed);
+          return -1;
+        }
+        T->arena.emplace_back(reinterpret_cast<const char*>(w),
+                              (size_t)wl);
+        T->entries[(size_t)id] = {h, T->arena.back().data(), (int32_t)wl};
+        T->live.store(id + 1, std::memory_order_relaxed);
+        T->slots[s].store(id + 1, std::memory_order_release);
+        return id;
+      }
+      // Another thread claimed the slot between our load and the lock:
+      // fall through and compare against what it stored.
+    }
+    const InternTable::Entry& E = T->entries[(size_t)(e - 1)];
+    if (E.h == h && E.len == (int32_t)wl &&
+        std::memcmp(E.w, w, (size_t)wl) == 0)
+      return e - 1;
+    s = (s + 1) & T->mask;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* intern_open(int64_t cap) {
+  InternTable* T = new InternTable;
+  T->cap = cap;
+  size_t n = 1;
+  while (n < (size_t)cap * 2) n <<= 1;  // load factor <= 0.5
+  T->slots.reset(new std::atomic<int64_t>[n]);
+  for (size_t i = 0; i < n; ++i)
+    T->slots[i].store(0, std::memory_order_relaxed);
+  T->mask = n - 1;
+  T->entries.resize((size_t)cap);
+  return T;
+}
+
+// Exact-id flat pack over a loader handle's docs: the exact-mode twin
+// of loader_fill_flat_u16 (same serial flat-wire contract), with the
+// hash fold replaced by interning. Returns total ids written, or -1 on
+// vocab overflow (out/out_lengths contents are then unspecified).
+int64_t intern_fill_flat_u16(void* loader_handle, void* intern_handle,
+                             uint64_t seed, int64_t truncate_at,
+                             int64_t max_per_doc, uint16_t* out,
+                             int32_t* out_lengths) {
+  InternTable* T = static_cast<InternTable*>(intern_handle);
+  const int64_t n_docs = loader_doc_count(loader_handle);
+  int64_t pos = 0;
+  for (int64_t d = 0; d < n_docs; ++d) {
+    int64_t len;
+    const char* data = loader_doc_data(loader_handle, d, &len);
+    bool bad = false;
+    int64_t n = tfidf::ForEachToken(
+        reinterpret_cast<const uint8_t*>(data), len, truncate_at,
+        max_per_doc, [&](const uint8_t* w, int64_t wl) {
+          int64_t id =
+              FindOrInsert(T, tfidf::HashWordRaw(w, wl, seed), w, wl);
+          if (id < 0) {
+            bad = true;
+            return;
+          }
+          out[pos++] = (uint16_t)id;
+        });
+    if (bad) return -1;
+    out_lengths[d] = (int32_t)n;
+  }
+  return pos;
+}
+
+int64_t intern_count(void* handle) {
+  return static_cast<InternTable*>(handle)->live.load();
+}
+
+int intern_overflow(void* handle) {
+  return static_cast<InternTable*>(handle)->overflow.load();
+}
+
+int64_t intern_blob_bytes(void* handle) {
+  InternTable* T = static_cast<InternTable*>(handle);
+  int64_t n = T->live.load(), bytes = 0;
+  for (int64_t i = 0; i < n; ++i) bytes += T->entries[(size_t)i].len;
+  return bytes;
+}
+
+// Dump the id -> word dictionary: offs/lens [count], blob packed bytes.
+void intern_dump(void* handle, int64_t* offs, int64_t* lens, char* blob) {
+  InternTable* T = static_cast<InternTable*>(handle);
+  int64_t n = T->live.load(), pos = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const InternTable::Entry& e = T->entries[(size_t)i];
+    offs[i] = pos;
+    lens[i] = e.len;
+    std::memcpy(blob + pos, e.w, (size_t)e.len);
+    pos += e.len;
+  }
+}
+
+void intern_close(void* handle) {
+  delete static_cast<InternTable*>(handle);
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------
+// exact_emit — the exact-terms finishing engine (rescore + format +
+// global sort), the native twin of rerank.exact_topk_from_wire.
+//
+// Inputs are the exact-ids wire integers: per-doc (id, count)
+// candidates, the [V] exact DF vector, truncated docSizes. Per doc:
+// float64 TF-IDF in the reference's op order (TFIDF.c:202,243), filter
+// score > 0, sort (-score, word asc), keep k, format
+// "name@word\t%.16f" — then ONE global byte-lex sort of all lines (the
+// reference's qsort, TFIDF.c:273). Boundary-tie docs (full wire whose
+// tail score ties the k-th entry — the word-asc choice is undecidable
+// from the wire) are re-read and resolved exactly HERE, against the
+// still-open intern table; no corpus scan.
+
+namespace {
+
+struct EmitResult {
+  std::vector<int32_t> per_doc_counts;  // kept entries per doc
+  std::vector<int64_t> offs, lens;      // word spans in word_blob
+  std::vector<double> scores;           // doc-major kept scores
+  std::string word_blob;
+  std::string lines;                    // final sorted output bytes
+};
+
+bool ReadWholeFile(const std::string& path, std::string* out) {
+  FILE* f = std::fopen(path.c_str(), "rb");
+  if (!f) return false;
+  std::fseek(f, 0, SEEK_END);
+  long sz = std::ftell(f);
+  if (sz < 0) {
+    std::fclose(f);
+    return false;
+  }
+  std::fseek(f, 0, SEEK_SET);
+  out->resize((size_t)sz);
+  size_t got = sz ? std::fread(&(*out)[0], 1, (size_t)sz, f) : 0;
+  std::fclose(f);
+  return got == (size_t)sz;
+}
+
+// Read-only probe of the intern table (no insertion).
+int64_t InternFind(InternTable* T, uint64_t h, const uint8_t* w,
+                   int64_t wl) {
+  size_t s = (size_t)h & T->mask;
+  for (;;) {
+    int64_t e = T->slots[s].load(std::memory_order_acquire);
+    if (e == 0) return -1;
+    const InternTable::Entry& E = T->entries[(size_t)(e - 1)];
+    if (E.h == h && E.len == (int32_t)wl &&
+        std::memcmp(E.w, w, (size_t)wl) == 0)
+      return e - 1;
+    s = (s + 1) & T->mask;
+  }
+}
+
+struct ExactEntry {
+  int32_t id;
+  double score;
+};
+
+}  // namespace
+
+extern "C" {
+
+// Returns an EmitResult*, or null when a boundary-tie document could
+// not be re-read (*out_failed_doc = its index) — the caller must fail
+// loudly, exactly like the Python twin's FileNotFoundError: emitting
+// the unresolved wire candidates would silently break the tie
+// contract.
+void* exact_emit_run(void* intern_handle, const char* input_dir,
+                     const char* names_blob, const int32_t* ids,
+                     const int32_t* counts, int64_t n_docs,
+                     int64_t kprime, const int32_t* df,
+                     int64_t vocab_size, const int32_t* lengths,
+                     int64_t num_docs_idf, int64_t k, int64_t truncate_at,
+                     int64_t max_tokens, uint64_t seed, int n_threads,
+                     int64_t* out_failed_doc) {
+  (void)vocab_size;
+  InternTable* T = static_cast<InternTable*>(intern_handle);
+  std::atomic<int64_t> failed{-1};
+  std::vector<const char*> names(n_docs);
+  {
+    const char* p = names_blob;
+    for (int64_t d = 0; d < n_docs; ++d) {
+      names[d] = p;
+      p += std::strlen(p) + 1;
+    }
+  }
+  const double n_idf = (double)num_docs_idf;
+  std::vector<std::vector<ExactEntry>> picked(n_docs);
+  tfidf::ParallelFor(n_docs, n_threads, [&](int64_t d) {
+    const int32_t* row_id = ids + d * kprime;
+    const int32_t* row_cn = counts + d * kprime;
+    const double len = lengths[d] > 0 ? (double)lengths[d] : 1.0;
+    std::vector<ExactEntry> cand;
+    cand.reserve((size_t)kprime);
+    bool full = true;
+    for (int64_t j = 0; j < kprime; ++j) {
+      if (row_cn[j] <= 0) {
+        full = false;
+        continue;
+      }
+      double idf = std::log(n_idf / (double)df[row_id[j]]);
+      cand.push_back({row_id[j], (double)row_cn[j] / len * idf});
+    }
+    auto by_score_word = [&](const ExactEntry& a, const ExactEntry& b) {
+      if (a.score != b.score) return a.score > b.score;
+      const InternTable::Entry &ea = T->entries[(size_t)a.id],
+                               &eb = T->entries[(size_t)b.id];
+      int c = std::memcmp(ea.w, eb.w,
+                          (size_t)(ea.len < eb.len ? ea.len : eb.len));
+      if (c != 0) return c < 0;
+      return ea.len < eb.len;
+    };
+    std::sort(cand.begin(), cand.end(), by_score_word);
+    int64_t kk = k < (int64_t)cand.size() ? k : (int64_t)cand.size();
+    // Boundary tie: full wire and the tail's positive score equals the
+    // k-th — resolve from the document itself (exactly the Python
+    // rule, rerank.exact_topk_from_wire).
+    bool tied = full && kprime > 0 && kk > 0 &&
+                cand.back().score == cand[(size_t)kk - 1].score &&
+                cand.back().score > 0.0;
+    if (tied) {
+      std::string path = std::string(input_dir) + "/" + names[d];
+      std::string data;
+      if (!ReadWholeFile(path, &data)) {
+        int64_t expect = -1;
+        failed.compare_exchange_strong(expect, d);
+        return;
+      }
+      {
+        // Exact doc-local recount: sort+RLE over (hash, bytes) like
+        // rerank.cc pass 1, then score every distinct term.
+        std::vector<tfidf::HashedTok> toks;
+        int64_t size = tfidf::ForEachTokenView(
+            data.data(), (int64_t)data.size(), truncate_at, max_tokens,
+            [&](std::string_view w) {
+              toks.push_back({tfidf::HashView(w, seed), w});
+            });
+        std::sort(toks.begin(), toks.end(), tfidf::HashedTokLess);
+        cand.clear();
+        const double dlen = size > 0 ? (double)size : 1.0;
+        for (size_t i = 0; i < toks.size();) {
+          size_t j = i + 1;
+          while (j < toks.size() && toks[j].h == toks[i].h &&
+                 toks[j].w == toks[i].w)
+            ++j;
+          int64_t id = InternFind(
+              T, toks[i].h,
+              reinterpret_cast<const uint8_t*>(toks[i].w.data()),
+              (int64_t)toks[i].w.size());
+          if (id >= 0) {
+            double idf = std::log(n_idf / (double)df[id]);
+            double s = (double)(j - i) / dlen * idf;
+            if (s > 0.0) cand.push_back({(int32_t)id, s});
+          }
+          i = j;
+        }
+        std::sort(cand.begin(), cand.end(), by_score_word);
+        kk = k < (int64_t)cand.size() ? k : (int64_t)cand.size();
+      }
+    }
+    std::vector<ExactEntry>& out = picked[d];
+    for (int64_t j = 0; j < kk && cand[(size_t)j].score > 0.0; ++j)
+      out.push_back(cand[(size_t)j]);
+  });
+
+  if (failed.load() >= 0) {
+    if (out_failed_doc) *out_failed_doc = failed.load();
+    return nullptr;
+  }
+  if (out_failed_doc) *out_failed_doc = -1;
+
+  // Assemble: doc-major entry arrays + the globally sorted line blob.
+  EmitResult* res = new EmitResult;
+  res->per_doc_counts.resize(n_docs);
+  int64_t total = 0, wbytes = 0;
+  for (int64_t d = 0; d < n_docs; ++d) {
+    res->per_doc_counts[d] = (int32_t)picked[d].size();
+    total += (int64_t)picked[d].size();
+    for (const ExactEntry& e : picked[d])
+      wbytes += T->entries[(size_t)e.id].len;
+  }
+  res->offs.reserve(total);
+  res->lens.reserve(total);
+  res->scores.reserve(total);
+  res->word_blob.reserve(wbytes);
+  std::string arena;  // all formatted lines, back to back
+  std::vector<std::pair<int64_t, int32_t>> spans;  // (off, len) per line
+  spans.reserve(total);
+  char buf[64];
+  for (int64_t d = 0; d < n_docs; ++d) {
+    for (const ExactEntry& e : picked[d]) {
+      const InternTable::Entry& w = T->entries[(size_t)e.id];
+      res->offs.push_back((int64_t)res->word_blob.size());
+      res->lens.push_back(w.len);
+      res->scores.push_back(e.score);
+      res->word_blob.append(w.w, (size_t)w.len);
+      int64_t off = (int64_t)arena.size();
+      arena.append(names[d]);
+      arena.push_back('@');
+      arena.append(w.w, (size_t)w.len);
+      arena.push_back('\t');
+      int m = std::snprintf(buf, sizeof buf, "%.16f", e.score);
+      arena.append(buf, (size_t)m);
+      spans.emplace_back(off, (int32_t)(arena.size() - off));
+    }
+  }
+  // The reference's global qsort over raw lines (TFIDF.c:273).
+  std::sort(spans.begin(), spans.end(),
+            [&](const std::pair<int64_t, int32_t>& a,
+                const std::pair<int64_t, int32_t>& b) {
+              std::string_view va(arena.data() + a.first, (size_t)a.second);
+              std::string_view vb(arena.data() + b.first, (size_t)b.second);
+              return va < vb;
+            });
+  res->lines.reserve(arena.size() + spans.size());
+  for (const auto& sp : spans) {
+    res->lines.append(arena.data() + sp.first, (size_t)sp.second);
+    res->lines.push_back('\n');
+  }
+  return res;
+}
+
+int64_t exact_emit_total(void* res) {
+  return (int64_t)static_cast<EmitResult*>(res)->scores.size();
+}
+
+int64_t exact_emit_word_bytes(void* res) {
+  return (int64_t)static_cast<EmitResult*>(res)->word_blob.size();
+}
+
+int64_t exact_emit_line_bytes(void* res) {
+  return (int64_t)static_cast<EmitResult*>(res)->lines.size();
+}
+
+void exact_emit_fill(void* res_p, int32_t* per_doc_counts, int64_t* offs,
+                     int64_t* lens, double* scores, char* word_blob,
+                     char* line_blob) {
+  EmitResult* res = static_cast<EmitResult*>(res_p);
+  std::memcpy(per_doc_counts, res->per_doc_counts.data(),
+              res->per_doc_counts.size() * sizeof(int32_t));
+  std::memcpy(offs, res->offs.data(), res->offs.size() * sizeof(int64_t));
+  std::memcpy(lens, res->lens.data(), res->lens.size() * sizeof(int64_t));
+  std::memcpy(scores, res->scores.data(),
+              res->scores.size() * sizeof(double));
+  std::memcpy(word_blob, res->word_blob.data(), res->word_blob.size());
+  std::memcpy(line_blob, res->lines.data(), res->lines.size());
+}
+
+void exact_emit_free(void* res) { delete static_cast<EmitResult*>(res); }
+
+}  // extern "C"

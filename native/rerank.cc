@@ -47,35 +47,13 @@ namespace {
 
 using tfidf::ParallelFor;
 
-// string_view adapter over the shared tokenizer loop + hash
-// (tokenize_common.h — the single source of truth; no local copies).
-template <typename Fn>
-int64_t ForEachTokenSv(const char* data, int64_t len, int64_t truncate_at,
-                       int64_t max_tokens, Fn fn) {
-  return tfidf::ForEachToken(
-      reinterpret_cast<const uint8_t*>(data), len, truncate_at, max_tokens,
-      [&](const uint8_t* w, int64_t wl) {
-        fn(std::string_view(reinterpret_cast<const char*>(w), (size_t)wl));
-      });
-}
-
-// Raw 64-bit FNV-1a of a token (pre-fold, tokenize_common.h): the
-// grouping/probe key everywhere below. Exactness never rests on it
-// alone — every hash-equal comparison is verified on bytes.
-inline uint64_t Fnv64(std::string_view w, uint64_t seed) {
-  return tfidf::HashWordRaw(reinterpret_cast<const uint8_t*>(w.data()),
-                            (int64_t)w.size(), seed);
-}
-
-struct Tok {
-  uint64_t h;
-  std::string_view w;
-};
-
-inline bool TokLess(const Tok& a, const Tok& b) {
-  if (a.h != b.h) return a.h < b.h;
-  return a.w < b.w;
-}
+// The string_view token adapters (ForEachTokenView / HashView /
+// HashedTok) live in tokenize_common.h, shared with intern.cc's
+// exact_emit — the single source of truth; no local copies.
+using tfidf::ForEachTokenView;
+using tfidf::HashView;
+using tfidf::HashedTok;
+using tfidf::HashedTokLess;
 
 struct Cand {               // one unique word in one doc (32 bytes)
   uint64_t h;
@@ -190,11 +168,11 @@ void* rerank_run(void* loader_handle, const int32_t* topk_ids,
     std::sort(buckets.begin(), buckets.end());
     int64_t len;
     const char* data = loader_doc_data(loader_handle, d, &len);
-    std::vector<Tok> toks;
-    doc_size[d] = ForEachTokenSv(
+    std::vector<HashedTok> toks;
+    doc_size[d] = ForEachTokenView(
         data, len, truncate_at, max_tokens,
-        [&](std::string_view w) { toks.push_back({Fnv64(w, seed), w}); });
-    std::sort(toks.begin(), toks.end(), TokLess);
+        [&](std::string_view w) { toks.push_back({HashView(w, seed), w}); });
+    std::sort(toks.begin(), toks.end(), HashedTokLess);
     for (size_t i = 0; i < toks.size();) {
       size_t j = i + 1;
       while (j < toks.size() && toks[j].h == toks[i].h &&

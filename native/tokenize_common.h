@@ -11,6 +11,7 @@
 
 #include <atomic>
 #include <cstdint>
+#include <string_view>
 #include <thread>
 #include <vector>
 
@@ -114,6 +115,38 @@ inline int64_t TokenizeHashInto(const uint8_t* data, int64_t len,
                       [&](const uint8_t* w, int64_t wl) {
                         *out++ = (T)HashWord(w, wl, seed, vocab_size);
                       });
+}
+
+// string_view-level adapters over the tokenizer loop + raw hash,
+// shared by the exact engines (rerank.cc, intern.cc exact_emit): a
+// (raw-hash, bytes) token key whose ordering groups equal words for
+// sort+RLE counting. Exactness never rests on the hash alone — every
+// hash-equal comparison is verified on bytes.
+struct HashedTok {
+  uint64_t h;
+  std::string_view w;
+};
+
+inline bool HashedTokLess(const HashedTok& a, const HashedTok& b) {
+  if (a.h != b.h) return a.h < b.h;
+  return a.w < b.w;
+}
+
+inline uint64_t HashView(std::string_view w, uint64_t seed) {
+  return HashWordRaw(reinterpret_cast<const uint8_t*>(w.data()),
+                     (int64_t)w.size(), seed);
+}
+
+template <typename Fn>
+inline int64_t ForEachTokenView(const char* data, int64_t len,
+                                int64_t truncate_at, int64_t max_tokens,
+                                Fn fn) {
+  return ForEachToken(
+      reinterpret_cast<const uint8_t*>(data), len, truncate_at,
+      max_tokens, [&](const uint8_t* w, int64_t wl) {
+        fn(std::string_view(reinterpret_cast<const char*>(w),
+                            (size_t)wl));
+      });
 }
 
 }  // namespace tfidf

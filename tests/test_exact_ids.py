@@ -1,0 +1,139 @@
+"""Device-exact exact-terms engine (round 4, VERDICT r3 item 3).
+
+The intern table (native/intern.cc) assigns collision-free word ids at
+pack time, so the device selection is word-exact and the host rescores
+from wire integers — no corpus re-pass. Oracle: the native
+bit-reference (byte-identical %.16f lines) and the Python exact_topk
+semantics."""
+
+import os
+import random
+import subprocess
+
+import numpy as np
+import pytest
+
+from tfidf_tpu.config import PipelineConfig, VocabMode
+from tfidf_tpu.io import fast_tokenizer as ft
+from tfidf_tpu.rerank import exact_terms, exact_topk_from_wire
+
+NATIVE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native", "tfidf_ref")
+
+pytestmark = pytest.mark.skipif(not ft.intern_available(),
+                                reason="native intern table not built")
+
+
+@pytest.fixture
+def corpus(tmp_path):
+    rng = random.Random(5)
+    d = tmp_path / "input"
+    d.mkdir()
+    words = [f"word{i}" for i in range(300)]
+    for i in range(1, 101):
+        (d / f"doc{i}").write_text(
+            " ".join(rng.choice(words) for _ in range(rng.randint(1, 60))))
+    # A doc of corpus-hapax words: one tie group wider than any margin —
+    # the boundary-tie fallback must resolve it doc-locally.
+    (d / "doc101").write_text(" ".join(f"hapax{j}" for j in range(40)))
+    return str(d)
+
+
+def _cfg(vocab=1 << 12, margin_k=20):
+    return PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=vocab,
+                          topk=margin_k, engine="sparse")
+
+
+class TestDeviceExact:
+    def test_byte_identical_to_oracle(self, corpus, tmp_path):
+        dev, engine = exact_terms(corpus, _cfg(), k=5, doc_len=64,
+                                  chunk_docs=32)
+        assert engine == "device-exact"
+        if not os.path.exists(NATIVE):
+            subprocess.run(["make", "-C", os.path.dirname(NATIVE)],
+                           check=True, capture_output=True)
+        out = str(tmp_path / "oracle.txt")
+        subprocess.run([NATIVE, corpus, out, "5"], check=True,
+                       stdout=subprocess.DEVNULL)
+        oracle_lines = set(open(out, "rb").read().splitlines())
+        emitted = 0
+        for name, terms in dev.items():
+            for w, s in terms:
+                line = b"%s@%s\t%.16f" % (name.encode(), w, s)
+                assert line in oracle_lines, line
+                emitted += 1
+        assert emitted > 100  # real coverage, not an empty pass
+
+    def test_tie_groups_resolve_word_asc(self, corpus):
+        # doc101 is 40 equal-scoring hapax words: top-5 must be the
+        # byte-lex first five (hapax0, hapax1, hapax10, hapax11,
+        # hapax12), which no wire margin alone could guarantee.
+        dev, engine = exact_terms(corpus, _cfg(), k=5, doc_len=64,
+                                  chunk_docs=32)
+        assert engine == "device-exact"
+        got = [w for w, _ in dev["doc101"]]
+        assert got == [b"hapax0", b"hapax1", b"hapax10", b"hapax11",
+                       b"hapax12"]
+
+    def test_overflow_falls_back_to_hashed_rerank(self, corpus, capsys):
+        # 340 distinct words > 256-bucket vocab: the intern table
+        # overflows and the hashed+margin+rerank engine takes over.
+        dev, engine = exact_terms(corpus, _cfg(vocab=256), k=5,
+                                  doc_len=64, chunk_docs=32)
+        assert engine == "hashed-rerank"
+        assert len(dev) == 101
+
+    def test_wire_integers_are_exact(self, corpus):
+        # The wire's (count, df) must equal a host count of the same
+        # tokenization — spot-check a few docs.
+        from tfidf_tpu.ingest import run_overlapped_exact
+        from tfidf_tpu.ops.tokenize import whitespace_tokenize
+
+        exact = run_overlapped_exact(corpus, _cfg(), chunk_docs=32,
+                                     doc_len=64)
+        id2w = exact.words
+        for d in (0, 50, 100):
+            name = exact.names[d]
+            with open(os.path.join(corpus, name), "rb") as f:
+                toks = whitespace_tokenize(f.read(), None)[:64]
+            for j in range(exact.topk_ids.shape[1]):
+                c = int(exact.topk_counts[d, j])
+                if c == 0:
+                    continue
+                w = id2w[int(exact.topk_ids[d, j])]
+                assert toks.count(w) == c, (name, w)
+
+    def test_tie_fallback_respects_truncation(self, tmp_path):
+        # doc_len=None: ingest truncates at cfg.max_doc_len, and the
+        # boundary-tie re-read must apply the SAME cap (review r4
+        # finding: an uncapped re-read scored docSize=30 and words the
+        # device never saw).
+        import math
+
+        d = tmp_path / "input"
+        d.mkdir()
+        (d / "doc1").write_text(" ".join(f"h{j:02d}" for j in range(30)))
+        (d / "doc2").write_text("h00 x")
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=4096,
+                             topk=8, max_doc_len=16, engine="sparse")
+        dev, engine = exact_terms(str(d), cfg, k=4, chunk_docs=4)
+        assert engine == "device-exact"
+        got = dev["doc1"]
+        # The tie group (h01..h15: count 1, df 1) must resolve word-asc
+        # over the TRUNCATED doc: top-4 = h01..h04 at (1/16) * ln(2/1).
+        want_score = (1.0 / 16.0) * math.log(2.0 / 1.0)
+        assert [w for w, _ in got] == [b"h01", b"h02", b"h03", b"h04"]
+        for _, s in got:
+            assert s == want_score
+
+    def test_cli_exact_terms_rides_device_engine(self, corpus, tmp_path):
+        from tfidf_tpu.cli import main
+        out = tmp_path / "exact.txt"
+        rc = main(["run", "--input", corpus, "--output", str(out),
+                   "--vocab-mode", "hashed", "--vocab-size", "4096",
+                   "--topk", "5", "--doc-len", "64", "--exact-terms"])
+        assert rc == 0
+        data = open(out, "rb").read()
+        assert b"doc101@hapax0\t" in data
+        lines = data.splitlines()
+        assert lines == sorted(lines)  # strcmp ordering contract

@@ -206,6 +206,35 @@ def _run_tpu(args) -> int:
                   and cfg.tokenizer is TokenizerKind.WHITESPACE
                   and mesh_ok and not args.pallas
                   and cfg.engine == "sparse")
+    if overlapped and exact_terms and not mesh_shape:
+        # Exact-terms with automatic engine choice (rerank.exact_terms):
+        # device-exact intern ids when the corpus fits the vocab (no
+        # collisions, no corpus re-pass), else hashed margin + native
+        # re-rank. Emits the same byte format either way.
+        import time
+
+        from tfidf_tpu.io.corpus import discover_names
+        from tfidf_tpu.rerank import exact_terms_lines
+        n_docs = len(discover_names(args.input,
+                                    strict=not args.no_strict))
+        t0 = time.perf_counter()
+        lines, engine, _ = exact_terms_lines(
+            args.input, cfg, k=args.topk, doc_len=args.doc_len,
+            chunk_docs=args.chunk_docs or 8192,
+            strict=not args.no_strict)
+        throughput.record(n_docs, time.perf_counter() - t0)
+        with phase_or_null(timer, "emit"):
+            # lines arrive already in the reference's strcmp order
+            # (TFIDF.c:273) — write-through.
+            with open(args.output, "wb") as f:
+                f.write(lines)
+        if timer is not None:
+            sys.stderr.write(
+                timer.report() + "\n"
+                f"{'docs/sec':>12}: {throughput.docs_per_sec:9.1f}\n"
+                f"{'engine':>12}: {engine}\n")
+        print(f"wrote {args.output} ({n_docs} docs)")
+        return 0
     if overlapped:
         import time
         import types

@@ -151,6 +151,18 @@ _TRIPLE_CACHE_BYTES = 4 << 30
 _FLAT_BUCKET = 1 << 19
 
 
+def _bucket_pad_flat(flat: np.ndarray, total: int) -> np.ndarray:
+    """Round a flat id stream up to a ``_FLAT_BUCKET`` multiple with
+    zero fill. At least one bucket even for an all-empty chunk: a
+    zero-size operand would fail the device gather's trace (and one
+    bucket is the shape small chunks land on anyway)."""
+    pad = max(total + (-total % _FLAT_BUCKET), _FLAT_BUCKET) - total
+    if total + pad <= flat.size:
+        flat[total:total + pad] = 0  # never ship np.empty garbage
+        return flat[:total + pad]
+    return np.pad(flat[:total], (0, pad))
+
+
 def _chunk_step(wire_arr, lens, df_acc, cfg: PipelineConfig, length: int,
                 ragged: bool):
     """THE per-chunk dispatch of the resident path — the single call
@@ -338,13 +350,15 @@ def _check_chunk_fits_int32(chunk_docs: int, length: int) -> None:
 
 
 def _finish_wire(trips, len_parts, df_acc, num_docs: int, k: int,
-                 score_dtype, cfg: PipelineConfig, wire_vals: bool):
+                 score_dtype, cfg: PipelineConfig, wire_vals: bool,
+                 exact_wire: bool = False):
     """THE final score+pack dispatch (single call site, as above)."""
     trip_i, trip_c, trip_h = trips
     return _score_pack_wire(
         tuple(trip_i), tuple(trip_c), tuple(trip_h), tuple(len_parts),
         df_acc, jnp.int32(num_docs), topk=k, score_dtype=score_dtype,
-        wide_ids=cfg.vocab_size > (1 << 16), include_vals=wire_vals)
+        wide_ids=cfg.vocab_size > (1 << 16), include_vals=wire_vals,
+        include_counts=exact_wire)
 
 
 def _resident_chunking(num_docs: int, chunk_docs: int):
@@ -385,14 +399,7 @@ def make_flat_packer(input_dir: str, cfg: PipelineConfig, chunk_docs: int,
             max_per_doc=length, pad_docs_to=chunk_docs)
         assert out is not None
         flat, lengths, total = out
-        # At least one bucket even for an all-empty chunk: a zero-size
-        # operand would fail the device gather's trace (and one bucket
-        # is the shape small chunks land on anyway).
-        pad = max(total + (-total % _FLAT_BUCKET), _FLAT_BUCKET) - total
-        if total + pad <= flat.size:
-            flat[total:total + pad] = 0  # never ship np.empty garbage
-            return flat[:total + pad], lengths, total
-        return np.pad(flat[:total], (0, pad)), lengths, total
+        return _bucket_pad_flat(flat, total), lengths, total
 
     def pack_python(chunk_names: List[str]):
         ids, lengths = padded(chunk_names)
@@ -419,18 +426,39 @@ def make_flat_packer(input_dir: str, cfg: PipelineConfig, chunk_docs: int,
 # caller's leisure).
 @functools.partial(jax.jit,
                    static_argnames=("topk", "score_dtype", "wide_ids",
-                                    "include_vals"))
+                                    "include_vals", "include_counts"))
 def _score_pack_wire(ids, counts, head, lengths, df, num_docs, *,
                      topk: int, score_dtype, wide_ids: bool,
-                     include_vals: bool = True):
+                     include_vals: bool = True,
+                     include_counts: bool = False):
     cat = (lambda parts: parts[0] if len(parts) == 1
            else jnp.concatenate(parts, axis=0))
     ids, counts, head = cat(ids), cat(counts), cat(head)
     lengths = cat(lengths)
     idf = idf_from_df(df, num_docs, score_dtype)
     scores = sparse_scores(ids, counts, head, lengths, idf)
-    vals, tids = sparse_topk(scores, ids, head, topk)
     as_bytes = lambda a: lax.bitcast_convert_type(a, jnp.uint8).reshape(-1)
+    if include_counts:
+        # Exact-ids wire (collision-free intern ids): the host rescores
+        # the selection in float64 from integers alone, so ship
+        # (id u16/i32, count u16) per selected slot plus ONE copy of
+        # the full [V] DF vector (256 KB at 2^16 — far smaller than a
+        # per-slot df column, and it doubles as the boundary-tie
+        # fallback's exact DF). No scores, no document re-pass
+        # (rerank.exact_topk_from_wire). count 0 marks invalid slots
+        # (a real selection has count >= 1).
+        from tfidf_tpu.ops.sparse import sparse_topk_counts
+        if ids.shape[1] > (1 << 16) - 1:
+            raise ValueError("exact-ids wire carries uint16 counts: "
+                             "doc_len must be < 65536")
+        _, tids, tcnt = sparse_topk_counts(scores, ids, counts, head, topk)
+        ok = tids >= 0
+        safe = jnp.maximum(tids, 0)
+        body = [as_bytes(safe if wide_ids else safe.astype(jnp.uint16)),
+                as_bytes(jnp.where(ok, tcnt, 0).astype(jnp.uint16)),
+                as_bytes(df.astype(jnp.int32))]
+        return df, jnp.concatenate(body)
+    vals, tids = sparse_topk(scores, ids, head, topk)
     # Occupied-bucket count rides the wire as a 4-byte tail: the
     # exact-terms margin warning (rerank.margin_check) needs only this
     # scalar, and folding it here keeps the DF vector itself on device
@@ -458,6 +486,21 @@ def _score_pack_wire(ids, counts, head, lengths, df, num_docs, *,
     tid_wire = tids if wide_ids else jnp.maximum(tids, 0).astype(jnp.uint16)
     return df, jnp.concatenate([as_bytes(vals_wire), as_bytes(tid_wire),
                                 occ])
+
+
+def _decode_wire_exact(buf: np.ndarray, d_padded: int, k: int,
+                       wide_ids: bool):
+    """Decode the exact-ids wire: (ids, counts) int32 [D, K] plus the
+    full [V] DF vector from the tail. Invalid slots have count 0 (ids
+    there are don't-care)."""
+    id_t = "<i4" if wide_ids else "<u2"
+    id_bytes = d_padded * k * (4 if wide_ids else 2)
+    cnt_bytes = d_padded * k * 2
+    tids = buf[:id_bytes].view(id_t).reshape(d_padded, k).astype(np.int32)
+    cnt = buf[id_bytes:id_bytes + cnt_bytes].view("<u2") \
+        .reshape(d_padded, k).astype(np.int32)
+    df_vec = buf[id_bytes + cnt_bytes:].view("<i4")
+    return tids, cnt, df_vec
 
 
 def _decode_wire(buf: np.ndarray, d_padded: int, k: int, wide_ids: bool,
@@ -871,6 +914,119 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
                         num_docs=num_docs,
                         df_occupied=int((df_host > 0).sum()),
                         path="streaming", phases=ph)
+
+
+@dataclasses.dataclass
+class ExactIngest:
+    """Device-exact ingest outputs: collision-free intern word ids.
+
+    Everything here is integer-exact — (count, df) per selected slot is
+    sufficient for the host to reproduce the reference's float64 score
+    (``rerank.exact_topk_from_wire``). Invalid slots have count 0.
+    """
+
+    names: List[str]
+    lengths: np.ndarray       # [D] truncated docSize
+    topk_ids: np.ndarray      # [D, K'] exact word ids
+    topk_counts: np.ndarray   # [D, K'] in-doc term counts
+    df: np.ndarray            # [V] exact corpus DF (from the wire tail)
+    num_docs: int
+    words: List[bytes]        # id -> word bytes (the intern dictionary)
+    phases: Optional[Dict[str, float]] = None
+
+
+def run_overlapped_exact(input_dir: str,
+                         config: Optional[PipelineConfig] = None,
+                         chunk_docs: int = 8192,
+                         doc_len: Optional[int] = None,
+                         strict: bool = True,
+                         session=None) -> ExactIngest:
+    """Exact-terms fast path: overlapped resident ingest on EXACT ids.
+
+    The native intern table (``native/intern.cc``) assigns every
+    distinct token a dense corpus-global id during the single pack
+    pass, so there are no hash collisions anywhere: the device's
+    integer counts/DF/top-k are word-exact, and the result wire ships
+    (id, count, df) per selected slot — the host rescores in float64
+    and NEVER re-reads the corpus (where the hashed mode's re-rank
+    engine pays a full native re-pass, ``native/rerank.cc``). This is
+    the reference's string-keyed-table semantics (``TFIDF.c:26-42``)
+    with O(1) interning instead of its O(V_doc) linear probes.
+
+    Raises :class:`~tfidf_tpu.io.fast_tokenizer.ExactVocabOverflow`
+    when the corpus holds more distinct words than ``cfg.vocab_size``,
+    RuntimeError when the native intern table is not built, and
+    ValueError past the resident budget — callers fall back to the
+    hashed+margin+rerank engine (``rerank.exact_terms``).
+    """
+    cfg = config or PipelineConfig(vocab_mode=VocabMode.HASHED, topk=16)
+    if cfg.topk is None:
+        raise ValueError("exact ingest requires a topk selection")
+    if cfg.tokenizer is not TokenizerKind.WHITESPACE:
+        raise ValueError("exact ingest serves the whitespace tokenizer")
+    if cfg.vocab_size > (1 << 16):
+        raise ValueError("exact-ids wire is uint16: vocab_size <= 65536")
+    if not fast_tokenizer.intern_available():
+        raise RuntimeError("native intern table unavailable "
+                           "(make -C native fast_tokenizer.so)")
+    length = doc_len or cfg.max_doc_len
+    names = discover_names(input_dir, strict)
+    num_docs = len(names)
+    if num_docs == 0:
+        raise ValueError(f"no documents in {input_dir}")
+    resident = int(os.environ.get("TFIDF_TPU_RESIDENT_ELEMS",
+                                  _RESIDENT_ELEMS))
+    if num_docs * length > resident:
+        raise ValueError("exact ingest is resident-only; corpus exceeds "
+                         "TFIDF_TPU_RESIDENT_ELEMS")
+    score_dtype = jax.dtypes.canonicalize_dtype(jnp.dtype(cfg.score_dtype))
+    k = min(cfg.topk, length)
+    chunk_docs, starts = _resident_chunking(num_docs, chunk_docs)
+    _check_chunk_fits_int32(chunk_docs, length)
+
+    # ``session``: an open InternSession to use and LEAVE OPEN (the
+    # caller wants the table afterwards — e.g. the native exact_emit
+    # finish probes it for tie fallback); default: own session.
+    import contextlib
+    ph = {"pack": 0.0, "put": 0.0}
+    ctx = (contextlib.nullcontext(session) if session is not None
+           else fast_tokenizer.InternSession(cfg.vocab_size))
+    with ctx as sess:
+        df_acc = jnp.zeros((cfg.vocab_size,), jnp.int32)
+        trip_i, trip_c, trip_h, len_parts, all_lengths = [], [], [], [], []
+        for start in starts:
+            chunk_names = names[start:start + chunk_docs]
+            t0 = time.perf_counter()
+            flat, lengths, total = sess.pack_flat(
+                [os.path.join(input_dir, n) for n in chunk_names],
+                cfg.truncate_tokens_at, length, pad_docs_to=chunk_docs,
+                seed=cfg.hash_seed)
+            flat = _bucket_pad_flat(flat, total)
+            ph["pack"] += time.perf_counter() - t0
+            all_lengths.append(lengths[:len(chunk_names)])
+            t0 = time.perf_counter()
+            lens = jax.device_put(lengths)
+            i_, c_, h_, df_acc = _chunk_step(
+                jax.device_put(flat), lens, df_acc, cfg, length,
+                ragged=True)
+            trip_i.append(i_)
+            trip_c.append(c_)
+            trip_h.append(h_)
+            len_parts.append(lens)
+            ph["put"] += time.perf_counter() - t0
+        t0 = time.perf_counter()
+        _, wire = _finish_wire((trip_i, trip_c, trip_h), len_parts,
+                               df_acc, num_docs, k, score_dtype, cfg,
+                               wire_vals=False, exact_wire=True)
+        buf = np.asarray(jax.device_get(wire))
+        ph["fetch"] = time.perf_counter() - t0
+        words = sess.words()
+    tids, cnt, df_vec = _decode_wire_exact(buf, len(starts) * chunk_docs,
+                                           k, wide_ids=False)
+    return ExactIngest(names=names, lengths=np.concatenate(all_lengths),
+                       topk_ids=tids[:num_docs],
+                       topk_counts=cnt[:num_docs], df=df_vec,
+                       num_docs=num_docs, words=words, phases=ph)
 
 
 def profile_resident(input_dir: str, config: Optional[PipelineConfig] = None,

@@ -27,11 +27,12 @@ _has_loader = False
 _has_open2 = False
 _has_rerank = False
 _has_flat = False
+_has_intern = False
 
 
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _load_failed, _has_loader, _has_open2, _has_rerank, \
-        _has_flat
+        _has_flat, _has_intern
     # The kill-switch wins even over an already-loaded library, and a
     # missing .so is not sticky (tests build it on demand mid-process).
     if os.environ.get("TFIDF_TPU_NO_NATIVE"):
@@ -96,6 +97,54 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int32)]
         _has_flat = True
     except AttributeError:  # stale .so predating the flat packer
+        pass
+    try:
+        lib.intern_open.restype = ctypes.c_void_p
+        lib.intern_open.argtypes = [ctypes.c_int64]
+        lib.intern_fill_flat_u16.restype = ctypes.c_int64
+        lib.intern_fill_flat_u16.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
+            ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint16),
+            ctypes.POINTER(ctypes.c_int32)]
+        lib.intern_count.restype = ctypes.c_int64
+        lib.intern_count.argtypes = [ctypes.c_void_p]
+        lib.intern_overflow.restype = ctypes.c_int
+        lib.intern_overflow.argtypes = [ctypes.c_void_p]
+        lib.intern_blob_bytes.restype = ctypes.c_int64
+        lib.intern_blob_bytes.argtypes = [ctypes.c_void_p]
+        lib.intern_dump.restype = None
+        lib.intern_dump.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_char_p]
+        lib.intern_close.restype = None
+        lib.intern_close.argtypes = [ctypes.c_void_p]
+        lib.exact_emit_run.restype = ctypes.c_void_p
+        lib.exact_emit_run.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_uint64, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.exact_emit_total.restype = ctypes.c_int64
+        lib.exact_emit_total.argtypes = [ctypes.c_void_p]
+        lib.exact_emit_word_bytes.restype = ctypes.c_int64
+        lib.exact_emit_word_bytes.argtypes = [ctypes.c_void_p]
+        lib.exact_emit_line_bytes.restype = ctypes.c_int64
+        lib.exact_emit_line_bytes.argtypes = [ctypes.c_void_p]
+        lib.exact_emit_fill.restype = None
+        lib.exact_emit_fill.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_double), ctypes.c_char_p,
+            ctypes.c_char_p]
+        lib.exact_emit_free.restype = None
+        lib.exact_emit_free.argtypes = [ctypes.c_void_p]
+        _has_intern = True
+    except AttributeError:  # stale .so predating the intern table
         pass
     try:
         lib.rerank_run.restype = ctypes.c_void_p
@@ -223,6 +272,32 @@ def flat_available() -> bool:
     return _load() is not None and _has_flat
 
 
+def _flat_pack_scaffold(lib, paths: List[str], max_per_doc: int,
+                        pad_docs_to: Optional[int],
+                        n_threads: Optional[int], fill):
+    """Shared loader scaffolding of the flat packers (hashed and
+    exact-id): path blob, parallel read (no count prepass), error
+    mapping, buffer sizing, close. ``fill(handle, flat, lengths)``
+    runs the per-token id pass and returns total ids (or a negative
+    sentinel the caller interprets)."""
+    n_threads = n_threads or min(os.cpu_count() or 1, 16)
+    blob = b"\0".join(p.encode() for p in paths) + b"\0"
+    handle = lib.loader_open2(blob, len(paths), n_threads, 0)
+    try:
+        err = lib.loader_error(handle)
+        if err >= 0:
+            raise FileNotFoundError(paths[err])
+        d_padded = max(pad_docs_to or len(paths), len(paths))
+        flat = np.empty((len(paths) * max_per_doc,), dtype=np.uint16)
+        lengths = np.zeros((d_padded,), dtype=np.int32)
+        total = fill(handle,
+                     flat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+                     lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        return flat, lengths, int(total)
+    finally:
+        lib.loader_close(handle)
+
+
 def load_pack_flat(paths: List[str], vocab_size: int, seed: int = 0,
                    truncate_at: Optional[int] = None,
                    max_per_doc: int = 256,
@@ -243,24 +318,11 @@ def load_pack_flat(paths: List[str], vocab_size: int, seed: int = 0,
     if lib is None or not _has_flat or not _has_open2 \
             or vocab_size > (1 << 16):
         return None
-    n_threads = n_threads or min(os.cpu_count() or 1, 16)
-    blob = b"\0".join(p.encode() for p in paths) + b"\0"
-    handle = lib.loader_open2(blob, len(paths), n_threads, 0)
-    try:
-        err = lib.loader_error(handle)
-        if err >= 0:
-            raise FileNotFoundError(paths[err])
-        d_padded = max(pad_docs_to or len(paths), len(paths))
-        flat = np.empty((len(paths) * max_per_doc,), dtype=np.uint16)
-        lengths = np.zeros((d_padded,), dtype=np.int32)
-        total = lib.loader_fill_flat_u16(
+    return _flat_pack_scaffold(
+        lib, paths, max_per_doc, pad_docs_to, n_threads,
+        lambda handle, flat_p, lens_p: lib.loader_fill_flat_u16(
             handle, ctypes.c_uint64(seed), vocab_size, truncate_at or 0,
-            max_per_doc,
-            flat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
-            lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
-        return flat, lengths, int(total)
-    finally:
-        lib.loader_close(handle)
+            max_per_doc, flat_p, lens_p))
 
 
 def rerank_available() -> bool:
@@ -338,6 +400,137 @@ def exact_rerank_paths(paths: List[str], topk_ids: np.ndarray,
         if res is not None:
             lib.rerank_free(res)
         lib.loader_close(handle)
+
+
+def intern_available() -> bool:
+    """True when the native exact-id intern symbols are present."""
+    return _load() is not None and _has_intern
+
+
+class ExactVocabOverflow(Exception):
+    """More distinct words than the configured vocab — the exact-id
+    fast path cannot serve this corpus; fall back to hashed+rerank."""
+
+
+class InternSession:
+    """A run-scoped exact word-id table (``native/intern.cc``).
+
+    Shared across every chunk of an overlapped ingest so ids are
+    corpus-global; ``words()`` dumps the id -> bytes dictionary at the
+    end. Use as a context manager (the table is native memory).
+    """
+
+    def __init__(self, cap: int):
+        lib = _load()
+        if lib is None or not _has_intern:
+            raise RuntimeError("native intern table unavailable")
+        if cap > (1 << 16):
+            raise ValueError("exact-id wire is uint16: cap <= 65536")
+        self._lib = lib
+        self._h = lib.intern_open(cap)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def close(self):
+        if self._h is not None:
+            self._lib.intern_close(self._h)
+            self._h = None
+
+    @property
+    def count(self) -> int:
+        return int(self._lib.intern_count(self._h))
+
+    def pack_flat(self, paths: List[str], truncate_at: Optional[int],
+                  max_per_doc: int, pad_docs_to: Optional[int] = None,
+                  seed: int = 0, n_threads: Optional[int] = None):
+        """Exact-id twin of :func:`load_pack_flat` (same return
+        contract, shared loader scaffold). Raises
+        :class:`ExactVocabOverflow` when the corpus holds more distinct
+        words than the table's cap."""
+        lib = self._lib
+        flat, lengths, total = _flat_pack_scaffold(
+            lib, paths, max_per_doc, pad_docs_to, n_threads,
+            lambda handle, flat_p, lens_p: lib.intern_fill_flat_u16(
+                handle, self._h, ctypes.c_uint64(seed), truncate_at or 0,
+                max_per_doc, flat_p, lens_p))
+        if total < 0:
+            raise ExactVocabOverflow(
+                f"corpus exceeds {self.count} distinct words")
+        return flat, lengths, total
+
+    def emit(self, input_dir: str, names: List[str],
+             topk_ids: np.ndarray, topk_counts: np.ndarray,
+             df: np.ndarray, lengths: np.ndarray, num_docs: int, k: int,
+             truncate_at: Optional[int], max_tokens: Optional[int],
+             seed: int = 0, n_threads: Optional[int] = None):
+        """Native exact-terms finish (``intern.cc exact_emit``): float64
+        rescore, per-doc (-score, word) sort, reference-format lines,
+        global byte-lex sort — plus doc-major (word, score) arrays for
+        recall consumers. Returns ``(lines, per_doc_counts, offs, lens,
+        scores, word_blob)`` where ``lines`` is the final sorted output
+        bytes."""
+        lib = self._lib
+        n_docs = len(names)
+        kprime = topk_ids.shape[1] if topk_ids.ndim == 2 else 0
+        assert topk_ids.ndim == 2 and topk_ids.shape[0] == n_docs
+        ids = np.ascontiguousarray(topk_ids, dtype=np.int32)
+        cnt = np.ascontiguousarray(topk_counts, dtype=np.int32)
+        dfv = np.ascontiguousarray(df, dtype=np.int32)
+        lens_arr = np.ascontiguousarray(lengths[:n_docs], dtype=np.int32)
+        blob = b"\0".join(n.encode() for n in names) + b"\0"
+        i32p = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        failed = np.full((1,), -1, dtype=np.int64)
+        res = lib.exact_emit_run(
+            self._h, input_dir.encode(), blob, i32p(ids), i32p(cnt),
+            n_docs, kprime, i32p(dfv), dfv.size, i32p(lens_arr),
+            num_docs, k, truncate_at or 0, max_tokens or 0,
+            ctypes.c_uint64(seed),
+            n_threads or min(os.cpu_count() or 1, 16),
+            failed.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        if not res:
+            # A boundary-tie document vanished between pack and emit —
+            # fail loudly like the Python twin (_doc_words).
+            raise FileNotFoundError(
+                os.path.join(input_dir, names[int(failed[0])])
+                if failed[0] >= 0 else input_dir)
+        try:
+            total = int(lib.exact_emit_total(res))
+            per_doc = np.zeros((n_docs,), dtype=np.int32)
+            offs = np.zeros((max(total, 1),), dtype=np.int64)
+            lens_out = np.zeros((max(total, 1),), dtype=np.int64)
+            scores = np.zeros((max(total, 1),), dtype=np.float64)
+            wblob = ctypes.create_string_buffer(
+                max(int(lib.exact_emit_word_bytes(res)), 1))
+            lblob = ctypes.create_string_buffer(
+                max(int(lib.exact_emit_line_bytes(res)), 1))
+            lib.exact_emit_fill(
+                res, i32p(per_doc),
+                offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                lens_out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                scores.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                wblob, lblob)
+            return (lblob.raw[:int(lib.exact_emit_line_bytes(res))],
+                    per_doc, offs, lens_out, scores, wblob.raw)
+        finally:
+            lib.exact_emit_free(res)
+
+    def words(self) -> List[bytes]:
+        """The id -> word dictionary, index = exact id."""
+        lib = self._lib
+        n = self.count
+        offs = np.zeros((max(n, 1),), dtype=np.int64)
+        lens = np.zeros((max(n, 1),), dtype=np.int64)
+        blob = ctypes.create_string_buffer(
+            max(int(lib.intern_blob_bytes(self._h)), 1))
+        lib.intern_dump(
+            self._h, offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), blob)
+        raw = blob.raw
+        return [raw[offs[i]:offs[i] + lens[i]] for i in range(n)]
 
 
 def tokenize_spans(data: bytes) -> Optional[List[bytes]]:

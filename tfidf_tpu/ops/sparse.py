@@ -132,6 +132,24 @@ def sparse_topk(scores: jax.Array, ids: jax.Array, head: jax.Array, k: int
     return jnp.where(ok, vals, 0), jnp.where(ok, picked, -1)
 
 
+def sparse_topk_counts(scores: jax.Array, ids: jax.Array,
+                       counts: jax.Array, head: jax.Array, k: int
+                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """:func:`sparse_topk` that also gathers the selected slots' integer
+    counts — the exact-ids wire's payload (``ingest._score_pack_wire``):
+    with collision-free ids, (count, df) per selection is everything the
+    host needs to rescore in exact float64. Invalid slots come back
+    (0, -1, 0); a real selection always has count >= 1."""
+    k = min(k, scores.shape[1])
+    neg = jnp.finfo(scores.dtype).min
+    vals, sel = lax.top_k(jnp.where(head, scores, neg), k)
+    picked = jnp.take_along_axis(ids, sel, axis=1)
+    cnt = jnp.take_along_axis(counts, sel, axis=1)
+    ok = vals > neg
+    return (jnp.where(ok, vals, 0), jnp.where(ok, picked, -1),
+            jnp.where(ok, cnt, 0))
+
+
 def to_bcoo(ids: jax.Array, counts: jax.Array, head: jax.Array,
             vocab_size: int) -> jsparse.BCOO:
     """Export row-sparse counts as a BCOO [D, V] term-document matrix.

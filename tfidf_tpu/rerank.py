@@ -74,6 +74,255 @@ def margin_check(df, margin: int, *, occupied: Optional[int] = None,
             f"measured-safe margin here is {suggested} (docs/EXACT.md)")
 
 
+def exact_topk_from_wire(exact, k: int, input_dir: str,
+                         cfg: PipelineConfig,
+                         max_tokens: Optional[int] = None
+                         ) -> Dict[str, DocTerms]:
+    """Float64 rescore of an exact-ids device selection — document
+    re-reads only for boundary-tie docs (the device-exact half of the
+    exact-terms mode).
+
+    ``exact`` is an :class:`~tfidf_tpu.ingest.ExactIngest`: because the
+    intern ids are collision-free, the wire's (count, df) integers are
+    word-exact, and the reference's score (tf = count/docSize,
+    idf = ln(N/df), float64 op order — ``TFIDF.c:202,243``) is computed
+    right here from integers. Same output contract as
+    :func:`exact_topk`: score-desc then word-asc, at most k entries,
+    positive scores only.
+
+    Boundary ties: a tie group (equal exact scores — e.g. a doc's
+    corpus-hapax words all score ln(N)/docSize) can extend past the
+    device's K'-candidate wire, and its word-asc members cannot then be
+    chosen from the wire alone. Such docs are DETECTED exactly (full
+    wire whose tail score equals the would-be k-th score) and resolved
+    with a doc-local exact pass: tokenize that one document, join
+    counts against the device's exact [V] DF — no corpus scan.
+    """
+    lens = np.maximum(exact.lengths.astype(np.float64), 1.0)
+    valid = exact.topk_counts > 0
+    tf = exact.topk_counts.astype(np.float64) / lens[:, None]
+    dfsel = np.where(valid, exact.df[np.maximum(exact.topk_ids, 0)], 1)
+    idf = np.log(float(exact.num_docs) / dfsel.astype(np.float64))
+    scores = np.where(valid, tf * idf, 0.0)
+    # Reference tie order (score desc, word asc): precompute each id's
+    # rank in byte-lex word order, then one vectorized lexsort per row.
+    words = exact.words
+    rank = np.empty(max(len(words), 1), dtype=np.int64)
+    rank[np.asarray(sorted(range(len(words)), key=words.__getitem__),
+                    dtype=np.int64)] = np.arange(len(words))
+    wr = rank[np.maximum(exact.topk_ids, 0)]
+    sel = np.lexsort((wr, -scores), axis=1)
+    sc = np.take_along_axis(scores, sel, axis=1)
+    ids = np.take_along_axis(exact.topk_ids, sel, axis=1)
+    kprime = sc.shape[1]
+    kk = min(k, kprime)
+    # Boundary-tie detection (exact, vectorized): the wire is full AND
+    # its worst candidate's positive score ties the k-th entry — the
+    # tie group may continue past the wire, so the word-asc choice is
+    # undecidable from the wire alone.
+    full = valid.all(axis=1)
+    tied = full & (sc[:, kk - 1] == sc[:, kprime - 1]) \
+        & (sc[:, kprime - 1] > 0.0) if kprime > 0 \
+        else np.zeros(sc.shape[0], bool)
+    # Bulk-convert once (C-speed) — the per-doc loop then touches only
+    # Python floats/ints, which halves dict-build time at 1M rows.
+    sc_l = sc[:, :kk].tolist()
+    id_l = ids[:, :kk].tolist()
+    out: Dict[str, DocTerms] = {}
+    for d, name in enumerate(exact.names):
+        if tied[d]:
+            continue  # resolved below from the document itself
+        row_sc, row_id, row = sc_l[d], id_l[d], []
+        for j in range(kk):
+            s = row_sc[j]
+            if s <= 0.0:
+                break  # sorted desc: the rest are zero/invalid
+            row.append((words[row_id[j]], s))
+        out[name] = row
+    n_tied = int(tied.sum())
+    if n_tied:
+        # Doc-local exact resolution: one tokenize per affected doc,
+        # DF joined from the wire's exact [V] vector — no corpus scan.
+        word2id = {w: i for i, w in enumerate(words)}
+        n = float(exact.num_docs)
+        for d in np.flatnonzero(tied):
+            name = exact.names[d]
+            toks, size = _doc_words(input_dir, name, cfg, max_tokens)
+            counts: Dict[bytes, int] = {}
+            for w in toks:
+                counts[w] = counts.get(w, 0) + 1
+            scored = []
+            for w, c in counts.items():
+                s = (c / max(size, 1)) \
+                    * float(np.log(n / exact.df[word2id[w]]))
+                if s > 0.0:
+                    scored.append((w, s))
+            scored.sort(key=lambda t: (-t[1], t[0]))
+            out[name] = scored[:k]
+    return out
+
+
+def exact_terms(input_dir: str, cfg: PipelineConfig, k: int, *,
+                doc_len: Optional[int] = None, chunk_docs: int = 8192,
+                strict: bool = True):
+    """One-call exact-terms mode with automatic engine choice.
+
+    Tries the device-exact fast path (``ingest.run_overlapped_exact``:
+    collision-free intern ids, host rescore from wire integers, no
+    corpus re-pass) and falls back to the hashed+margin+rerank engine
+    when the corpus cannot be served exactly — more distinct words than
+    ``cfg.vocab_size``, no native build, or past the resident budget.
+
+    ``cfg.topk`` is the device margin selection (margin*k). The device-
+    exact path clamps it to 2k: with no collisions the margin only has
+    to absorb float32-vs-float64 rank-boundary rounding, not collision
+    displacement (docs/EXACT.md) — recall is pinned by the bench.
+
+    Returns ``(per_doc, engine)`` where engine is "device-exact" or
+    "hashed-rerank".
+    """
+    import sys
+
+    from tfidf_tpu.io import fast_tokenizer as ft
+
+    # The truncation the ingest applies (ingest length rule) — the
+    # rescore must re-tokenize with the SAME cap or tied docs would
+    # score terms the device never saw.
+    length = doc_len or cfg.max_doc_len
+    exact = None
+    if ft.intern_available():
+        from tfidf_tpu.ingest import run_overlapped_exact
+        try:
+            # Narrow try: only the ingest may legitimately fail over
+            # (overflow / resident budget / vocab width). A bug in the
+            # rescore below must surface, not silently re-run the
+            # corpus on the slow engine.
+            exact = run_overlapped_exact(input_dir,
+                                         _device_cfg(cfg, k),
+                                         chunk_docs=chunk_docs,
+                                         doc_len=doc_len, strict=strict)
+        except (ft.ExactVocabOverflow, ValueError) as e:
+            sys.stderr.write(f"exact-terms: device-exact path "
+                             f"unavailable ({e}); using hashed re-rank "
+                             f"engine\n")
+    else:
+        sys.stderr.write("exact-terms: native intern table not built; "
+                         "using hashed re-rank engine\n")
+    if exact is not None:
+        return (exact_topk_from_wire(exact, k, input_dir, cfg,
+                                     max_tokens=length),
+                "device-exact")
+    return _exact_terms_fallback(input_dir, cfg, k, doc_len=doc_len,
+                                 chunk_docs=chunk_docs, strict=strict)
+
+
+def _device_cfg(cfg: PipelineConfig, k: int) -> PipelineConfig:
+    """The device-exact selection config: margin k+8, the SINGLE margin
+    rule for both exact-terms entry points. With collision-free ids the
+    spare slots exist only to EXPOSE a boundary tie (which then
+    resolves doc-locally) — correctness holds for any margin > k, so
+    the margin does not scale with cfg.topk the way the hashed
+    engine's collision margin must (docs/EXACT.md)."""
+    import dataclasses as _dc
+
+    dev_topk = k + 8 if cfg.topk is None else min(cfg.topk, k + 8)
+    return _dc.replace(cfg, topk=dev_topk)
+
+
+def exact_terms_lines(input_dir: str, cfg: PipelineConfig, k: int, *,
+                      doc_len: Optional[int] = None,
+                      chunk_docs: int = 8192, strict: bool = True):
+    """Exact-terms mode producing the FINAL sorted output bytes — the
+    complete job (ingest + float64 rescore + per-doc and global sort +
+    reference formatting), which is what the CPU oracle's wall clock
+    also covers.
+
+    Fast path: device-exact ingest + the native ``exact_emit`` finish
+    (rescore/format/sort all in C++, boundary ties resolved doc-locally
+    against the live intern table). Falls back to :func:`exact_terms` +
+    Python line assembly when the corpus can't be served exactly.
+
+    Returns ``(lines, engine, sample_fn)``: ``lines`` is the sorted
+    output bytes (trailing newline included), and ``sample_fn(names)``
+    lazily builds the per-doc ``[(word, score), ...]`` lists for a doc
+    subset (recall measurement) without paying the full-corpus dict.
+    """
+    import sys
+
+    from tfidf_tpu.io import fast_tokenizer as ft
+
+    length = doc_len or cfg.max_doc_len  # the ingest truncation cap
+    if ft.intern_available():
+        from tfidf_tpu.ingest import run_overlapped_exact
+        with ft.InternSession(cfg.vocab_size) as sess:
+            try:
+                # Narrow try (see exact_terms): only the ingest may
+                # legitimately fail over to the hashed engine.
+                exact = run_overlapped_exact(input_dir,
+                                             _device_cfg(cfg, k),
+                                             chunk_docs=chunk_docs,
+                                             doc_len=doc_len,
+                                             strict=strict, session=sess)
+            except (ft.ExactVocabOverflow, ValueError) as e:
+                sys.stderr.write(f"exact-terms: device-exact path "
+                                 f"unavailable ({e}); using hashed "
+                                 f"re-rank engine\n")
+                exact = None
+            if exact is not None:
+                lines, per_doc, offs, lens, scores, wblob = sess.emit(
+                    input_dir, exact.names, exact.topk_ids,
+                    exact.topk_counts, exact.df, exact.lengths,
+                    exact.num_docs, k, cfg.truncate_tokens_at, length,
+                    seed=cfg.hash_seed)
+
+                def sample_fn(names):
+                    want = set(names)
+                    starts = np.zeros(len(per_doc) + 1, dtype=np.int64)
+                    np.cumsum(per_doc, out=starts[1:])
+                    out: Dict[str, DocTerms] = {}
+                    for d, name in enumerate(exact.names):
+                        if name not in want:
+                            continue
+                        lo, hi = int(starts[d]), int(starts[d + 1])
+                        out[name] = [(wblob[offs[j]:offs[j] + lens[j]],
+                                      float(scores[j]))
+                                     for j in range(lo, hi)]
+                    return out
+
+                return lines, "device-exact", sample_fn
+    else:
+        sys.stderr.write("exact-terms: native intern table not built; "
+                         "using hashed re-rank engine\n")
+
+    per_doc_dict, engine = _exact_terms_fallback(input_dir, cfg, k,
+                                                 doc_len=doc_len,
+                                                 chunk_docs=chunk_docs,
+                                                 strict=strict)
+    lines_list = [b"%s@%s\t%.16f" % (name.encode(), w, s)
+                  for name, terms in per_doc_dict.items() if name
+                  for w, s in terms]
+    lines_list.sort()
+    lines = b"".join(l + b"\n" for l in lines_list)
+    return lines, engine, (lambda names: {n: per_doc_dict[n]
+                                          for n in names
+                                          if n in per_doc_dict})
+
+
+def _exact_terms_fallback(input_dir: str, cfg: PipelineConfig, k: int, *,
+                          doc_len: Optional[int], chunk_docs: int,
+                          strict: bool):
+    """The hashed+margin+rerank engine (shared by the two entry points)."""
+    from tfidf_tpu.ingest import run_overlapped
+
+    r = run_overlapped(input_dir, cfg, chunk_docs=chunk_docs,
+                       doc_len=doc_len, strict=strict, wire_vals=False)
+    # max_tokens mirrors the ingest truncation rule (doc_len or
+    # cfg.max_doc_len) so the re-rank's TF/docSize stay device-parity.
+    return (exact_topk(input_dir, r.names, r.topk_ids, r.num_docs, cfg,
+                       k=k, max_tokens=doc_len or cfg.max_doc_len,
+                       df_occupied=r.df_occupied), "hashed-rerank")
+
+
 def _doc_words(input_dir: str, name: str, cfg: PipelineConfig,
                max_tokens: Optional[int]) -> Tuple[List[bytes], int]:
     """Exact host tokenization of one document, mirroring the packer:
