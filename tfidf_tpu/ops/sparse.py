@@ -45,17 +45,34 @@ def sorted_term_counts(token_ids: jax.Array, lengths: jax.Array
       its in-document frequency. Non-head slots must be masked by
       consumers. Padding sorts to the row tail as id ``INT32_MAX``.
     """
-    d, length = token_ids.shape
     token_ids = token_ids.astype(jnp.int32)  # ids may arrive as uint16
+    pos = jnp.arange(token_ids.shape[1], dtype=jnp.int32)[None, :]
+    return _sorted_counts_core(token_ids, pos < lengths[:, None], lengths)
+
+
+def sorted_term_counts_masked(token_ids: jax.Array, valid: jax.Array
+                              ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """:func:`sorted_term_counts` for NON-CONTIGUOUS validity — e.g.
+    the concatenated n-gram id streams of the device chargram, where
+    each n contributes its own masked tail. Returns the same (ids,
+    counts, head) triple; post-sort the live entries occupy each row's
+    prefix regardless of where the mask's holes were."""
+    return _sorted_counts_core(token_ids.astype(jnp.int32), valid,
+                               valid.sum(axis=1, dtype=jnp.int32))
+
+
+def _sorted_counts_core(token_ids, valid, lengths):
+    d, length = token_ids.shape
     pos = jnp.arange(length, dtype=jnp.int32)[None, :]
-    valid = pos < lengths[:, None]
     sentinel = jnp.iinfo(jnp.int32).max
     sorted_ids = jnp.sort(jnp.where(valid, token_ids, sentinel), axis=1)
-    # Post-sort validity is the same mask: sentinels sort to the tail, so
-    # the first lengths[d] slots are exactly the live ones.
+    # Post-sort validity: sentinels sort to the tail, so the first
+    # lengths[d] (= live count) slots are exactly the live ones — true
+    # for BOTH the contiguous-prefix and the masked entry paths.
+    live = pos < lengths[:, None]
     prev = jnp.concatenate(
         [jnp.full((d, 1), -1, sorted_ids.dtype), sorted_ids[:, :-1]], axis=1)
-    head = valid & (sorted_ids != prev)
+    head = live & (sorted_ids != prev)
     # Run length at a head slot = (next head position, clipped to the
     # live prefix) - own position: an exclusive suffix-min over head
     # positions. Pure cumulative/elementwise ops — no scatter, which on

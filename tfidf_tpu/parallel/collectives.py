@@ -177,7 +177,8 @@ def _chargram_df_psum(df):
 @functools.lru_cache(maxsize=64)
 def make_chargram_sharded_forward(plan: MeshPlan, vocab_size: int,
                                   ngram_lo: int, ngram_hi: int, seed: int,
-                                  score_dtype, topk: int):
+                                  score_dtype, topk: int,
+                                  engine: str = "dense"):
     """Sharded device-chargram forward over the docs axis (VERDICT r2
     item 9: mesh chargram no longer detours through the host tokenizer).
 
@@ -185,18 +186,26 @@ def make_chargram_sharded_forward(plan: MeshPlan, vocab_size: int,
     shard would need an (n-1)-byte halo exchange — the rolling hash is
     row-local but not chunk-local; long byte streams route through the
     host tokenizer or ``parallel.longdoc``. The body IS the
-    single-device ``pipeline._chargram_forward`` — only the DF
-    reduction differs (the sparse engine's sharing contract).
+    single-device ``pipeline._chargram_forward`` (``engine="dense"``)
+    or the round-4 row-sparse wide-vocab lowering
+    (``pipeline._chargram_sparse_forward``, ``engine="sparse"``) —
+    only the DF reduction differs (the sparse engine's sharing
+    contract).
     """
     if plan.n_seq_shards != 1 or plan.n_vocab_shards != 1:
         raise ValueError("device chargram shards the docs axis only; "
                          "build the MeshPlan with seq=1, vocab=1")
     if topk is None:
         raise ValueError("sharded device chargram serves topk mode only")
+    if engine not in ("dense", "sparse"):
+        raise ValueError(f"unknown chargram engine {engine!r}")
 
     def body(byte_ids, byte_lengths, num_docs):
-        from tfidf_tpu.pipeline import _chargram_forward  # cycle-free late
-        return _chargram_forward(
+        from tfidf_tpu.pipeline import (_chargram_forward,
+                                        _chargram_sparse_forward)
+        fwd = (_chargram_sparse_forward if engine == "sparse"
+               else _chargram_forward)
+        return fwd(
             byte_ids, byte_lengths, num_docs, vocab_size=vocab_size,
             ngram_lo=ngram_lo, ngram_hi=ngram_hi, seed=seed,
             score_dtype=score_dtype, topk=topk,

@@ -166,6 +166,54 @@ _chargram_forward_jit = jax.jit(
 )
 
 
+def _chargram_sparse_forward(byte_ids, byte_lengths, num_docs, *,
+                             vocab_size: int, ngram_lo: int, ngram_hi: int,
+                             seed: int, score_dtype, topk: int,
+                             df_reduce=None):
+    """Row-sparse device chargram: raw bytes -> (df, topk) with NO
+    [D, V] histogram — the wide-vocab lowering (BASELINE config 4's
+    point is vocab >> 2^16, where the dense [D, V] counts matrix is
+    the thing that cannot exist: 1024 docs x 2^20 x int32 = 4 GB).
+
+    The (hi-lo+1) rolling-hash id streams concatenate along the token
+    axis with their validity masks (windows never span documents, so
+    concatenation is safe), then the ordinary sort+RLE engine runs on
+    the masked stream (``sorted_term_counts_masked``). docSize is the
+    total n-gram count, identical to the dense path's.
+    """
+    from tfidf_tpu.ops.hashing import device_ngram_ids
+    from tfidf_tpu.ops.sparse import (sorted_term_counts_masked, sparse_df,
+                                      sparse_scores, sparse_topk)
+
+    d, _ = byte_ids.shape
+    ids_parts, valid_parts = [], []
+    total_len = jnp.zeros((d,), jnp.int32)
+    for n in range(ngram_lo, ngram_hi + 1):
+        ids, valid = device_ngram_ids(byte_ids, byte_lengths, n, vocab_size,
+                                      seed)
+        ids_parts.append(ids)
+        valid_parts.append(valid)
+        total_len = total_len + jnp.maximum(byte_lengths - (n - 1), 0)
+    s_ids, counts, head = sorted_term_counts_masked(
+        jnp.concatenate(ids_parts, axis=1),
+        jnp.concatenate(valid_parts, axis=1))
+    df = sparse_df(s_ids, head, vocab_size)
+    if df_reduce is not None:
+        df = df_reduce(df)
+    from tfidf_tpu.ops.scoring import idf_from_df
+    idf = idf_from_df(df, num_docs, score_dtype)
+    scores = sparse_scores(s_ids, counts, head, total_len, idf)
+    tv, ti = sparse_topk(scores, s_ids, head, topk)
+    return df, total_len, tv, ti
+
+
+_chargram_sparse_forward_jit = jax.jit(
+    _chargram_sparse_forward,
+    static_argnames=("vocab_size", "ngram_lo", "ngram_hi", "seed",
+                     "score_dtype", "topk"),
+)
+
+
 class TfidfPipeline(PhaseTimedMixin):
     """Configured TF-IDF runner: corpus in, scored records out.
 
@@ -331,9 +379,20 @@ class TfidfPipeline(PhaseTimedMixin):
                     packed.byte_lengths,
                     plan.sharding(plan.lengths_spec()))
             self._fence((byte_ids, byte_lens))
+        # Lowering choice: explicit engine="sparse" always gets the
+        # row-sparse chargram; a measured DEFAULT keeps the dense
+        # histogram up to 2^16 (the round-3 measured configuration) and
+        # switches to sparse beyond it, where the dense [D, V] counts
+        # matrix is the thing that cannot exist (wide-vocab stress,
+        # BASELINE config 4).
+        use_sparse = (cfg.engine == "sparse"
+                      and (not getattr(cfg, "_engine_defaulted", False)
+                           or cfg.vocab_size > (1 << 16)))
         with self._phase("compute"):
             if plan is None:
-                out = _chargram_forward_jit(
+                fwd_jit = (_chargram_sparse_forward_jit if use_sparse
+                           else _chargram_forward_jit)
+                out = fwd_jit(
                     byte_ids, byte_lens,
                     jnp.int32(packed.num_docs), vocab_size=cfg.vocab_size,
                     ngram_lo=lo, ngram_hi=hi, seed=cfg.hash_seed,
@@ -343,7 +402,8 @@ class TfidfPipeline(PhaseTimedMixin):
                     make_chargram_sharded_forward
                 fwd = make_chargram_sharded_forward(
                     plan, cfg.vocab_size, lo, hi, cfg.hash_seed,
-                    jnp.dtype(cfg.score_dtype), cfg.topk)
+                    jnp.dtype(cfg.score_dtype), cfg.topk,
+                    engine="sparse" if use_sparse else "dense")
                 out = fwd(byte_ids, byte_lens, jnp.int32(packed.num_docs))
             self._fence(out)
         with self._phase("fetch"):
@@ -362,17 +422,18 @@ class TfidfPipeline(PhaseTimedMixin):
         from tfidf_tpu.config import TokenizerKind, VocabMode
 
         cfg = self.config
-        # Device chargram only serves topk+dense runs: it has no word
-        # strings (id_to_word stays empty -> no full output lines) and
-        # its dense [D, V] histogram defeats engine="sparse". Everything
-        # else takes the host tokenizer path, which can serve both.
+        # Device chargram serves topk runs only: it has no word strings
+        # (id_to_word stays empty -> no full output lines). Everything
+        # else takes the host tokenizer path.
+        # Both engines now have device-chargram lowerings (dense
+        # histogram and the round-4 row-sparse wide-vocab path), so the
+        # engine no longer gates the device route — run_bytes picks the
+        # lowering.
         chargram_device = (
             cfg.tokenizer is TokenizerKind.CHARGRAM
             and cfg.vocab_mode is VocabMode.HASHED
             and cfg.chargram_on_device
-            and cfg.topk is not None
-            and (cfg.engine == "dense"
-                 or getattr(cfg, "_engine_defaulted", False)))
+            and cfg.topk is not None)
         if cfg.mesh_shape:
             # Docs-only meshes keep the device chargram path (sharded
             # via shard_map, collectives.make_chargram_sharded_forward);

@@ -47,6 +47,14 @@ class PhaseTimer:
     def items(self) -> List[Tuple[str, float]]:
         return [(n, self._acc[n]) for n in self._order]
 
+    def as_dict(self, ndigits: int = 3) -> Dict[str, float]:
+        """Rounded phase dict — bench/JSON artifact form."""
+        return {n: round(s, ndigits) for n, s in self.items()}
+
+    def reset(self) -> None:
+        self._acc.clear()
+        self._order.clear()
+
     def report(self) -> str:
         total = sum(self._acc.values()) or 1.0
         rows = [f"{n:>12}: {s * 1e3:9.1f} ms ({100 * s / total:4.1f}%)"
