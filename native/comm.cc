@@ -95,6 +95,184 @@ void RunThreadRanks(int nranks, const std::function<void(Comm&)>& body) {
 
 }  // namespace tfidf
 
+// ---------------------------------------------------------------------
+// Process backend: fork + socketpair star, rank 0 as hub. The
+// reference's deployment model is N OS processes under mpirun
+// (TFIDF.c:82-92); this backend actually EXECUTES that model on a
+// machine with no MPI runtime. Every collective is root-centric in the
+// pipeline, so a star topology suffices; non-hub roots are served by
+// relaying through the hub. Frames are length-prefixed byte spans,
+// the same wire discipline as MpiComm (no derived-datatype extent bug
+// by construction, SURVEY §2.5-2).
+
+#include <sys/socket.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstdlib>
+
+namespace tfidf {
+namespace {
+
+void WriteAll(int fd, const void* p, size_t n) {
+  const uint8_t* b = static_cast<const uint8_t*>(p);
+  while (n) {
+    ssize_t w = ::write(fd, b, n);
+    if (w <= 0) {
+      std::perror("comm write");
+      std::abort();  // a dead peer hangs the reference too (SURVEY §5)
+    }
+    b += w;
+    n -= (size_t)w;
+  }
+}
+
+void ReadAll(int fd, void* p, size_t n) {
+  uint8_t* b = static_cast<uint8_t*>(p);
+  while (n) {
+    ssize_t r = ::read(fd, b, n);
+    if (r <= 0) {
+      std::perror("comm read");
+      std::abort();
+    }
+    b += r;
+    n -= (size_t)r;
+  }
+}
+
+void SendFrame(int fd, const std::vector<uint8_t>& buf) {
+  uint64_t n = buf.size();
+  WriteAll(fd, &n, sizeof n);
+  if (n) WriteAll(fd, buf.data(), n);
+}
+
+std::vector<uint8_t> RecvFrame(int fd) {
+  uint64_t n = 0;
+  ReadAll(fd, &n, sizeof n);
+  std::vector<uint8_t> buf(n);
+  if (n) ReadAll(fd, buf.data(), n);
+  return buf;
+}
+
+class ProcessComm : public Comm {
+ public:
+  // Hub: fds[r] = socket to rank r (fds[0] unused). Spoke: fd to hub.
+  ProcessComm(int rank, int nranks, std::vector<int> hub_fds, int spoke_fd)
+      : rank_(rank), nranks_(nranks), fds_(std::move(hub_fds)),
+        fd_(spoke_fd) {}
+
+  int rank() const override { return rank_; }
+  int size() const override { return nranks_; }
+
+  void Broadcast(std::vector<uint8_t>& buf, int root) override {
+    if (rank_ == 0) {
+      if (root != 0) buf = RecvFrame(fds_[root]);
+      for (int r = 1; r < nranks_; ++r)
+        if (r != root) SendFrame(fds_[r], buf);
+    } else if (rank_ == root) {
+      SendFrame(fd_, buf);
+    } else {
+      buf = RecvFrame(fd_);
+    }
+  }
+
+  void GatherVariable(const std::vector<uint8_t>& payload, int root,
+                      std::vector<std::vector<uint8_t>>& out) override {
+    if (rank_ == 0) {
+      std::vector<std::vector<uint8_t>> all(nranks_);
+      all[0] = payload;
+      for (int r = 1; r < nranks_; ++r) all[r] = RecvFrame(fds_[r]);
+      if (root == 0) {
+        out = std::move(all);
+      } else {
+        for (int r = 0; r < nranks_; ++r)
+          if (r != root) SendFrame(fds_[root], all[r]);
+      }
+    } else {
+      SendFrame(fd_, payload);
+      if (rank_ == root) {
+        out.assign(nranks_, {});
+        out[root] = payload;
+        for (int r = 0; r < nranks_; ++r)
+          if (r != root) out[r] = RecvFrame(fd_);
+      }
+    }
+  }
+
+  void ReduceToRoot(std::vector<uint8_t>& buf, int root,
+                    const MergeFn& merge) override {
+    // Ordered fold at root (the reference's non-commutative op,
+    // TFIDF.c:324) — same construction as MpiComm.
+    std::vector<std::vector<uint8_t>> all;
+    GatherVariable(buf, root, all);
+    if (rank_ == root) {
+      for (int r = 0; r < (int)all.size(); ++r) {
+        if (r == root) continue;
+        merge(all[r], buf);
+      }
+    }
+  }
+
+  void Barrier() override {
+    if (rank_ == 0) {
+      for (int r = 1; r < nranks_; ++r) RecvFrame(fds_[r]);
+      std::vector<uint8_t> token;
+      for (int r = 1; r < nranks_; ++r) SendFrame(fds_[r], token);
+    } else {
+      SendFrame(fd_, {});
+      RecvFrame(fd_);
+    }
+  }
+
+ private:
+  int rank_, nranks_;
+  std::vector<int> fds_;
+  int fd_;
+};
+
+}  // namespace
+
+int RunProcessRanks(int nranks, const std::function<int(Comm&)>& body) {
+  std::vector<int> hub_fds(nranks, -1);
+  std::vector<pid_t> pids(nranks, -1);
+  for (int r = 1; r < nranks; ++r) {
+    int sv[2];
+    if (::socketpair(AF_UNIX, SOCK_STREAM, 0, sv) != 0) {
+      std::perror("socketpair");
+      return 70;
+    }
+    pid_t pid = ::fork();
+    if (pid < 0) {
+      std::perror("fork");
+      return 70;
+    }
+    if (pid == 0) {           // child = rank r
+      ::close(sv[0]);
+      for (int i = 1; i < r; ++i) ::close(hub_fds[i]);  // hub's earlier fds
+      ProcessComm comm(r, nranks, {}, sv[1]);
+      int rc = body(comm);
+      ::close(sv[1]);
+      ::_exit(rc & 0xFF);
+    }
+    ::close(sv[1]);
+    hub_fds[r] = sv[0];
+    pids[r] = pid;
+  }
+  ProcessComm comm(0, nranks, hub_fds, -1);
+  int rc = body(comm);
+  for (int r = 1; r < nranks; ++r) {
+    ::close(hub_fds[r]);
+    int status = 0;
+    ::waitpid(pids[r], &status, 0);
+    if (!WIFEXITED(status) || WEXITSTATUS(status) != 0) rc = rc ? rc : 71;
+  }
+  return rc;
+}
+
+}  // namespace tfidf
+
 #ifdef TFIDF_HAVE_MPI
 #include <mpi.h>
 

@@ -63,6 +63,15 @@ class Comm {
 // backend. Blocks until all ranks finish.
 void RunThreadRanks(int nranks, const std::function<void(Comm&)>& body);
 
+// Run `body(comm)` once per rank on `nranks` OS PROCESSES (fork +
+// socketpair star with rank 0 as hub) — the reference's actual
+// deployment model (N processes under mpirun, TFIDF.c:82-92) without
+// needing an MPI runtime in the image. Length-prefixed byte frames on
+// the wire, like MpiComm. Rank 0 runs in the calling process; returns
+// its body's view of completion (non-zero if any child exited
+// non-zero). POSIX only.
+int RunProcessRanks(int nranks, const std::function<int(Comm&)>& body);
+
 #ifdef TFIDF_HAVE_MPI
 // MPI-backed Comm for real multi-process runs; caller owns MPI_Init.
 Comm* CreateMpiComm();

@@ -323,6 +323,14 @@ int main(int argc, char** argv) {
 #else
   int nranks = argc > 3 ? std::atoi(argv[3]) : 4;
   if (nranks < 2) nranks = 2;  // coordinator + >=1 worker
+  // argv[4]: rank backend — "thread" (default) or "process" (fork +
+  // socketpair, the reference's N-OS-process deployment model without
+  // an MPI runtime; byte-identical output pinned by tests).
+  const std::string backend = argc > 4 ? argv[4] : "thread";
+  if (backend == "process")
+    return tfidf::RunProcessRanks(nranks, [&](tfidf::Comm& c) {
+      return tfidf::PipelineMain(c, input, output);
+    });
   int rc = 0;
   tfidf::RunThreadRanks(nranks, [&](tfidf::Comm& c) {
     int r = tfidf::PipelineMain(c, input, output);
