@@ -27,8 +27,9 @@ IDF, lexicographic ordering) are preserved by the golden path
 from tfidf_tpu.config import PipelineConfig, VocabMode, TokenizerKind
 from tfidf_tpu.pipeline import TfidfPipeline, PipelineResult
 from tfidf_tpu.io.corpus import Corpus, discover_corpus, PackedBatch
-from tfidf_tpu.ingest import IngestResult, run_overlapped
-from tfidf_tpu.rerank import exact_topk
+from tfidf_tpu.ingest import (ExactIngest, IngestResult, run_overlapped,
+                              run_overlapped_exact)
+from tfidf_tpu.rerank import exact_terms, exact_terms_lines, exact_topk
 
 __version__ = "0.1.0"
 
@@ -41,8 +42,12 @@ __all__ = [
     "Corpus",
     "discover_corpus",
     "PackedBatch",
+    "ExactIngest",
     "IngestResult",
     "run_overlapped",
+    "run_overlapped_exact",
+    "exact_terms",
+    "exact_terms_lines",
     "exact_topk",
     "__version__",
 ]

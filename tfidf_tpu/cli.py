@@ -66,12 +66,14 @@ def _build_parser() -> argparse.ArgumentParser:
                           "on host with exact strings and DF, emitting "
                           "exact words instead of bucket representatives")
     run.add_argument("--exact-margin", type=int, default=4,
-                     help="candidate margin multiplier for --exact-terms: "
-                          "the chip keeps margin*k buckets so collisions "
-                          "cannot push true top-k words out of reach. "
-                          "4 is the measured recall-1.0 knee at vocab "
-                          "load factor ~0.125 (docs/EXACT.md); the run "
-                          "warns when occupancy suggests raising it")
+                     help="candidate margin multiplier for --exact-terms' "
+                          "HASHED fallback engine: the chip keeps "
+                          "margin*k buckets so collisions cannot push "
+                          "true top-k words out of reach (4 is the "
+                          "measured recall-1.0 knee, docs/EXACT.md; the "
+                          "run warns when occupancy suggests raising "
+                          "it). The default device-exact engine has no "
+                          "collisions and clamps its own margin to k+8")
     run.add_argument("--mesh", type=str, default=None,
                      help="mesh shape docs,seq,vocab (e.g. 4,1,2); "
                           "default: single device")
