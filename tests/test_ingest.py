@@ -379,17 +379,45 @@ class TestMeshIngest:
     def test_resident_budget_scales_with_shards(self, corpus_dir,
                                                 monkeypatch):
         # Per-shard HBM holds corpus/S: a corpus over the single-chip
-        # budget but under S x budget must still run; over S x budget
-        # must refuse loudly (no silent fallback).
+        # budget but under S x budget rides the resident path; over
+        # S x budget the docs-sharded STREAMING regime takes over
+        # (round 4: the mesh composition covers both regimes).
         monkeypatch.setenv("TFIDF_TPU_RESIDENT_ELEMS", "1024")
         plan = self._plan(4)  # 40 docs x 64 = 2560 elems <= 4 x 1024
         mesh = run_overlapped(corpus_dir, _cfg(), chunk_docs=16,
                               doc_len=64, plan=plan)
         assert mesh.path == "resident-mesh"
         monkeypatch.setenv("TFIDF_TPU_RESIDENT_ELEMS", "256")
-        with pytest.raises(ValueError, match="mesh-resident budget"):
-            run_overlapped(corpus_dir, _cfg(), chunk_docs=16, doc_len=64,
-                           plan=plan)
+        streamed = run_overlapped(corpus_dir, _cfg(), chunk_docs=16,
+                                  doc_len=64, plan=plan)
+        assert streamed.path == "streaming-mesh"
+        np.testing.assert_array_equal(np.asarray(mesh.df),
+                                      np.asarray(streamed.df))
+        np.testing.assert_array_equal(mesh.topk_ids, streamed.topk_ids)
+        np.testing.assert_allclose(mesh.topk_vals, streamed.topk_vals,
+                                   rtol=1e-6)
+
+    def test_streaming_mesh_matches_single_streaming(self, corpus_dir,
+                                                     monkeypatch):
+        # The docs-sharded streaming regime == single-device streaming
+        # on the same corpus, with and without the triple cache.
+        monkeypatch.setenv("TFIDF_TPU_RESIDENT_ELEMS", "0")
+        for cache in ("0", str(1 << 30)):
+            monkeypatch.setenv("TFIDF_TPU_TRIPLE_CACHE_BYTES", cache)
+            single = run_overlapped(corpus_dir, _cfg(), chunk_docs=16,
+                                    doc_len=64)
+            mesh = run_overlapped(corpus_dir, _cfg(), chunk_docs=16,
+                                  doc_len=64, plan=self._plan())
+            assert single.path == "streaming"
+            assert mesh.path == "streaming-mesh"
+            want_cached = 0 if cache == "0" else 3
+            assert mesh.phases["triple_cached_chunks"] == want_cached
+            np.testing.assert_array_equal(np.asarray(single.df),
+                                          np.asarray(mesh.df))
+            np.testing.assert_array_equal(single.topk_ids, mesh.topk_ids)
+            np.testing.assert_allclose(single.topk_vals, mesh.topk_vals,
+                                       rtol=1e-6)
+            np.testing.assert_array_equal(single.lengths, mesh.lengths)
 
     def test_chunk_int32_guard(self, corpus_dir):
         with pytest.raises(ValueError, match="int32"):

@@ -211,6 +211,194 @@ def _mesh_chunk_step_fn(plan: "MeshPlan", vocab_size: int):
     return jax.jit(mapped)
 
 
+# Mesh streaming kernels (two-pass, beyond the resident budget): pass
+# A folds shard-local DF partials with NO collective; one tiny program
+# reduces them to the corpus-wide IDF (the run's single psum); pass B
+# scores each chunk per shard against the replicated IDF.
+@functools.lru_cache(maxsize=32)
+def _mesh_phase_a_fn(plan: "MeshPlan", vocab_size: int):
+    from jax.sharding import PartitionSpec as P
+
+    from tfidf_tpu.parallel.mesh import DOCS_AXIS
+
+    def body(tokens, lengths, df_part):
+        ids, _, head = sorted_term_counts(tokens, lengths)
+        return df_part + sparse_df(ids, head, vocab_size)[None, :]
+
+    mapped = jax.shard_map(
+        body, mesh=plan.mesh,
+        in_specs=(P(DOCS_AXIS, None), P(DOCS_AXIS), P(DOCS_AXIS, None)),
+        out_specs=P(DOCS_AXIS, None))
+    return jax.jit(mapped)
+
+
+@functools.lru_cache(maxsize=32)
+def _mesh_idf_fn(plan: "MeshPlan", score_dtype):
+    from jax.sharding import PartitionSpec as P
+
+    from tfidf_tpu.parallel.mesh import DOCS_AXIS
+
+    def body(df_part, num_docs):
+        df_total = lax.psum(df_part[0], DOCS_AXIS)  # the ONE collective
+        return df_total, idf_from_df(df_total, num_docs, score_dtype)
+
+    mapped = jax.shard_map(body, mesh=plan.mesh,
+                           in_specs=(P(DOCS_AXIS, None), P()),
+                           out_specs=(P(), P()), check_vma=False)
+    return jax.jit(mapped)
+
+
+@functools.lru_cache(maxsize=32)
+def _mesh_phase_b_fn(plan: "MeshPlan", topk: int):
+    from jax.sharding import PartitionSpec as P
+
+    from tfidf_tpu.parallel.mesh import DOCS_AXIS
+
+    def body(tokens, lengths, idf):
+        ids, counts, head = sorted_term_counts(tokens, lengths)
+        scores = sparse_scores(ids, counts, head, lengths, idf)
+        return sparse_topk(scores, ids, head, topk)
+
+    mapped = jax.shard_map(
+        body, mesh=plan.mesh,
+        in_specs=(P(DOCS_AXIS, None), P(DOCS_AXIS), P()),
+        out_specs=(P(DOCS_AXIS, None), P(DOCS_AXIS, None)),
+        check_vma=False)
+    return jax.jit(mapped)
+
+
+@functools.lru_cache(maxsize=32)
+def _mesh_phase_b_cached_fn(plan: "MeshPlan", topk: int):
+    from jax.sharding import PartitionSpec as P
+
+    from tfidf_tpu.parallel.mesh import DOCS_AXIS
+
+    def body(ids, counts, head, lengths, idf):
+        scores = sparse_scores(ids, counts, head, lengths, idf)
+        return sparse_topk(scores, ids, head, topk)
+
+    mapped = jax.shard_map(
+        body, mesh=plan.mesh,
+        in_specs=(P(DOCS_AXIS, None),) * 3 + (P(DOCS_AXIS), P()),
+        out_specs=(P(DOCS_AXIS, None), P(DOCS_AXIS, None)),
+        check_vma=False)
+    return jax.jit(mapped)
+
+
+# Pass-A sort+cache variant: same as _mesh_chunk_step_fn (triples kept
+# for the streaming triple cache) — reused directly.
+
+
+def _run_overlapped_mesh_streaming(input_dir: str, cfg: PipelineConfig,
+                                   plan: "MeshPlan", chunk_docs: int,
+                                   length: int, names: List[str],
+                                   spill: str) -> IngestResult:
+    """Two-pass streaming ingest over a docs-sharded mesh — the
+    beyond-HBM regime of the multi-chip composition. Same structure as
+    the single-device streaming path (pass A folds DF, pass B rescores
+    against the final IDF; device triple cache up to a byte budget that
+    scales with the shard count), with every program under shard_map
+    and exactly ONE collective per run (the DF psum in ``_mesh_idf_fn``).
+    Value parity with the single-device streaming path is pinned by
+    tests/test_ingest.py."""
+    from jax.sharding import PartitionSpec as P
+
+    from tfidf_tpu.parallel.mesh import DOCS_AXIS
+
+    num_docs = len(names)
+    score_dtype = jax.dtypes.canonicalize_dtype(jnp.dtype(cfg.score_dtype))
+    k = min(cfg.topk, length)
+    shards = plan.n_docs_shards
+    chunk_docs += -chunk_docs % shards  # rows must block-shard evenly
+    _check_chunk_fits_int32(chunk_docs, length)
+    starts = list(range(0, num_docs, chunk_docs))
+    pack_chunk = make_chunk_packer(input_dir, cfg, chunk_docs, length)
+    if spill == "auto":
+        est = num_docs * length * 4
+        budget = int(os.environ.get("TFIDF_TPU_SPILL_BYTES",
+                                    _DEFAULT_SPILL_BYTES))
+        spill = "host" if est <= budget else "reread"
+
+    batch_sh = plan.sharding(P(DOCS_AXIS, None))
+    lens_sh = plan.sharding(plan.lengths_spec())
+    step = _mesh_chunk_step_fn(plan, cfg.vocab_size)  # sort + DF fold
+    phase_a = _mesh_phase_a_fn(plan, cfg.vocab_size)
+
+    # Triple cache: per-shard HBM holds 1/S of each cached chunk, so
+    # the budget scales with the shard count.
+    cache_budget = shards * int(os.environ.get(
+        "TFIDF_TPU_TRIPLE_CACHE_BYTES", _TRIPLE_CACHE_BYTES))
+    trip_cache: Dict[int, tuple] = {}
+    cache_bytes = 0
+    chunk_cache_bytes = chunk_docs * length * 9 + chunk_docs * 4
+
+    ph = {"pack_a": 0.0, "pack_b": 0.0}
+    df_acc = jax.device_put(np.zeros((shards, cfg.vocab_size), np.int32),
+                            batch_sh)
+    cached: List[Optional[Tuple[np.ndarray, np.ndarray]]] = []
+    all_lengths: List[np.ndarray] = []
+    t_pass = time.perf_counter()
+    for ci, start in enumerate(starts):
+        chunk_names = names[start:start + chunk_docs]
+        t0 = time.perf_counter()
+        token_ids, lengths = pack_chunk(chunk_names)
+        ph["pack_a"] += time.perf_counter() - t0
+        all_lengths.append(lengths[:len(chunk_names)])
+        toks = jax.device_put(token_ids, batch_sh)
+        lens = jax.device_put(lengths, lens_sh)
+        if cache_bytes + chunk_cache_bytes <= cache_budget:
+            i_, c_, h_, df_acc = step(toks, lens, df_acc)
+            trip_cache[ci] = (i_, c_, h_, lens)
+            cache_bytes += chunk_cache_bytes
+            if spill == "host":
+                cached.append(None)
+        else:
+            if spill == "host":
+                cached.append((token_ids, lengths))
+            df_acc = phase_a(toks, lens, df_acc)
+    df_acc.block_until_ready()
+    ph["pass_a"] = time.perf_counter() - t_pass
+    ph["triple_cached_chunks"] = float(len(trip_cache))
+
+    df_total, idf = _mesh_idf_fn(plan, score_dtype)(df_acc,
+                                                    jnp.int32(num_docs))
+
+    phase_b = _mesh_phase_b_fn(plan, k)
+    phase_b_cached = _mesh_phase_b_cached_fn(plan, k)
+    vals_parts, ids_parts = [], []
+    t_pass = time.perf_counter()
+    for ci, start in enumerate(starts):
+        if ci in trip_cache:
+            i_, c_, h_, lens = trip_cache.pop(ci)
+            v, t = phase_b_cached(i_, c_, h_, lens, idf)
+        else:
+            if spill == "host":
+                token_ids, lengths = cached[ci]
+            else:
+                t0 = time.perf_counter()
+                token_ids, lengths = pack_chunk(
+                    names[start:start + chunk_docs])
+                ph["pack_b"] += time.perf_counter() - t0
+            v, t = phase_b(jax.device_put(token_ids, batch_sh),
+                           jax.device_put(lengths, lens_sh), idf)
+        vals_parts.append(v)
+        ids_parts.append(t)
+    jax.block_until_ready((vals_parts, ids_parts))
+    ph["pass_b"] = time.perf_counter() - t_pass
+
+    t0 = time.perf_counter()
+    df_host, vals, tids = jax.device_get(
+        (df_total, jnp.concatenate(vals_parts),
+         jnp.concatenate(ids_parts)))
+    ph["fetch"] = time.perf_counter() - t0
+    return IngestResult(df=df_host, topk_vals=vals[:num_docs],
+                        topk_ids=tids[:num_docs],
+                        lengths=np.concatenate(all_lengths), names=names,
+                        num_docs=num_docs,
+                        df_occupied=int((df_host > 0).sum()),
+                        path="streaming-mesh", phases=ph)
+
+
 @functools.lru_cache(maxsize=32)
 def _mesh_finish_fn(plan: "MeshPlan", n_chunks: int, topk: int, score_dtype):
     from jax.sharding import PartitionSpec as P
@@ -589,7 +777,7 @@ class IngestResult:
     # without ever fetching the [V] DF vector from device.
     df_occupied: Optional[int] = None
     path: str = ""            # regime: "resident" | "streaming" |
-                              # "resident-mesh" (docs-sharded mesh)
+                              # "resident-mesh" | "streaming-mesh"
     # Wall-clock phase breakdown of the run (seconds). Overlapped phases
     # don't sum to the wall. Resident path: "pack" (synchronous host
     # packing), "put" (upload/dispatch staging), "fetch" (the single
@@ -666,10 +854,12 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
     in invalid id slots (no bucket-0 stand-in).
 
     ``plan`` (a ``parallel.mesh.MeshPlan``, docs axis only) runs the
-    resident path docs-sharded over the device mesh — each shard sorts
-    its own rows, DF partials fold shard-locally, and the finish
-    program's single ``lax.psum`` is the run's only collective
-    (``_run_overlapped_mesh``).
+    ingest docs-sharded over the device mesh — each shard sorts its
+    own rows, DF partials fold shard-locally, and a single ``lax.psum``
+    is the run's only collective. Within the shard-scaled resident
+    budget the fused resident path runs (``_run_overlapped_mesh``);
+    beyond it the two-pass streaming regime takes over with the same
+    triple cache (``_run_overlapped_mesh_streaming``).
 
     Requires HASHED vocab (fixed id space across chunks) and a top-k
     selection (full per-term output would defeat the streaming design).
@@ -694,11 +884,12 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
         if not mesh_names:
             raise ValueError(f"no documents in {input_dir}")
         if len(mesh_names) * length > resident * plan.n_docs_shards:
-            raise ValueError(
-                f"corpus ({len(mesh_names)} docs x {length}) exceeds the "
-                f"mesh-resident budget ({resident} elems x "
-                f"{plan.n_docs_shards} shards); stream it single-device "
-                f"or raise TFIDF_TPU_RESIDENT_ELEMS")
+            # Beyond the (shard-scaled) resident budget: the two-pass
+            # streaming regime, docs-sharded. wire_vals is advisory
+            # here like the single-device streaming path.
+            return _run_overlapped_mesh_streaming(
+                input_dir, cfg, plan, chunk_docs, length, mesh_names,
+                spill)
         return _run_overlapped_mesh(input_dir, cfg, plan, chunk_docs,
                                     length, mesh_names, wire_vals)
     names = discover_names(input_dir, strict)
