@@ -1,0 +1,158 @@
+"""Real-TEXT corpus for the whitespace pipeline (BASELINE configs 1–2).
+
+The headline bench runs synthetic Zipf corpora; config 4 already runs
+real source CODE (tools/chargram_bench.py). This tool measures the
+whitespace word pipeline on real English-ish TEXT the image ships:
+installed-package METADATA descriptions, .md/.rst/.txt docs from the
+Python environment, and /usr/share/doc files — a non-synthetic word
+distribution (true hapax tails, real punctuation-glued tokens) the
+Zipf generator cannot fake.
+
+Measures, on the real chip:
+  1. resident overlapped ingest docs/sec (hashed 2^16, top-16), and
+  2. the exact-terms mode end-to-end (engine reported: the intern
+     table overflows iff the corpus has > 2^16 distinct words) with
+     exact recall vs the native bit-reference on a doc sample.
+
+Prints one JSON line per measurement; numbers land in BASELINE.md.
+    python tools/realtext_bench.py
+"""
+
+import glob
+import gzip
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+MAX_BYTES = 4096
+TOPK = 16
+VOCAB = 1 << 16
+DOC_LEN = 2048  # > max tokens at MAX_BYTES (>=2 bytes/token incl. separator): truncation never bites, so recall vs the oracle is pure engine signal
+RECALL_DOCS = 256
+
+
+def collect_text(limit=4096):
+    pats = ["/opt/venv/**/METADATA", "/opt/venv/**/*.md",
+            "/opt/venv/**/*.rst", "/opt/venv/**/*.txt",
+            "/usr/share/doc/**/*"]
+    docs = []
+    for p in pats:
+        for f in sorted(glob.glob(p, recursive=True)):
+            if len(docs) >= limit:
+                return docs
+            if not os.path.isfile(f):
+                continue
+            try:
+                if f.endswith(".gz"):
+                    with gzip.open(f, "rb") as fh:
+                        data = fh.read(MAX_BYTES)
+                else:
+                    with open(f, "rb") as fh:
+                        data = fh.read(MAX_BYTES)
+            except OSError:
+                continue
+            if data.strip():
+                docs.append(data)
+    return docs
+
+
+def main():
+    docs = collect_text()
+    total = sum(len(d) for d in docs)
+    print(f"{len(docs)} real text docs, {total / 1e6:.1f} MB",
+          file=sys.stderr)
+    root = tempfile.mkdtemp(prefix="tfidf_realtext_")
+    try:
+        input_dir = os.path.join(root, "input")
+        os.makedirs(input_dir)
+        for i, d in enumerate(docs, 1):
+            with open(os.path.join(input_dir, f"doc{i}"), "wb") as f:
+                f.write(d)
+
+        from tfidf_tpu.config import PipelineConfig, VocabMode
+        from tfidf_tpu.ingest import run_overlapped
+        from tfidf_tpu.recall import exact_doc_recall, parse_oracle_output
+        from tfidf_tpu.rerank import exact_terms_lines
+
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=VOCAB,
+                             max_doc_len=DOC_LEN, doc_chunk=DOC_LEN,
+                             topk=TOPK, engine="sparse")
+        chunk = max(512, len(docs) // 4)
+        run_overlapped(input_dir, cfg, chunk_docs=chunk, doc_len=DOC_LEN)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            r = run_overlapped(input_dir, cfg, chunk_docs=chunk,
+                               doc_len=DOC_LEN)
+            best = min(best, time.perf_counter() - t0)
+        print(json.dumps({
+            "metric": "docs/sec, real-text corpus (package docs/"
+                      "metadata/changelogs), hashed 2^16, top-16",
+            "value": round(len(docs) / best, 1), "unit": "docs/sec",
+            "n_docs": len(docs), "corpus_mb": round(total / 1e6, 1),
+            "wall_s": round(best, 3), "ingest_path": r.path,
+            "df_occupied": r.df_occupied}), flush=True)
+
+        # Exact-terms on real text: engine choice is data-driven (the
+        # intern table overflows iff distinct words > 2^16).
+        ecfg = PipelineConfig(vocab_mode=VocabMode.HASHED,
+                              vocab_size=VOCAB, max_doc_len=DOC_LEN,
+                              doc_chunk=DOC_LEN, topk=4 * TOPK,
+                              engine="sparse")
+        exact_terms_lines(input_dir, ecfg, k=TOPK, doc_len=DOC_LEN,
+                          chunk_docs=chunk)  # warm
+        ebest, engine, sample_fn = float("inf"), "?", None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            _, engine, sample_fn = exact_terms_lines(
+                input_dir, ecfg, k=TOPK, doc_len=DOC_LEN,
+                chunk_docs=chunk)
+            ebest = min(ebest, time.perf_counter() - t0)
+
+        # Recall vs the native bit-reference on a sample.
+        binary = os.path.join(REPO, "native", "tfidf_ref")
+        if not os.path.exists(binary):
+            subprocess.run(["make", "-C", os.path.join(REPO, "native")],
+                           check=True, capture_output=True)
+        oracle_out = os.path.join(root, "oracle.txt")
+        subprocess.run([binary, input_dir, oracle_out, "9"], check=True,
+                       stdout=subprocess.DEVNULL)
+        # The doc_len cap must clear every doc or recall conflates
+        # truncation with engine error — assert, don't assume.
+        import tfidf_tpu.ops.tokenize as tok
+        assert max(len(tok.whitespace_tokenize(d, None)) for d in docs) \
+            <= DOC_LEN, "raise DOC_LEN: a doc exceeds the token cap"
+        sample = [f"doc{i}" for i in
+                  range(1, min(RECALL_DOCS, len(docs)) + 1)]
+        per_doc = parse_oracle_output(oracle_out, docs=sample)
+        got = sample_fn(sample)
+        scores = []
+        for name, ref in per_doc.items():
+            rr = exact_doc_recall(ref, [w for w, _ in got[name]], TOPK)
+            if rr is not None:
+                scores.append(rr)
+                if rr < 1.0:
+                    print(f"recall<1 on {name}: {rr}", file=sys.stderr)
+        print(json.dumps({
+            "metric": "exact-terms on real text",
+            "exact_docs_per_sec": round(len(docs) / ebest, 1),
+            "exact_engine": engine,
+            "recall_vs_oracle_sample": round(float(np.mean(scores)), 4),
+            "recall_note": "doc_len exceeds every doc's token count, "
+                           "so recall is pure engine signal",
+            "n_sampled": len(scores)}), flush=True)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()
