@@ -103,6 +103,17 @@ class TestDeviceExact:
                 w = id2w[int(exact.topk_ids[d, j])]
                 assert toks.count(w) == c, (name, w)
 
+    def test_device_margin_strictly_exceeds_k(self):
+        # Review r4: with dev margin == k the tie detector fires on
+        # EVERY dense doc (tail slot IS the k-th slot) and the fast
+        # path degrades to a full corpus re-read. The clamp must keep
+        # kprime > k whatever cfg.topk says.
+        from tfidf_tpu.rerank import _device_cfg
+        for margin_topk, k in ((8, 8), (4, 8), (64, 16), (None, 5)):
+            cfg = PipelineConfig(vocab_mode=VocabMode.HASHED,
+                                 vocab_size=4096, topk=margin_topk)
+            assert _device_cfg(cfg, k).topk > k
+
     def test_tie_fallback_respects_truncation(self, tmp_path):
         # doc_len=None: ingest truncates at cfg.max_doc_len, and the
         # boundary-tie re-read must apply the SAME cap (review r4

@@ -223,7 +223,7 @@ def _run_tpu(args) -> int:
         lines, engine, _ = exact_terms_lines(
             args.input, cfg, k=args.topk, doc_len=args.doc_len,
             chunk_docs=args.chunk_docs or 8192,
-            strict=not args.no_strict)
+            strict=not args.no_strict, spill=args.spill or "auto")
         throughput.record(n_docs, time.perf_counter() - t0)
         with phase_or_null(timer, "emit"):
             # lines arrive already in the reference's strcmp order

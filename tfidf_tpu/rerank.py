@@ -225,13 +225,19 @@ def _device_cfg(cfg: PipelineConfig, k: int) -> PipelineConfig:
     engine's collision margin must (docs/EXACT.md)."""
     import dataclasses as _dc
 
-    dev_topk = k + 8 if cfg.topk is None else min(cfg.topk, k + 8)
+    # The margin must STRICTLY exceed k: with kprime == k the tie
+    # detector's condition (tail score == k-th score on a full wire) is
+    # trivially true and every dense doc degrades to the doc-local
+    # re-read (review r4: measured 50/50 docs re-read at cfg.topk == k).
+    dev_topk = k + 8 if cfg.topk is None \
+        else max(k + 1, min(cfg.topk, k + 8))
     return _dc.replace(cfg, topk=dev_topk)
 
 
 def exact_terms_lines(input_dir: str, cfg: PipelineConfig, k: int, *,
                       doc_len: Optional[int] = None,
-                      chunk_docs: int = 8192, strict: bool = True):
+                      chunk_docs: int = 8192, strict: bool = True,
+                      spill: str = "auto"):
     """Exact-terms mode producing the FINAL sorted output bytes — the
     complete job (ingest + float64 rescore + per-doc and global sort +
     reference formatting), which is what the CPU oracle's wall clock
@@ -297,7 +303,7 @@ def exact_terms_lines(input_dir: str, cfg: PipelineConfig, k: int, *,
     per_doc_dict, engine = _exact_terms_fallback(input_dir, cfg, k,
                                                  doc_len=doc_len,
                                                  chunk_docs=chunk_docs,
-                                                 strict=strict)
+                                                 strict=strict, spill=spill)
     lines_list = [b"%s@%s\t%.16f" % (name.encode(), w, s)
                   for name, terms in per_doc_dict.items() if name
                   for w, s in terms]
@@ -310,12 +316,15 @@ def exact_terms_lines(input_dir: str, cfg: PipelineConfig, k: int, *,
 
 def _exact_terms_fallback(input_dir: str, cfg: PipelineConfig, k: int, *,
                           doc_len: Optional[int], chunk_docs: int,
-                          strict: bool):
-    """The hashed+margin+rerank engine (shared by the two entry points)."""
+                          strict: bool, spill: str = "auto"):
+    """The hashed+margin+rerank engine (shared by the two entry points).
+    ``spill`` applies when the ingest runs the streaming regime — the
+    device-exact path is resident-only, so only this engine reads it."""
     from tfidf_tpu.ingest import run_overlapped
 
     r = run_overlapped(input_dir, cfg, chunk_docs=chunk_docs,
-                       doc_len=doc_len, strict=strict, wire_vals=False)
+                       doc_len=doc_len, strict=strict, wire_vals=False,
+                       spill=spill)
     # max_tokens mirrors the ingest truncation rule (doc_len or
     # cfg.max_doc_len) so the re-rank's TF/docSize stay device-parity.
     return (exact_topk(input_dir, r.names, r.topk_ids, r.num_docs, cfg,
