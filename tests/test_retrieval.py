@@ -83,6 +83,23 @@ class TestSingleDevice:
         vals, idx = r.search(["the"], k=2)
         assert idx.shape == (1, 2)
 
+    def test_index_dir_chunked_matches_batch(self, toy_corpus_dir):
+        # Round 4: doc_len opts index_dir into the overlapped chunked
+        # ingest (the scalable pipeline). With no truncation in play the
+        # search results must equal the whole-corpus batch path.
+        queries = ["the quick fox", "tpu mesh psum", "dog"]
+        batch = TfidfRetriever(CFG).index_dir(toy_corpus_dir)
+        bv, bi = batch.search(queries, k=3)
+        # chunk 2 = even split; chunk 4 = the tail chunk carries
+        # padding rows (6 docs -> 4 + 2+2pad), which must stay inert.
+        for chunk_docs in (2, 4):
+            chunked = TfidfRetriever(CFG).index_dir(
+                toy_corpus_dir, doc_len=64, chunk_docs=chunk_docs)
+            assert chunked.names == batch.names
+            cv, ci = chunked.search(queries, k=3)
+            np.testing.assert_array_equal(bi, ci)
+            np.testing.assert_allclose(bv, cv, rtol=1e-6)
+
 
 class TestSharded:
     def test_matches_single_device(self):

@@ -117,6 +117,10 @@ def _build_parser() -> argparse.ArgumentParser:
     q.add_argument("--vocab-size", type=int, default=1 << 16)
     q.add_argument("--mesh-docs", type=int, default=None,
                    help="shard the index over this many devices")
+    q.add_argument("--doc-len", type=int, default=None,
+                   help="static tokens per document: index via the "
+                        "overlapped chunked ingest (native loader; "
+                        "longer docs truncated). Single-device only")
     q.add_argument("--no-strict", action="store_true")
     return p
 
@@ -430,8 +434,12 @@ def _run_query(args) -> int:
         # the first N so a sub-mesh works on any device count.
         devs = jax.devices()[:args.mesh_docs] if args.mesh_docs else None
         plan = MeshPlan.create(docs=args.mesh_docs, devices=devs)
+    if args.doc_len is not None and plan is not None:
+        sys.stderr.write("error: query --doc-len (chunked indexing) is "
+                         "single-device; drop --mesh-docs\n")
+        return 2
     r = TfidfRetriever(cfg, plan=plan).index_dir(
-        args.input, strict=not args.no_strict)
+        args.input, strict=not args.no_strict, doc_len=args.doc_len)
     vals, idx = r.search(args.query, k=args.k)
     for qi, text in enumerate(args.query):
         print(f"query: {text}")

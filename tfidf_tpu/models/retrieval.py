@@ -56,6 +56,26 @@ def _build_index(token_ids, lengths, num_docs, *, vocab_size: int):
     return ids, weights, head, idf
 
 
+@jax.jit
+def _finish_index(trip_i, trip_c, trip_h, len_parts, df_acc, num_docs):
+    """Chunk-ingested triples -> (ids, weights, head, idf).
+
+    The indexing twin of ``ingest._finish_wire``: the per-chunk sort +
+    DF fold already ran (``ingest._chunk_step`` — the SAME compiled
+    programs the overlapped ingest dispatches), so finishing is one
+    gather-scored normalization against the corpus-wide IDF.
+    """
+    cat = (lambda parts: parts[0] if len(parts) == 1
+           else jnp.concatenate(parts, axis=0))
+    ids, counts, head = cat(trip_i), cat(trip_c), cat(trip_h)
+    lengths = cat(len_parts)
+    idf = idf_from_df(df_acc, num_docs, jnp.float32)
+    scores = sparse_scores(ids, counts, head, lengths, idf)
+    norm = jnp.sqrt(jnp.sum(scores * scores, axis=1, keepdims=True))
+    weights = scores / jnp.maximum(norm, 1e-30)
+    return ids, weights, head, idf
+
+
 @functools.partial(jax.jit, static_argnames=("k",))
 def _search_bcoo(data, cols, qmat, *, k: int):
     """[D, V] BCOO x [V, Q] dense on the MXU -> per-query top-k docs."""
@@ -152,9 +172,58 @@ class TfidfRetriever:
         self._num_docs = len(corpus)
         return self
 
-    def index_dir(self, input_dir: str,
-                  strict: bool = True) -> "TfidfRetriever":
-        return self.index(discover_corpus(input_dir, strict))
+    def index_dir(self, input_dir: str, strict: bool = True,
+                  doc_len: Optional[int] = None,
+                  chunk_docs: int = 8192) -> "TfidfRetriever":
+        """Index a directory. ``doc_len`` opts into the overlapped
+        chunked ingest (native loader, ragged uint16 wire, host packs
+        chunk i+1 while the device sorts chunk i) — the same scalable
+        pipeline ``run_overlapped`` uses, sharing its compiled chunk
+        programs. The trade is the ingest's: documents longer than
+        ``doc_len`` tokens are truncated. Default (None) packs the
+        whole corpus in one batch with L grown to the longest doc;
+        meshes always take the batch path (sharded placement)."""
+        if doc_len is None or self.plan is not None:
+            return self.index(discover_corpus(input_dir, strict))
+        from tfidf_tpu.ingest import (_chunk_step, _resident_chunking,
+                                      make_chunk_packer, make_flat_packer)
+        from tfidf_tpu.io.corpus import discover_names
+
+        cfg = self.config
+        names = discover_names(input_dir, strict)
+        if not names:
+            raise ValueError(f"no documents in {input_dir}")
+        num_docs = len(names)
+        chunk_docs, starts = _resident_chunking(num_docs, chunk_docs)
+        ragged = cfg.vocab_size <= (1 << 16)
+        pack = (make_flat_packer(input_dir, cfg, chunk_docs, doc_len)
+                if ragged
+                else make_chunk_packer(input_dir, cfg, chunk_docs,
+                                       doc_len))
+        df_acc = jnp.zeros((cfg.vocab_size,), jnp.int32)
+        trip_i, trip_c, trip_h, len_parts = [], [], [], []
+        for start in starts:
+            chunk_names = names[start:start + chunk_docs]
+            packed = pack(chunk_names)
+            wire_arr, lengths = packed[0], packed[1]
+            lens = jax.device_put(lengths)
+            i_, c_, h_, df_acc = _chunk_step(
+                jax.device_put(wire_arr), lens, df_acc, cfg, doc_len,
+                ragged=ragged)
+            trip_i.append(i_)
+            trip_c.append(c_)
+            trip_h.append(h_)
+            len_parts.append(lens)
+        ids, weights, head, idf = _finish_index(
+            tuple(trip_i), tuple(trip_c), tuple(trip_h),
+            tuple(len_parts), df_acc, jnp.int32(num_docs))
+        self._ids, self._weights, self._head = ids, weights, head
+        self._idf = idf
+        # Only the final chunk carries padding rows; real docs occupy
+        # rows [0, num_docs), so the tail-padding search guard holds.
+        self.names = names
+        self._num_docs = num_docs
+        return self
 
     @property
     def indexed(self) -> bool:
