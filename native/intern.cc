@@ -98,6 +98,38 @@ int64_t FindOrInsert(InternTable* T, uint64_t h, const uint8_t* w,
   }
 }
 
+// Shared body of the exact-id flat packers (u16 / i32 wires): serial
+// like loader_fill_flat_u16 — each doc's offset depends on every prior
+// doc's count. Returns total ids, or -1 on vocab overflow.
+template <typename T>
+int64_t InternFillFlat(void* loader_handle, void* intern_handle,
+                       uint64_t seed, int64_t truncate_at,
+                       int64_t max_per_doc, T* out,
+                       int32_t* out_lengths) {
+  InternTable* tab = static_cast<InternTable*>(intern_handle);
+  const int64_t n_docs = loader_doc_count(loader_handle);
+  int64_t pos = 0;
+  for (int64_t d = 0; d < n_docs; ++d) {
+    int64_t len;
+    const char* data = loader_doc_data(loader_handle, d, &len);
+    bool bad = false;
+    int64_t n = tfidf::ForEachToken(
+        reinterpret_cast<const uint8_t*>(data), len, truncate_at,
+        max_per_doc, [&](const uint8_t* w, int64_t wl) {
+          int64_t id =
+              FindOrInsert(tab, tfidf::HashWordRaw(w, wl, seed), w, wl);
+          if (id < 0) {
+            bad = true;
+            return;
+          }
+          out[pos++] = (T)id;
+        });
+    if (bad) return -1;
+    out_lengths[d] = (int32_t)n;
+  }
+  return pos;
+}
+
 }  // namespace
 
 extern "C" {
@@ -123,28 +155,17 @@ int64_t intern_fill_flat_u16(void* loader_handle, void* intern_handle,
                              uint64_t seed, int64_t truncate_at,
                              int64_t max_per_doc, uint16_t* out,
                              int32_t* out_lengths) {
-  InternTable* T = static_cast<InternTable*>(intern_handle);
-  const int64_t n_docs = loader_doc_count(loader_handle);
-  int64_t pos = 0;
-  for (int64_t d = 0; d < n_docs; ++d) {
-    int64_t len;
-    const char* data = loader_doc_data(loader_handle, d, &len);
-    bool bad = false;
-    int64_t n = tfidf::ForEachToken(
-        reinterpret_cast<const uint8_t*>(data), len, truncate_at,
-        max_per_doc, [&](const uint8_t* w, int64_t wl) {
-          int64_t id =
-              FindOrInsert(T, tfidf::HashWordRaw(w, wl, seed), w, wl);
-          if (id < 0) {
-            bad = true;
-            return;
-          }
-          out[pos++] = (uint16_t)id;
-        });
-    if (bad) return -1;
-    out_lengths[d] = (int32_t)n;
-  }
-  return pos;
+  return InternFillFlat(loader_handle, intern_handle, seed, truncate_at,
+                        max_per_doc, out, out_lengths);
+}
+
+// int32 wire for vocab caps past 2^16 (wide-vocab exact mode).
+int64_t intern_fill_flat_i32(void* loader_handle, void* intern_handle,
+                             uint64_t seed, int64_t truncate_at,
+                             int64_t max_per_doc, int32_t* out,
+                             int32_t* out_lengths) {
+  return InternFillFlat(loader_handle, intern_handle, seed, truncate_at,
+                        max_per_doc, out, out_lengths);
 }
 
 int64_t intern_count(void* handle) {

@@ -103,6 +103,24 @@ class TestDeviceExact:
                 w = id2w[int(exact.topk_ids[d, j])]
                 assert toks.count(w) == c, (name, w)
 
+    def test_wide_vocab_cap_uses_i32_wire(self, corpus, tmp_path):
+        # A cap past 2^16 switches the intern wire to int32 (round 4
+        # extension) — same byte-exact output as the oracle.
+        dev, engine = exact_terms(corpus, _cfg(vocab=1 << 17), k=5,
+                                  doc_len=64, chunk_docs=32)
+        assert engine == "device-exact"
+        if not os.path.exists(NATIVE):
+            subprocess.run(["make", "-C", os.path.dirname(NATIVE)],
+                           check=True, capture_output=True)
+        out = str(tmp_path / "oracle_wide.txt")
+        subprocess.run([NATIVE, corpus, out, "5"], check=True,
+                       stdout=subprocess.DEVNULL)
+        oracle_lines = set(open(out, "rb").read().splitlines())
+        for name, terms in dev.items():
+            for w, s in terms:
+                assert b"%s@%s\t%.16f" % (name.encode(), w, s) \
+                    in oracle_lines
+
     def test_device_margin_strictly_exceeds_k(self):
         # Review r4: with dev margin == k the tie detector fires on
         # EVERY dense doc (tail slot IS the k-th slot) and the fast

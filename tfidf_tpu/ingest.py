@@ -1155,8 +1155,10 @@ def run_overlapped_exact(input_dir: str,
         raise ValueError("exact ingest requires a topk selection")
     if cfg.tokenizer is not TokenizerKind.WHITESPACE:
         raise ValueError("exact ingest serves the whitespace tokenizer")
-    if cfg.vocab_size > (1 << 16):
-        raise ValueError("exact-ids wire is uint16: vocab_size <= 65536")
+    if cfg.vocab_size > (1 << 22):
+        # [V] df/idf arrays and the intern table stay small through
+        # 2^22 (16 MB df); beyond that the hashed engine is the design.
+        raise ValueError("exact ingest caps the vocab at 2^22 ids")
     if not fast_tokenizer.intern_available():
         raise RuntimeError("native intern table unavailable "
                            "(make -C native fast_tokenizer.so)")
@@ -1212,8 +1214,9 @@ def run_overlapped_exact(input_dir: str,
         buf = np.asarray(jax.device_get(wire))
         ph["fetch"] = time.perf_counter() - t0
         words = sess.words()
-    tids, cnt, df_vec = _decode_wire_exact(buf, len(starts) * chunk_docs,
-                                           k, wide_ids=False)
+    tids, cnt, df_vec = _decode_wire_exact(
+        buf, len(starts) * chunk_docs, k,
+        wide_ids=cfg.vocab_size > (1 << 16))
     return ExactIngest(names=names, lengths=np.concatenate(all_lengths),
                        topk_ids=tids[:num_docs],
                        topk_counts=cnt[:num_docs], df=df_vec,

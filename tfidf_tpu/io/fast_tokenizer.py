@@ -107,6 +107,12 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int64, ctypes.c_int64,
             ctypes.POINTER(ctypes.c_uint16),
             ctypes.POINTER(ctypes.c_int32)]
+        lib.intern_fill_flat_i32.restype = ctypes.c_int64
+        lib.intern_fill_flat_i32.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
+            ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32)]
         lib.intern_count.restype = ctypes.c_int64
         lib.intern_count.argtypes = [ctypes.c_void_p]
         lib.intern_overflow.restype = ctypes.c_int
@@ -274,12 +280,14 @@ def flat_available() -> bool:
 
 def _flat_pack_scaffold(lib, paths: List[str], max_per_doc: int,
                         pad_docs_to: Optional[int],
-                        n_threads: Optional[int], fill):
+                        n_threads: Optional[int], fill,
+                        dtype=np.uint16):
     """Shared loader scaffolding of the flat packers (hashed and
     exact-id): path blob, parallel read (no count prepass), error
     mapping, buffer sizing, close. ``fill(handle, flat, lengths)``
-    runs the per-token id pass and returns total ids (or a negative
-    sentinel the caller interprets)."""
+    receives the numpy buffers, runs the per-token id pass, and
+    returns total ids (or a negative sentinel the caller interprets).
+    ``dtype`` is the wire id width (uint16, or int32 for wide caps)."""
     n_threads = n_threads or min(os.cpu_count() or 1, 16)
     blob = b"\0".join(p.encode() for p in paths) + b"\0"
     handle = lib.loader_open2(blob, len(paths), n_threads, 0)
@@ -288,11 +296,9 @@ def _flat_pack_scaffold(lib, paths: List[str], max_per_doc: int,
         if err >= 0:
             raise FileNotFoundError(paths[err])
         d_padded = max(pad_docs_to or len(paths), len(paths))
-        flat = np.empty((len(paths) * max_per_doc,), dtype=np.uint16)
+        flat = np.empty((len(paths) * max_per_doc,), dtype=dtype)
         lengths = np.zeros((d_padded,), dtype=np.int32)
-        total = fill(handle,
-                     flat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
-                     lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        total = fill(handle, flat, lengths)
         return flat, lengths, int(total)
     finally:
         lib.loader_close(handle)
@@ -320,9 +326,11 @@ def load_pack_flat(paths: List[str], vocab_size: int, seed: int = 0,
         return None
     return _flat_pack_scaffold(
         lib, paths, max_per_doc, pad_docs_to, n_threads,
-        lambda handle, flat_p, lens_p: lib.loader_fill_flat_u16(
+        lambda handle, flat, lens: lib.loader_fill_flat_u16(
             handle, ctypes.c_uint64(seed), vocab_size, truncate_at or 0,
-            max_per_doc, flat_p, lens_p))
+            max_per_doc,
+            flat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))))
 
 
 def rerank_available() -> bool:
@@ -424,9 +432,8 @@ class InternSession:
         lib = _load()
         if lib is None or not _has_intern:
             raise RuntimeError("native intern table unavailable")
-        if cap > (1 << 16):
-            raise ValueError("exact-id wire is uint16: cap <= 65536")
         self._lib = lib
+        self._cap = cap
         self._h = lib.intern_open(cap)
 
     def __enter__(self):
@@ -448,15 +455,26 @@ class InternSession:
                   max_per_doc: int, pad_docs_to: Optional[int] = None,
                   seed: int = 0, n_threads: Optional[int] = None):
         """Exact-id twin of :func:`load_pack_flat` (same return
-        contract, shared loader scaffold). Raises
+        contract, shared loader scaffold). The wire is uint16 up to a
+        2^16 cap and int32 beyond (wide-vocab exact mode). Raises
         :class:`ExactVocabOverflow` when the corpus holds more distinct
         words than the table's cap."""
         lib = self._lib
-        flat, lengths, total = _flat_pack_scaffold(
-            lib, paths, max_per_doc, pad_docs_to, n_threads,
-            lambda handle, flat_p, lens_p: lib.intern_fill_flat_u16(
+        wide = self._cap > (1 << 16)
+        fill_fn = lib.intern_fill_flat_i32 if wide \
+            else lib.intern_fill_flat_u16
+        id_ct = ctypes.c_int32 if wide else ctypes.c_uint16
+
+        def fill(handle, flat, lens):
+            return fill_fn(
                 handle, self._h, ctypes.c_uint64(seed), truncate_at or 0,
-                max_per_doc, flat_p, lens_p))
+                max_per_doc,
+                flat.ctypes.data_as(ctypes.POINTER(id_ct)),
+                lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+
+        flat, lengths, total = _flat_pack_scaffold(
+            lib, paths, max_per_doc, pad_docs_to, n_threads, fill,
+            dtype=np.int32 if wide else np.uint16)
         if total < 0:
             raise ExactVocabOverflow(
                 f"corpus exceeds {self.count} distinct words")
