@@ -389,10 +389,46 @@ void* exact_emit_run(void* intern_handle, const char* input_dir,
   res->lens.reserve(total);
   res->scores.reserve(total);
   res->word_blob.reserve(wbytes);
-  std::string arena;  // all formatted lines, back to back
-  std::vector<std::pair<int64_t, int32_t>> spans;  // (off, len) per line
-  spans.reserve(total);
-  char buf[64];
+
+  // The reference's global line qsort (TFIDF.c:273) as an INTEGER key
+  // sort: line byte-lex order == (rank of name+'@', rank of word)
+  // because '@' precedes the name comparison exactly where the line
+  // does, and '\t' (below every non-whitespace byte a word can hold)
+  // makes plain word-lex agree with the line's word+'\t' segment. One
+  // u64 key per line beats comparing 60-byte strings ~line-count times.
+  std::vector<int32_t> name_rank(n_docs);
+  {
+    std::vector<int32_t> order(n_docs);
+    for (int64_t d = 0; d < n_docs; ++d) order[(size_t)d] = (int32_t)d;
+    std::sort(order.begin(), order.end(), [&](int32_t a, int32_t b) {
+      std::string ka = std::string(names[a]) + '@';
+      std::string kb = std::string(names[b]) + '@';
+      return ka < kb;
+    });
+    for (int64_t i = 0; i < n_docs; ++i)
+      name_rank[(size_t)order[(size_t)i]] = (int32_t)i;
+  }
+  const int64_t live = T->live.load();
+  std::vector<int32_t> word_rank((size_t)(live ? live : 1));
+  {
+    std::vector<int32_t> order((size_t)live);
+    for (int64_t i = 0; i < live; ++i) order[(size_t)i] = (int32_t)i;
+    std::sort(order.begin(), order.end(), [&](int32_t a, int32_t b) {
+      const InternTable::Entry &ea = T->entries[(size_t)a],
+                               &eb = T->entries[(size_t)b];
+      int c = std::memcmp(ea.w, eb.w,
+                          (size_t)(ea.len < eb.len ? ea.len : eb.len));
+      if (c != 0) return c < 0;
+      return ea.len < eb.len;
+    });
+    for (int64_t i = 0; i < live; ++i)
+      word_rank[(size_t)order[(size_t)i]] = (int32_t)i;
+  }
+
+  std::vector<std::pair<uint64_t, int64_t>> keyed;  // (key, entry no.)
+  std::vector<int32_t> entry_doc((size_t)(total ? total : 1));
+  keyed.reserve(total);
+  int64_t eno = 0;
   for (int64_t d = 0; d < n_docs; ++d) {
     for (const ExactEntry& e : picked[d]) {
       const InternTable::Entry& w = T->entries[(size_t)e.id];
@@ -400,27 +436,26 @@ void* exact_emit_run(void* intern_handle, const char* input_dir,
       res->lens.push_back(w.len);
       res->scores.push_back(e.score);
       res->word_blob.append(w.w, (size_t)w.len);
-      int64_t off = (int64_t)arena.size();
-      arena.append(names[d]);
-      arena.push_back('@');
-      arena.append(w.w, (size_t)w.len);
-      arena.push_back('\t');
-      int m = std::snprintf(buf, sizeof buf, "%.16f", e.score);
-      arena.append(buf, (size_t)m);
-      spans.emplace_back(off, (int32_t)(arena.size() - off));
+      entry_doc[(size_t)eno] = (int32_t)d;
+      keyed.emplace_back(((uint64_t)(uint32_t)name_rank[(size_t)d] << 32)
+                             | (uint32_t)word_rank[(size_t)e.id],
+                         eno);
+      ++eno;
     }
   }
-  // The reference's global qsort over raw lines (TFIDF.c:273).
-  std::sort(spans.begin(), spans.end(),
-            [&](const std::pair<int64_t, int32_t>& a,
-                const std::pair<int64_t, int32_t>& b) {
-              std::string_view va(arena.data() + a.first, (size_t)a.second);
-              std::string_view vb(arena.data() + b.first, (size_t)b.second);
-              return va < vb;
-            });
-  res->lines.reserve(arena.size() + spans.size());
-  for (const auto& sp : spans) {
-    res->lines.append(arena.data() + sp.first, (size_t)sp.second);
+  std::sort(keyed.begin(), keyed.end());
+  char buf[64];
+  res->lines.reserve((int64_t)total * 48);
+  for (const auto& kv : keyed) {
+    int64_t entry = kv.second;
+    res->lines.append(names[(size_t)entry_doc[(size_t)entry]]);
+    res->lines.push_back('@');
+    res->lines.append(res->word_blob, (size_t)res->offs[(size_t)entry],
+                      (size_t)res->lens[(size_t)entry]);
+    res->lines.push_back('\t');
+    int m = std::snprintf(buf, sizeof buf, "%.16f",
+                          res->scores[(size_t)entry]);
+    res->lines.append(buf, (size_t)m);
     res->lines.push_back('\n');
   }
   return res;
