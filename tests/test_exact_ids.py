@@ -103,6 +103,22 @@ class TestDeviceExact:
                 w = id2w[int(exact.topk_ids[d, j])]
                 assert toks.count(w) == c, (name, w)
 
+    def test_empty_and_whitespace_docs(self, tmp_path):
+        # Degenerate documents must flow through the whole engine:
+        # empty file, whitespace-only file, single-word file.
+        d = tmp_path / "input"
+        d.mkdir()
+        (d / "doc1").write_bytes(b"")
+        (d / "doc2").write_bytes(b"   \n\t  ")
+        (d / "doc3").write_bytes(b"lonely")
+        (d / "doc4").write_bytes(b"alpha beta alpha")
+        dev, engine = exact_terms(str(d), _cfg(), k=3, doc_len=16,
+                                  chunk_docs=4)
+        assert engine == "device-exact"
+        assert dev["doc1"] == [] and dev["doc2"] == []
+        assert [w for w, _ in dev["doc3"]] == [b"lonely"]
+        assert {w for w, _ in dev["doc4"]} == {b"alpha", b"beta"}
+
     def test_wide_vocab_cap_uses_i32_wire(self, corpus, tmp_path):
         # A cap past 2^16 switches the intern wire to int32 (round 4
         # extension) — same byte-exact output as the oracle.
