@@ -171,6 +171,45 @@ class TestDeviceExact:
         for _, s in got:
             assert s == want_score
 
+    def test_beyond_resident_falls_back_to_hashed(self, corpus,
+                                                  monkeypatch, capsys):
+        # The device-exact path is resident-only; past the budget the
+        # hashed streaming+rerank engine must serve the same contract.
+        monkeypatch.setenv("TFIDF_TPU_RESIDENT_ELEMS", "0")
+        dev, engine = exact_terms(corpus, _cfg(), k=5, doc_len=64,
+                                  chunk_docs=32)
+        assert engine == "hashed-rerank"
+        assert len(dev) == 101 and dev["doc101"]
+
+    def test_cli_exact_terms_with_mesh_uses_hashed_engine(self, corpus,
+                                                          tmp_path):
+        # --exact-terms + --mesh: the mesh ingest provides the margin
+        # selection (ids-only wire) and the hashed re-rank engine emits
+        # exact words — the CLI matrix has no dead cells. That engine's
+        # documented limit applies: score TIES beyond the margin pick
+        # bucket-order members, not word-asc (docs/EXACT.md engine 2),
+        # so the pin is oracle-score-exactness per line + per-doc
+        # counts, not byte equality with the device-exact engine.
+        from tfidf_tpu.cli import main
+        out = tmp_path / "mesh_exact.txt"
+        rc = main(["run", "--input", corpus, "--output", str(out),
+                   "--vocab-mode", "hashed", "--vocab-size", "4096",
+                   "--topk", "5", "--doc-len", "64", "--exact-terms",
+                   "--mesh", "4,1,1"])
+        assert rc == 0
+        if not os.path.exists(NATIVE):
+            subprocess.run(["make", "-C", os.path.dirname(NATIVE)],
+                           check=True, capture_output=True)
+        oracle_out = str(tmp_path / "oracle_mesh.txt")
+        subprocess.run([NATIVE, corpus, oracle_out, "5"], check=True,
+                       stdout=subprocess.DEVNULL)
+        oracle_lines = set(open(oracle_out, "rb").read().splitlines())
+        lines = open(out, "rb").read().splitlines()
+        assert lines and all(l in oracle_lines for l in lines)
+        # doc101's top-5 are 5 of its (all-tied) hapax words
+        hapax = [l for l in lines if l.startswith(b"doc101@hapax")]
+        assert len(hapax) == 5
+
     def test_cli_exact_terms_rides_device_engine(self, corpus, tmp_path):
         from tfidf_tpu.cli import main
         out = tmp_path / "exact.txt"
