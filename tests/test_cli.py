@@ -24,6 +24,17 @@ class TestCli:
         assert rc == 0
         assert out.read_bytes() == golden_output(discover_corpus(toy_corpus_dir))
 
+    def test_mpi_process_comm_flag(self, toy_corpus_dir, tmp_path):
+        # --comm process runs the fork+socketpair OS-process backend —
+        # same bytes as the default thread backend and the golden spec.
+        out = tmp_path / "proc.txt"
+        rc = main(["run", "--input", toy_corpus_dir, "--output", str(out),
+                   "--backend", "mpi", "--nranks", "3",
+                   "--comm", "process"])
+        assert rc == 0
+        assert out.read_bytes() == golden_output(
+            discover_corpus(toy_corpus_dir))
+
     def test_backends_agree(self, toy_corpus_dir, tmp_path):
         a, b = tmp_path / "a.txt", tmp_path / "b.txt"
         assert main(["run", "--input", toy_corpus_dir, "--output", str(a),

@@ -80,7 +80,13 @@ def _build_parser() -> argparse.ArgumentParser:
     run.add_argument("--no-strict", action="store_true",
                      help="accept any filenames, not just doc<i>")
     run.add_argument("--nranks", type=int, default=4,
-                     help="ranks for --backend=mpi (thread backend)")
+                     help="ranks for --backend=mpi")
+    run.add_argument("--comm", choices=["thread", "process"],
+                     default="thread",
+                     help="--backend=mpi rank backend: threads in one "
+                          "process, or fork+socketpair OS processes "
+                          "(the reference's mpirun deployment model; "
+                          "byte-identical output)")
     run.add_argument("--timing", action="store_true",
                      help="print per-phase wall-clock (discover/pack/"
                           "transfer/compute/fetch/emit) and docs/sec "
@@ -135,7 +141,8 @@ def _run_mpi(args) -> int:
                              "(make -C native failed)\n")
             return 1
     proc = subprocess.run(
-        [NATIVE_BIN, args.input, args.output, str(args.nranks)])
+        [NATIVE_BIN, args.input, args.output, str(args.nranks),
+         getattr(args, "comm", "thread")])
     return proc.returncode
 
 
