@@ -102,6 +102,23 @@ for arr, ref in ((vals, rvals), (ids, rids)):
     for shard in arr.addressable_shards:
         np.testing.assert_allclose(np.asarray(shard.data),
                                    ref[shard.index], rtol=1e-6)
+
+# Streaming incremental DF (BASELINE config 5) across the same
+# process-spanning mesh: the minibatch update's psum crosses the
+# process boundary; the folded DF must equal the dense reference's.
+from tfidf_tpu.streaming import _mesh_update_sparse_fn
+upd = _mesh_update_sparse_fn(plan, vocab)
+df_state = jnp.zeros((vocab,), jnp.int32)
+for lo in range(0, d, d // 2):  # two minibatches
+    bt = jax.make_array_from_callback(
+        (d // 2, L), plan.sharding(plan.batch_spec()),
+        lambda idx, lo=lo: toks[lo:lo + d // 2][idx])
+    bl = jax.make_array_from_callback(
+        (d // 2,), plan.sharding(plan.lengths_spec()),
+        lambda idx, lo=lo: lens[lo:lo + d // 2][idx])
+    df_state = upd(df_state, bt, bl)
+np.testing.assert_array_equal(
+    np.asarray(df_state.addressable_shards[0].data), rdf)
 print("OK", topo.process_id)
 """
 
