@@ -175,3 +175,25 @@ class TestWriterLock:
         # lock released -> saving works again
         assert ckpt.save_state(root, {"df": np.zeros(4)}) in ("orbax", "npz")
         assert ckpt.exists(root)
+
+
+class TestStreamMesh:
+    def test_cli_stream_mesh_matches_single(self, tmp_path):
+        # Round 4: stream --mesh-docs shards every minibatch; output
+        # bytes must equal the single-device stream.
+        from tfidf_tpu.cli import main
+
+        ind = tmp_path / "input"
+        ind.mkdir()
+        rng = np.random.default_rng(3)
+        for i in range(1, 23):
+            (ind / f"doc{i}").write_text(
+                " ".join(f"w{rng.integers(0, 40)}" for _ in range(12)))
+        single, mesh = str(tmp_path / "s.txt"), str(tmp_path / "m.txt")
+        base = ["stream", "--input", str(ind), "--batch-docs", "8",
+                "--vocab-size", "256", "--topk", "3"]
+        assert main(base + ["--output", single]) == 0
+        assert main(base + ["--output", mesh, "--mesh-docs", "4"]) == 0
+        assert open(single, "rb").read() == open(mesh, "rb").read()
+        # batch size must block-shard evenly: clean error otherwise
+        assert main(base + ["--output", mesh, "--mesh-docs", "3"]) == 2

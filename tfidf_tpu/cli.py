@@ -106,6 +106,10 @@ def _build_parser() -> argparse.ArgumentParser:
                          "stream)")
     st.add_argument("--vocab-size", type=int, default=1 << 16)
     st.add_argument("--topk", type=int, default=8)
+    st.add_argument("--mesh-docs", type=int, default=None,
+                    help="shard each minibatch over this many devices "
+                         "(0 = all); the DF update becomes the "
+                         "incremental psum of BASELINE config 5")
     st.add_argument("--checkpoint", default=None,
                     help="checkpoint directory; state is saved after "
                          "every minibatch")
@@ -368,7 +372,18 @@ def _run_stream(args) -> int:
     cfg = PipelineConfig(vocab_mode=VocabMode.HASHED,
                          vocab_size=args.vocab_size, topk=args.topk,
                          max_doc_len=args.doc_len, doc_chunk=args.doc_len)
-    stream = StreamingTfidf(cfg)
+    plan = None
+    if args.mesh_docs is not None:
+        import jax
+
+        from tfidf_tpu.parallel import MeshPlan
+        devs = jax.devices()[:args.mesh_docs] if args.mesh_docs else None
+        plan = MeshPlan.create(docs=args.mesh_docs, devices=devs)
+        if args.batch_docs % plan.n_docs_shards:
+            sys.stderr.write("error: --batch-docs must be a multiple of "
+                             "--mesh-docs (rows block-shard evenly)\n")
+            return 2
+    stream = StreamingTfidf(cfg, plan)
     names = discover_names(args.input, strict=not args.no_strict)
     if not names:
         sys.stderr.write(f"error: no documents in {args.input}\n")
