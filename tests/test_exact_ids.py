@@ -171,6 +171,22 @@ class TestDeviceExact:
         for _, s in got:
             assert s == want_score
 
+    def test_lines_fallback_assembles_sorted_output(self, corpus,
+                                                    monkeypatch):
+        # exact_terms_lines' hashed-fallback branch builds the sorted
+        # line bytes in Python — must match the reference ordering
+        # contract and the dict-entry contract of exact_terms.
+        from tfidf_tpu.rerank import exact_terms_lines
+        monkeypatch.setenv("TFIDF_TPU_RESIDENT_ELEMS", "0")  # force f/b
+        lines, engine, sample_fn = exact_terms_lines(
+            corpus, _cfg(), k=5, doc_len=64, chunk_docs=32)
+        assert engine == "hashed-rerank"
+        rows = lines.splitlines()
+        assert rows == sorted(rows) and rows
+        sample = sample_fn(["doc3"])
+        assert [b"doc3@%s\t%.16f" % (w, s) in rows
+                for w, s in sample["doc3"]]
+
     def test_beyond_resident_falls_back_to_hashed(self, corpus,
                                                   monkeypatch, capsys):
         # The device-exact path is resident-only; past the budget the
