@@ -117,6 +117,9 @@ def _build_parser() -> argparse.ArgumentParser:
                     help="restore from --checkpoint and skip the "
                          "documents already folded into the DF state")
     st.add_argument("--no-strict", action="store_true")
+    st.add_argument("--timing", action="store_true",
+                    help="print per-phase wall-clock (pass1/pass2/emit) "
+                         "and docs/sec to stderr")
 
     q = sub.add_parser(
         "query", help="index a corpus and run ranked cosine retrieval")
@@ -415,27 +418,40 @@ def _run_stream(args) -> int:
                 num_docs=len(batch_names), names=padded,
                 vocab_size=cfg.vocab_size, id_to_word=None)
 
+    from tfidf_tpu.utils.timing import PhaseTimer, Throughput, phase_or_null
+    timer = PhaseTimer() if getattr(args, "timing", False) else None
+    throughput = Throughput()
+
     # Pass 1: fold DF, checkpoint after every minibatch.
-    for batch in batches(start):
-        stream.update(batch)
-        if args.checkpoint:
-            ckpt.save_state(args.checkpoint, stream.state_dict())
+    with phase_or_null(timer, "pass1_df"):
+        for batch in batches(start):
+            stream.update(batch)
+            if args.checkpoint:
+                ckpt.save_state(args.checkpoint, stream.state_dict())
     print(f"df folded over {stream.docs_seen} docs")
 
     # Pass 2: score all minibatches against the final DF snapshot.
     import types
     all_names: List[str] = []
     all_vals, all_ids = [], []
-    for batch in batches(0):
-        vals, ids = stream.score(batch)
-        all_names.extend(batch.names[:batch.num_docs])
-        all_vals.append(np.asarray(vals)[:batch.num_docs])
-        all_ids.append(np.asarray(ids)[:batch.num_docs])
+    with phase_or_null(timer, "pass2_score"):
+        for batch in batches(0):
+            vals, ids = stream.score(batch)
+            all_names.extend(batch.names[:batch.num_docs])
+            all_vals.append(np.asarray(vals)[:batch.num_docs])
+            all_ids.append(np.asarray(ids)[:batch.num_docs])
     report = types.SimpleNamespace(
         num_docs=len(all_names), names=all_names,
         topk_vals=np.concatenate(all_vals), topk_ids=np.concatenate(all_ids),
         id_to_word={})
-    _write_topk(args.output, report)  # same format as `run --topk`
+    with phase_or_null(timer, "emit"):
+        _write_topk(args.output, report)  # same format as `run --topk`
+    if timer is not None:
+        total = sum(s for _, s in timer.items())
+        throughput.record(len(all_names), total)
+        sys.stderr.write(timer.report() + "\n"
+                         f"{'docs/sec':>12}: "
+                         f"{throughput.docs_per_sec:9.1f}\n")
     print(f"wrote {args.output} ({stream.docs_seen} docs)")
     return 0
 
