@@ -318,12 +318,22 @@ void* exact_emit_run(void* intern_handle, const char* input_dir,
     };
     std::sort(cand.begin(), cand.end(), by_score_word);
     int64_t kk = k < (int64_t)cand.size() ? k : (int64_t)cand.size();
-    // Boundary tie: full wire and the tail's positive score equals the
+    // Boundary tie: full wire and the tail's positive score ties the
     // k-th — resolve from the document itself (exactly the Python
-    // rule, rerank.exact_topk_from_wire).
+    // rule, rerank.exact_topk_from_wire). Two refinements (advisor r4):
+    //  * "ties" means within float32 rounding distance (4e-6 rel), not
+    //    only exact f64 equality — the device ranked by float32, so a
+    //    near-tie group can collapse there and be truncated in
+    //    intern-id order even when the f64 scores are distinct;
+    //  * a doc with lengths[d] <= kprime tokens cannot have more
+    //    distinct terms than the wire holds — its full wire is the
+    //    complete term set, so the heuristic must not fire (otherwise
+    //    doc_len <= k degrades every dense doc to a re-read).
     bool tied = full && kprime > 0 && kk > 0 &&
-                cand.back().score == cand[(size_t)kk - 1].score &&
-                cand.back().score > 0.0;
+                (int64_t)lengths[d] > kprime &&
+                cand.back().score > 0.0 &&
+                cand[(size_t)kk - 1].score - cand.back().score <=
+                    cand[(size_t)kk - 1].score * 4e-6;
     if (tied) {
       std::string path = std::string(input_dir) + "/" + names[d];
       std::string data;
@@ -396,8 +406,31 @@ void* exact_emit_run(void* intern_handle, const char* input_dir,
   // does, and '\t' (below every non-whitespace byte a word can hold)
   // makes plain word-lex agree with the line's word+'\t' segment. One
   // u64 key per line beats comparing 60-byte strings ~line-count times.
-  std::vector<int32_t> name_rank(n_docs);
+  //
+  // The equivalence needs '@'-free names: with names "doc" and "doc@a"
+  // the key ranks every "doc" line before every "doc@a" line, while
+  // full-line bytes interleave them ("doc@a@beta" < "doc@xray").
+  // Likewise it needs words free of bytes below '\t' (0x01-0x08, legal
+  // token bytes): plain word-lex puts "a" before "a\x01x", but the
+  // line segments order "a\x01x\t" before "a\t" since 0x01 < 0x09.
+  // Reachable only via --no-strict / binary-ish corpora; such runs
+  // take the assemble-and-sort-the-bytes fallback below (advisor r4).
+  bool need_byte_sort = false;
+  for (int64_t d = 0; d < n_docs && !need_byte_sort; ++d)
+    if (std::strchr(names[d], '@') != nullptr) need_byte_sort = true;
   {
+    const int64_t nlive = T->live.load();
+    for (int64_t i = 0; i < nlive && !need_byte_sort; ++i) {
+      const InternTable::Entry& E = T->entries[(size_t)i];
+      for (int32_t b = 0; b < E.len; ++b)
+        if ((unsigned char)E.w[b] < (unsigned char)'\t') {
+          need_byte_sort = true;
+          break;
+        }
+    }
+  }
+  std::vector<int32_t> name_rank(need_byte_sort ? 0 : (size_t)n_docs);
+  if (!need_byte_sort) {
     std::vector<int32_t> order(n_docs);
     for (int64_t d = 0; d < n_docs; ++d) order[(size_t)d] = (int32_t)d;
     std::sort(order.begin(), order.end(), [&](int32_t a, int32_t b) {
@@ -409,8 +442,8 @@ void* exact_emit_run(void* intern_handle, const char* input_dir,
       name_rank[(size_t)order[(size_t)i]] = (int32_t)i;
   }
   const int64_t live = T->live.load();
-  std::vector<int32_t> word_rank((size_t)(live ? live : 1));
-  {
+  std::vector<int32_t> word_rank(need_byte_sort ? 1 : (size_t)(live ? live : 1));
+  if (!need_byte_sort) {
     std::vector<int32_t> order((size_t)live);
     for (int64_t i = 0; i < live; ++i) order[(size_t)i] = (int32_t)i;
     std::sort(order.begin(), order.end(), [&](int32_t a, int32_t b) {
@@ -426,8 +459,13 @@ void* exact_emit_run(void* intern_handle, const char* input_dir,
   }
 
   std::vector<std::pair<uint64_t, int64_t>> keyed;  // (key, entry no.)
+  std::vector<std::string> line_strs;  // '@'-in-name fallback only
   std::vector<int32_t> entry_doc((size_t)(total ? total : 1));
-  keyed.reserve(total);
+  char buf[64];
+  if (need_byte_sort)
+    line_strs.reserve((size_t)total);
+  else
+    keyed.reserve(total);
   int64_t eno = 0;
   for (int64_t d = 0; d < n_docs; ++d) {
     for (const ExactEntry& e : picked[d]) {
@@ -437,15 +475,35 @@ void* exact_emit_run(void* intern_handle, const char* input_dir,
       res->scores.push_back(e.score);
       res->word_blob.append(w.w, (size_t)w.len);
       entry_doc[(size_t)eno] = (int32_t)d;
-      keyed.emplace_back(((uint64_t)(uint32_t)name_rank[(size_t)d] << 32)
-                             | (uint32_t)word_rank[(size_t)e.id],
-                         eno);
+      if (need_byte_sort) {
+        std::string line(names[(size_t)d]);
+        line.push_back('@');
+        line.append(w.w, (size_t)w.len);
+        line.push_back('\t');
+        int m = std::snprintf(buf, sizeof buf, "%.16f", e.score);
+        line.append(buf, (size_t)m);
+        line_strs.push_back(std::move(line));
+      } else {
+        keyed.emplace_back(((uint64_t)(uint32_t)name_rank[(size_t)d] << 32)
+                               | (uint32_t)word_rank[(size_t)e.id],
+                           eno);
+      }
       ++eno;
     }
   }
-  std::sort(keyed.begin(), keyed.end());
-  char buf[64];
   res->lines.reserve((int64_t)total * 48);
+  if (need_byte_sort) {
+    // Full-line byte sort — the reference's qsort semantics verbatim,
+    // correct for any name bytes (scores included in the compare,
+    // matching TFIDF.c:273 when assembled prefixes collide).
+    std::sort(line_strs.begin(), line_strs.end());
+    for (const std::string& l : line_strs) {
+      res->lines.append(l);
+      res->lines.push_back('\n');
+    }
+    return res;
+  }
+  std::sort(keyed.begin(), keyed.end());
   for (const auto& kv : keyed) {
     int64_t entry = kv.second;
     res->lines.append(names[(size_t)entry_doc[(size_t)entry]]);

@@ -237,3 +237,70 @@ class TestDeviceExact:
         assert b"doc101@hapax0\t" in data
         lines = data.splitlines()
         assert lines == sorted(lines)  # strcmp ordering contract
+
+
+class TestAdvisorR4Fixes:
+    """Regression tests for the round-4 advisor findings (ADVICE.md)."""
+
+    def test_at_in_name_uses_full_line_byte_sort(self, tmp_path):
+        # medium: names "doc" and "doc@a" break exact_emit's
+        # (name+'@', word) integer rank key — "doc@xray" would sort
+        # before "doc@a@beta" even though full-line bytes interleave
+        # them. The '@' fallback must sort the assembled line bytes.
+        from tfidf_tpu.rerank import exact_terms_lines
+        d = tmp_path / "input"
+        d.mkdir()
+        (d / "doc").write_text("xray zulu")
+        (d / "doc@a").write_text("beta alpha")
+        lines, engine, _ = exact_terms_lines(str(d), _cfg(), k=4,
+                                             chunk_docs=4, strict=False)
+        assert engine == "device-exact"
+        rows = lines.splitlines()
+        assert rows == sorted(rows) and len(rows) == 4
+        # The interleaving the integer key got wrong:
+        assert rows[0].startswith(b"doc@a@alpha")
+        assert rows[1].startswith(b"doc@a@beta")
+        assert rows[2].startswith(b"doc@xray")
+
+    def test_short_doc_cap_skips_tie_reread(self, tmp_path):
+        # low: when the wire width (kprime = min(topk, doc_len)) is >=
+        # a doc's token count, its full wire IS the complete term set —
+        # the tie heuristic must not fire. Old behavior degraded every
+        # dense doc to a doc-local re-read; here the doc file does not
+        # even exist, so a fired tie would raise FileNotFoundError.
+        from tfidf_tpu.ingest import ExactIngest
+        exact = ExactIngest(
+            names=["ghost"], lengths=np.array([3], np.int32),
+            topk_ids=np.array([[0, 1, 2]], np.int32),
+            topk_counts=np.array([[1, 1, 1]], np.int32),
+            df=np.array([1, 1, 1], np.int32), num_docs=2,
+            words=[b"a", b"b", b"c"])
+        out = exact_topk_from_wire(exact, 2, str(tmp_path), _cfg())
+        # All three score (1/3)ln(2), word-asc picks a then b.
+        assert [w for w, _ in out["ghost"]] == [b"a", b"b"]
+
+    def test_f32_near_tie_resolves_doc_locally(self, tmp_path):
+        # low: the device ranks by float32 — candidates whose float64
+        # scores are distinct but within float32 rounding distance can
+        # be truncated in id order before the wire. The detector must
+        # treat "within 4e-6 relative" as tied and re-read the doc,
+        # recovering a true top-k member the wire never carried.
+        from tfidf_tpu.ingest import ExactIngest
+        d = tmp_path / "input"
+        d.mkdir()
+        (d / "docx").write_text("a b c d")
+        # Crafted DF: s(a) clear winner; s(b), s(c) within ~4e-7
+        # relative (f32-collapsible); s(d) — NOT on the wire — beats
+        # both, so the wire alone would return the wrong 2nd term.
+        df = np.array([2.0, 20.00001, 20.00002, 20.0])
+        exact = ExactIngest(
+            names=["docx"], lengths=np.array([4], np.int32),
+            topk_ids=np.array([[0, 1, 2]], np.int32),
+            topk_counts=np.array([[1, 1, 1]], np.int32),
+            df=df, num_docs=100, words=[b"a", b"b", b"c", b"d"])
+        out = exact_topk_from_wire(exact, 2, str(d), _cfg())
+        got = out["docx"]
+        assert [w for w, _ in got] == [b"a", b"d"]
+        # np.log mirrors the production path (rerank re-read branch) —
+        # math.log may differ by 1 ulp on SIMD numpy builds.
+        assert got[1][1] == (1.0 / 4.0) * float(np.log(100.0 / 20.0))

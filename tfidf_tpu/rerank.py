@@ -116,14 +116,26 @@ def exact_topk_from_wire(exact, k: int, input_dir: str,
     ids = np.take_along_axis(exact.topk_ids, sel, axis=1)
     kprime = sc.shape[1]
     kk = min(k, kprime)
-    # Boundary-tie detection (exact, vectorized): the wire is full AND
-    # its worst candidate's positive score ties the k-th entry — the
-    # tie group may continue past the wire, so the word-asc choice is
-    # undecidable from the wire alone.
+    # Boundary-tie detection (vectorized): the wire is full AND its
+    # worst candidate's positive score ties the k-th entry — the tie
+    # group may continue past the wire, so the word-asc choice is
+    # undecidable from the wire alone. Two refinements (advisor r4):
+    #  * "ties" means within float32 rounding distance (4e-6 relative),
+    #    not only exact float64 equality — the device ranked by float32,
+    #    so a near-tie group can collapse there and be truncated in
+    #    intern-id order even when the float64 scores are distinct;
+    #  * a doc with lengths <= kprime tokens cannot have more distinct
+    #    terms than the wire holds — its full wire IS the complete term
+    #    set, so the heuristic must not fire (otherwise doc_len <= k
+    #    degrades every dense doc to a doc-local re-read).
     full = valid.all(axis=1)
-    tied = full & (sc[:, kk - 1] == sc[:, kprime - 1]) \
-        & (sc[:, kprime - 1] > 0.0) if kprime > 0 \
-        else np.zeros(sc.shape[0], bool)
+    if kprime > 0:
+        near = (sc[:, kk - 1] - sc[:, kprime - 1]) \
+            <= sc[:, kk - 1] * 4e-6
+        tied = full & near & (sc[:, kprime - 1] > 0.0) \
+            & (exact.lengths > kprime)
+    else:
+        tied = np.zeros(sc.shape[0], bool)
     # Bulk-convert once (C-speed) — the per-doc loop then touches only
     # Python floats/ints, which halves dict-build time at 1M rows.
     sc_l = sc[:, :kk].tolist()
