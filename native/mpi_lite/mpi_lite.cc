@@ -1,0 +1,197 @@
+// mpi_lite runtime: the MPI subset in mpi.h over pairwise AF_UNIX
+// socketpairs created by mpirun_lite and inherited across exec.
+//
+// Wire protocol per (src, dst) channel: framed messages
+// [u32 tag][u64 bytes][payload]. The calls this runtime serves
+// (comm.cc MpiComm) are strictly ordered per channel — every Send has
+// exactly one program-ordered matching Recv — so a frame's tag must
+// equal the tag the receiver asked for; a mismatch is a protocol bug
+// and aborts loudly rather than reordering. Collective tags live in a
+// reserved range (< 0) so they cannot collide with point-to-point tags.
+//
+// Deadlock note: all collectives here are root-sequenced (root sends
+// to or receives from peers one at a time; peers talk only to root),
+// so channel buffers bound memory, not progress.
+
+#include "mpi.h"
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+constexpr int kTagBcast = -101;
+constexpr int kTagBarrierIn = -102;
+constexpr int kTagBarrierOut = -103;
+
+struct World {
+  int rank = 0;
+  int size = 1;
+  std::vector<int> fds;  // fds[r] = channel to rank r; own slot -1
+  bool inited = false;
+};
+
+World g_world;
+
+[[noreturn]] void Die(const char* what) {
+  std::fprintf(stderr, "mpi_lite[rank %d]: %s (errno=%d %s)\n",
+               g_world.rank, what, errno, std::strerror(errno));
+  std::abort();
+}
+
+void WriteAll(int fd, const void* data, size_t n) {
+  const char* p = static_cast<const char*>(data);
+  while (n) {
+    ssize_t w = ::write(fd, p, n);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      Die("write failed");
+    }
+    p += w;
+    n -= (size_t)w;
+  }
+}
+
+void ReadAll(int fd, void* data, size_t n) {
+  char* p = static_cast<char*>(data);
+  while (n) {
+    ssize_t r = ::read(fd, p, n);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      Die("read failed");
+    }
+    if (r == 0) Die("peer closed channel mid-message");
+    p += r;
+    n -= (size_t)r;
+  }
+}
+
+void SendFrame(int peer, int tag, const void* data, uint64_t n) {
+  int fd = g_world.fds[(size_t)peer];
+  if (fd < 0) Die("send to self/unwired peer");
+  int32_t t = (int32_t)tag;
+  WriteAll(fd, &t, sizeof t);
+  WriteAll(fd, &n, sizeof n);
+  if (n) WriteAll(fd, data, (size_t)n);
+}
+
+uint64_t RecvFrame(int peer, int tag, void* data, uint64_t cap) {
+  int fd = g_world.fds[(size_t)peer];
+  if (fd < 0) Die("recv from self/unwired peer");
+  int32_t t;
+  uint64_t n;
+  ReadAll(fd, &t, sizeof t);
+  ReadAll(fd, &n, sizeof n);
+  if (t != (int32_t)tag) Die("tag mismatch (out-of-order protocol)");
+  if (n > cap) Die("frame larger than receive buffer");
+  if (n) ReadAll(fd, data, (size_t)n);
+  return n;
+}
+
+size_t DtypeSize(MPI_Datatype d) {
+  switch (d) {
+    case MPI_BYTE: return 1;
+    case MPI_UINT64_T: return 8;
+    default: Die("unsupported datatype");
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+int MPI_Init(int*, char***) {
+  const char* rank_s = std::getenv("MPILITE_RANK");
+  const char* size_s = std::getenv("MPILITE_SIZE");
+  const char* fds_s = std::getenv("MPILITE_FDS");
+  if (!rank_s || !size_s || !fds_s) {
+    std::fprintf(stderr,
+                 "mpi_lite: not launched by mpirun_lite (MPILITE_* env "
+                 "missing); run: mpirun_lite -np N <prog> <args...>\n");
+    std::exit(2);
+  }
+  g_world.rank = std::atoi(rank_s);
+  g_world.size = std::atoi(size_s);
+  g_world.fds.clear();
+  std::string s(fds_s);
+  size_t pos = 0;
+  while (pos <= s.size()) {
+    size_t c = s.find(',', pos);
+    if (c == std::string::npos) c = s.size();
+    g_world.fds.push_back(std::atoi(s.substr(pos, c - pos).c_str()));
+    pos = c + 1;
+  }
+  if ((int)g_world.fds.size() != g_world.size)
+    Die("MPILITE_FDS length != MPILITE_SIZE");
+  g_world.inited = true;
+  return MPI_SUCCESS;
+}
+
+int MPI_Finalize(void) {
+  for (int fd : g_world.fds)
+    if (fd >= 0) ::close(fd);
+  g_world.fds.clear();
+  g_world.inited = false;
+  return MPI_SUCCESS;
+}
+
+int MPI_Comm_rank(MPI_Comm, int* rank) {
+  *rank = g_world.rank;
+  return MPI_SUCCESS;
+}
+
+int MPI_Comm_size(MPI_Comm, int* size) {
+  *size = g_world.size;
+  return MPI_SUCCESS;
+}
+
+int MPI_Bcast(void* buf, int count, MPI_Datatype dtype, int root,
+              MPI_Comm) {
+  const uint64_t bytes = (uint64_t)count * DtypeSize(dtype);
+  if (g_world.size == 1) return MPI_SUCCESS;
+  if (g_world.rank == root) {
+    for (int r = 0; r < g_world.size; ++r)
+      if (r != root) SendFrame(r, kTagBcast, buf, bytes);
+  } else {
+    uint64_t n = RecvFrame(root, kTagBcast, buf, bytes);
+    if (n != bytes) Die("bcast size mismatch");
+  }
+  return MPI_SUCCESS;
+}
+
+int MPI_Send(const void* buf, int count, MPI_Datatype dtype, int dest,
+             int tag, MPI_Comm) {
+  if (tag < 0) Die("negative tags are reserved for collectives");
+  SendFrame(dest, tag, buf, (uint64_t)count * DtypeSize(dtype));
+  return MPI_SUCCESS;
+}
+
+int MPI_Recv(void* buf, int count, MPI_Datatype dtype, int source,
+             int tag, MPI_Comm, MPI_Status*) {
+  if (tag < 0) Die("negative tags are reserved for collectives");
+  RecvFrame(source, tag, buf, (uint64_t)count * DtypeSize(dtype));
+  return MPI_SUCCESS;
+}
+
+int MPI_Barrier(MPI_Comm) {
+  if (g_world.size == 1) return MPI_SUCCESS;
+  uint8_t token = 0;
+  if (g_world.rank == 0) {
+    for (int r = 1; r < g_world.size; ++r)
+      RecvFrame(r, kTagBarrierIn, &token, 1);
+    for (int r = 1; r < g_world.size; ++r)
+      SendFrame(r, kTagBarrierOut, &token, 1);
+  } else {
+    SendFrame(0, kTagBarrierIn, &token, 1);
+    RecvFrame(0, kTagBarrierOut, &token, 1);
+  }
+  return MPI_SUCCESS;
+}
+
+}  // extern "C"

@@ -1,0 +1,96 @@
+// mpirun_lite — single-node process launcher for mpi_lite.
+//
+//   mpirun_lite -np N <prog> [args...]
+//
+// Creates one AF_UNIX socketpair per rank pair (i, j), forks N
+// children, and execs <prog> in each with:
+//   MPILITE_RANK=<r> MPILITE_SIZE=<N>
+//   MPILITE_FDS=<fd to rank 0>,<fd to rank 1>,... (own slot -1)
+// Children inherit only their own row's fds (everything else closed),
+// so the runtime's channels are private pairwise pipes — the same
+// process model as `mpirun -np N ./TFIDF` (TFIDF.c:82-92), minus the
+// network. Exit status: 0 iff every rank exits 0.
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <sys/socket.h>
+#include <sys/wait.h>
+#include <unistd.h>
+#include <vector>
+
+int main(int argc, char** argv) {
+  int np = 0, argi = 1;
+  if (argc >= 3 && std::strcmp(argv[1], "-np") == 0) {
+    np = std::atoi(argv[2]);
+    argi = 3;
+  }
+  if (np < 1 || argi >= argc) {
+    std::fprintf(stderr, "usage: %s -np N <prog> [args...]\n", argv[0]);
+    return 2;
+  }
+
+  // pair_fd[i][j] = fd rank i uses to talk to rank j (i != j).
+  std::vector<std::vector<int>> pair_fd((size_t)np,
+                                        std::vector<int>((size_t)np, -1));
+  for (int i = 0; i < np; ++i)
+    for (int j = i + 1; j < np; ++j) {
+      int sv[2];
+      if (socketpair(AF_UNIX, SOCK_STREAM, 0, sv) != 0) {
+        std::perror("socketpair");
+        return 2;
+      }
+      pair_fd[(size_t)i][(size_t)j] = sv[0];
+      pair_fd[(size_t)j][(size_t)i] = sv[1];
+    }
+
+  std::vector<pid_t> kids((size_t)np);
+  for (int r = 0; r < np; ++r) {
+    pid_t pid = fork();
+    if (pid < 0) {
+      std::perror("fork");
+      return 2;
+    }
+    if (pid == 0) {
+      // Child rank r: keep row r, close every other pair's fds.
+      for (int i = 0; i < np; ++i)
+        for (int j = 0; j < np; ++j)
+          if (i != r && j != r && pair_fd[(size_t)i][(size_t)j] >= 0 &&
+              i < j) {
+            close(pair_fd[(size_t)i][(size_t)j]);
+            close(pair_fd[(size_t)j][(size_t)i]);
+          }
+      for (int j = 0; j < np; ++j)
+        if (j != r) close(pair_fd[(size_t)j][(size_t)r]);
+      std::string fds;
+      for (int j = 0; j < np; ++j) {
+        if (j) fds += ',';
+        fds += std::to_string(pair_fd[(size_t)r][(size_t)j]);
+      }
+      setenv("MPILITE_RANK", std::to_string(r).c_str(), 1);
+      setenv("MPILITE_SIZE", std::to_string(np).c_str(), 1);
+      setenv("MPILITE_FDS", fds.c_str(), 1);
+      execvp(argv[argi], argv + argi);
+      std::perror("execvp");
+      _exit(127);
+    }
+    kids[(size_t)r] = pid;
+  }
+  // Parent: close every fd, reap every rank.
+  for (int i = 0; i < np; ++i)
+    for (int j = i + 1; j < np; ++j) {
+      close(pair_fd[(size_t)i][(size_t)j]);
+      close(pair_fd[(size_t)j][(size_t)i]);
+    }
+  int rc = 0;
+  for (int r = 0; r < np; ++r) {
+    int st = 0;
+    if (waitpid(kids[(size_t)r], &st, 0) < 0) rc = 2;
+    else if (!WIFEXITED(st) || WEXITSTATUS(st) != 0) {
+      std::fprintf(stderr, "mpirun_lite: rank %d exited abnormally\n", r);
+      rc = WIFEXITED(st) ? WEXITSTATUS(st) : 2;
+    }
+  }
+  return rc;
+}
