@@ -122,20 +122,17 @@ def make_corpus(root: str) -> str:
     return input_dir
 
 
-def bench_native(input_dir: str, out: str) -> float:
+def native_once(input_dir: str, out: str) -> float:
     binary = os.path.join(REPO, "native", "tfidf_ref")
     if not os.path.exists(binary):
         built = subprocess.run(["make", "-C", os.path.join(REPO, "native")],
                                capture_output=True, text=True)
         if built.returncode != 0:
             raise RuntimeError(f"native build failed:\n{built.stderr[-2000:]}")
-    best = float("inf")
-    for _ in range(REPEATS):
-        t0 = time.perf_counter()
-        subprocess.run([binary, input_dir, out, "9"], check=True,
-                       stdout=subprocess.DEVNULL)
-        best = min(best, time.perf_counter() - t0)
-    return best
+    t0 = time.perf_counter()
+    subprocess.run([binary, input_dir, out, "9"], check=True,
+                   stdout=subprocess.DEVNULL)
+    return time.perf_counter() - t0
 
 
 def bench_tpu(input_dir: str):
@@ -167,33 +164,35 @@ def bench_tpu(input_dir: str):
     pack_s = time.perf_counter() - t0
 
     # Untimed warmup compiles both phases at the chunk shape; the timed
-    # runs re-ingest from raw bytes and hit the jit cache. Best-of-N
-    # with the SAME N as the native side (min is the honest steady state
-    # on a noisy single-core host; asymmetric N would bias the ratio).
+    # runs re-ingest from raw bytes and hit the jit cache.
     result = run_overlapped(input_dir, cfg, chunk_docs=chunk,
                             doc_len=DOC_LEN)
-    best = float("inf")
-    phases = dict(result.phases or {})  # warmup's, replaced by best run's
-    for _ in range(REPEATS):
+
+    def tpu_once():
         t0 = time.perf_counter()
-        result = run_overlapped(input_dir, cfg, chunk_docs=chunk,
-                                doc_len=DOC_LEN)
-        if time.perf_counter() - t0 < best:
-            best = time.perf_counter() - t0
-            phases = dict(result.phases or {})
-        assert result.topk_vals.shape == (N_DOCS, TOPK)
-    # Serialized (fenced) per-phase costs: pack / upload / compute /
-    # fetch with no overlap — the honest answer to "where does the
-    # wall-clock go" (VERDICT r2 item 1). jit cache is warm here. Only
-    # valid in the resident regime: the profiler stages every chunk on
-    # device at once, which the streaming regime exists to avoid.
+        r = run_overlapped(input_dir, cfg, chunk_docs=chunk,
+                           doc_len=DOC_LEN)
+        dt = time.perf_counter() - t0
+        assert r.topk_vals.shape == (N_DOCS, TOPK)
+        return dt, r
+
+    return tpu_once, pack_s, result, cfg, chunk
+
+
+def profile_phases(input_dir: str, cfg, chunk: int, result):
+    """Serialized (fenced) per-phase costs: pack / upload / compute /
+    fetch with no overlap — the honest answer to "where does the
+    wall-clock go" (VERDICT r2 item 1). jit cache must be warm. Only
+    valid in the resident regime: the profiler stages every chunk on
+    device at once, which the streaming regime exists to avoid."""
+    phases = dict(result.phases or {})
     if result.path == "resident":
         from tfidf_tpu.ingest import profile_resident
         phases["serialized"] = {
             k: round(v, 3)
             for k, v in profile_resident(input_dir, cfg, chunk_docs=chunk,
                                          doc_len=DOC_LEN).items()}
-    return best, pack_s, result, phases
+    return phases
 
 
 def bench_exact(input_dir: str):
@@ -254,7 +253,8 @@ def measure_recall(result, reranked, oracle_out: str):
 def main() -> None:
     record = {
         "metric": f"docs/sec, {N_DOCS}-doc Zipf corpus, hashed 2^16 "
-                  f"vocab, top-{TOPK} (vs 8-worker native CPU oracle)",
+                  f"vocab, top-{TOPK} (paired-run median vs 8-worker "
+                  f"native CPU oracle)",
         "value": 0.0,
         "unit": "docs/sec",
         "vs_baseline": 0.0,
@@ -273,11 +273,28 @@ def main() -> None:
         log(f"generating {N_DOCS}-doc corpus...")
         input_dir = make_corpus(tmp)
         oracle_out = os.path.join(tmp, "ref_out.txt")
-        log("native oracle runs...")
-        cpu_s = bench_native(input_dir, oracle_out)
-        log(f"native: {cpu_s:.2f}s; TPU runs...")
-        tpu_s, pack_s, result, phases = bench_tpu(input_dir)
-        log(f"tpu: {tpu_s:.2f}s (pack-only {pack_s:.2f}s); exact mode...")
+        # Paired-run protocol (VERDICT r4 item 7): oracle and TPU runs
+        # INTERLEAVED, one ratio per pair, so link/host jitter hits both
+        # sides of each ratio sample alike. The artifact ratio is the
+        # paired median with its IQR — prose can no longer quote a
+        # better run than the artifact records.
+        log("warming TPU path (compile)...")
+        tpu_once, pack_s, result, cfg_tpu, chunk = bench_tpu(input_dir)
+        cpu_times, tpu_times, ratios = [], [], []
+        for i in range(REPEATS):
+            c = native_once(input_dir, oracle_out)
+            t, r = tpu_once()
+            if not tpu_times or t <= min(tpu_times):
+                result = r
+            cpu_times.append(c)
+            tpu_times.append(t)
+            ratios.append(c / t)
+            log(f"  pair {i + 1}/{REPEATS}: cpu {c:.2f}s tpu {t:.2f}s "
+                f"ratio {c / t:.2f}")
+        cpu_s, tpu_s = min(cpu_times), min(tpu_times)
+        phases = profile_phases(input_dir, cfg_tpu, chunk, result)
+        log(f"paired median ratio {float(np.median(ratios)):.2f} "
+            f"(pack-only {pack_s:.2f}s); exact mode...")
         exact_s, reranked, exact_engine = bench_exact(input_dir)
         log(f"exact-terms: {exact_s:.2f}s; recall...")
         recall, recall_exact = measure_recall(result, reranked, oracle_out)
@@ -311,9 +328,18 @@ def main() -> None:
                 "basis": "serialized.compute (fenced, warm); "
                          "docs/SCALING.md '50x story'",
             }
+        # THE artifact numbers: paired medians. Best-of fields keep the
+        # old best-run semantics for continuity, explicitly labeled.
+        med_ratio = float(np.median(ratios))
+        q25, q75 = (float(np.percentile(ratios, 25)),
+                    float(np.percentile(ratios, 75)))
         record.update(
-            value=round(tpu_dps, 1),
-            vs_baseline=round(tpu_dps / cpu_dps, 2),
+            value=round(N_DOCS / float(np.median(tpu_times)), 1),
+            vs_baseline=round(med_ratio, 2),
+            vs_baseline_iqr=[round(q25, 2), round(q75, 2)],
+            paired_ratios=[round(x, 2) for x in ratios],
+            tpu_docs_per_sec_best=round(tpu_dps, 1),
+            vs_baseline_best=round(tpu_dps / cpu_dps, 2),
             cpu_docs_per_sec=round(cpu_dps, 1),
             tpu_s=round(tpu_s, 3),
             cpu_s=round(cpu_s, 3),

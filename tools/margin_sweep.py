@@ -34,7 +34,7 @@ def main():
     print(f"corpus: {bench.N_DOCS} docs...", file=sys.stderr)
     input_dir = bench.make_corpus(tmp)
     oracle_out = os.path.join(tmp, "ref.txt")
-    bench.bench_native(input_dir, oracle_out)
+    bench.native_once(input_dir, oracle_out)
 
     from tfidf_tpu.config import PipelineConfig, VocabMode
     from tfidf_tpu.ingest import run_overlapped
