@@ -155,3 +155,31 @@ class TestCli:
                    "--query", "the quick", "-k", "2", "--mesh-docs", "4"])
         assert rc == 0
         assert "query: the quick" in capsys.readouterr().out
+
+
+def test_inspect_prints_reference_debug_tables(toy_corpus_dir, tmp_path,
+                                               capfd):
+    # --inspect mirrors the reference's TF Job / IDF Job stdout dumps
+    # (TFIDF.c:199-205,236-239): word@document\tcount/docSize then
+    # word@document\tnumDocs/df, before the normal run output.
+    from tfidf_tpu.cli import main
+    out = tmp_path / "o.txt"
+    rc = main(["run", "--input", toy_corpus_dir, "--output", str(out)])
+    base = capfd.readouterr().out
+    rc = main(["run", "--input", toy_corpus_dir, "--output", str(out),
+               "--inspect"])
+    assert rc == 0
+    got = capfd.readouterr().out
+    assert "-------------TF Job-------------" in got
+    assert "------------IDF Job-------------" in got
+    tf_sec = got.split("TF Job-------------\n")[1] \
+        .split("------------IDF")[0]
+    # every TF record is word@doc\tcount/size with integer fields
+    rows = [l for l in tf_sec.splitlines() if l]
+    assert rows
+    for l in rows:
+        key, frac = l.split("\t")
+        w, doc = key.split("@", 1)
+        c, size = frac.split("/")
+        assert int(c) >= 1 and int(size) >= int(c) and w and doc
+    assert base in got or base == ""  # normal run output still present

@@ -87,6 +87,11 @@ def _build_parser() -> argparse.ArgumentParser:
                           "process, or fork+socketpair OS processes "
                           "(the reference's mpirun deployment model; "
                           "byte-identical output)")
+    run.add_argument("--inspect", action="store_true",
+                     help="print the reference's per-phase debug tables "
+                          "(TF Job / IDF Job, TFIDF.c:199-205,236-239) to "
+                          "stdout before running — an eyeball-diff aid "
+                          "for toy corpora")
     run.add_argument("--timing", action="store_true",
                      help="print per-phase wall-clock (discover/pack/"
                           "transfer/compute/fetch/emit) and docs/sec "
@@ -190,6 +195,22 @@ def _run_tpu(args) -> int:
     from tfidf_tpu.utils.timing import PhaseTimer, Throughput, phase_or_null
     timer = PhaseTimer() if args.timing else None
     throughput = Throughput()
+
+    if getattr(args, "inspect", False):
+        # The reference's debugging affordance: dump the TF/IDF phase
+        # tables in its exact print formats (golden.inspect_tables).
+        # Host-side by design — it is the EXPECTED tables the device
+        # run is then eyeball-diffed against, like the original's
+        # stdout vs its output file.
+        from tfidf_tpu.golden import inspect_tables
+        corpus_dbg = discover_corpus(args.input,
+                                     strict=not args.no_strict)
+        if len(corpus_dbg) > 200:
+            sys.stderr.write(f"warning: --inspect prints every record "
+                             f"({len(corpus_dbg)} docs) — meant for toy "
+                             f"corpora\n")
+        sys.stdout.buffer.write(inspect_tables(corpus_dbg))
+        sys.stdout.buffer.flush()
 
     # Scalable route (explicit opt-in via --doc-len): hashed-vocab
     # top-k runs on a single device go through the overlapped chunked

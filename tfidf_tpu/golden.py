@@ -67,3 +67,40 @@ def golden_output(corpus: Corpus) -> bytes:
     """The full ``output.txt`` byte stream (one line per record,
     ``\\n``-terminated, ``TFIDF.c:278-281``)."""
     return b"".join(line + b"\n" for line in golden_lines(corpus))
+
+
+def inspect_tables(corpus: Corpus) -> bytes:
+    """The reference's per-phase debug tables (``--inspect``).
+
+    Mirrors the eyeball-diff prints of the reference — the "TF Job"
+    table (``word@document\\twordCount/docSize``, ``TFIDF.c:199-205``)
+    and the "IDF Job" table (``word@document\\tnumDocs/numDocsWithWord``,
+    ``TFIDF.c:236-239``) — in the same formats, including the
+    word@document key order that is REVERSED from the final output's
+    document@word (SURVEY §2.5 C9). Record order is per-document in
+    discovery order, first-seen word order within a document; the
+    reference's own interleaving depends on its rank schedule and is
+    not a contract. Intended for toy corpora, exactly like the
+    original prints.
+    """
+    token_docs = [whitespace_tokenize(doc) for doc in corpus.docs]
+    num_docs = len(corpus)
+    df: Dict[bytes, int] = {}
+    for toks in token_docs:
+        for w in set(toks):
+            df[w] = df.get(w, 0) + 1
+    per_doc = []
+    for name, toks in zip(corpus.names, token_docs):
+        counts: Dict[bytes, int] = {}
+        for w in toks:
+            counts[w] = counts.get(w, 0) + 1
+        per_doc.append((name.encode(), len(toks), counts))
+    out: List[bytes] = [b"-------------TF Job-------------"]
+    for name, size, counts in per_doc:
+        for w, c in counts.items():
+            out.append(b"%s@%s\t%d/%d" % (w, name, c, size))
+    out.append(b"------------IDF Job-------------")
+    for name, size, counts in per_doc:
+        for w in counts:
+            out.append(b"%s@%s\t%d/%d" % (w, name, num_docs, df[w]))
+    return b"".join(l + b"\n" for l in out)
