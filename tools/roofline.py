@@ -1,0 +1,287 @@
+"""Roofline + stage split of the resident device program (VERDICT r4 #1).
+
+Round 4 left a ~7-10x unexplained gap between the bench shape's fenced
+"compute" (~30-47 Mtok/s for 32768x256) and the sort engine's own
+measured marginal rate (~350 Mtok/s at L=4096, docs/ENGINES.md). This
+tool decomposes that number on the real chip:
+
+  floor     dispatch+fetch round trip of a trivial program
+  h2d       cost of the FIRST program to consume freshly device_put
+            wire data (the tunneled link stages uploads lazily, so this
+            is where the real host->device transfer bill lands)
+  sort      sorted_term_counts alone (pre-materialized inputs)
+  sort+df   + sparse_df (the engine_bench unit)
+  forward   + idf/score/topk (sparse_forward, the algorithmic whole)
+  prod N=c  the production dispatch structure: c x _chunk_step +
+            _finish_wire, inputs pre-materialized, fenced by a
+            checksum fetch (compute only)
+  wirefetch the [D, k] packed wire's device_get alone
+
+plus an analytic bytes model per stage vs HBM peak. Every timing is
+fenced by a device_get of a small dependent reduction —
+block_until_ready under-reports on this backend (docs/ENGINES.md).
+
+Usage: python tools/roofline.py [--docs 32768] [--len 256] [--repeats 5]
+Writes a markdown table to stdout and one JSON line to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import sys
+import time
+
+REPO = __file__.rsplit("/", 2)[0]
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from tfidf_tpu.config import PipelineConfig, VocabMode  # noqa: E402
+from tfidf_tpu.ingest import (_chunk_step, _finish_wire,  # noqa: E402
+                              _bucket_pad_flat)
+from tfidf_tpu.ops.sparse import (sorted_term_counts, sparse_df,  # noqa: E402
+                                  sparse_forward)
+
+VOCAB = 1 << 16
+TOPK = 16
+HBM_PEAK_GBS = 819.0  # v5e: 819 GB/s HBM2 per chip (public spec)
+
+
+def fence(x):
+    """Force execution and completion via a real (tiny) fetch."""
+    return jax.device_get(x)
+
+
+def timeit(fn, repeats: int) -> float:
+    fence(fn())  # warm (compile + any lazy input transfer)
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fence(fn())
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _checksum3(a, b, c):
+    return (a.astype(jnp.int64).sum() + b.astype(jnp.int64).sum()
+            + c.astype(jnp.int64).sum())
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--docs", type=int, default=32768)
+    ap.add_argument("--len", type=int, dest="length", default=256)
+    ap.add_argument("--repeats", type=int, default=5)
+    args = ap.parse_args()
+    d, length = args.docs, args.length
+    rep = args.repeats
+
+    backend = jax.default_backend()
+    print(f"backend={backend} device={jax.devices()[0].device_kind} "
+          f"docs={d} len={length} best-of-{rep}", file=sys.stderr)
+
+    rng = np.random.default_rng(0)
+    ids_np = (np.clip(rng.zipf(1.3, (d, length)), 1, 8192) - 1) % VOCAB
+    lens_np = rng.integers(length // 2, length + 1, d).astype(np.int32)
+    mask = np.arange(length)[None, :] < lens_np[:, None]
+    ids_np = np.where(mask, ids_np, 0).astype(np.int32)
+    tokens = float(lens_np.sum())
+
+    cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=VOCAB,
+                         max_doc_len=length, doc_chunk=length, topk=TOPK,
+                         engine="sparse")
+    score_dtype = jax.dtypes.canonicalize_dtype(jnp.dtype(cfg.score_dtype))
+
+    res: dict = {"docs": d, "len": length, "tokens": int(tokens),
+                 "backend": backend}
+
+    # -- floor: trivial program round trip --------------------------------
+    tiny = jnp.zeros((8,), jnp.int32)
+    add1 = jax.jit(lambda x: x + 1)
+    res["floor_s"] = timeit(lambda: add1(tiny), rep)
+
+    # -- h2d: first consumption of freshly staged uploads ------------------
+    # The ragged wire the production path ships: uint16 flat ids.
+    flat_np = np.zeros(0, np.uint16)
+    flat_np = ids_np[mask].astype(np.uint16)
+    flat_np = _bucket_pad_flat(np.ascontiguousarray(flat_np),
+                               flat_np.size)
+    consume = jax.jit(lambda t, l: (t.astype(jnp.int32).sum()
+                                    + l.sum().astype(jnp.int32)))
+    fence(consume(jnp.asarray(flat_np[:8]), jnp.asarray(lens_np[:8])))
+    best = float("inf")
+    for _ in range(rep):
+        t0 = time.perf_counter()
+        t_dev = jax.device_put(flat_np)
+        l_dev = jax.device_put(lens_np)
+        fence(consume(t_dev, l_dev))
+        best = min(best, time.perf_counter() - t0)
+    res["h2d_first_consume_s"] = best
+    res["wire_mb"] = flat_np.nbytes / 1e6
+
+    # Pre-materialized device inputs for all compute stages.
+    tok_dev = jax.device_put(ids_np)
+    len_dev = jax.device_put(lens_np)
+    fence(consume(tok_dev, len_dev))
+
+    # -- stage: sort -------------------------------------------------------
+    sort_fn = jax.jit(lambda t, l: _checksum3(*sorted_term_counts(t, l)))
+    res["sort_s"] = timeit(lambda: sort_fn(tok_dev, len_dev), rep)
+
+    # -- stage: sort + df --------------------------------------------------
+    @jax.jit
+    def sortdf(t, l):
+        i, c, h = sorted_term_counts(t, l)
+        return sparse_df(i, h, VOCAB).astype(jnp.int64).sum()
+    res["sort_df_s"] = timeit(lambda: sortdf(tok_dev, len_dev), rep)
+
+    # -- stage: full forward (sort+df+idf+score+topk) ----------------------
+    @functools.partial(jax.jit, static_argnames=())
+    def fwd(t, l):
+        df, vals, out_ids = sparse_forward(
+            t, l, jnp.int32(d), vocab_size=VOCAB,
+            score_dtype=score_dtype, topk=TOPK)
+        return (df.astype(jnp.int64).sum()
+                + out_ids.astype(jnp.int64).sum()
+                + vals.sum().astype(jnp.int64))
+    res["forward_s"] = timeit(lambda: fwd(tok_dev, len_dev), rep)
+
+    # -- production dispatch structure at several chunk counts -------------
+    k = min(TOPK, length)
+    for n_chunks in (1, 2, 4, 8):
+        if d % n_chunks:
+            continue
+        cd = d // n_chunks
+        parts = []
+        for s in range(0, d, cd):
+            sub_mask = mask[s:s + cd]
+            flat = ids_np[s:s + cd][sub_mask].astype(np.uint16)
+            flat = _bucket_pad_flat(np.ascontiguousarray(flat), flat.size)
+            parts.append((jax.device_put(flat),
+                          jax.device_put(lens_np[s:s + cd])))
+        for t_, l_ in parts:
+            fence(consume(t_, l_))
+
+        def prod():
+            df_acc = jnp.zeros((VOCAB,), jnp.int32)
+            ti, tc, th, lp = [], [], [], []
+            for t_, l_ in parts:
+                i_, c_, h_, df_acc = _chunk_step(t_, l_, df_acc, cfg,
+                                                 length, ragged=True)
+                ti.append(i_)
+                tc.append(c_)
+                th.append(h_)
+                lp.append(l_)
+            _, wire = _finish_wire((ti, tc, th), lp, df_acc, d, k,
+                                   score_dtype, cfg, wire_vals=True)
+            # checksum fence: compute cost without the wire's fetch
+            return jnp.asarray(wire).astype(jnp.int32).sum()
+
+        res[f"prod_c{n_chunks}_s"] = timeit(prod, rep)
+        if n_chunks == 4:
+            # the wire fetch alone, on top of warm compute
+            def prod_wire():
+                df_acc = jnp.zeros((VOCAB,), jnp.int32)
+                ti, tc, th, lp = [], [], [], []
+                for t_, l_ in parts:
+                    i_, c_, h_, df_acc = _chunk_step(t_, l_, df_acc, cfg,
+                                                     length, ragged=True)
+                    ti.append(i_)
+                    tc.append(c_)
+                    th.append(h_)
+                    lp.append(l_)
+                _, wire = _finish_wire((ti, tc, th), lp, df_acc, d, k,
+                                       score_dtype, cfg, wire_vals=True)
+                return wire
+            fence(prod_wire())
+            best = float("inf")
+            for _ in range(rep):
+                t0 = time.perf_counter()
+                fence(prod_wire())
+                best = min(best, time.perf_counter() - t0)
+            res["prod_c4_with_fetch_s"] = best
+
+    # -- pipelined marginal device time -----------------------------------
+    # Dispatch the full forward N times back-to-back and fence ONCE: the
+    # tunnel's dispatch latency overlaps device compute, so the marginal
+    # per-iteration time is the chip's true steady-state cost — what a
+    # co-located host (or a pipelined production loop) would pay per
+    # batch. This is the honest denominator for device_docs_per_sec:
+    # the one-shot fenced number above charges the chip for ~100 ms of
+    # link round trip it does not spend.
+    # Device-side program execution is in-order, so fencing the LAST
+    # chain output proves all n_pipe programs completed.
+    n_pipe = 8
+
+    def fwd_chain():
+        out = None
+        for _ in range(n_pipe):
+            out = fwd(tok_dev, len_dev)
+        return out
+
+    fence(fwd_chain())
+    best = float("inf")
+    for _ in range(rep):
+        t0 = time.perf_counter()
+        fence(fwd_chain())
+        best = min(best, time.perf_counter() - t0)
+    res["forward_x8_s"] = best
+    res["forward_marginal_s"] = max(
+        (best - res["forward_s"]) / (n_pipe - 1), 1e-9)
+
+    # -- analytic bytes model ---------------------------------------------
+    n = d * length
+    lg = int(np.ceil(np.log2(length)))
+    lgn = int(np.ceil(np.log2(n)))
+    bytes_row_sort = n * 4 * 2 * (lg * (lg + 1) // 2)
+    bytes_rle = n * 4 * 6          # prev/head/cummin/counts passes
+    bytes_df_sort = n * 4 * 2 * (lgn * (lgn + 1) // 2)
+    bytes_score_topk = n * 4 * 4 + d * TOPK * 8
+    model = {
+        "row_sort_gb": bytes_row_sort / 1e9,
+        "rle_gb": bytes_rle / 1e9,
+        "df_global_sort_gb": bytes_df_sort / 1e9,
+        "score_topk_gb": bytes_score_topk / 1e9,
+    }
+    total_gb = sum(model.values())
+    model["total_gb"] = total_gb
+    model["hbm_bound_s"] = total_gb / HBM_PEAK_GBS
+    res["bytes_model"] = {k2: round(v, 4) for k2, v in model.items()}
+
+    # -- report ------------------------------------------------------------
+    def row(name, s, note=""):
+        mtoks = tokens / s / 1e6 if s else float("inf")
+        print(f"| {name} | {s * 1e3:8.1f} ms | {mtoks:8.1f} | {note} |")
+
+    print(f"\nStage | time | Mtok/s | note")
+    print("|---|---|---|---|")
+    row("floor", res["floor_s"])
+    row("h2d first consume", res["h2d_first_consume_s"],
+        f"{res['wire_mb']:.1f} MB wire")
+    row("sort", res["sort_s"])
+    row("sort+df", res["sort_df_s"])
+    row("forward", res["forward_s"])
+    if "forward_marginal_s" in res:
+        row("forward marginal (x8 pipelined)", res["forward_marginal_s"],
+            "true per-batch device cost")
+    for c in (1, 2, 4, 8):
+        key = f"prod_c{c}_s"
+        if key in res:
+            row(f"prod x{c} chunks", res[key])
+    if "prod_c4_with_fetch_s" in res:
+        row("prod x4 + wire fetch", res["prod_c4_with_fetch_s"])
+    print(f"\nbytes model: {json.dumps(res['bytes_model'])}")
+    print(f"HBM-bound floor at {HBM_PEAK_GBS:.0f} GB/s: "
+          f"{res['bytes_model']['hbm_bound_s'] * 1e3:.1f} ms "
+          f"({tokens / res['bytes_model']['hbm_bound_s'] / 1e6:.0f} Mtok/s)")
+    print(json.dumps({k2: (round(v, 5) if isinstance(v, float) else v)
+                      for k2, v in res.items()}), file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,141 @@
+"""Capture + analyze a real-chip jax.profiler trace (VERDICT r4 #2).
+
+Runs the resident device program (sparse_forward and the production
+chunked structure) at the bench shape under ``jax.profiler.trace``,
+then parses the emitted ``*.trace.json.gz`` and aggregates device-lane
+op durations — which XLA ops actually dominate the compute the bench
+charges to the chip (sort vs DF vs score vs top-k vs gather/pack).
+
+Usage: python tools/trace_capture.py [--docs 32768] [--len 256]
+       [--out /tmp/tfidf_trace]
+Prints a per-op table to stdout; the raw trace dir is left for
+inspection (point TensorBoard or Perfetto at it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+
+REPO = __file__.rsplit("/", 2)[0]
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from tfidf_tpu.config import PipelineConfig, VocabMode  # noqa: E402
+from tfidf_tpu.ingest import (_chunk_step, _finish_wire,  # noqa: E402
+                              _bucket_pad_flat)
+from tfidf_tpu.ops.sparse import sparse_forward  # noqa: E402
+
+VOCAB = 1 << 16
+TOPK = 16
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--docs", type=int, default=32768)
+    ap.add_argument("--len", type=int, dest="length", default=256)
+    ap.add_argument("--out", default="/tmp/tfidf_trace")
+    ap.add_argument("--iters", type=int, default=3)
+    args = ap.parse_args()
+    d, length = args.docs, args.length
+
+    print(f"backend={jax.default_backend()}", file=sys.stderr)
+    rng = np.random.default_rng(0)
+    ids_np = (np.clip(rng.zipf(1.3, (d, length)), 1, 8192) - 1) % VOCAB
+    lens_np = rng.integers(length // 2, length + 1, d).astype(np.int32)
+    mask = np.arange(length)[None, :] < lens_np[:, None]
+    ids_np = np.where(mask, ids_np, 0).astype(np.int32)
+
+    cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=VOCAB,
+                         max_doc_len=length, doc_chunk=length, topk=TOPK,
+                         engine="sparse")
+    score_dtype = jax.dtypes.canonicalize_dtype(jnp.dtype(cfg.score_dtype))
+
+    tok_dev = jax.device_put(ids_np)
+    len_dev = jax.device_put(lens_np)
+    flat = ids_np[mask].astype(np.uint16)
+    flat_dev = jax.device_put(
+        _bucket_pad_flat(np.ascontiguousarray(flat), flat.size))
+
+    @jax.jit
+    def fwd(t, l):
+        df, vals, out_ids = sparse_forward(
+            t, l, jnp.int32(d), vocab_size=VOCAB,
+            score_dtype=score_dtype, topk=TOPK)
+        return (df.sum() + out_ids.sum() + vals.sum().astype(jnp.int32))
+
+    k = min(TOPK, length)
+
+    def prod():
+        df_acc = jnp.zeros((VOCAB,), jnp.int32)
+        i_, c_, h_, df_acc = _chunk_step(flat_dev, len_dev, df_acc, cfg,
+                                         length, ragged=True)
+        _, wire = _finish_wire(([i_], [c_], [h_]), [len_dev], df_acc, d,
+                               k, score_dtype, cfg, wire_vals=True)
+        return jnp.asarray(wire).astype(jnp.int32).sum()
+
+    # Warm everything (compiles + lazy input transfers) OUTSIDE the trace.
+    jax.device_get(fwd(tok_dev, len_dev))
+    jax.device_get(prod())
+
+    os.makedirs(args.out, exist_ok=True)
+    with jax.profiler.trace(args.out):
+        for _ in range(args.iters):
+            jax.device_get(fwd(tok_dev, len_dev))
+        for _ in range(args.iters):
+            jax.device_get(prod())
+
+    traces = sorted(glob.glob(os.path.join(
+        args.out, "**", "*.trace.json.gz"), recursive=True))
+    if not traces:
+        everything = glob.glob(os.path.join(args.out, "**", "*"),
+                               recursive=True)
+        print("no trace.json.gz found; artifacts:", file=sys.stderr)
+        for p in everything:
+            print("  " + p, file=sys.stderr)
+        sys.exit(1)
+    path = traces[-1]
+    with gzip.open(path, "rt") as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+
+    # Device lanes: pid/tid whose process name mentions the accelerator.
+    proc_names = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            proc_names[e["pid"]] = e["args"].get("name", "")
+    dev_pids = {p for p, n in proc_names.items()
+                if "TPU" in n or "/device" in n.lower() or "Device" in n}
+    agg: dict = collections.defaultdict(float)
+    cnt: dict = collections.defaultdict(int)
+    total = 0.0
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in dev_pids:
+            continue
+        name = e.get("name", "?")
+        dur = float(e.get("dur", 0.0))  # microseconds
+        agg[name] += dur
+        cnt[name] += 1
+        total += dur
+    print(f"trace: {path}")
+    print(f"device pids: "
+          f"{ {p: proc_names[p] for p in dev_pids} }", file=sys.stderr)
+    print(f"\n| op | total ms | calls | % of device time |")
+    print("|---|---|---|---|")
+    for name, us in sorted(agg.items(), key=lambda kv: -kv[1])[:25]:
+        print(f"| {name[:60]} | {us / 1e3:9.2f} | {cnt[name]:5d} | "
+              f"{100 * us / max(total, 1e-9):5.1f}% |")
+    print(f"\ntotal device-lane time: {total / 1e3:.1f} ms over "
+          f"{2 * args.iters} timed calls")
+
+
+if __name__ == "__main__":
+    main()
