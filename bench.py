@@ -312,6 +312,11 @@ def main() -> None:
         if ser.get("compute"):
             dev_dps = N_DOCS / ser["compute"]
             record["device_docs_per_sec"] = round(dev_dps, 1)
+            if ser.get("compute_marginal"):
+                # Steady-state per-batch device rate (pipelined chain,
+                # tunnel round trip amortized — ingest.profile_resident).
+                record["device_docs_per_sec_marginal"] = round(
+                    N_DOCS / ser["compute_marginal"], 1)
             record["link_tax_s"] = round(ser.get("upload", 0.0)
                                          + ser.get("fetch", 0.0), 3)
             record["north_star_projection"] = {

@@ -175,10 +175,16 @@ void loader_fill_u16(void* handle, uint64_t seed, int64_t vocab_size,
 // offset depends on every prior doc's count, and the deployment host
 // has a single core anyway (a count prepass + parallel fill would cost
 // the very scan loader_open2(want_counts=0) exists to skip).
+// ``align``: each doc starts at a multiple of this many ids (zero
+// fill between docs). An aligned layout lets the device rebuild the
+// padded batch by gathering [L/align]-granule rows instead of per-id
+// scalars — the per-element gather measured 67.5 ms/chunk at the
+// bench shape (tools/trace_capture.py, round 5) for ~4% more wire
+// bytes at align=16. align <= 1 is the legacy back-to-back layout.
 int64_t loader_fill_flat_u16(void* handle, uint64_t seed,
                              int64_t vocab_size, int64_t truncate_at,
                              int64_t max_per_doc, uint16_t* out,
-                             int32_t* out_lengths) {
+                             int32_t* out_lengths, int64_t align) {
   Loader* L = static_cast<Loader*>(handle);
   int64_t pos = 0;
   for (size_t d = 0; d < L->docs.size(); ++d) {
@@ -188,6 +194,11 @@ int64_t loader_fill_flat_u16(void* handle, uint64_t seed,
         out + pos, max_per_doc);
     out_lengths[d] = (int32_t)n;
     pos += n;
+    if (align > 1) {
+      int64_t pad = (align - pos % align) % align;
+      std::memset(out + pos, 0, (size_t)pad * sizeof(uint16_t));
+      pos += pad;
+    }
   }
   return pos;
 }

@@ -151,3 +151,91 @@ class TestTwoProcess:
         # last line each worker prints.
         assert sorted(o.strip().splitlines()[-1]
                       for o, _ in outs) == ["OK 0", "OK 1"]
+
+
+_INGEST_WORKER = r"""
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+from tfidf_tpu.parallel.multihost import initialize
+topo = initialize(coordinator_address=sys.argv[1],
+                  num_processes=2, process_id=int(sys.argv[2]))
+input_dir, expect_npz = sys.argv[3], sys.argv[4]
+
+# The FLAGSHIP ingest across real processes (VERDICT r4 item 4): the
+# docs-sharded resident run_overlapped over a process-spanning mesh.
+# Each process packs only its own shards' documents (per-process chunk
+# ingest); the run's single DF psum and the result allgather cross the
+# gloo transport. The expected arrays were produced by the SAME mesh
+# shape on two single-process devices, so every float op is identical
+# and the comparison is exact.
+import numpy as np
+from tfidf_tpu.config import PipelineConfig, VocabMode
+from tfidf_tpu.ingest import run_overlapped
+from tfidf_tpu.parallel.mesh import MeshPlan
+
+plan = MeshPlan.create(docs=2, devices=jax.devices())
+assert jax.process_count() == 2
+cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=2048,
+                     topk=4, engine="sparse")
+r = run_overlapped(input_dir, cfg, chunk_docs=16, doc_len=32, plan=plan)
+exp = np.load(expect_npz)
+np.testing.assert_array_equal(r.topk_ids, exp["ids"])
+np.testing.assert_array_equal(np.asarray(r.df), exp["df"])
+np.testing.assert_array_equal(r.topk_vals, exp["vals"])
+np.testing.assert_array_equal(r.lengths, exp["lengths"])
+assert r.path == "resident-mesh", r.path
+print("OK", topo.process_id)
+"""
+
+
+class TestTwoProcessIngest:
+    def test_flagship_mesh_ingest_across_processes(self, tmp_path):
+        """run_overlapped's mesh regime over 2 jax.distributed
+        processes == the same mesh on one process, bit for bit."""
+        import socket
+
+        import numpy as np
+
+        from tfidf_tpu.config import PipelineConfig, VocabMode
+        from tfidf_tpu.ingest import run_overlapped
+        from tfidf_tpu.parallel.mesh import MeshPlan
+        import jax
+
+        d = tmp_path / "input"
+        d.mkdir()
+        rng = np.random.default_rng(9)
+        for i in range(1, 25):
+            (d / f"doc{i}").write_text(
+                " ".join(f"w{rng.integers(0, 200)}"
+                         for _ in range(rng.integers(1, 30))))
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=2048,
+                             topk=4, engine="sparse")
+        plan1 = MeshPlan.create(docs=2, devices=jax.devices("cpu")[:2])
+        ref = run_overlapped(str(d), cfg, chunk_docs=16, doc_len=32,
+                             plan=plan1)
+        expect = tmp_path / "expect.npz"
+        np.savez(expect, ids=ref.topk_ids, vals=ref.topk_vals,
+                 df=np.asarray(ref.df), lengths=ref.lengths)
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("XLA_FLAGS", None)  # 1 CPU device per process
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            addr = f"localhost:{s.getsockname()[1]}"
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", _INGEST_WORKER, addr, str(pid),
+             str(d), str(expect)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env) for pid in range(2)]
+        try:
+            outs = [p.communicate(timeout=180) for p in procs]
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        for p, (out, err) in zip(procs, outs):
+            assert p.returncode == 0, f"rc={p.returncode}\n{out}\n{err}"
+        assert sorted(o.strip().splitlines()[-1]
+                      for o, _ in outs) == ["OK 0", "OK 1"]

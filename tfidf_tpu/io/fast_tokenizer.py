@@ -94,7 +94,7 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int64,
             ctypes.c_int64, ctypes.c_int64,
             ctypes.POINTER(ctypes.c_uint16),
-            ctypes.POINTER(ctypes.c_int32)]
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64]
         _has_flat = True
     except AttributeError:  # stale .so predating the flat packer
         pass
@@ -106,13 +106,13 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
             ctypes.c_int64, ctypes.c_int64,
             ctypes.POINTER(ctypes.c_uint16),
-            ctypes.POINTER(ctypes.c_int32)]
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64]
         lib.intern_fill_flat_i32.restype = ctypes.c_int64
         lib.intern_fill_flat_i32.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
             ctypes.c_int64, ctypes.c_int64,
             ctypes.POINTER(ctypes.c_int32),
-            ctypes.POINTER(ctypes.c_int32)]
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64]
         lib.intern_count.restype = ctypes.c_int64
         lib.intern_count.argtypes = [ctypes.c_void_p]
         lib.intern_overflow.restype = ctypes.c_int
@@ -281,13 +281,15 @@ def flat_available() -> bool:
 def _flat_pack_scaffold(lib, paths: List[str], max_per_doc: int,
                         pad_docs_to: Optional[int],
                         n_threads: Optional[int], fill,
-                        dtype=np.uint16):
+                        dtype=np.uint16, align: int = 1):
     """Shared loader scaffolding of the flat packers (hashed and
     exact-id): path blob, parallel read (no count prepass), error
     mapping, buffer sizing, close. ``fill(handle, flat, lengths)``
     receives the numpy buffers, runs the per-token id pass, and
     returns total ids (or a negative sentinel the caller interprets).
-    ``dtype`` is the wire id width (uint16, or int32 for wide caps)."""
+    ``dtype`` is the wire id width (uint16, or int32 for wide caps);
+    ``align`` is the granule-aligned wire layout (ingest._WIRE_ALIGN):
+    each doc starts at a multiple of ``align`` ids."""
     n_threads = n_threads or min(os.cpu_count() or 1, 16)
     blob = b"\0".join(p.encode() for p in paths) + b"\0"
     handle = lib.loader_open2(blob, len(paths), n_threads, 0)
@@ -296,7 +298,9 @@ def _flat_pack_scaffold(lib, paths: List[str], max_per_doc: int,
         if err >= 0:
             raise FileNotFoundError(paths[err])
         d_padded = max(pad_docs_to or len(paths), len(paths))
-        flat = np.empty((len(paths) * max_per_doc,), dtype=dtype)
+        per_doc_cap = max_per_doc if align <= 1 \
+            else -(-max_per_doc // align) * align
+        flat = np.empty((len(paths) * per_doc_cap,), dtype=dtype)
         lengths = np.zeros((d_padded,), dtype=np.int32)
         total = fill(handle, flat, lengths)
         return flat, lengths, int(total)
@@ -308,7 +312,7 @@ def load_pack_flat(paths: List[str], vocab_size: int, seed: int = 0,
                    truncate_at: Optional[int] = None,
                    max_per_doc: int = 256,
                    pad_docs_to: Optional[int] = None,
-                   n_threads: Optional[int] = None):
+                   n_threads: Optional[int] = None, align: int = 1):
     """Native ragged pack: read + tokenize + hash into a FLAT uint16
     stream (every doc back to back, no padding) plus per-doc lengths.
 
@@ -330,7 +334,9 @@ def load_pack_flat(paths: List[str], vocab_size: int, seed: int = 0,
             handle, ctypes.c_uint64(seed), vocab_size, truncate_at or 0,
             max_per_doc,
             flat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
-            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))))
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.c_int64(align)),
+        align=align)
 
 
 def rerank_available() -> bool:
@@ -453,7 +459,8 @@ class InternSession:
 
     def pack_flat(self, paths: List[str], truncate_at: Optional[int],
                   max_per_doc: int, pad_docs_to: Optional[int] = None,
-                  seed: int = 0, n_threads: Optional[int] = None):
+                  seed: int = 0, n_threads: Optional[int] = None,
+                  align: int = 1):
         """Exact-id twin of :func:`load_pack_flat` (same return
         contract, shared loader scaffold). The wire is uint16 up to a
         2^16 cap and int32 beyond (wide-vocab exact mode). Raises
@@ -470,11 +477,12 @@ class InternSession:
                 handle, self._h, ctypes.c_uint64(seed), truncate_at or 0,
                 max_per_doc,
                 flat.ctypes.data_as(ctypes.POINTER(id_ct)),
-                lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+                lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                ctypes.c_int64(align))
 
         flat, lengths, total = _flat_pack_scaffold(
             lib, paths, max_per_doc, pad_docs_to, n_threads, fill,
-            dtype=np.int32 if wide else np.uint16)
+            dtype=np.int32 if wide else np.uint16, align=align)
         if total < 0:
             raise ExactVocabOverflow(
                 f"corpus exceeds {self.count} distinct words")

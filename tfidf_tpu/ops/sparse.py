@@ -137,6 +137,89 @@ def sparse_scores(ids: jax.Array, counts: jax.Array, head: jax.Array,
     return jnp.where(head, score, jnp.zeros((), dtype))
 
 
+def join_method(explicit: Optional[str] = None) -> str:
+    """Resolve the DF->score join lowering: ``"sort"`` (sort-join, the
+    measured TPU default) or ``"gather"`` ([V]-table gather, the CPU
+    default and the mesh/streaming path where the DF vector is NOT
+    derivable from the local triples). Override via ``TFIDF_TPU_JOIN``.
+    Resolved at trace time — same doctrine as :func:`sparse_df`."""
+    if explicit is not None:
+        return explicit
+    method = os.environ.get("TFIDF_TPU_JOIN") or (
+        "sort" if jax.default_backend() == "tpu" else "gather")
+    if method not in ("sort", "gather"):
+        raise ValueError(f"unknown join method {method!r}")
+    return method
+
+
+def df_slot_sorted(ids: jax.Array, head: jax.Array
+                   ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-slot DF join from ONE global sort (no [V] table) — see
+    :func:`df_join_sorted`. Returns ``(df_slot [D, L], srt, slot)``
+    where ``srt`` is the sorted head-masked id stream (reusable for
+    the :func:`sparse_df` searchsorted lowering)."""
+    d, length = ids.shape
+    n = d * length
+    sentinel = jnp.iinfo(jnp.int32).max
+    hm = jnp.where(head, ids, sentinel).reshape(-1)
+    slot = jnp.arange(n, dtype=jnp.int32)
+    srt, orig = lax.sort((hm, slot), num_keys=1, is_stable=True)
+    # Per-element run length: start position via cummax, next start via
+    # the exclusive suffix-min (elements between starts hold n).
+    start = srt != jnp.concatenate(
+        [jnp.full((1,), -1, srt.dtype), srt[:-1]])
+    spos = lax.cummax(jnp.where(start, slot, -1))
+    nstart = jnp.where(start, slot, n)
+    smin = lax.cummin(nstart[::-1])[::-1]
+    next_start = jnp.concatenate([smin[1:], jnp.full((1,), n, jnp.int32)])
+    df_elem = next_start - spos
+    _, df_slot = lax.sort((orig, df_elem), num_keys=1, is_stable=False)
+    return df_slot.reshape(d, length), srt, slot
+
+
+def df_join_sorted(ids: jax.Array, head: jax.Array, vocab_size: int,
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """DF vector AND per-slot DF join from ONE global sort — the
+    TPU-shaped replacement for ``idf[ids]`` (round-5 trace: the [V]-
+    table gather over [D*L] slots ran at ~1.7 GB/s, 59.8 ms at the
+    bench shape, the single largest device cost; an equal-width sort
+    measured 12.5 ms).
+
+    Method: stable-sort the head-masked ids WITH their slot index. In
+    sorted order every id's occurrences are one run, so each element's
+    run length IS its document frequency (heads are per-doc-distinct —
+    the currDoc dedup). Run lengths come from the same cummin trick as
+    :func:`sorted_term_counts`; a second sort by slot index inverts the
+    permutation, landing each element's DF back on its slot. The [V]
+    DF vector falls out of the same sorted array via ``searchsorted``
+    bin edges (the :func:`sparse_df` "sort" lowering — identical
+    counts).
+
+    Returns ``(df [V], df_slot [D, L])``; ``df_slot`` is garbage at
+    non-head slots (the sentinel run's length) — consumers mask by
+    ``head``, exactly like the counts contract.
+    """
+    df_slot, srt, _ = df_slot_sorted(ids, head)
+    edges = jnp.arange(vocab_size + 1, dtype=jnp.int32)
+    pos = jnp.searchsorted(srt, edges)
+    return (pos[1:] - pos[:-1]).astype(jnp.int32), df_slot
+
+
+def sparse_scores_joined(counts: jax.Array, head: jax.Array,
+                         lengths: jax.Array, df_slot: jax.Array,
+                         num_docs, dtype) -> jax.Array:
+    """:func:`sparse_scores` on a pre-joined per-slot DF (sort-join
+    path). Identical values: same integer DF, the same ``idf_from_df``
+    formula applied elementwise to the [D, L] join instead of the [V]
+    table."""
+    from tfidf_tpu.ops.scoring import idf_from_df
+
+    idf_slot = idf_from_df(jnp.where(head, df_slot, 0), num_docs, dtype)
+    lens = jnp.maximum(lengths, 1).astype(dtype)[:, None]
+    score = counts.astype(dtype) / lens * idf_slot
+    return jnp.where(head, score, jnp.zeros((), dtype))
+
+
 def sparse_topk(scores: jax.Array, ids: jax.Array, head: jax.Array, k: int
                 ) -> Tuple[jax.Array, jax.Array]:
     """Per-doc top-k over the row-sparse axis (L candidates, not V)."""
@@ -181,7 +264,8 @@ def to_bcoo(ids: jax.Array, counts: jax.Array, head: jax.Array,
 
 
 def sparse_forward(token_ids, lengths, num_docs, *, vocab_size: int,
-                   score_dtype, topk: Optional[int], df_reduce=None):
+                   score_dtype, topk: Optional[int], df_reduce=None,
+                   join: Optional[str] = None):
     """Full sparse pipeline step: tokens -> (df, topk | row-sparse scores).
 
     Mirrors ``pipeline._forward`` but never builds [D, V]. Returns
@@ -192,15 +276,27 @@ def sparse_forward(token_ids, lengths, num_docs, *, vocab_size: int,
     axis inside a shard_map body (``parallel.collectives``). Keeping it a
     parameter means the single-device and sharded engines share this one
     definition and cannot drift.
+
+    ``join`` (static): the DF->score join lowering — ``"sort"``
+    (sort-join, measured TPU default) or ``"gather"``; ``None``
+    resolves via :func:`join_method`. The sort-join derives each
+    slot's DF from the batch's own triples, so it only applies when
+    the scoring DF IS the local batch's DF — i.e. ``df_reduce is
+    None``; a reduced (mesh-global) DF always takes the gather path.
     """
     from tfidf_tpu.ops.scoring import idf_from_df  # cycle-free late import
 
     ids, counts, head = sorted_term_counts(token_ids, lengths)
-    df = sparse_df(ids, head, vocab_size)
-    if df_reduce is not None:
-        df = df_reduce(df)
-    idf = idf_from_df(df, num_docs, score_dtype)
-    scores = sparse_scores(ids, counts, head, lengths, idf)
+    if df_reduce is None and join_method(join) == "sort":
+        df, df_slot = df_join_sorted(ids, head, vocab_size)
+        scores = sparse_scores_joined(counts, head, lengths, df_slot,
+                                      num_docs, score_dtype)
+    else:
+        df = sparse_df(ids, head, vocab_size)
+        if df_reduce is not None:
+            df = df_reduce(df)
+        idf = idf_from_df(df, num_docs, score_dtype)
+        scores = sparse_scores(ids, counts, head, lengths, idf)
     if topk is not None:
         vals, out_ids = sparse_topk(scores, ids, head, topk)
         return df, vals, out_ids

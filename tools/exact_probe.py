@@ -1,0 +1,78 @@
+"""Phase probe of the exact-terms mode (VERDICT r4 item 5 groundwork).
+
+Times the device-exact engine's serial tail — wire fetch, native
+exact_emit (rescore + format + global sort), boundary-tie re-reads —
+separately from the ingest, on a bench-shaped corpus. What to overlap
+or parallelize is decided from THIS split, not guessed.
+
+Usage: python tools/exact_probe.py [--docs 8192] [--len 256]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+REPO = __file__.rsplit("/", 2)[0]
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--docs", type=int, default=8192)
+    ap.add_argument("--len", type=int, dest="length", default=256)
+    ap.add_argument("--repeats", type=int, default=3)
+    args = ap.parse_args()
+
+    sys.path.insert(0, REPO)
+    import bench as benchmod
+    benchmod.N_DOCS = args.docs
+    benchmod.DOC_LEN = args.length
+
+    tmp = tempfile.mkdtemp(prefix="exact_probe_")
+    try:
+        input_dir = benchmod.make_corpus(tmp)
+        from tfidf_tpu.config import PipelineConfig, VocabMode
+        from tfidf_tpu.io import fast_tokenizer as ft
+        from tfidf_tpu.ingest import run_overlapped_exact
+        from tfidf_tpu.rerank import _device_cfg
+
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED,
+                             vocab_size=benchmod.VOCAB,
+                             max_doc_len=args.length,
+                             doc_chunk=args.length,
+                             topk=benchmod.MARGIN, engine="sparse")
+        k = benchmod.TOPK
+        chunk = max(2048, args.docs // 4)
+
+        for it in range(args.repeats):
+            with ft.InternSession(cfg.vocab_size) as sess:
+                t0 = time.perf_counter()
+                exact = run_overlapped_exact(input_dir, _device_cfg(cfg, k),
+                                             chunk_docs=chunk,
+                                             doc_len=args.length,
+                                             strict=True, session=sess)
+                t_ingest = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                lines, per_doc, offs, lens_, scores, wblob = sess.emit(
+                    input_dir, exact.names, exact.topk_ids,
+                    exact.topk_counts, exact.df, exact.lengths,
+                    exact.num_docs, k, cfg.truncate_tokens_at,
+                    args.length, seed=cfg.hash_seed)
+                t_emit = time.perf_counter() - t0
+            ing_ph = dict(exact.phases or {})
+            print(f"run {it}: ingest {t_ingest:.3f}s "
+                  f"(phases {ing_ph}) emit {t_emit:.3f}s "
+                  f"lines {len(lines)} bytes", flush=True)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

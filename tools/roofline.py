@@ -42,7 +42,7 @@ import numpy as np  # noqa: E402
 
 from tfidf_tpu.config import PipelineConfig, VocabMode  # noqa: E402
 from tfidf_tpu.ingest import (_chunk_step, _finish_wire,  # noqa: E402
-                              _bucket_pad_flat)
+                              flatten_aligned)
 from tfidf_tpu.ops.sparse import (sorted_term_counts, sparse_df,  # noqa: E402
                                   sparse_forward)
 
@@ -77,7 +77,15 @@ def main() -> None:
     ap.add_argument("--docs", type=int, default=32768)
     ap.add_argument("--len", type=int, dest="length", default=256)
     ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--chunks", default="1,2,4,8",
+                    help="chunk counts for the prod stage")
+    ap.add_argument("--stages", default="all",
+                    help="comma list of: floor,h2d,sort,df,fwd,pipe,"
+                         "prod (or 'all'); each compile is ~20-40 s on "
+                         "the tunnel, so pick what you need")
     args = ap.parse_args()
+    stages = (set("floor,h2d,sort,df,fwd,pipe,prod".split(","))
+              if args.stages == "all" else set(args.stages.split(",")))
     d, length = args.docs, args.length
     rep = args.repeats
 
@@ -101,28 +109,28 @@ def main() -> None:
                  "backend": backend}
 
     # -- floor: trivial program round trip --------------------------------
-    tiny = jnp.zeros((8,), jnp.int32)
-    add1 = jax.jit(lambda x: x + 1)
-    res["floor_s"] = timeit(lambda: add1(tiny), rep)
+    if "floor" in stages:
+        tiny = jnp.zeros((8,), jnp.int32)
+        add1 = jax.jit(lambda x: x + 1)
+        res["floor_s"] = timeit(lambda: add1(tiny), rep)
 
     # -- h2d: first consumption of freshly staged uploads ------------------
-    # The ragged wire the production path ships: uint16 flat ids.
-    flat_np = np.zeros(0, np.uint16)
-    flat_np = ids_np[mask].astype(np.uint16)
-    flat_np = _bucket_pad_flat(np.ascontiguousarray(flat_np),
-                               flat_np.size)
+    # The ragged wire the production path ships: uint16 flat ids in the
+    # packers' (granule-aligned) layout.
+    flat_np = flatten_aligned(ids_np, lens_np)
     consume = jax.jit(lambda t, l: (t.astype(jnp.int32).sum()
                                     + l.sum().astype(jnp.int32)))
-    fence(consume(jnp.asarray(flat_np[:8]), jnp.asarray(lens_np[:8])))
-    best = float("inf")
-    for _ in range(rep):
-        t0 = time.perf_counter()
-        t_dev = jax.device_put(flat_np)
-        l_dev = jax.device_put(lens_np)
-        fence(consume(t_dev, l_dev))
-        best = min(best, time.perf_counter() - t0)
-    res["h2d_first_consume_s"] = best
-    res["wire_mb"] = flat_np.nbytes / 1e6
+    if "h2d" in stages:
+        fence(consume(jnp.asarray(flat_np[:8]), jnp.asarray(lens_np[:8])))
+        best = float("inf")
+        for _ in range(rep):
+            t0 = time.perf_counter()
+            t_dev = jax.device_put(flat_np)
+            l_dev = jax.device_put(lens_np)
+            fence(consume(t_dev, l_dev))
+            best = min(best, time.perf_counter() - t0)
+        res["h2d_first_consume_s"] = best
+        res["wire_mb"] = flat_np.nbytes / 1e6
 
     # Pre-materialized device inputs for all compute stages.
     tok_dev = jax.device_put(ids_np)
@@ -130,15 +138,17 @@ def main() -> None:
     fence(consume(tok_dev, len_dev))
 
     # -- stage: sort -------------------------------------------------------
-    sort_fn = jax.jit(lambda t, l: _checksum3(*sorted_term_counts(t, l)))
-    res["sort_s"] = timeit(lambda: sort_fn(tok_dev, len_dev), rep)
+    if "sort" in stages:
+        sort_fn = jax.jit(lambda t, l: _checksum3(*sorted_term_counts(t, l)))
+        res["sort_s"] = timeit(lambda: sort_fn(tok_dev, len_dev), rep)
 
     # -- stage: sort + df --------------------------------------------------
-    @jax.jit
-    def sortdf(t, l):
-        i, c, h = sorted_term_counts(t, l)
-        return sparse_df(i, h, VOCAB).astype(jnp.int64).sum()
-    res["sort_df_s"] = timeit(lambda: sortdf(tok_dev, len_dev), rep)
+    if "df" in stages:
+        @jax.jit
+        def sortdf(t, l):
+            i, c, h = sorted_term_counts(t, l)
+            return sparse_df(i, h, VOCAB).astype(jnp.int64).sum()
+        res["sort_df_s"] = timeit(lambda: sortdf(tok_dev, len_dev), rep)
 
     # -- stage: full forward (sort+df+idf+score+topk) ----------------------
     @functools.partial(jax.jit, static_argnames=())
@@ -149,19 +159,19 @@ def main() -> None:
         return (df.astype(jnp.int64).sum()
                 + out_ids.astype(jnp.int64).sum()
                 + vals.sum().astype(jnp.int64))
-    res["forward_s"] = timeit(lambda: fwd(tok_dev, len_dev), rep)
+    if "fwd" in stages or "pipe" in stages:
+        res["forward_s"] = timeit(lambda: fwd(tok_dev, len_dev), rep)
 
     # -- production dispatch structure at several chunk counts -------------
     k = min(TOPK, length)
-    for n_chunks in (1, 2, 4, 8):
-        if d % n_chunks:
+    for n_chunks in (int(c) for c in args.chunks.split(",")):
+        if "prod" not in stages or d % n_chunks:
             continue
         cd = d // n_chunks
         parts = []
         for s in range(0, d, cd):
-            sub_mask = mask[s:s + cd]
-            flat = ids_np[s:s + cd][sub_mask].astype(np.uint16)
-            flat = _bucket_pad_flat(np.ascontiguousarray(flat), flat.size)
+            flat = flatten_aligned(ids_np[s:s + cd],
+                                   lens_np[s:s + cd])
             parts.append((jax.device_put(flat),
                           jax.device_put(lens_np[s:s + cd])))
         for t_, l_ in parts:
@@ -183,6 +193,26 @@ def main() -> None:
             return jnp.asarray(wire).astype(jnp.int32).sum()
 
         res[f"prod_c{n_chunks}_s"] = timeit(prod, rep)
+        if n_chunks == 1:
+            # Pipelined production marginal: the steady-state per-batch
+            # cost of the full resident program pair (chunk + finish),
+            # tunnel latency amortized (device executes in-order, so
+            # fencing the last chain output proves all completed).
+            def prod_chain():
+                out = None
+                for _ in range(8):
+                    out = prod()
+                return out
+
+            fence(prod_chain())
+            best = float("inf")
+            for _ in range(rep):
+                t0 = time.perf_counter()
+                fence(prod_chain())
+                best = min(best, time.perf_counter() - t0)
+            res["prod_c1_x8_s"] = best
+            res["prod_c1_marginal_s"] = max(
+                (best - res["prod_c1_s"]) / 7, 1e-9)
         if n_chunks == 4:
             # the wire fetch alone, on top of warm compute
             def prod_wire():
@@ -216,7 +246,7 @@ def main() -> None:
     # link round trip it does not spend.
     # Device-side program execution is in-order, so fencing the LAST
     # chain output proves all n_pipe programs completed.
-    n_pipe = 8
+    n_pipe = 8 if "pipe" in stages else 0
 
     def fwd_chain():
         out = None
@@ -224,15 +254,16 @@ def main() -> None:
             out = fwd(tok_dev, len_dev)
         return out
 
-    fence(fwd_chain())
-    best = float("inf")
-    for _ in range(rep):
-        t0 = time.perf_counter()
+    if n_pipe:
         fence(fwd_chain())
-        best = min(best, time.perf_counter() - t0)
-    res["forward_x8_s"] = best
-    res["forward_marginal_s"] = max(
-        (best - res["forward_s"]) / (n_pipe - 1), 1e-9)
+        best = float("inf")
+        for _ in range(rep):
+            t0 = time.perf_counter()
+            fence(fwd_chain())
+            best = min(best, time.perf_counter() - t0)
+        res["forward_x8_s"] = best
+        res["forward_marginal_s"] = max(
+            (best - res["forward_s"]) / (n_pipe - 1), 1e-9)
 
     # -- analytic bytes model ---------------------------------------------
     n = d * length
@@ -260,12 +291,17 @@ def main() -> None:
 
     print(f"\nStage | time | Mtok/s | note")
     print("|---|---|---|---|")
-    row("floor", res["floor_s"])
-    row("h2d first consume", res["h2d_first_consume_s"],
-        f"{res['wire_mb']:.1f} MB wire")
-    row("sort", res["sort_s"])
-    row("sort+df", res["sort_df_s"])
-    row("forward", res["forward_s"])
+    if "floor_s" in res:
+        row("floor", res["floor_s"])
+    if "h2d_first_consume_s" in res:
+        row("h2d first consume", res["h2d_first_consume_s"],
+            f"{res['wire_mb']:.1f} MB wire")
+    if "sort_s" in res:
+        row("sort", res["sort_s"])
+    if "sort_df_s" in res:
+        row("sort+df", res["sort_df_s"])
+    if "forward_s" in res:
+        row("forward", res["forward_s"])
     if "forward_marginal_s" in res:
         row("forward marginal (x8 pipelined)", res["forward_marginal_s"],
             "true per-batch device cost")
@@ -273,6 +309,9 @@ def main() -> None:
         key = f"prod_c{c}_s"
         if key in res:
             row(f"prod x{c} chunks", res[key])
+    if "prod_c1_marginal_s" in res:
+        row("prod marginal (x8 pipelined)", res["prod_c1_marginal_s"],
+            "true per-batch production cost")
     if "prod_c4_with_fetch_s" in res:
         row("prod x4 + wire fetch", res["prod_c4_with_fetch_s"])
     print(f"\nbytes model: {json.dumps(res['bytes_model'])}")

@@ -31,7 +31,7 @@ import numpy as np  # noqa: E402
 
 from tfidf_tpu.config import PipelineConfig, VocabMode  # noqa: E402
 from tfidf_tpu.ingest import (_chunk_step, _finish_wire,  # noqa: E402
-                              _bucket_pad_flat)
+                              flatten_aligned)
 from tfidf_tpu.ops.sparse import sparse_forward  # noqa: E402
 
 VOCAB = 1 << 16
@@ -61,9 +61,9 @@ def main() -> None:
 
     tok_dev = jax.device_put(ids_np)
     len_dev = jax.device_put(lens_np)
-    flat = ids_np[mask].astype(np.uint16)
-    flat_dev = jax.device_put(
-        _bucket_pad_flat(np.ascontiguousarray(flat), flat.size))
+    # The packers' aligned layout — _chunk_step decodes with
+    # _WIRE_ALIGN, so the traced program must consume the real wire.
+    flat_dev = jax.device_put(flatten_aligned(ids_np, lens_np))
 
     @jax.jit
     def fwd(t, l):
