@@ -1,0 +1,84 @@
+"""Retrieval throughput artifact (VERDICT r4 item 9).
+
+Indexes a Zipf corpus with models/retrieval.TfidfRetriever (the
+overlapped chunked ingest) and measures batched-query search QPS on
+the live backend — the config-3 BCOO north-star use. Prints one JSON
+line per query-batch size plus an index-build row; paste into
+BASELINE.md.
+
+Usage: python tools/retrieval_bench.py [--docs 100000] [--batches 16,64,256]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import sys
+import tempfile
+import time
+
+REPO = __file__.rsplit("/", 2)[0]
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--docs", type=int, default=100000)
+    ap.add_argument("--len", type=int, dest="length", default=256)
+    ap.add_argument("--batches", default="16,64,256")
+    ap.add_argument("--k", type=int, default=10)
+    ap.add_argument("--repeats", type=int, default=3)
+    args = ap.parse_args()
+
+    import bench as benchmod
+    benchmod.N_DOCS = args.docs
+    benchmod.DOC_LEN = args.length
+
+    import jax
+    from tfidf_tpu.config import PipelineConfig, VocabMode
+    from tfidf_tpu.models.retrieval import TfidfRetriever
+
+    print(f"backend={jax.default_backend()}", file=sys.stderr)
+    tmp = tempfile.mkdtemp(prefix="retr_bench_")
+    try:
+        print(f"generating {args.docs}-doc corpus...", file=sys.stderr)
+        input_dir = benchmod.make_corpus(tmp)
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED,
+                             vocab_size=benchmod.VOCAB,
+                             max_doc_len=args.length, topk=None,
+                             engine="sparse")
+        r = TfidfRetriever(cfg)
+        t0 = time.perf_counter()
+        r.index_dir(input_dir, doc_len=args.length)
+        jax.block_until_ready((r._ids, r._weights))
+        t_index = time.perf_counter() - t0
+        print(json.dumps({"metric": "retrieval_index_docs_per_sec",
+                          "docs": args.docs,
+                          "index_s": round(t_index, 3),
+                          "value": round(args.docs / t_index, 1)}))
+
+        rng = np.random.default_rng(7)
+        for q in (int(b) for b in args.batches.split(",")):
+            queries = [" ".join(f"w{rng.integers(0, benchmod.N_WORDS)}"
+                                for _ in range(5)) for _ in range(q)]
+            r.search(queries[:2], k=args.k)  # warm/compile
+            best = float("inf")
+            for _ in range(args.repeats):
+                t0 = time.perf_counter()
+                vals, idx = r.search(queries, k=args.k)
+                best = min(best, time.perf_counter() - t0)
+            assert vals.shape[0] == q
+            print(json.dumps({
+                "metric": "retrieval_qps", "batch": q,
+                "k": args.k, "search_s": round(best, 4),
+                "value": round(q / best, 1),
+                "docs": args.docs}), flush=True)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()
