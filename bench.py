@@ -43,10 +43,12 @@ sys.path.insert(0, REPO)
 
 N_DOCS = int(os.environ.get("BENCH_DOCS", 32768))
 DOC_LEN = int(os.environ.get("BENCH_DOC_LEN", 256))
-REPEATS = int(os.environ.get("BENCH_REPEATS", 3))  # SAME for both sides
-# best-of-3: the tunneled link and the single-core host both jitter
-# +-20-40% run to run (docs/SCALING.md "link variance"); min is the
-# honest steady state and the SAME rule applies to the CPU oracle.
+REPEATS = int(os.environ.get("BENCH_REPEATS", 5))  # SAME for both sides
+# 5 interleaved pairs: the tunneled link and the single-core host both
+# jitter +-20-40% run to run (docs/SCALING.md "link variance"); the
+# artifact ratio is the paired MEDIAN, and five samples make that
+# median meaningfully sturdier than three for ~25 s of extra oracle
+# time. Best-of fields keep min as the honest steady state.
 RECALL_DOCS = int(os.environ.get("BENCH_RECALL_DOCS", 512))
 PREFLIGHT_S = float(os.environ.get("BENCH_PREFLIGHT_S", 120))
 N_WORDS = 8192
