@@ -239,3 +239,88 @@ class TestTwoProcessIngest:
             assert p.returncode == 0, f"rc={p.returncode}\n{out}\n{err}"
         assert sorted(o.strip().splitlines()[-1]
                       for o, _ in outs) == ["OK 0", "OK 1"]
+
+
+_STREAM_WORKER = r"""
+import os
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+from tfidf_tpu.parallel.multihost import initialize
+topo = initialize(coordinator_address=sys.argv[1],
+                  num_processes=2, process_id=int(sys.argv[2]))
+input_dir, expect_npz = sys.argv[3], sys.argv[4]
+
+# The beyond-HBM regime across processes: force the streaming-mesh
+# path (resident budget 0) and pin bit-parity against the same mesh
+# shape on one process.
+os.environ["TFIDF_TPU_RESIDENT_ELEMS"] = "0"
+import numpy as np
+from tfidf_tpu.config import PipelineConfig, VocabMode
+from tfidf_tpu.ingest import run_overlapped
+from tfidf_tpu.parallel.mesh import MeshPlan
+
+plan = MeshPlan.create(docs=2, devices=jax.devices())
+cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=2048,
+                     topk=4, engine="sparse")
+r = run_overlapped(input_dir, cfg, chunk_docs=16, doc_len=32, plan=plan)
+assert r.path == "streaming-mesh", r.path
+exp = np.load(expect_npz)
+np.testing.assert_array_equal(r.topk_ids, exp["ids"])
+np.testing.assert_array_equal(np.asarray(r.df), exp["df"])
+np.testing.assert_array_equal(r.topk_vals, exp["vals"])
+np.testing.assert_array_equal(r.lengths, exp["lengths"])
+print("OK", topo.process_id)
+"""
+
+
+class TestTwoProcessStreamingMesh:
+    def test_streaming_mesh_across_processes(self, tmp_path, monkeypatch):
+        import socket
+
+        import numpy as np
+
+        from tfidf_tpu.config import PipelineConfig, VocabMode
+        from tfidf_tpu.ingest import run_overlapped
+        from tfidf_tpu.parallel.mesh import MeshPlan
+        import jax
+
+        d = tmp_path / "input"
+        d.mkdir()
+        rng = np.random.default_rng(13)
+        for i in range(1, 25):
+            (d / f"doc{i}").write_text(
+                " ".join(f"w{rng.integers(0, 200)}"
+                         for _ in range(rng.integers(1, 30))))
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=2048,
+                             topk=4, engine="sparse")
+        monkeypatch.setenv("TFIDF_TPU_RESIDENT_ELEMS", "0")
+        plan1 = MeshPlan.create(docs=2, devices=jax.devices("cpu")[:2])
+        ref = run_overlapped(str(d), cfg, chunk_docs=16, doc_len=32,
+                             plan=plan1)
+        assert ref.path == "streaming-mesh"
+        expect = tmp_path / "expect.npz"
+        np.savez(expect, ids=ref.topk_ids, vals=ref.topk_vals,
+                 df=np.asarray(ref.df), lengths=ref.lengths)
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("XLA_FLAGS", None)
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            addr = f"localhost:{s.getsockname()[1]}"
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", _STREAM_WORKER, addr, str(pid),
+             str(d), str(expect)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env) for pid in range(2)]
+        try:
+            outs = [p.communicate(timeout=180) for p in procs]
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        for p, (out, err) in zip(procs, outs):
+            assert p.returncode == 0, f"rc={p.returncode}\n{out}\n{err}"
+        assert sorted(o.strip().splitlines()[-1]
+                      for o, _ in outs) == ["OK 0", "OK 1"]

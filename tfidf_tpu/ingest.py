@@ -394,8 +394,14 @@ def _run_overlapped_mesh_streaming(input_dir: str, cfg: PipelineConfig,
     chunk_cache_bytes = chunk_docs * length * 9 + chunk_docs * 4
 
     ph = {"pack_a": 0.0, "pack_b": 0.0}
-    df_acc = jax.device_put(np.zeros((shards, cfg.vocab_size), np.int32),
-                            batch_sh)
+    # Multi-process composition: _put_sharded / _fetch_global make this
+    # regime process-spanning like the resident one. Unlike the
+    # resident path, every process packs the FULL chunk and the
+    # callback slices its addressable rows — acceptable for the
+    # beyond-HBM regime (host pack overlaps device pass A), noted as
+    # duplicated host work.
+    df_acc = _put_sharded(np.zeros((shards, cfg.vocab_size), np.int32),
+                          batch_sh)
     cached: List[Optional[Tuple[np.ndarray, np.ndarray]]] = []
     all_lengths: List[np.ndarray] = []
     t_pass = time.perf_counter()
@@ -405,8 +411,8 @@ def _run_overlapped_mesh_streaming(input_dir: str, cfg: PipelineConfig,
         token_ids, lengths = pack_chunk(chunk_names)
         ph["pack_a"] += time.perf_counter() - t0
         all_lengths.append(lengths[:len(chunk_names)])
-        toks = jax.device_put(token_ids, batch_sh)
-        lens = jax.device_put(lengths, lens_sh)
+        toks = _put_sharded(token_ids, batch_sh)
+        lens = _put_sharded(lengths, lens_sh)
         if cache_bytes + chunk_cache_bytes <= cache_budget:
             i_, c_, h_, df_acc = step(toks, lens, df_acc)
             trip_cache[ci] = (i_, c_, h_, lens)
@@ -440,15 +446,15 @@ def _run_overlapped_mesh_streaming(input_dir: str, cfg: PipelineConfig,
                 token_ids, lengths = pack_chunk(
                     names[start:start + chunk_docs])
                 ph["pack_b"] += time.perf_counter() - t0
-            v, t = phase_b(jax.device_put(token_ids, batch_sh),
-                           jax.device_put(lengths, lens_sh), idf)
+            v, t = phase_b(_put_sharded(token_ids, batch_sh),
+                           _put_sharded(lengths, lens_sh), idf)
         vals_parts.append(v)
         ids_parts.append(t)
     jax.block_until_ready((vals_parts, ids_parts))
     ph["pass_b"] = time.perf_counter() - t_pass
 
     t0 = time.perf_counter()
-    df_host, vals, tids = jax.device_get(
+    df_host, vals, tids = _fetch_global(
         (df_total, jnp.concatenate(vals_parts),
          jnp.concatenate(ids_parts)))
     ph["fetch"] = time.perf_counter() - t0
