@@ -102,15 +102,15 @@ if _WIRE_ALIGN & (_WIRE_ALIGN - 1):
                      f"got {_WIRE_ALIGN}")
 
 
-def flatten_aligned(ids: "np.ndarray", lengths: "np.ndarray",
-                    align: int = None) -> "np.ndarray":
+def flatten_aligned(ids, lengths, align: int = None):
     """Host-side flat wire from a padded [D, L] id batch, in THE
     (granule-aligned) layout both native packers emit: each doc's live
     ids back to back, zero-filled up to the next ``align`` multiple,
     then bucket-padded (``_bucket_pad_flat``). The single Python
     definition of the layout — ``make_flat_packer``'s fallback and the
     measurement tools (roofline/trace capture) all call this, so the
-    wire contract cannot drift between them."""
+    wire contract cannot drift between them. Returns ``(flat, total)``
+    where ``total`` is the live (pre-bucket-pad) aligned id count."""
     if align is None:
         align = _WIRE_ALIGN
     d, width = ids.shape
@@ -125,7 +125,8 @@ def flatten_aligned(ids: "np.ndarray", lengths: "np.ndarray",
         flat = np.ascontiguousarray(z[amask].astype(np.uint16))
     else:
         flat = np.ascontiguousarray(ids[mask].astype(np.uint16))
-    return _bucket_pad_flat(flat, flat.size)
+    total = flat.size
+    return _bucket_pad_flat(flat, total), total
 
 
 def _ragged_to_padded(flat, lengths, length: int, align: int = 1):
@@ -157,7 +158,7 @@ def _ragged_to_padded(flat, lengths, length: int, align: int = 1):
 @functools.partial(jax.jit,
                    static_argnames=("length", "vocab_size", "align"))
 def _chunk_ragged(flat, lengths, df_acc, *, length: int, vocab_size: int,
-                  align: int = 1):
+                  align: int):
     tok = _ragged_to_padded(flat, lengths, length, align)
     ids, counts, head = sorted_term_counts(tok, lengths)
     return ids, counts, head, df_acc + sparse_df(ids, head, vocab_size)
@@ -169,7 +170,7 @@ def _chunk_ragged(flat, lengths, df_acc, *, length: int, vocab_size: int,
 @functools.partial(jax.jit,
                    static_argnames=("length", "vocab_size", "align"))
 def _phase_a_ragged(flat, lengths, df_acc, *, length: int, vocab_size: int,
-                    align: int = 1):
+                    align: int):
     tok = _ragged_to_padded(flat, lengths, length, align)
     ids, _, head = sorted_term_counts(tok, lengths)
     return df_acc + sparse_df(ids, head, vocab_size)
@@ -177,7 +178,7 @@ def _phase_a_ragged(flat, lengths, df_acc, *, length: int, vocab_size: int,
 
 @functools.partial(jax.jit, static_argnames=("length", "topk", "align"))
 def _phase_b_ragged(flat, lengths, idf, *, length: int, topk: int,
-                    align: int = 1):
+                    align: int):
     tok = _ragged_to_padded(flat, lengths, length, align)
     ids, counts, head = sorted_term_counts(tok, lengths)
     scores = sparse_scores(ids, counts, head, lengths, idf)
@@ -732,12 +733,8 @@ def make_flat_packer(input_dir: str, cfg: PipelineConfig, chunk_docs: int,
         ids, lengths = padded(chunk_names)
         # Aligned layout, identical to the native packer (the one
         # Python definition of the wire — flatten_aligned).
-        if _WIRE_ALIGN > 1:
-            al = -(-np.maximum(lengths, 0) // _WIRE_ALIGN) * _WIRE_ALIGN
-            total = int(al.sum())
-        else:
-            total = int(np.maximum(lengths, 0).sum())
-        return flatten_aligned(ids, lengths), lengths, total
+        flat, total = flatten_aligned(ids, lengths)
+        return flat, lengths, total
 
     return pack_native if use_native else pack_python
 
@@ -777,7 +774,7 @@ def _score_pack_wire(ids, counts, head, lengths, df, num_docs, *,
         # never takes this path.
         from tfidf_tpu.ops.sparse import (df_slot_sorted,
                                           sparse_scores_joined)
-        df_slot, _, _ = df_slot_sorted(ids, head)
+        df_slot, _ = df_slot_sorted(ids, head)
         scores = sparse_scores_joined(counts, head, lengths, df_slot,
                                       num_docs, score_dtype)
     else:

@@ -153,11 +153,11 @@ def join_method(explicit: Optional[str] = None) -> str:
 
 
 def df_slot_sorted(ids: jax.Array, head: jax.Array
-                   ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+                   ) -> Tuple[jax.Array, jax.Array]:
     """Per-slot DF join from ONE global sort (no [V] table) — see
-    :func:`df_join_sorted`. Returns ``(df_slot [D, L], srt, slot)``
-    where ``srt`` is the sorted head-masked id stream (reusable for
-    the :func:`sparse_df` searchsorted lowering)."""
+    :func:`df_join_sorted`. Returns ``(df_slot [D, L], srt)`` where
+    ``srt`` is the sorted head-masked id stream (reusable for the
+    :func:`sparse_df` searchsorted lowering)."""
     d, length = ids.shape
     n = d * length
     sentinel = jnp.iinfo(jnp.int32).max
@@ -174,7 +174,7 @@ def df_slot_sorted(ids: jax.Array, head: jax.Array
     next_start = jnp.concatenate([smin[1:], jnp.full((1,), n, jnp.int32)])
     df_elem = next_start - spos
     _, df_slot = lax.sort((orig, df_elem), num_keys=1, is_stable=False)
-    return df_slot.reshape(d, length), srt, slot
+    return df_slot.reshape(d, length), srt
 
 
 def df_join_sorted(ids: jax.Array, head: jax.Array, vocab_size: int,
@@ -199,7 +199,7 @@ def df_join_sorted(ids: jax.Array, head: jax.Array, vocab_size: int,
     non-head slots (the sentinel run's length) — consumers mask by
     ``head``, exactly like the counts contract.
     """
-    df_slot, srt, _ = df_slot_sorted(ids, head)
+    df_slot, srt = df_slot_sorted(ids, head)
     edges = jnp.arange(vocab_size + 1, dtype=jnp.int32)
     pos = jnp.searchsorted(srt, edges)
     return (pos[1:] - pos[:-1]).astype(jnp.int32), df_slot

@@ -117,7 +117,7 @@ def main() -> None:
     # -- h2d: first consumption of freshly staged uploads ------------------
     # The ragged wire the production path ships: uint16 flat ids in the
     # packers' (granule-aligned) layout.
-    flat_np = flatten_aligned(ids_np, lens_np)
+    flat_np, _ = flatten_aligned(ids_np, lens_np)
     consume = jax.jit(lambda t, l: (t.astype(jnp.int32).sum()
                                     + l.sum().astype(jnp.int32)))
     if "h2d" in stages:
@@ -170,8 +170,8 @@ def main() -> None:
         cd = d // n_chunks
         parts = []
         for s in range(0, d, cd):
-            flat = flatten_aligned(ids_np[s:s + cd],
-                                   lens_np[s:s + cd])
+            flat, _ = flatten_aligned(ids_np[s:s + cd],
+                                      lens_np[s:s + cd])
             parts.append((jax.device_put(flat),
                           jax.device_put(lens_np[s:s + cd])))
         for t_, l_ in parts:

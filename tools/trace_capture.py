@@ -63,7 +63,7 @@ def main() -> None:
     len_dev = jax.device_put(lens_np)
     # The packers' aligned layout — _chunk_step decodes with
     # _WIRE_ALIGN, so the traced program must consume the real wire.
-    flat_dev = jax.device_put(flatten_aligned(ids_np, lens_np))
+    flat_dev = jax.device_put(flatten_aligned(ids_np, lens_np)[0])
 
     @jax.jit
     def fwd(t, l):
