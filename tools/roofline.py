@@ -42,7 +42,7 @@ import numpy as np  # noqa: E402
 
 from tfidf_tpu.config import PipelineConfig, VocabMode  # noqa: E402
 from tfidf_tpu.ingest import (_chunk_step, _finish_wire,  # noqa: E402
-                              flatten_aligned)
+                              _resident_df_mode, flatten_aligned)
 from tfidf_tpu.ops.sparse import (sorted_term_counts, sparse_df,  # noqa: E402
                                   sparse_forward)
 
@@ -181,8 +181,9 @@ def main() -> None:
             df_acc = jnp.zeros((VOCAB,), jnp.int32)
             ti, tc, th, lp = [], [], [], []
             for t_, l_ in parts:
-                i_, c_, h_, df_acc = _chunk_step(t_, l_, df_acc, cfg,
-                                                 length, ragged=True)
+                i_, c_, h_, df_acc = _chunk_step(
+                    t_, l_, df_acc, cfg, length, ragged=True,
+                    fold_df=not _resident_df_mode()[1])
                 ti.append(i_)
                 tc.append(c_)
                 th.append(h_)

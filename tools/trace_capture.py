@@ -31,7 +31,7 @@ import numpy as np  # noqa: E402
 
 from tfidf_tpu.config import PipelineConfig, VocabMode  # noqa: E402
 from tfidf_tpu.ingest import (_chunk_step, _finish_wire,  # noqa: E402
-                              flatten_aligned)
+                              _resident_df_mode, flatten_aligned)
 from tfidf_tpu.ops.sparse import sparse_forward  # noqa: E402
 
 VOCAB = 1 << 16
@@ -76,8 +76,9 @@ def main() -> None:
 
     def prod():
         df_acc = jnp.zeros((VOCAB,), jnp.int32)
-        i_, c_, h_, df_acc = _chunk_step(flat_dev, len_dev, df_acc, cfg,
-                                         length, ragged=True)
+        i_, c_, h_, df_acc = _chunk_step(
+            flat_dev, len_dev, df_acc, cfg, length, ragged=True,
+            fold_df=not _resident_df_mode()[1])
         _, wire = _finish_wire(([i_], [c_], [h_]), [len_dev], df_acc, d,
                                k, score_dtype, cfg, wire_vals=True)
         return jnp.asarray(wire).astype(jnp.int32).sum()
