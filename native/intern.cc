@@ -521,6 +521,15 @@ void* exact_emit_run(void* intern_handle, const char* input_dir,
       res->lines.append(l);
       res->lines.push_back('\n');
     }
+    // The pick phase ran either way — without this the byte-sort
+    // fallback returned before the debug line, so '@'-in-name corpora
+    // silently dropped the pick timing and tie count (advisor r5).
+    if (debug)
+      std::fprintf(stderr,
+                   "exact_emit: pick %.3fs (tied %lld/%lld) byte-sort "
+                   "fallback total %.3fs\n",
+                   t_pick, (long long)n_tied.load(), (long long)n_docs,
+                   now() - t0);
     return res;
   }
   double t_rank = debug ? now() - t0 - t_pick : 0.0;

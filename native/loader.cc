@@ -203,6 +203,27 @@ int64_t loader_fill_flat_u16(void* handle, uint64_t seed,
   return pos;
 }
 
+// Capacity-aware flat fill: identical ragged layout, but the caller
+// hands the buffer's full staging CAPACITY (in ids — the ingest
+// packers pass the bucket-rounded chunk capacity) and the tail
+// [total, cap) is zero-filled HERE, so the wire buffer leaves native
+// ragged AND ship-ready: no Python re-pad/memset pass, and the old
+// flow's np.pad copy (when the bucket pad outgrew the buffer) cannot
+// happen by construction.
+int64_t loader_fill_flat_u16_v2(void* handle, uint64_t seed,
+                                int64_t vocab_size, int64_t truncate_at,
+                                int64_t max_per_doc, uint16_t* out,
+                                int64_t cap, int32_t* out_lengths,
+                                int64_t align) {
+  int64_t total = loader_fill_flat_u16(handle, seed, vocab_size,
+                                       truncate_at, max_per_doc, out,
+                                       out_lengths, align);
+  if (total < cap)
+    std::memset(out + total, 0,
+                (size_t)(cap - total) * sizeof(uint16_t));
+  return total;
+}
+
 void loader_close(void* handle) { delete static_cast<Loader*>(handle); }
 
 }  // extern "C"

@@ -16,6 +16,7 @@
 #include "mpi.h"
 
 #include <cerrno>
+#include <climits>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -124,7 +125,21 @@ int MPI_Init(int*, char***) {
   while (pos <= s.size()) {
     size_t c = s.find(',', pos);
     if (c == std::string::npos) c = s.size();
-    g_world.fds.push_back(std::atoi(s.substr(pos, c - pos).c_str()));
+    // strtol with end-pointer validation (advisor r5): atoi turns a
+    // malformed entry ("x", "", "3x") into 0 — i.e. an innocent-looking
+    // fd 0 that later reads stdin. A launcher bug must die HERE, named.
+    const std::string tok = s.substr(pos, c - pos);
+    char* end = nullptr;
+    errno = 0;
+    long fd = std::strtol(tok.c_str(), &end, 10);
+    if (tok.empty() || end == tok.c_str() || *end != '\0' || errno != 0 ||
+        fd < -1 || fd > INT_MAX) {
+      std::fprintf(stderr,
+                   "mpi_lite: malformed MPILITE_FDS entry '%s' in '%s'\n",
+                   tok.c_str(), fds_s);
+      std::exit(2);
+    }
+    g_world.fds.push_back((int)fd);
     pos = c + 1;
   }
   if ((int)g_world.fds.size() != g_world.size)

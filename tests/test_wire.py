@@ -1,0 +1,262 @@
+"""The ragged (CSR-style) chunk wire and the double-buffered upload
+pipeline (ingest.py round 6): ragged<->padded round-trip equality,
+device-rebuild vs host-pad parity on both engines, the overlap-loop
+ordering contract, and the --wire knob's fallback selection."""
+
+import os
+
+import numpy as np
+import pytest
+
+from tfidf_tpu import PipelineConfig, discover_corpus
+from tfidf_tpu import ingest as ing
+from tfidf_tpu.config import VocabMode
+from tfidf_tpu.io.corpus import (Corpus, pack_corpus, pack_ragged,
+                                 ragged_to_padded_host)
+from tfidf_tpu.pipeline import TfidfPipeline
+
+
+def _cfg(**kw):
+    base = dict(vocab_mode=VocabMode.HASHED, vocab_size=1 << 10,
+                max_doc_len=64, doc_chunk=64, topk=5, engine="sparse")
+    base.update(kw)
+    return PipelineConfig(**base)
+
+
+@pytest.fixture
+def corpus_dir(tmp_path):
+    rng = np.random.default_rng(7)
+    for i in range(1, 41):
+        words = [f"w{rng.integers(0, 60)}"
+                 for _ in range(int(rng.integers(0, 40)))]
+        (tmp_path / f"doc{i}").write_text(" ".join(words))
+    return str(tmp_path)
+
+
+class TestRoundTrip:
+    """flatten_aligned -> rebuild is the identity on live slots, for
+    every granule, including empty and full-length docs."""
+
+    @pytest.mark.parametrize("align", [1, 4, 16])
+    def test_property_random_lengths(self, align):
+        rng = np.random.default_rng(3)
+        length = 24
+        for case in range(20):
+            d = int(rng.integers(1, 9))
+            lens = rng.integers(0, length + 1, d).astype(np.int32)
+            # force the edge cases into every draw
+            lens[rng.integers(0, d)] = 0          # empty doc
+            lens[rng.integers(0, d)] = length     # L-length doc
+            ids = np.zeros((d, length), np.int32)
+            mask = np.arange(length)[None, :] < lens[:, None]
+            ids[mask] = rng.integers(1, 60000, int(mask.sum()))
+            flat, total = ing.flatten_aligned(ids, lens, align)
+            assert flat.size % ing._FLAT_BUCKET == 0
+            aligned = (-(-np.maximum(lens, 0) // align) * align).sum()
+            assert total == aligned
+            # Host rebuild: bit-identical to the zero-padded batch.
+            np.testing.assert_array_equal(
+                ragged_to_padded_host(flat, lens, length, align), ids)
+            # Device rebuild: value-identical at live slots (padding
+            # slots carry clamp garbage that every consumer masks).
+            tok = np.asarray(ing._ragged_to_padded(flat, lens, length,
+                                                   align))
+            np.testing.assert_array_equal(np.where(mask, tok, 0), ids)
+
+    def test_all_empty_batch(self):
+        lens = np.zeros((4,), np.int32)
+        ids = np.zeros((4, 16), np.int32)
+        flat, total = ing.flatten_aligned(ids, lens, 8)
+        assert total == 0 and flat.size == ing._FLAT_BUCKET
+        np.testing.assert_array_equal(
+            ragged_to_padded_host(flat, lens, 16, 8), ids)
+
+
+class TestEngineParity:
+    """A RaggedBatch through the minibatch layers equals the padded
+    batch bit for bit — the device rebuild vs host-pad contract."""
+
+    @pytest.mark.parametrize("engine", ["sparse", "dense"])
+    def test_pipeline_run_packed(self, engine):
+        docs = [b"apple banana apple", b"", b"cherry date fig " * 8,
+                b"kiwi"]
+        corpus = Corpus(names=[f"doc{i}" for i in range(1, 5)], docs=docs)
+        cfg = _cfg(engine=engine, vocab_size=1 << 12, topk=4)
+        pipe = TfidfPipeline(cfg)
+        r_pad = pipe.run_packed(pack_corpus(corpus, cfg))
+        r_rag = pipe.run_packed(pack_ragged(corpus, cfg))
+        np.testing.assert_array_equal(r_pad.df, r_rag.df)
+        np.testing.assert_array_equal(r_pad.topk_ids, r_rag.topk_ids)
+        np.testing.assert_allclose(r_pad.topk_vals, r_rag.topk_vals)
+
+    def test_streaming_update_score(self):
+        from tfidf_tpu.streaming import StreamingTfidf
+        docs = [b"alpha beta alpha gamma", b"", b"delta " * 30]
+        corpus = Corpus(names=["doc1", "doc2", "doc3"], docs=docs)
+        cfg = _cfg(vocab_size=1 << 12, topk=3)
+        s_pad, s_rag = StreamingTfidf(cfg), StreamingTfidf(cfg)
+        b_pad = s_pad.pack(corpus, fixed_len=32)
+        b_rag = s_rag.pack_ragged(corpus, fixed_len=32)
+        s_pad.update(b_pad)
+        s_rag.update(b_rag)
+        np.testing.assert_array_equal(s_pad.df(), s_rag.df())
+        v1, i1 = s_pad.score(b_pad)
+        v2, i2 = s_rag.score(b_rag)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_allclose(np.asarray(v1), np.asarray(v2))
+
+    @pytest.mark.parametrize("regime", ["resident", "streaming"])
+    def test_run_overlapped_wire_parity(self, corpus_dir, regime,
+                                        monkeypatch):
+        if regime == "streaming":
+            monkeypatch.setenv("TFIDF_TPU_RESIDENT_ELEMS", "0")
+            monkeypatch.setenv("TFIDF_TPU_TRIPLE_CACHE_BYTES", "0")
+        r_rag = ing.run_overlapped(corpus_dir, _cfg(wire="ragged"),
+                                   chunk_docs=16, doc_len=64)
+        r_pad = ing.run_overlapped(corpus_dir, _cfg(wire="padded"),
+                                   chunk_docs=16, doc_len=64)
+        assert r_rag.wire == "ragged" and r_pad.wire == "padded"
+        np.testing.assert_array_equal(r_rag.df, r_pad.df)
+        np.testing.assert_allclose(r_rag.topk_vals, r_pad.topk_vals,
+                                   rtol=1e-6)
+        # bytes accounting: the padded run's actual wire IS the padded
+        # format; both runs report the same padded-format denominator.
+        assert r_pad.bytes_on_wire == r_pad.bytes_on_wire_padded
+        assert r_rag.bytes_on_wire_padded == r_pad.bytes_on_wire_padded
+        assert r_rag.bytes_on_wire > 0
+
+    def test_pallas_rebuild_matches_xla(self, corpus_dir, monkeypatch):
+        monkeypatch.setenv("TFIDF_TPU_REBUILD", "pallas")
+        monkeypatch.setenv("TFIDF_TPU_WIRE_ALIGN", "16")
+        r_p = ing.run_overlapped(corpus_dir, _cfg(), chunk_docs=16,
+                                 doc_len=64)
+        monkeypatch.setenv("TFIDF_TPU_REBUILD", "xla")
+        r_x = ing.run_overlapped(corpus_dir, _cfg(), chunk_docs=16,
+                                 doc_len=64)
+        np.testing.assert_array_equal(r_p.df, r_x.df)
+        np.testing.assert_allclose(r_p.topk_vals, r_x.topk_vals)
+
+
+class TestOverlapLoop:
+    """Ordering contract of the double-buffered upload pipeline: the
+    packer thread runs ahead of dispatch, every chunk's upload is
+    issued before the (single, terminal) result fetch completes."""
+
+    def _trace_run(self, corpus_dir, **kw):
+        events = []
+        ing._overlap_trace = events.append
+        try:
+            ing.run_overlapped(corpus_dir, _cfg(), chunk_docs=10,
+                               doc_len=64, **kw)
+        finally:
+            ing._overlap_trace = None
+        return events
+
+    def test_uploads_precede_fetch(self, corpus_dir):
+        events = self._trace_run(corpus_dir)
+        uploads = [i for i, e in enumerate(events) if e[0] == "upload"]
+        fetch_done = events.index(("fetch_done", -1))
+        assert len(uploads) == 4  # 40 docs / 10-doc chunks
+        # chunk i+1's upload is issued before chunk i's fetch completes
+        # (there is one terminal fetch; every upload precedes it).
+        assert all(u < fetch_done for u in uploads)
+        fetch_start = events.index(("fetch_start", -1))
+        assert all(u < fetch_start for u in uploads)
+
+    def test_pack_rides_ahead_of_dispatch(self, corpus_dir):
+        events = self._trace_run(corpus_dir)
+
+        def idx(ev):
+            return events.index(ev)
+
+        # Double buffer: chunk i+1's pack is submitted (in flight on
+        # the worker thread) before chunk i's dispatch returns.
+        n = 4
+        for i in range(n - 1):
+            assert idx(("pack_submit", i + 1)) < idx(("dispatch", i))
+        # and the packer retires chunks in submission order.
+        dones = [e[1] for e in events if e[0] == "pack_done"]
+        assert dones == sorted(dones)
+
+    def test_streaming_loop_traces_too(self, corpus_dir, monkeypatch):
+        monkeypatch.setenv("TFIDF_TPU_RESIDENT_ELEMS", "0")
+        monkeypatch.setenv("TFIDF_TPU_TRIPLE_CACHE_BYTES", "0")
+        events = self._trace_run(corpus_dir)
+        uploads = [i for i, e in enumerate(events) if e[0] == "upload"]
+        fetch_start = events.index(("fetch_start", -1))
+        assert len(uploads) == 4
+        assert all(u < fetch_start for u in uploads)
+
+
+class TestWireSelection:
+    """config.wire resolution: ragged by default, padded forced or
+    degraded-to automatically when ragged cannot carry the run."""
+
+    def test_config_validates_wire(self):
+        with pytest.raises(ValueError, match="wire"):
+            _cfg(wire="csr")
+
+    def test_forced_padded(self):
+        assert not ing.use_ragged_wire(_cfg(wire="padded"), 16, 64)
+
+    def test_wide_vocab_degrades(self):
+        cfg = _cfg(vocab_size=(1 << 16) + 1)
+        assert not ing.use_ragged_wire(cfg, 16, 64)
+
+    def test_over_bucket_chunk_degrades(self):
+        # aligned flat capacity past the int32 bucket bound -> padded
+        assert not ing.use_ragged_wire(_cfg(), 1 << 26, 64)
+        assert ing.use_ragged_wire(_cfg(), 1 << 20, 64)
+
+    def test_wide_vocab_run_reports_padded(self, corpus_dir):
+        r = ing.run_overlapped(corpus_dir,
+                               _cfg(vocab_size=(1 << 16) + 8),
+                               chunk_docs=16, doc_len=64)
+        assert r.wire == "padded"
+
+
+class TestWireAlignGuard:
+    """The _WIRE_ALIGN env knob is validated at the packer/rebuild
+    entry points, by name — not at module import (ADVICE round 5)."""
+
+    def test_non_power_of_two_raises(self, monkeypatch):
+        monkeypatch.setenv("TFIDF_TPU_WIRE_ALIGN", "12")
+        with pytest.raises(ValueError, match="TFIDF_TPU_WIRE_ALIGN"):
+            ing._wire_align()
+
+    def test_over_bucket_raises(self, monkeypatch):
+        monkeypatch.setenv("TFIDF_TPU_WIRE_ALIGN",
+                           str(ing._FLAT_BUCKET * 2))
+        with pytest.raises(ValueError, match="TFIDF_TPU_WIRE_ALIGN"):
+            ing._wire_align()
+
+    def test_entry_point_names_the_knob(self, corpus_dir, monkeypatch):
+        monkeypatch.setenv("TFIDF_TPU_WIRE_ALIGN", "3")
+        with pytest.raises(ValueError, match="TFIDF_TPU_WIRE_ALIGN"):
+            ing.run_overlapped(corpus_dir, _cfg(), chunk_docs=16,
+                               doc_len=64)
+
+    def test_valid_align_passes(self, monkeypatch):
+        monkeypatch.setenv("TFIDF_TPU_WIRE_ALIGN", "8")
+        assert ing._wire_align() == 8
+
+
+class TestTotalSlotsGuard:
+    """Total-resident-slots int32 bound for the finish-program
+    sort-join (ADVICE round 5): raised by name at the ingest entry
+    points and re-asserted inside df_slot_sorted at trace time."""
+
+    def test_entry_point_guard(self):
+        with pytest.raises(ValueError, match="int32"):
+            ing._check_total_slots_fit_int32(1 << 26, 64)
+        ing._check_total_slots_fit_int32(1 << 20, 64)  # fits
+
+    def test_df_slot_sorted_reasserts(self):
+        import jax
+        import jax.numpy as jnp
+
+        from tfidf_tpu.ops.sparse import df_slot_sorted
+        big = jax.ShapeDtypeStruct((1 << 26, 64), jnp.int32)
+        head = jax.ShapeDtypeStruct((1 << 26, 64), jnp.bool_)
+        with pytest.raises(ValueError, match="int32"):
+            jax.eval_shape(df_slot_sorted, big, head)

@@ -26,7 +26,8 @@ IDF, lexicographic ordering) are preserved by the golden path
 
 from tfidf_tpu.config import PipelineConfig, VocabMode, TokenizerKind
 from tfidf_tpu.pipeline import TfidfPipeline, PipelineResult
-from tfidf_tpu.io.corpus import Corpus, discover_corpus, PackedBatch
+from tfidf_tpu.io.corpus import (Corpus, discover_corpus, PackedBatch,
+                                 RaggedBatch, pack_ragged)
 from tfidf_tpu.ingest import (ExactIngest, IngestResult, run_overlapped,
                               run_overlapped_exact)
 from tfidf_tpu.rerank import exact_terms, exact_terms_lines, exact_topk
@@ -42,6 +43,8 @@ __all__ = [
     "Corpus",
     "discover_corpus",
     "PackedBatch",
+    "RaggedBatch",
+    "pack_ragged",
     "ExactIngest",
     "IngestResult",
     "run_overlapped",

@@ -61,6 +61,17 @@ def _build_parser() -> argparse.ArgumentParser:
                           "only): keep packed chunks in host RAM between "
                           "passes, re-read from disk, or pick by byte "
                           "budget (default auto)")
+    run.add_argument("--wire", choices=["ragged", "padded"],
+                     default="ragged",
+                     help="host->device chunk wire format (--doc-len "
+                          "runs): 'ragged' ships one flat uint16 token "
+                          "stream per chunk (bytes scale with real "
+                          "tokens) and rebuilds [D, L] on device; "
+                          "'padded' forces the dense wire — the bit-"
+                          "identical parity fallback, also selected "
+                          "automatically for vocabs past 2^16 or chunks "
+                          "whose flat stream would overflow the int32 "
+                          "bucket bound")
     run.add_argument("--exact-terms", action="store_true",
                      help="hashed+topk mode: re-rank the device top-k "
                           "on host with exact strings and DF, emitting "
@@ -191,11 +202,16 @@ def _run_tpu(args) -> int:
         engine=args.engine,
         use_pallas=args.pallas,
         mesh_shape=mesh_shape,
+        wire=getattr(args, "wire", "ragged"),
     )
     from tfidf_tpu.utils.timing import PhaseTimer, Throughput, phase_or_null
     timer = PhaseTimer() if args.timing else None
     throughput = Throughput()
 
+    # --inspect's discovery is kept and REUSED by whichever run path
+    # follows (ADVICE round 5: the old flow discovered the corpus
+    # twice, doubling I/O on anything beyond a toy input).
+    corpus_dbg = None
     if getattr(args, "inspect", False):
         # The reference's debugging affordance: dump the TF/IDF phase
         # tables in its exact print formats (golden.inspect_tables).
@@ -256,8 +272,9 @@ def _run_tpu(args) -> int:
 
         from tfidf_tpu.io.corpus import discover_names
         from tfidf_tpu.rerank import exact_terms_lines
-        n_docs = len(discover_names(args.input,
-                                    strict=not args.no_strict))
+        n_docs = (len(corpus_dbg) if corpus_dbg is not None
+                  else len(discover_names(args.input,
+                                          strict=not args.no_strict)))
         t0 = time.perf_counter()
         lines, engine, _ = exact_terms_lines(
             args.input, cfg, k=args.topk, doc_len=args.doc_len,
@@ -316,7 +333,8 @@ def _run_tpu(args) -> int:
         return 2
     else:
         with phase_or_null(timer, "discover"):
-            corpus = discover_corpus(args.input, strict=not args.no_strict)
+            corpus = (corpus_dbg if corpus_dbg is not None else
+                      discover_corpus(args.input, strict=not args.no_strict))
         # --mesh flows through config.mesh_shape: TfidfPipeline
         # dispatches to ShardedPipeline over the described device mesh.
         with throughput.measure(len(corpus)):

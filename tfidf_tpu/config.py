@@ -80,6 +80,15 @@ class PipelineConfig:
       topk: if set, only the top-k (by score) records per document are
         gathered to host — the scalable replacement for the reference's
         full serial gather (``TFIDF.c:256-270``).
+      wire: host→device wire format for the overlapped chunked ingest.
+        "ragged" (default) ships one concatenated uint16 token stream
+        per chunk (CSR-style, granule-aligned — bytes scale with real
+        tokens, not D×L) and rebuilds the padded batch on device;
+        "padded" forces the dense [D, L] wire — the bit-identical
+        parity fallback. "ragged" silently degrades to the padded wire
+        when it cannot carry the run (vocab > 2^16, or a chunk whose
+        aligned flat stream would overflow the int32/``_FLAT_BUCKET``
+        offset bound — see ``ingest.use_ragged_wire``).
     """
 
     vocab_mode: VocabMode = VocabMode.EXACT
@@ -102,8 +111,12 @@ class PipelineConfig:
     use_pallas: bool = False
     score_dtype: str = "float32"
     topk: Optional[int] = None
+    wire: str = "ragged"
 
     def __post_init__(self):
+        if self.wire not in ("ragged", "padded"):
+            raise ValueError(f"unknown wire format {self.wire!r} "
+                             f"(choose 'ragged' or 'padded')")
         if self.vocab_size <= 0:
             raise ValueError("vocab_size must be positive")
         lo, hi = self.ngram_range

@@ -37,6 +37,7 @@ import dataclasses
 import functools
 import os
 import time
+import warnings
 from typing import TYPE_CHECKING, Dict, List, Optional, Tuple
 
 import jax
@@ -67,7 +68,19 @@ _DEFAULT_SPILL_BYTES = 1 << 30
 _LOOKAHEAD = 2
 
 
-@functools.partial(jax.jit, static_argnames=("vocab_size",))
+# The wire buffer donations below can never alias an output (a uint16
+# [N] wire has no int32/float output twin), so XLA's "donated buffers
+# were not usable" compile-time warning is EXPECTED — donation here
+# buys early HBM release of dead wire buffers, not aliasing. Silence
+# that exact message; any other donation warning still surfaces.
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable")
+
+
+# Wire buffer (arg 0) donated: streaming dispatch sites device_put a
+# fresh buffer per chunk — see the ragged twins' donation note.
+@functools.partial(jax.jit, donate_argnums=(0,),
+                   static_argnames=("vocab_size",))
 def _phase_a(token_ids, lengths, df_acc, *, vocab_size: int):
     """Fold one chunk's partial DF into the device-resident accumulator."""
     ids, _, head = sorted_term_counts(token_ids, lengths)
@@ -96,26 +109,51 @@ def _chunk_sort_fold(token_ids, lengths, df_acc, *, vocab_size: int,
 # the rebuild into a granule gather ([D, L/G] rows of G contiguous
 # ids), ~G x fewer gather elements for ~G/2 wasted ids per doc on the
 # wire (+4% bytes at G=16, L=256). 1 = legacy back-to-back layout.
+# This module constant is an import-time SNAPSHOT kept for
+# introspection; every packer/rebuild entry point resolves the knob
+# through :func:`_wire_align` at CALL time, which is also where it is
+# VALIDATED — so a bad value fails loudly at the entry point naming
+# the env knob instead of poisoning module import (ADVICE round 5).
 _WIRE_ALIGN = max(1, int(os.environ.get("TFIDF_TPU_WIRE_ALIGN", "16")))
-if _WIRE_ALIGN & (_WIRE_ALIGN - 1):
-    # Must divide _FLAT_BUCKET (a power of two): the decode reshapes
-    # the bucket-padded stream into [*, align] granules. Fail here with
-    # the knob's name, not at trace time with a bare reshape error.
-    raise ValueError(f"TFIDF_TPU_WIRE_ALIGN must be a power of two, "
-                     f"got {_WIRE_ALIGN}")
 
 
-def flatten_aligned(ids, lengths, align: int = None):
+def _wire_align() -> int:
+    """The validated wire-granule alignment, read from the environment
+    at call time (the packer and rebuild entry points: flatten_aligned,
+    make_flat_packer, _chunk_step, the streaming kernel call sites).
+
+    Must be a power of two — the decode reshapes the bucket-padded
+    stream into ``[*, align]`` granules — and no larger than
+    ``_FLAT_BUCKET``, so the bucket pad stays a whole number of
+    granules. Raising HERE names the knob for every misconfiguration;
+    the old import-time check missed the over-bucket case and a bare
+    trace-time reshape error named nothing (ADVICE round 5)."""
+    align = max(1, int(os.environ.get("TFIDF_TPU_WIRE_ALIGN", "16")))
+    if align & (align - 1):
+        raise ValueError(f"TFIDF_TPU_WIRE_ALIGN must be a power of two, "
+                         f"got {align}")
+    if align > _FLAT_BUCKET:
+        raise ValueError(
+            f"TFIDF_TPU_WIRE_ALIGN ({align}) must not exceed the flat "
+            f"wire bucket (_FLAT_BUCKET = {_FLAT_BUCKET}): the "
+            f"bucket-padded stream must hold a whole number of granules")
+    return align
+
+
+def flatten_aligned(ids, lengths, align: int = None, dtype=np.uint16):
     """Host-side flat wire from a padded [D, L] id batch, in THE
     (granule-aligned) layout both native packers emit: each doc's live
     ids back to back, zero-filled up to the next ``align`` multiple,
     then bucket-padded (``_bucket_pad_flat``). The single Python
-    definition of the layout — ``make_flat_packer``'s fallback and the
+    definition of the layout — ``make_flat_packer``'s fallback, the
+    minibatch ragged packer (``io.corpus.pack_ragged``), and the
     measurement tools (roofline/trace capture) all call this, so the
-    wire contract cannot drift between them. Returns ``(flat, total)``
-    where ``total`` is the live (pre-bucket-pad) aligned id count."""
+    wire contract cannot drift between them. ``dtype`` is the wire id
+    width — uint16 for vocabs within 2^16, int32 beyond (the same rule
+    the native packers apply). Returns ``(flat, total)`` where
+    ``total`` is the live (pre-bucket-pad) aligned id count."""
     if align is None:
-        align = _WIRE_ALIGN
+        align = _wire_align()
     d, width = ids.shape
     mask = np.arange(width)[None, :] < lengths[:d, None]
     if align > 1:
@@ -125,18 +163,32 @@ def flatten_aligned(ids, lengths, align: int = None):
             z = np.pad(z, ((0, 0), (0, wc - width)))
         al = -(-np.maximum(lengths[:d], 0) // align) * align
         amask = np.arange(wc)[None, :] < al[:, None]
-        flat = np.ascontiguousarray(z[amask].astype(np.uint16))
+        flat = np.ascontiguousarray(z[amask].astype(dtype))
     else:
-        flat = np.ascontiguousarray(ids[mask].astype(np.uint16))
+        flat = np.ascontiguousarray(ids[mask].astype(dtype))
     total = flat.size
     return _bucket_pad_flat(flat, total), total
 
 
-def _ragged_to_padded(flat, lengths, length: int, align: int = 1):
+def _ragged_to_padded(flat, lengths, length: int, align: int = 1,
+                      rebuild: str = "xla"):
     """Rebuild the padded [D, L] batch from a flat id stream with one
     gather. Out-of-range slots are clamped — their values are masked by
     ``lengths`` in every consumer (sorted_term_counts contract).
-    ``align`` must match the packer's wire layout (``_WIRE_ALIGN``)."""
+    ``align`` must match the packer's wire layout (``_wire_align``).
+
+    ``rebuild`` selects the lowering: ``"xla"`` (the measured default,
+    a granule gather) or ``"pallas"`` (the Mosaic copy kernel,
+    ``ops.pallas_kernels.ragged_rebuild_pallas`` — scalar-prefetched
+    granule DMA, one program per [doc, granule] block). The Pallas
+    variant needs a granule of at least 8 ids to be a sane block; below
+    that (or off-TPU without interpret) the XLA gather serves."""
+    if rebuild == "pallas" and align >= 8:
+        from tfidf_tpu.ops.pallas_kernels import (default_interpret,
+                                                  ragged_rebuild_pallas)
+        return ragged_rebuild_pallas(flat, lengths, length=length,
+                                     align=align,
+                                     interpret=default_interpret())
     if align > 1:
         g = align
         lg = -(-length // g)
@@ -154,16 +206,39 @@ def _ragged_to_padded(flat, lengths, length: int, align: int = 1):
     return flat[jnp.minimum(idx, flat.shape[0] - 1)].astype(jnp.int32)
 
 
+# Standalone device rebuild for the minibatch API layers
+# (pipeline.run_packed / streaming accepting io.corpus.RaggedBatch):
+# one small program turns the flat wire into the padded [D, L] batch
+# ON DEVICE, so those layers get the same bytes-on-wire saving as the
+# overlapped ingest without restructuring their forward programs.
+# Rebuilt padding slots are masked by ``lengths`` in every consumer
+# (sorted_term_counts / tf_counts contract), so the clamp garbage the
+# gather leaves past each doc's length is immaterial. NOT donated: a
+# public-ish entry point may be handed a device buffer the caller
+# still holds.
+@functools.partial(jax.jit,
+                   static_argnames=("length", "align", "rebuild"))
+def rebuild_padded(flat, lengths, *, length: int, align: int,
+                   rebuild: str = "xla"):
+    """Device-side ragged→padded rebuild (jitted ``_ragged_to_padded``).
+    Returns int32 [D, length]."""
+    return _ragged_to_padded(flat, lengths, length, align, rebuild)
+
+
 # Ragged variant: the chunk arrives as a FLAT id stream (granule-
 # aligned, ~25% fewer bytes through the link than padded on the
 # measured corpus) and the padded [chunk, L] batch is rebuilt on
-# device before the same sort+fold.
+# device before the same sort+fold. NOT donated: profile_resident
+# re-dispatches the same resident wire buffers through this kernel to
+# measure the pipelined marginal, and donation would delete them after
+# the first call (the profiler-cache-sharing doctrine pins one
+# executable for production and profiler alike).
 @functools.partial(jax.jit,
                    static_argnames=("length", "vocab_size", "align",
-                                    "fold_df"))
+                                    "fold_df", "rebuild"))
 def _chunk_ragged(flat, lengths, df_acc, *, length: int, vocab_size: int,
-                  align: int, fold_df: bool = True):
-    tok = _ragged_to_padded(flat, lengths, length, align)
+                  align: int, fold_df: bool = True, rebuild: str = "xla"):
+    tok = _ragged_to_padded(flat, lengths, length, align, rebuild)
     ids, counts, head = sorted_term_counts(tok, lengths)
     if not fold_df:  # finish program derives DF (see _chunk_step)
         return ids, counts, head, df_acc
@@ -172,20 +247,27 @@ def _chunk_ragged(flat, lengths, df_acc, *, length: int, vocab_size: int,
 
 # Streaming (two-pass) ragged kernels: pass A keeps NOTHING but the DF
 # accumulator (memory flat in corpus size); pass B re-derives triples
-# and scores against the final IDF. Same flat wire as the resident path.
-@functools.partial(jax.jit,
-                   static_argnames=("length", "vocab_size", "align"))
+# and scores against the final IDF. Same flat wire as the resident
+# path. The wire buffer (arg 0) is DONATED: streaming call sites
+# always device_put a fresh buffer per chunk and never touch it again,
+# so XLA may reuse its HBM for the outputs — the upload pipeline's
+# steady-state residency stays at two in-flight wire buffers. (On
+# non-TPU backends donation is a no-op with a one-time warning.)
+@functools.partial(jax.jit, donate_argnums=(0,),
+                   static_argnames=("length", "vocab_size", "align",
+                                    "rebuild"))
 def _phase_a_ragged(flat, lengths, df_acc, *, length: int, vocab_size: int,
-                    align: int):
-    tok = _ragged_to_padded(flat, lengths, length, align)
+                    align: int, rebuild: str = "xla"):
+    tok = _ragged_to_padded(flat, lengths, length, align, rebuild)
     ids, _, head = sorted_term_counts(tok, lengths)
     return df_acc + sparse_df(ids, head, vocab_size)
 
 
-@functools.partial(jax.jit, static_argnames=("length", "topk", "align"))
+@functools.partial(jax.jit, donate_argnums=(0,),
+                   static_argnames=("length", "topk", "align", "rebuild"))
 def _phase_b_ragged(flat, lengths, idf, *, length: int, topk: int,
-                    align: int):
-    tok = _ragged_to_padded(flat, lengths, length, align)
+                    align: int, rebuild: str = "xla"):
+    tok = _ragged_to_padded(flat, lengths, length, align, rebuild)
     ids, counts, head = sorted_term_counts(tok, lengths)
     scores = sparse_scores(ids, counts, head, lengths, idf)
     return sparse_topk(scores, ids, head, topk)
@@ -214,20 +296,163 @@ _TRIPLE_CACHE_BYTES = 4 << 30
 
 # Flat-stream padding granularity: chunks' flat sizes are rounded up to
 # this many ids so XLA sees a handful of shapes (compile cache), not one
-# per chunk. 2^19 u16 ids = 1 MB on the wire.
-_FLAT_BUCKET = 1 << 19
+# per chunk. Default 2^17 u16 ids = 256 KB on the wire. The round-5
+# bucket (2^19) silently ATE the ragged wire's entire byte saving at
+# the bench shape: an 8192-doc chunk's ~1.64M live ids rounded up to
+# 2.10M — exactly the padded [D, L] size — so bytes-on-wire never
+# dropped. 2^17 keeps the round-up waste under ~8% of a bench chunk
+# while chunk totals still concentrate tightly enough (law of large
+# numbers over thousands of docs) that a run sees only a couple of
+# distinct flat shapes, i.e. a couple of compiles, amortized by the
+# warmup. Tunable for the compile-count-vs-bytes trade; must be a
+# power of two >= the wire granule (the bucket pad is whole granules).
+_FLAT_BUCKET = int(os.environ.get("TFIDF_TPU_FLAT_BUCKET", str(1 << 17)))
+if _FLAT_BUCKET <= 0 or _FLAT_BUCKET & (_FLAT_BUCKET - 1):
+    raise ValueError(f"TFIDF_TPU_FLAT_BUCKET must be a positive power "
+                     f"of two, got {_FLAT_BUCKET}")
 
 
 def _bucket_pad_flat(flat: np.ndarray, total: int) -> np.ndarray:
     """Round a flat id stream up to a ``_FLAT_BUCKET`` multiple with
     zero fill. At least one bucket even for an all-empty chunk: a
     zero-size operand would fail the device gather's trace (and one
-    bucket is the shape small chunks land on anyway)."""
+    bucket is the shape small chunks land on anyway). The native flat
+    packers now allocate bucket-rounded capacity (``cap_ids``), so the
+    in-place branch is the only one they ever take — the ``np.pad``
+    copy remains for under-sized callers only."""
     pad = max(total + (-total % _FLAT_BUCKET), _FLAT_BUCKET) - total
     if total + pad <= flat.size:
         flat[total:total + pad] = 0  # never ship np.empty garbage
         return flat[:total + pad]
     return np.pad(flat[:total], (0, pad))
+
+
+def _bucket_cap_ids(chunk_docs: int, length: int, align: int) -> int:
+    """Staging capacity (in ids) of one chunk's flat wire buffer:
+    worst-case aligned content rounded up to whole ``_FLAT_BUCKET``\\ s
+    (minimum one), so ``_bucket_pad_flat`` always pads in place — the
+    wire leaves the packer with no re-pad copy."""
+    per_doc = -(-length // align) * align
+    cap = max(chunk_docs * per_doc, 1)
+    return cap + (-cap % _FLAT_BUCKET)
+
+
+# Ragged flat offsets are int32 and the stream ships in whole
+# _FLAT_BUCKET granules, so a chunk's aligned flat capacity must stay
+# below the last int32-addressable bucket boundary. Past it the padded
+# wire (which has no flat offsets) is selected automatically — the
+# same parity fallback --wire=padded forces.
+_RAGGED_MAX_IDS = (1 << 31) - _FLAT_BUCKET
+
+
+def use_ragged_wire(cfg: PipelineConfig, chunk_docs: int,
+                    length: int) -> bool:
+    """Resolve one run's chunk wire format from ``config.wire``:
+    True = the ragged (CSR-style) flat uint16 stream, False = the
+    padded [D, L] batch. ``"ragged"`` (the default) degrades to the
+    padded parity wire when the uint16 stream cannot carry the run:
+    vocab past 2^16, or a chunk whose aligned flat capacity would
+    cross the int32/_FLAT_BUCKET offset bound (``_RAGGED_MAX_IDS``).
+    ``"padded"`` forces the legacy bit-identical path everywhere."""
+    if getattr(cfg, "wire", "ragged") == "padded":
+        return False
+    if cfg.vocab_size > (1 << 16):
+        return False  # the uint16 wire cannot carry the ids
+    per_doc = -(-length // _wire_align()) * _wire_align()
+    return chunk_docs * per_doc <= _RAGGED_MAX_IDS
+
+
+def rebuild_method(explicit: Optional[str] = None) -> str:
+    """Resolve the device-side ragged→padded rebuild lowering:
+    ``"xla"`` (granule gather — the measured default) or ``"pallas"``
+    (the Mosaic granule-DMA kernel, ops/pallas_kernels). Override via
+    ``TFIDF_TPU_REBUILD``; resolved at trace time like
+    :func:`ops.sparse.join_method`."""
+    if explicit is not None:
+        return explicit
+    method = os.environ.get("TFIDF_TPU_REBUILD") or "xla"
+    if method not in ("xla", "pallas"):
+        raise ValueError(f"unknown TFIDF_TPU_REBUILD method {method!r}")
+    return method
+
+
+# Test/diagnostic hook: when set to a callable, the overlapped loops
+# report ("event", chunk_index) tuples as work is ISSUED — the
+# ordering contract of the double-buffered upload pipeline
+# (tests/test_wire.py pins that chunk i+1's pack is in flight before
+# chunk i's dispatch returns, and every upload precedes the fetch).
+_overlap_trace = None
+
+
+def _trace(event: str, idx: int = -1) -> None:
+    if _overlap_trace is not None:
+        _overlap_trace((event, idx))
+
+
+class _PackAhead:
+    """Double-buffered host packing: ONE worker thread runs the chunk
+    packer ahead of the dispatch loop, so chunk i+1's tokenize+hash
+    overlaps chunk i's ``device_put`` staging and program dispatch on
+    the main thread (the native packers release the GIL for the whole
+    per-token pass). Depth 2 (``TFIDF_TPU_PACK_AHEAD``) is the classic
+    double buffer: one chunk being consumed, one being packed.
+
+    Buffers are per-chunk numpy arrays rather than a reused ping-pong
+    pair: ``device_put`` may alias host memory zero-copy (and the
+    tunneled backend stages lazily), so rewriting a staging buffer
+    before its consuming program runs would corrupt the wire. True
+    pinned-memory staging needs allocator support numpy does not
+    expose; allocation is micro-seconds next to the pack itself.
+
+    ``get(i)`` blocks until chunk i's pack lands (the loop's only
+    stall), then immediately queues the next chunk. Exceptions from
+    the packer surface at ``get``. Single worker = packs retire in
+    submission order, which the exact-id intern table requires."""
+
+    def __init__(self, fn, items, depth: Optional[int] = None):
+        import concurrent.futures as cf
+        if depth is None:
+            depth = max(1, int(os.environ.get("TFIDF_TPU_PACK_AHEAD",
+                                              "2")))
+        self._fn = fn
+        self._items = list(items)
+        self._host_s = 0.0
+        self._ex = cf.ThreadPoolExecutor(max_workers=1)
+        self._futs = {}
+        self._next = 0
+        for _ in range(min(depth, len(self._items))):
+            self._submit()
+
+    def _submit(self) -> None:
+        i = self._next
+        if i >= len(self._items):
+            return
+        _trace("pack_submit", i)
+
+        def job(item=self._items[i]):
+            t0 = time.perf_counter()
+            out = self._fn(item)
+            self._host_s += time.perf_counter() - t0
+            return out
+
+        self._futs[i] = self._ex.submit(job)
+        self._next += 1
+
+    def get(self, i: int):
+        out = self._futs.pop(i).result()
+        _trace("pack_done", i)
+        self._submit()
+        return out
+
+    @property
+    def host_seconds(self) -> float:
+        """Wall-clock the worker spent packing (thread time — overlaps
+        the main thread's staging/dispatch; phases report it as
+        ``pack_host`` next to the stall-only ``pack``)."""
+        return self._host_s
+
+    def close(self) -> None:
+        self._ex.shutdown(wait=True, cancel_futures=True)
 
 
 def _chunk_step(wire_arr, lens, df_acc, cfg: PipelineConfig, length: int,
@@ -248,7 +473,8 @@ def _chunk_step(wire_arr, lens, df_acc, cfg: PipelineConfig, length: int,
     if ragged:
         return _chunk_ragged(wire_arr, lens, df_acc, length=length,
                              vocab_size=cfg.vocab_size,
-                             align=_WIRE_ALIGN, fold_df=fold_df)
+                             align=_wire_align(), fold_df=fold_df,
+                             rebuild=rebuild_method())
     return _chunk_sort_fold(wire_arr, lens, df_acc,
                             vocab_size=cfg.vocab_size, fold_df=fold_df)
 
@@ -675,15 +901,36 @@ def _run_overlapped_mesh(input_dir: str, cfg: PipelineConfig,
 
 
 def _check_chunk_fits_int32(chunk_docs: int, length: int) -> None:
-    """Flat-offset overflow guard (advisor r3): ``_ragged_to_padded``
-    builds int32 offsets, so a single chunk must hold < 2^31 ids
-    (the aligned layout rounds each doc up to ``_WIRE_ALIGN``)."""
-    per_doc = -(-length // _WIRE_ALIGN) * _WIRE_ALIGN
-    if chunk_docs * per_doc >= (1 << 31):
+    """Chunk-shape int32 guard (advisor r3): the ragged rebuild builds
+    int32 flat offsets and the row sort builds int32 slot positions,
+    so a single chunk must hold < 2^31 token slots on EITHER wire.
+    (The ragged wire's slightly tighter aligned-capacity bound no
+    longer raises — :func:`use_ragged_wire` degrades those chunks to
+    the padded wire instead.) Also revalidates the wire alignment so
+    a bad ``TFIDF_TPU_WIRE_ALIGN`` fails at this entry point by name."""
+    _wire_align()
+    if chunk_docs * length >= (1 << 31):
         raise ValueError(
             f"chunk of {chunk_docs} docs x {length} tokens overflows "
             f"int32 flat offsets; lower --chunk-docs or raise "
             f"TFIDF_TPU_MAX_CHUNKS")
+
+
+def _check_total_slots_fit_int32(total_rows: int, length: int) -> None:
+    """Total-resident-slots int32 guard (ADVICE round 5): the resident
+    finish program concatenates EVERY chunk's triples, and the
+    sort-join (``ops.sparse.df_slot_sorted``) builds int32 slot
+    indices over that concatenated [D_total * L] stream — a bound the
+    per-chunk check cannot see. In practice the HBM budget subsumes it
+    (2^31 slots carry ≈19 GB of triples before any sort workspace),
+    but past it the failure mode would be silent index wraparound, so
+    the bound is explicit here and re-asserted inside df_slot_sorted."""
+    if total_rows * length >= (1 << 31):
+        raise ValueError(
+            f"resident corpus of {total_rows} doc slots x {length} tokens "
+            f"overflows the finish program's int32 sort-join slot "
+            f"indices; lower TFIDF_TPU_RESIDENT_ELEMS so the streaming "
+            f"regime takes over, or reduce --doc-len")
 
 
 def _resident_df_mode() -> Tuple[str, bool]:
@@ -745,13 +992,20 @@ def make_flat_packer(input_dir: str, cfg: PipelineConfig, chunk_docs: int,
     use_native = (cfg.tokenizer is TokenizerKind.WHITESPACE
                   and fast_tokenizer.flat_available())
     padded = make_chunk_packer(input_dir, cfg, chunk_docs, length)
+    # Resolved (and validated) ONCE per packer so a whole run's layout
+    # is self-consistent; the rebuild side re-reads the same knob.
+    align = _wire_align()
+    # Bucket-rounded staging capacity: the native fill emits the wire
+    # ragged AND bucket-padded in one buffer (no host-side re-pad copy
+    # — _bucket_pad_flat always pads in place at this capacity).
+    cap = _bucket_cap_ids(chunk_docs, length, align)
 
     def pack_native(chunk_names: List[str]):
         out = fast_tokenizer.load_pack_flat(
             [os.path.join(input_dir, n) for n in chunk_names],
             cfg.vocab_size, cfg.hash_seed, cfg.truncate_tokens_at,
             max_per_doc=length, pad_docs_to=chunk_docs,
-            align=_WIRE_ALIGN)
+            align=align, cap_ids=cap)
         assert out is not None
         flat, lengths, total = out
         return _bucket_pad_flat(flat, total), lengths, total
@@ -760,7 +1014,7 @@ def make_flat_packer(input_dir: str, cfg: PipelineConfig, chunk_docs: int,
         ids, lengths = padded(chunk_names)
         # Aligned layout, identical to the native packer (the one
         # Python definition of the wire — flatten_aligned).
-        flat, total = flatten_aligned(ids, lengths)
+        flat, total = flatten_aligned(ids, lengths, align)
         return flat, lengths, total
 
     return pack_native if use_native else pack_python
@@ -927,9 +1181,11 @@ def _concat_rows(parts):
 _RESIDENT_ELEMS = 1 << 28
 
 
-@functools.partial(jax.jit, static_argnames=("topk",))
+@functools.partial(jax.jit, donate_argnums=(0,),
+                   static_argnames=("topk",))
 def _phase_b(token_ids, lengths, idf, *, topk: int):
-    """Score one chunk against the final corpus-wide IDF -> top-k."""
+    """Score one chunk against the final corpus-wide IDF -> top-k.
+    Wire buffer donated (fresh per chunk at every call site)."""
     ids, counts, head = sorted_term_counts(token_ids, lengths)
     scores = sparse_scores(ids, counts, head, lengths, idf)
     return sparse_topk(scores, ids, head, topk)
@@ -970,11 +1226,25 @@ class IngestResult:
     path: str = ""            # regime: "resident" | "streaming" |
                               # "resident-mesh" | "streaming-mesh"
     # Wall-clock phase breakdown of the run (seconds). Overlapped phases
-    # don't sum to the wall. Resident path: "pack" (synchronous host
-    # packing), "put" (upload/dispatch staging), "fetch" (the single
-    # unfenced result round trip — transfer/compute drain included).
-    # Streaming path: pack_a/pack_b, pass_a/pass_b, fetch.
+    # don't sum to the wall. Resident path: "pack" (stall waiting on
+    # the double-buffered packer thread — the only synchronous pack
+    # cost), "pack_host" (the packer thread's own wall, overlapped),
+    # "put" (upload/dispatch staging), "fetch" (the single unfenced
+    # result round trip — transfer/compute drain included).
+    # Streaming path: pack_a/pack_b (stalls), pack_host, pass_a/pass_b,
+    # fetch. Values are numeric only (cli --timing feeds them to
+    # PhaseTimer.add verbatim).
     phases: Optional[Dict[str, float]] = None
+    # Chunk wire format this run resolved to ("ragged" | "padded" —
+    # use_ragged_wire; mesh paths are always "padded" by design) and
+    # the actual host->device payload: bytes_on_wire counts every
+    # shipped wire buffer (flat stream or padded batch, plus lengths);
+    # bytes_on_wire_padded is what the SAME run would have shipped on
+    # the padded wire — the denominator of the bench's wire-ratio
+    # artifact field.
+    wire: str = ""
+    bytes_on_wire: Optional[int] = None
+    bytes_on_wire_padded: Optional[int] = None
 
 
 def make_chunk_packer(input_dir: str, cfg: PipelineConfig, chunk_docs: int,
@@ -1125,36 +1395,51 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
             pack_chunk = make_chunk_packer(input_dir, cfg, chunk_docs,
                                            length)
         _check_chunk_fits_int32(chunk_docs, length)
+        _check_total_slots_fit_int32(len(starts) * chunk_docs, length)
+        ragged = use_ragged_wire(cfg, chunk_docs, length)
         flat_pack = (make_flat_packer(input_dir, cfg, chunk_docs, length)
-                     if cfg.vocab_size <= (1 << 16) else None)
+                     if ragged else None)
 
         ph = {"pack": 0.0, "put": 0.0}
+        padded_chunk_bytes = chunk_docs * length * itemsize
+        bytes_wire = bytes_padded = 0
         df_acc = jnp.zeros((cfg.vocab_size,), jnp.int32)
         trip_i, trip_c, trip_h, len_parts, all_lengths = [], [], [], [], []
-        for start in starts:
-            chunk_names = names[start:start + chunk_docs]
-            t0 = time.perf_counter()
-            if flat_pack is not None:
-                flat, lengths, _ = flat_pack(chunk_names)
-            else:
-                token_ids, lengths = pack_chunk(chunk_names)
-            ph["pack"] += time.perf_counter() - t0
-            all_lengths.append(lengths[:len(chunk_names)])
-            t0 = time.perf_counter()
-            lens = jax.device_put(lengths)
-            # Sort + DF-fold this chunk NOW (async dispatch): the
-            # transfer+sort runs behind the host's packing of the next
-            # chunk, and the wire buffer is dead once consumed.
-            wire_arr = flat if flat_pack is not None else token_ids
-            i_, c_, h_, df_acc = _chunk_step(
-                jax.device_put(wire_arr), lens, df_acc, cfg, length,
-                ragged=flat_pack is not None,
-                fold_df=not _resident_df_mode()[1])
-            trip_i.append(i_)
-            trip_c.append(c_)
-            trip_h.append(h_)
-            len_parts.append(lens)
-            ph["put"] += time.perf_counter() - t0
+        # Double-buffered upload pipeline: the packer thread runs one
+        # chunk ahead, so chunk i+1's tokenize+hash overlaps chunk i's
+        # device_put staging and dispatch (which themselves overlap the
+        # device's transfer+sort of earlier chunks — see _PackAhead).
+        packer = _PackAhead(flat_pack if ragged else pack_chunk,
+                            [names[s:s + chunk_docs] for s in starts])
+        try:
+            for ci in range(len(starts)):
+                n_chunk = len(names[starts[ci]:starts[ci] + chunk_docs])
+                t0 = time.perf_counter()
+                packed = packer.get(ci)  # stall only; pack rides ahead
+                ph["pack"] += time.perf_counter() - t0
+                wire_arr, lengths = packed[0], packed[1]
+                all_lengths.append(lengths[:n_chunk])
+                bytes_wire += wire_arr.nbytes + lengths.nbytes
+                bytes_padded += padded_chunk_bytes + lengths.nbytes
+                t0 = time.perf_counter()
+                lens = jax.device_put(lengths)
+                # Sort + DF-fold this chunk NOW (async dispatch): the
+                # transfer+sort runs behind the host's packing of the
+                # next chunk, and the wire buffer is dead once consumed.
+                _trace("upload", ci)
+                i_, c_, h_, df_acc = _chunk_step(
+                    jax.device_put(wire_arr), lens, df_acc, cfg, length,
+                    ragged=ragged,
+                    fold_df=not _resident_df_mode()[1])
+                _trace("dispatch", ci)
+                trip_i.append(i_)
+                trip_c.append(c_)
+                trip_h.append(h_)
+                len_parts.append(lens)
+                ph["put"] += time.perf_counter() - t0
+        finally:
+            packer.close()
+        ph["pack_host"] = packer.host_seconds
         t0 = time.perf_counter()
         wide = cfg.vocab_size > (1 << 16)
         df_dev, wire = _finish_wire((trip_i, trip_c, trip_h), len_parts,
@@ -1163,7 +1448,9 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
         # ONE unfenced fetch = one link round trip: drain + transfer.
         # DF stays on device (jax.Array acts array-like; np.asarray
         # fetches it on first real read — no hot-path consumer does).
+        _trace("fetch_start")
         buf = np.asarray(jax.device_get(wire))
+        _trace("fetch_done")
         ph["fetch"] = time.perf_counter() - t0
         d_padded = len(starts) * chunk_docs
         vals, tids, occ = _decode_wire(buf, d_padded, k, wide, score_dtype,
@@ -1175,7 +1462,10 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
                             lengths=np.concatenate(all_lengths),
                             names=names, num_docs=num_docs,
                             df_occupied=occ,
-                            path="resident", phases=ph)
+                            path="resident", phases=ph,
+                            wire="ragged" if ragged else "padded",
+                            bytes_on_wire=bytes_wire,
+                            bytes_on_wire_padded=bytes_padded)
 
     # Pass A: fold every chunk's partial DF into one device accumulator.
     # The loop packs chunk i+1 while the device still runs chunk i
@@ -1189,13 +1479,19 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
     max_ahead = max(_LOOKAHEAD,
                     int(os.environ.get("TFIDF_TPU_INFLIGHT_BYTES", 1 << 29))
                     // chunk_bytes)
-    # Ragged flat wire whenever the vocab fits uint16 — same ~25% byte
-    # saving as the resident path, and spill="host" then caches the
-    # FLAT arrays, so pass B never re-packs at all (round-2 streaming
-    # paid a full second pack+pad per chunk even from RAM).
+    # Ragged flat wire by default (config.wire) — same ~25% byte saving
+    # as the resident path, and spill="host" then caches the FLAT
+    # arrays, so pass B never re-packs at all (round-2 streaming paid a
+    # full second pack+pad per chunk even from RAM). use_ragged_wire
+    # degrades to padded for wide vocabs / over-bucket chunks.
+    ragged = use_ragged_wire(cfg, chunk_docs, length)
     flat_pack = (make_flat_packer(input_dir, cfg, chunk_docs, length)
-                 if cfg.vocab_size <= (1 << 16) else None)
+                 if ragged else None)
+    align = _wire_align()
+    rebuild = rebuild_method()
     ph = {"pack_a": 0.0, "pack_b": 0.0}
+    padded_chunk_bytes = chunk_docs * length * itemsize
+    bytes_wire = bytes_padded = 0
     df_acc = jnp.zeros((cfg.vocab_size,), jnp.int32)
     cached: List[Optional[Tuple[np.ndarray, np.ndarray]]] = []
     all_lengths: List[np.ndarray] = []
@@ -1219,42 +1515,54 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
         if flat_pack is not None:
             return _phase_a_ragged(wire_arr, lens, df_acc, length=length,
                                    vocab_size=cfg.vocab_size,
-                                   align=_WIRE_ALIGN)
+                                   align=align, rebuild=rebuild)
         return _phase_a(wire_arr, lens, df_acc, vocab_size=cfg.vocab_size)
 
     def phase_b_any(wire_arr, lens, idf):
         if flat_pack is not None:
             return _phase_b_ragged(wire_arr, lens, idf, length=length,
-                                   topk=k, align=_WIRE_ALIGN)
+                                   topk=k, align=align, rebuild=rebuild)
         return _phase_b(wire_arr, lens, idf, topk=k)
 
     t_pass = time.perf_counter()
-    for ci, start in enumerate(starts):
-        chunk_names = names[start:start + chunk_docs]
-        t0 = time.perf_counter()
-        wire_arr, lengths = pack_any(chunk_names)
-        ph["pack_a"] += time.perf_counter() - t0
-        all_lengths.append(lengths[:len(chunk_names)])
-        if cache_bytes + chunk_cache_bytes <= cache_budget:
-            # Sort once, keep the triples: pass B scores these directly
-            # (_phase_b_cached) — no host cache, no re-pack, no re-sort
-            # for this chunk.
-            lens_dev = jax.device_put(lengths)
-            i_, c_, h_, df_acc = _chunk_step(
-                jax.device_put(wire_arr), lens_dev, df_acc, cfg, length,
-                ragged=flat_pack is not None)
-            trip_cache[ci] = (i_, c_, h_, lens_dev)
-            cache_bytes += chunk_cache_bytes
-            if spill == "host":
-                cached.append(None)  # pass B won't read the host copy
-        else:
-            if spill == "host":
-                cached.append((wire_arr, lengths))
-            df_acc = phase_a_any(jax.device_put(wire_arr),
-                                 jax.device_put(lengths), df_acc)
-        in_flight.append(df_acc)
-        if len(in_flight) > max_ahead:
-            in_flight.pop(0).block_until_ready()
+    # Pass A rides the same double-buffered packer thread as the
+    # resident path: chunk i+1 packs while chunk i stages/dispatches.
+    packer = _PackAhead(pack_any,
+                        [names[s:s + chunk_docs] for s in starts])
+    try:
+        for ci, start in enumerate(starts):
+            chunk_names = names[start:start + chunk_docs]
+            t0 = time.perf_counter()
+            wire_arr, lengths = packer.get(ci)
+            ph["pack_a"] += time.perf_counter() - t0  # stall only
+            all_lengths.append(lengths[:len(chunk_names)])
+            bytes_wire += wire_arr.nbytes + lengths.nbytes
+            bytes_padded += padded_chunk_bytes + lengths.nbytes
+            _trace("upload", ci)
+            if cache_bytes + chunk_cache_bytes <= cache_budget:
+                # Sort once, keep the triples: pass B scores these
+                # directly (_phase_b_cached) — no host cache, no
+                # re-pack, no re-sort for this chunk.
+                lens_dev = jax.device_put(lengths)
+                i_, c_, h_, df_acc = _chunk_step(
+                    jax.device_put(wire_arr), lens_dev, df_acc, cfg,
+                    length, ragged=ragged)
+                trip_cache[ci] = (i_, c_, h_, lens_dev)
+                cache_bytes += chunk_cache_bytes
+                if spill == "host":
+                    cached.append(None)  # pass B won't read the host copy
+            else:
+                if spill == "host":
+                    cached.append((wire_arr, lengths))
+                df_acc = phase_a_any(jax.device_put(wire_arr),
+                                     jax.device_put(lengths), df_acc)
+            _trace("dispatch", ci)
+            in_flight.append(df_acc)
+            if len(in_flight) > max_ahead:
+                in_flight.pop(0).block_until_ready()
+    finally:
+        packer.close()
+    ph["pack_host"] = packer.host_seconds
     df_acc.block_until_ready()
     ph["pass_a"] = time.perf_counter() - t_pass
     ph["triple_cached_chunks"] = float(len(trip_cache))
@@ -1263,41 +1571,63 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
 
     # Pass B: rescore each chunk against the corpus-wide IDF. Same
     # overlap structure; only the [chunk, K] selections accumulate on
-    # device, fetched in one transfer at the end.
+    # device, fetched in one transfer at the end. spill="reread"
+    # chunks ride their own pack-ahead pipeline (only the chunks the
+    # triple cache missed ever re-pack).
     vals_parts, ids_parts = [], []
     t_pass = time.perf_counter()
-    for ci, start in enumerate(starts):
-        if ci in trip_cache:
-            i_, c_, h_, lens_dev = trip_cache.pop(ci)
-            v, t = _phase_b_cached(i_, c_, h_, lens_dev, idf, topk=k)
+    reread = ([ci for ci in range(len(starts)) if ci not in trip_cache]
+              if spill == "reread" else [])
+    packer_b = (_PackAhead(pack_any,
+                           [names[starts[ci]:starts[ci] + chunk_docs]
+                            for ci in reread]) if reread else None)
+    bpos = 0
+    try:
+        for ci, start in enumerate(starts):
+            if ci in trip_cache:
+                i_, c_, h_, lens_dev = trip_cache.pop(ci)
+                v, t = _phase_b_cached(i_, c_, h_, lens_dev, idf, topk=k)
+                vals_parts.append(v)
+                ids_parts.append(t)
+                continue
+            if spill == "host":
+                wire_arr, lengths = cached[ci]
+            else:
+                t0 = time.perf_counter()
+                wire_arr, lengths = packer_b.get(bpos)
+                bpos += 1
+                ph["pack_b"] += time.perf_counter() - t0  # stall only
+            bytes_wire += wire_arr.nbytes + lengths.nbytes
+            bytes_padded += padded_chunk_bytes + lengths.nbytes
+            v, t = phase_b_any(jax.device_put(wire_arr),
+                               jax.device_put(lengths), idf)
             vals_parts.append(v)
             ids_parts.append(t)
-            continue
-        if spill == "host":
-            wire_arr, lengths = cached[ci]
-        else:
-            t0 = time.perf_counter()
-            wire_arr, lengths = pack_any(names[start:start + chunk_docs])
-            ph["pack_b"] += time.perf_counter() - t0
-        v, t = phase_b_any(jax.device_put(wire_arr),
-                           jax.device_put(lengths), idf)
-        vals_parts.append(v)
-        ids_parts.append(t)
-        if ci >= max_ahead:  # same byte-budgeted lookahead as pass A
-            vals_parts[ci - max_ahead].block_until_ready()
+            if ci >= max_ahead:  # same byte-budgeted lookahead as pass A
+                vals_parts[ci - max_ahead].block_until_ready()
+    finally:
+        if packer_b is not None:
+            packer_b.close()
+            ph["pack_host"] = (ph.get("pack_host", 0.0)
+                               + packer_b.host_seconds)
     jax.block_until_ready((vals_parts, ids_parts))
     ph["pass_b"] = time.perf_counter() - t_pass
 
     t0 = time.perf_counter()
+    _trace("fetch_start")
     df_host, vals, tids = jax.device_get(
         (df_acc, jnp.concatenate(vals_parts), jnp.concatenate(ids_parts)))
+    _trace("fetch_done")
     ph["fetch"] = time.perf_counter() - t0
     return IngestResult(df=df_host, topk_vals=vals[:num_docs],
                         topk_ids=tids[:num_docs],
                         lengths=np.concatenate(all_lengths), names=names,
                         num_docs=num_docs,
                         df_occupied=int((df_host > 0).sum()),
-                        path="streaming", phases=ph)
+                        path="streaming", phases=ph,
+                        wire="ragged" if ragged else "padded",
+                        bytes_on_wire=bytes_wire,
+                        bytes_on_wire_padded=bytes_padded)
 
 
 @dataclasses.dataclass
@@ -1369,6 +1699,11 @@ def run_overlapped_exact(input_dir: str,
     k = min(cfg.topk, length)
     chunk_docs, starts = _resident_chunking(num_docs, chunk_docs)
     _check_chunk_fits_int32(chunk_docs, length)
+    _check_total_slots_fit_int32(len(starts) * chunk_docs, length)
+    # The exact-id wire is inherently ragged (the intern packer only
+    # emits the flat stream); config.wire governs the hashed ingest.
+    align = _wire_align()
+    cap = _bucket_cap_ids(chunk_docs, length, align)
 
     # ``session``: an open InternSession to use and LEAVE OPEN (the
     # caller wants the table afterwards — e.g. the native exact_emit
@@ -1380,26 +1715,40 @@ def run_overlapped_exact(input_dir: str,
     with ctx as sess:
         df_acc = jnp.zeros((cfg.vocab_size,), jnp.int32)
         trip_i, trip_c, trip_h, len_parts, all_lengths = [], [], [], [], []
-        for start in starts:
-            chunk_names = names[start:start + chunk_docs]
-            t0 = time.perf_counter()
+
+        def pack_exact(chunk_names):
             flat, lengths, total = sess.pack_flat(
                 [os.path.join(input_dir, n) for n in chunk_names],
                 cfg.truncate_tokens_at, length, pad_docs_to=chunk_docs,
-                seed=cfg.hash_seed, align=_WIRE_ALIGN)
-            flat = _bucket_pad_flat(flat, total)
-            ph["pack"] += time.perf_counter() - t0
-            all_lengths.append(lengths[:len(chunk_names)])
-            t0 = time.perf_counter()
-            lens = jax.device_put(lengths)
-            i_, c_, h_, df_acc = _chunk_step(
-                jax.device_put(flat), lens, df_acc, cfg, length,
-                ragged=True, fold_df=not _resident_df_mode()[1])
-            trip_i.append(i_)
-            trip_c.append(c_)
-            trip_h.append(h_)
-            len_parts.append(lens)
-            ph["put"] += time.perf_counter() - t0
+                seed=cfg.hash_seed, align=align, cap_ids=cap)
+            return _bucket_pad_flat(flat, total), lengths, total
+
+        # Same double-buffered packer thread as the hashed resident
+        # path. The single worker keeps chunks in submission order,
+        # which the intern table REQUIRES (ids are assigned in first-
+        # appearance order across the whole corpus).
+        packer = _PackAhead(pack_exact,
+                            [names[s:s + chunk_docs] for s in starts])
+        try:
+            for ci in range(len(starts)):
+                n_chunk = len(names[starts[ci]:starts[ci] + chunk_docs])
+                t0 = time.perf_counter()
+                flat, lengths, _total = packer.get(ci)
+                ph["pack"] += time.perf_counter() - t0  # stall only
+                all_lengths.append(lengths[:n_chunk])
+                t0 = time.perf_counter()
+                lens = jax.device_put(lengths)
+                i_, c_, h_, df_acc = _chunk_step(
+                    jax.device_put(flat), lens, df_acc, cfg, length,
+                    ragged=True, fold_df=not _resident_df_mode()[1])
+                trip_i.append(i_)
+                trip_c.append(c_)
+                trip_h.append(h_)
+                len_parts.append(lens)
+                ph["put"] += time.perf_counter() - t0
+        finally:
+            packer.close()
+        ph["pack_host"] = packer.host_seconds
         t0 = time.perf_counter()
         _, wire = _finish_wire((trip_i, trip_c, trip_h), len_parts,
                                df_acc, num_docs, k, score_dtype, cfg,
@@ -1439,7 +1788,7 @@ def profile_resident(input_dir: str, config: Optional[PipelineConfig] = None,
     score_dtype = jax.dtypes.canonicalize_dtype(jnp.dtype(cfg.score_dtype))
     k = min(cfg.topk, length)
     chunk_docs, starts = _resident_chunking(num_docs, chunk_docs)
-    ragged = cfg.vocab_size <= (1 << 16)
+    ragged = use_ragged_wire(cfg, chunk_docs, length)
     pack = (make_flat_packer(input_dir, cfg, chunk_docs, length) if ragged
             else make_chunk_packer(input_dir, cfg, chunk_docs, length))
 
@@ -1447,6 +1796,17 @@ def profile_resident(input_dir: str, config: Optional[PipelineConfig] = None,
     t0 = time.perf_counter()
     packed = [pack(names[s:s + chunk_docs]) for s in starts]
     ph["pack"] = time.perf_counter() - t0
+    # Actual wire payload of the serialized profile (same buffers the
+    # upload phase stages) and the padded-format equivalent — the
+    # bench's bytes_on_wire fields for the fenced protocol.
+    use_native = (cfg.tokenizer is TokenizerKind.WHITESPACE
+                  and fast_tokenizer.loader_available())
+    itemsize = 2 if (use_native and cfg.vocab_size <= (1 << 16)) else 4
+    ph["bytes_on_wire"] = float(sum(p[0].nbytes + p[1].nbytes
+                                    for p in packed))
+    ph["bytes_on_wire_padded"] = float(
+        len(packed) * chunk_docs * length * itemsize
+        + sum(p[1].nbytes for p in packed))
 
     # The tunneled link stages device_put data and only moves it when a
     # consuming program runs (tools/link_probe.py vs the ab probes), so

@@ -27,12 +27,13 @@ _has_loader = False
 _has_open2 = False
 _has_rerank = False
 _has_flat = False
+_has_flat_v2 = False
 _has_intern = False
 
 
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _load_failed, _has_loader, _has_open2, _has_rerank, \
-        _has_flat, _has_intern
+        _has_flat, _has_flat_v2, _has_intern
     # The kill-switch wins even over an already-loaded library, and a
     # missing .so is not sticky (tests build it on demand mid-process).
     if os.environ.get("TFIDF_TPU_NO_NATIVE"):
@@ -97,6 +98,16 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int32), ctypes.c_int64]
         _has_flat = True
     except AttributeError:  # stale .so predating the flat packer
+        pass
+    try:
+        lib.loader_fill_flat_u16_v2.restype = ctypes.c_int64
+        lib.loader_fill_flat_u16_v2.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint16), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64]
+        _has_flat_v2 = True
+    except AttributeError:  # stale .so predating the capacity fill
         pass
     try:
         lib.intern_open.restype = ctypes.c_void_p
@@ -281,15 +292,19 @@ def flat_available() -> bool:
 def _flat_pack_scaffold(lib, paths: List[str], max_per_doc: int,
                         pad_docs_to: Optional[int],
                         n_threads: Optional[int], fill,
-                        dtype=np.uint16, align: int = 1):
+                        dtype=np.uint16, align: int = 1,
+                        cap_ids: Optional[int] = None):
     """Shared loader scaffolding of the flat packers (hashed and
     exact-id): path blob, parallel read (no count prepass), error
     mapping, buffer sizing, close. ``fill(handle, flat, lengths)``
     receives the numpy buffers, runs the per-token id pass, and
     returns total ids (or a negative sentinel the caller interprets).
     ``dtype`` is the wire id width (uint16, or int32 for wide caps);
-    ``align`` is the granule-aligned wire layout (ingest._WIRE_ALIGN):
-    each doc starts at a multiple of ``align`` ids."""
+    ``align`` is the granule-aligned wire layout (ingest._wire_align):
+    each doc starts at a multiple of ``align`` ids. ``cap_ids``
+    over-allocates the flat buffer to that many ids (callers pass the
+    bucket-rounded chunk capacity so the downstream bucket pad never
+    copies — the wire is emitted ragged AND ship-ready in one buffer)."""
     n_threads = n_threads or min(os.cpu_count() or 1, 16)
     blob = b"\0".join(p.encode() for p in paths) + b"\0"
     handle = lib.loader_open2(blob, len(paths), n_threads, 0)
@@ -300,7 +315,8 @@ def _flat_pack_scaffold(lib, paths: List[str], max_per_doc: int,
         d_padded = max(pad_docs_to or len(paths), len(paths))
         per_doc_cap = max_per_doc if align <= 1 \
             else -(-max_per_doc // align) * align
-        flat = np.empty((len(paths) * per_doc_cap,), dtype=dtype)
+        n_ids = max(len(paths) * per_doc_cap, cap_ids or 0)
+        flat = np.empty((n_ids,), dtype=dtype)
         lengths = np.zeros((d_padded,), dtype=np.int32)
         total = fill(handle, flat, lengths)
         return flat, lengths, int(total)
@@ -312,7 +328,8 @@ def load_pack_flat(paths: List[str], vocab_size: int, seed: int = 0,
                    truncate_at: Optional[int] = None,
                    max_per_doc: int = 256,
                    pad_docs_to: Optional[int] = None,
-                   n_threads: Optional[int] = None, align: int = 1):
+                   n_threads: Optional[int] = None, align: int = 1,
+                   cap_ids: Optional[int] = None):
     """Native ragged pack: read + tokenize + hash into a FLAT uint16
     stream (every doc back to back, no padding) plus per-doc lengths.
 
@@ -323,20 +340,36 @@ def load_pack_flat(paths: List[str], vocab_size: int, seed: int = 0,
     (``ingest._chunk_ragged``). Requires vocab_size <= 2^16. Returns
     ``(flat_ids, lengths, total)`` with ``lengths`` padded to
     ``pad_docs_to`` rows, or None when the native packer is missing.
+
+    ``cap_ids`` sizes the flat buffer to the bucket-rounded chunk
+    capacity; with the v2 native fill the tail ``[total, cap_ids)`` is
+    zero-filled in C++ too, so the buffer leaves native ragged AND
+    ship-ready — no host-side re-pad pass at all.
     """
     lib = _load()
     if lib is None or not _has_flat or not _has_open2 \
             or vocab_size > (1 << 16):
         return None
-    return _flat_pack_scaffold(
-        lib, paths, max_per_doc, pad_docs_to, n_threads,
-        lambda handle, flat, lens: lib.loader_fill_flat_u16(
+
+    def fill(handle, flat, lens):
+        if _has_flat_v2 and cap_ids:
+            return lib.loader_fill_flat_u16_v2(
+                handle, ctypes.c_uint64(seed), vocab_size,
+                truncate_at or 0, max_per_doc,
+                flat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+                ctypes.c_int64(flat.size),
+                lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                ctypes.c_int64(align))
+        return lib.loader_fill_flat_u16(
             handle, ctypes.c_uint64(seed), vocab_size, truncate_at or 0,
             max_per_doc,
             flat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
             lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            ctypes.c_int64(align)),
-        align=align)
+            ctypes.c_int64(align))
+
+    return _flat_pack_scaffold(lib, paths, max_per_doc, pad_docs_to,
+                               n_threads, fill, align=align,
+                               cap_ids=cap_ids)
 
 
 def rerank_available() -> bool:
@@ -460,10 +493,11 @@ class InternSession:
     def pack_flat(self, paths: List[str], truncate_at: Optional[int],
                   max_per_doc: int, pad_docs_to: Optional[int] = None,
                   seed: int = 0, n_threads: Optional[int] = None,
-                  align: int = 1):
+                  align: int = 1, cap_ids: Optional[int] = None):
         """Exact-id twin of :func:`load_pack_flat` (same return
-        contract, shared loader scaffold). The wire is uint16 up to a
-        2^16 cap and int32 beyond (wide-vocab exact mode). Raises
+        contract, shared loader scaffold, same ``cap_ids`` bucket-
+        capacity staging). The wire is uint16 up to a 2^16 cap and
+        int32 beyond (wide-vocab exact mode). Raises
         :class:`ExactVocabOverflow` when the corpus holds more distinct
         words than the table's cap."""
         lib = self._lib
@@ -482,7 +516,8 @@ class InternSession:
 
         flat, lengths, total = _flat_pack_scaffold(
             lib, paths, max_per_doc, pad_docs_to, n_threads, fill,
-            dtype=np.int32 if wide else np.uint16, align=align)
+            dtype=np.int32 if wide else np.uint16, align=align,
+            cap_ids=cap_ids)
         if total < 0:
             raise ExactVocabOverflow(
                 f"corpus exceeds {self.count} distinct words")

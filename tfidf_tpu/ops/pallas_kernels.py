@@ -176,3 +176,78 @@ def tf_df_pallas(token_ids: jax.Array, lengths: jax.Array, *,
 def default_interpret() -> bool:
     """Interpret mode unless we are actually on TPU hardware."""
     return jax.default_backend() != "tpu"
+
+
+# --- ragged wire rebuild ---------------------------------------------
+#
+# The ingest wire ships each chunk as ONE flat granule-aligned uint16
+# stream (ingest.flatten_aligned); the padded [D, L] batch is rebuilt
+# on device. The production lowering is an XLA granule gather
+# (ingest._ragged_to_padded); this kernel is the Mosaic variant
+# (TFIDF_TPU_REBUILD=pallas): the flat stream is viewed as [N/G, G]
+# granules, and each (doc, granule) grid step copies granule
+# offsets[d] + j of the stream into block (d, j) of the output — the
+# per-row dynamic start rides BlockSpec index_maps over a scalar-
+# prefetched offset vector, so the copy is pure block DMA with no
+# gather instruction at all. Out-of-range granules clamp to the last
+# one; their values land in masked slots (the sorted_term_counts
+# contract, same as the XLA lowering's clamp).
+#
+# MEASURED SCOPE: one G-id block per grid step is far below the
+# 128-lane tile the DMA engine likes, so this exists as the in-tree
+# demonstration and an A/B probe for the rebuild path; the XLA granule
+# gather stays the measured default (docs/SCALING.md round 5).
+
+
+def _rebuild_kernel(offs_ref, gran_ref, out_ref):
+    # All movement happens in the index_maps; the body is the copy.
+    del offs_ref
+    out_ref[...] = gran_ref[...]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("length", "align", "interpret"))
+def ragged_rebuild_pallas(flat: jax.Array, lengths: jax.Array, *,
+                          length: int, align: int,
+                          interpret: bool = False) -> jax.Array:
+    """Pallas twin of ``ingest._ragged_to_padded`` (aligned layout).
+
+    Args:
+      flat: [N] uint16/int32 granule-aligned flat id stream, N a
+        multiple of ``align`` (the bucket-padded wire guarantees it).
+      lengths: int32 [D] live tokens per doc.
+      length: static L of the rebuilt batch.
+      align: the wire granule G (>= 8 — smaller granules make no sense
+        as blocks; callers fall back to the XLA gather below that).
+      interpret: run in interpreter mode (CPU tests).
+
+    Returns int32 [D, length] — value-identical at live slots to the
+    XLA lowering (pinned by tests/test_wire.py); padding slots carry
+    clamped granule values that every consumer masks by ``lengths``.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    g = align
+    lg = -(-length // g)
+    d = lengths.shape[0]
+    gran = flat.reshape(-1, g).astype(jnp.int32)
+    ngran = gran.shape[0]
+    al = (jnp.maximum(lengths, 0) + g - 1) // g  # granules per doc
+    offg = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                            jnp.cumsum(al[:-1], dtype=jnp.int32)])
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(d, lg),
+        in_specs=[pl.BlockSpec(
+            (1, g),
+            lambda di, j, offs: (jnp.minimum(offs[di] + j, ngran - 1), 0))],
+        out_specs=pl.BlockSpec((1, g), lambda di, j, offs: (di, j)),
+    )
+    out = pl.pallas_call(
+        _rebuild_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((d, lg * g), jnp.int32),
+        interpret=interpret,
+    )(offg, gran)
+    return out[:, :length]

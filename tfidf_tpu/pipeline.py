@@ -25,7 +25,8 @@ import numpy as np
 from tfidf_tpu.config import PipelineConfig
 from tfidf_tpu.formatter import (format_records, format_sparse_records,
                                  to_output_bytes)
-from tfidf_tpu.io.corpus import Corpus, PackedBatch, pack_corpus
+from tfidf_tpu.io.corpus import (Corpus, PackedBatch, RaggedBatch,
+                                 pack_corpus)
 from tfidf_tpu.ops.histogram import df_from_counts, tf_counts, tf_counts_chunked
 from tfidf_tpu.ops.scoring import tfidf_dense
 from tfidf_tpu.ops.sparse import sparse_forward
@@ -267,9 +268,27 @@ class TfidfPipeline(PhaseTimedMixin):
                            getattr(self.config, "_engine_defaulted", False))
         return ShardedPipeline(plan, cfg, timer=self.timer)
 
+    def _place(self, batch):
+        """Device placement of either wire format. A PackedBatch ships
+        the padded [D, L] ids verbatim; a RaggedBatch ships the flat
+        aligned stream (bytes scale with real tokens, not D×L) and the
+        padded batch is rebuilt ON DEVICE (``ingest.rebuild_padded``) —
+        the minibatch twin of the overlapped ingest's ragged wire."""
+        lens = jnp.asarray(batch.lengths)
+        if isinstance(batch, RaggedBatch):
+            from tfidf_tpu.ingest import rebuild_padded
+            return rebuild_padded(jnp.asarray(batch.flat), lens,
+                                  length=batch.length,
+                                  align=batch.align), lens
+        return jnp.asarray(batch.token_ids), lens
+
     def run_packed(self, batch: PackedBatch) -> PipelineResult:
         cfg = self.config
         if cfg.mesh_shape:
+            # Mesh wire stays padded by doctrine (the shard_map bodies
+            # take [D, L] rows); a ragged minibatch rebuilds on host.
+            if isinstance(batch, RaggedBatch):
+                batch = batch.to_padded()
             return self._mesh_pipeline().run_packed(batch)
         if cfg.engine == "sparse":
             return self._run_sparse(batch)
@@ -279,7 +298,7 @@ class TfidfPipeline(PhaseTimedMixin):
         else:
             interpret = False
         with self._phase("transfer"):
-            toks, lens = jnp.asarray(batch.token_ids), jnp.asarray(batch.lengths)
+            toks, lens = self._place(batch)
             self._fence((toks, lens))
         with self._phase("compute"):
             out = _forward_jit(
@@ -314,7 +333,7 @@ class TfidfPipeline(PhaseTimedMixin):
         """Row-sparse engine: O(D x L) memory, no [D, V] materialization."""
         cfg = self.config
         with self._phase("transfer"):
-            toks, lens = jnp.asarray(batch.token_ids), jnp.asarray(batch.lengths)
+            toks, lens = self._place(batch)
             self._fence((toks, lens))
         with self._phase("compute"):
             out = _sparse_forward_jit(

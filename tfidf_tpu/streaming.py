@@ -32,7 +32,8 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from tfidf_tpu.config import PipelineConfig, VocabMode
-from tfidf_tpu.io.corpus import Corpus, PackedBatch, pack_corpus
+from tfidf_tpu.io.corpus import (Corpus, PackedBatch, RaggedBatch,
+                                 pack_corpus)
 from tfidf_tpu.ops.histogram import df_from_counts, tf_counts
 from tfidf_tpu.ops.scoring import idf_from_df, tfidf_dense
 from tfidf_tpu.ops.sparse import (sorted_term_counts, sparse_df,
@@ -197,7 +198,29 @@ class StreamingTfidf:
             num_docs=batch.num_docs, names=batch.names,
             vocab_size=batch.vocab_size, id_to_word=batch.id_to_word)
 
-    def _place(self, batch: PackedBatch):
+    def pack_ragged(self, corpus: Corpus,
+                    fixed_len: Optional[int] = None) -> RaggedBatch:
+        """Pack a minibatch in the ragged wire format (one flat aligned
+        id stream — host→device bytes scale with real tokens, not
+        D×L; ``io.corpus.pack_ragged``). ``fixed_len`` pins the rebuilt
+        batch's static L exactly like :meth:`pack` — without it each
+        new longest-doc length recompiles the update/score programs.
+        ``update``/``score`` accept the result directly: single-device
+        runs rebuild the padded batch ON DEVICE; mesh runs rebuild on
+        host (the mesh wire stays padded by doctrine)."""
+        from tfidf_tpu.io.corpus import ragged_from_packed
+        return ragged_from_packed(self.pack(corpus, fixed_len=fixed_len))
+
+    def _place(self, batch):
+        if isinstance(batch, RaggedBatch):
+            if self.plan is not None:
+                batch = batch.to_padded()  # mesh wire stays padded
+            else:
+                from tfidf_tpu.ingest import rebuild_padded
+                lens = jnp.asarray(batch.lengths)
+                return rebuild_padded(jnp.asarray(batch.flat), lens,
+                                      length=batch.length,
+                                      align=batch.align), lens
         toks, lens = jnp.asarray(batch.token_ids), jnp.asarray(batch.lengths)
         if self.plan is not None:
             toks = jax.device_put(toks, self.plan.sharding(self.plan.batch_spec()))
