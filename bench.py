@@ -360,6 +360,16 @@ def main() -> None:
             record["bytes_on_wire_padded"] = int(result.bytes_on_wire_padded)
             record["wire_ratio"] = round(
                 result.bytes_on_wire / result.bytes_on_wire_padded, 3)
+        # Downlink accounting (round 7): actual device->host result
+        # payload vs what the same selection costs as (int32 id,
+        # float32 score) pairs. result_wire_ratio <= 0.55 means the
+        # packed word wire carried the run.
+        if result.bytes_off_wire:
+            record["result_wire"] = result.result_wire
+            record["bytes_off_wire"] = int(result.bytes_off_wire)
+            record["bytes_off_wire_pair"] = int(result.bytes_off_wire_pair)
+            record["result_wire_ratio"] = round(
+                result.bytes_off_wire / result.bytes_off_wire_pair, 3)
         # Per-phase overlap efficiency: how much of the fenced
         # (serialized) phase wall the double-buffered pipeline hides.
         # pack_stall_s is the dispatch loop's only synchronous pack
@@ -384,6 +394,26 @@ def main() -> None:
             overlap["overlap_efficiency"] = round(
                 max(0.0, 1.0 - tpu_s / ser_sum), 3)
         record["overlap"] = overlap
+        # Downlink overlap efficiency (round 7): fetch_stall_s is the
+        # dispatch loop's only synchronous drain cost (waiting on the
+        # _DrainAhead worker after the last chunk's scoring was
+        # dispatched); fetch_host_s is the worker's own materialize+
+        # unpack wall, which overlapped scoring; fetch_hidden_frac is
+        # the fraction of the fenced serialized fetch the chunked
+        # async drain hid behind phase-B compute.
+        fetch_stall = float(rph.get("fetch", 0.0))
+        downlink = {
+            "fetch_stall_s": round(fetch_stall, 3),
+            "fetch_host_s": round(float(rph.get("fetch_host", 0.0)), 3),
+        }
+        if "fetch" in ser:
+            downlink["fetch_serialized_s"] = round(ser["fetch"], 3)
+            if ser["fetch"] > 0:
+                downlink["fetch_hidden_frac"] = round(
+                    max(0.0, 1.0 - fetch_stall / ser["fetch"]), 3)
+        if "fetch_warm" in ser:
+            downlink["fetch_warm_s"] = round(ser["fetch_warm"], 3)
+        record["downlink"] = downlink
         # THE artifact numbers: paired medians. Best-of fields keep the
         # old best-run semantics for continuity, explicitly labeled.
         med_ratio = float(np.median(ratios))

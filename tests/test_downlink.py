@@ -1,0 +1,315 @@
+"""The packed device→host result wire and the chunked async drain
+(ops/downlink + ingest round 7): word pack/unpack round-trip property
+(sign-bit sentinel, NaN pass-through, bf16 bit-exactness), packed-vs-
+pair engine parity on the resident, streaming, pipeline, and scoring
+paths, the _DrainAhead ordering/depth contracts, the wire-selection
+fallbacks, and the Pallas packing variant's bit-identity."""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tfidf_tpu import PipelineConfig
+from tfidf_tpu import ingest as ing
+from tfidf_tpu.config import VocabMode
+from tfidf_tpu.io.corpus import Corpus, pack_corpus
+from tfidf_tpu.ops import downlink as dl
+from tfidf_tpu.pipeline import TfidfPipeline
+
+
+def _cfg(**kw):
+    base = dict(vocab_mode=VocabMode.HASHED, vocab_size=1 << 10,
+                max_doc_len=64, doc_chunk=64, topk=5, engine="sparse")
+    base.update(kw)
+    return PipelineConfig(**base)
+
+
+@pytest.fixture
+def corpus_dir(tmp_path):
+    rng = np.random.default_rng(11)
+    for i in range(1, 41):
+        words = [f"w{rng.integers(0, 60)}"
+                 for _ in range(int(rng.integers(0, 40)))]
+        (tmp_path / f"doc{i}").write_text(" ".join(words))
+    return str(tmp_path)
+
+
+# fp16 carries 11 significand bits: relative rounding error <= 2^-11.
+FP16_RTOL = 1e-3
+
+
+class TestWordRoundTrip:
+    """pack -> unpack is the identity on ids and the 16-bit rounding
+    of scores; invalid slots decode to the (0, -1) contract."""
+
+    def test_property_random(self):
+        rng = np.random.default_rng(5)
+        for _ in range(10):
+            d, k = int(rng.integers(1, 30)), int(rng.integers(1, 9))
+            vals = np.abs(rng.normal(size=(d, k))).astype(np.float32)
+            tids = rng.integers(0, 1 << 16, (d, k)).astype(np.int32)
+            # force invalid slots (sub-k docs) into every draw
+            inv = rng.random((d, k)) < 0.25
+            tids[inv] = -1
+            words = np.asarray(dl.pack_result_words(vals, tids))
+            assert words.dtype == np.uint32 and words.shape == (d, k)
+            v, t = dl.unpack_result_words(words)
+            np.testing.assert_array_equal(t, np.where(inv, -1, tids))
+            assert (v[inv] == 0).all()
+            np.testing.assert_allclose(v[~inv], vals[~inv],
+                                       rtol=FP16_RTOL, atol=1e-7)
+
+    def test_id_boundary_and_zero_score(self):
+        # id 2^16-1 is the last carriable id; a legitimate 0.0 score
+        # (a term in every doc) must survive as VALID, not sentinel.
+        vals = np.array([[0.0, 1.5]], np.float32)
+        tids = np.array([[65535, 0]], np.int32)
+        v, t = dl.unpack_result_words(
+            np.asarray(dl.pack_result_words(vals, tids)))
+        np.testing.assert_array_equal(t, tids)
+        assert v[0, 0] == 0.0 and abs(v[0, 1] - 1.5) < 1e-3
+
+    def test_nan_passes_through(self):
+        # NaN compares False against the sign test, so it survives as
+        # NaN instead of being misread as the invalid sentinel.
+        vals = np.array([[np.nan, 2.0]], np.float32)
+        tids = np.array([[7, 9]], np.int32)
+        v, t = dl.unpack_result_words(
+            np.asarray(dl.pack_result_words(vals, tids)))
+        assert np.isnan(v[0, 0]) and t[0, 0] == 7
+        assert t[0, 1] == 9
+
+    def test_bf16_bits_are_float32_high_half(self):
+        # On a bfloat16 run the word's score half IS the float32 high
+        # half — the round trip is bit-exact at bf16 precision.
+        rng = np.random.default_rng(6)
+        vals32 = np.abs(rng.normal(size=(6, 4))).astype(np.float32)
+        vals = jnp.asarray(vals32, jnp.bfloat16)
+        tids = rng.integers(0, 1 << 16, (6, 4)).astype(np.int32)
+        words = np.asarray(dl.pack_result_words(vals, tids))
+        v, t = dl.unpack_result_words(words, score_dtype=jnp.bfloat16)
+        np.testing.assert_array_equal(
+            v.view(np.uint16), np.asarray(vals).view(np.uint16))
+        np.testing.assert_array_equal(t, tids)
+
+    def test_pallas_pack_bit_identical(self):
+        from tfidf_tpu.ops.pallas_kernels import pack_words_pallas
+        rng = np.random.default_rng(8)
+        vals = np.abs(rng.normal(size=(20, 5))).astype(np.float32)
+        tids = rng.integers(-1, 1 << 16, (20, 5)).astype(np.int32)
+        np.testing.assert_array_equal(
+            np.asarray(pack_words_pallas(vals, tids, interpret=True)),
+            np.asarray(dl.pack_result_words(vals, tids)))
+
+
+class TestWireSelection:
+    """result_wire resolution: packed by default, pair forced or
+    degraded-to automatically when the word cannot carry the run."""
+
+    def test_config_validates(self):
+        with pytest.raises(ValueError, match="result wire"):
+            _cfg(result_wire="zip")
+
+    def test_default_is_packed(self):
+        assert dl.use_packed_result_wire(_cfg())
+
+    def test_forced_pair(self):
+        assert not dl.use_packed_result_wire(_cfg(result_wire="pair"))
+
+    def test_no_topk_degrades(self):
+        assert not dl.use_packed_result_wire(_cfg(topk=None))
+
+    def test_wide_vocab_degrades(self):
+        assert dl.use_packed_result_wire(_cfg(vocab_size=1 << 16))
+        assert not dl.use_packed_result_wire(
+            _cfg(vocab_size=(1 << 16) + 1))
+        # explicit vocab bound (padded mesh vocab) wins over config's
+        assert not dl.use_packed_result_wire(
+            _cfg(), vocab_size=(1 << 16) + 8)
+
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv("TFIDF_TPU_RESULT_WIRE", "pair")
+        assert not dl.use_packed_result_wire(_cfg())
+        monkeypatch.setenv("TFIDF_TPU_RESULT_WIRE", "brotli")
+        with pytest.raises(ValueError, match="TFIDF_TPU_RESULT_WIRE"):
+            dl.use_packed_result_wire(_cfg())
+
+    def test_wide_vocab_run_reports_pair(self, corpus_dir):
+        r = ing.run_overlapped(corpus_dir,
+                               _cfg(vocab_size=(1 << 16) + 8),
+                               chunk_docs=16, doc_len=64)
+        assert r.result_wire == "pair"
+
+
+class TestEngineParity:
+    """The packed wire is bit-exact on ids and within fp16 rounding on
+    scores vs the pair wire, on every path that ships results."""
+
+    @pytest.mark.parametrize("regime", ["resident", "streaming"])
+    def test_run_overlapped(self, corpus_dir, regime, monkeypatch):
+        if regime == "streaming":
+            monkeypatch.setenv("TFIDF_TPU_RESIDENT_ELEMS", "0")
+            monkeypatch.setenv("TFIDF_TPU_TRIPLE_CACHE_BYTES", "0")
+        r_w = ing.run_overlapped(corpus_dir, _cfg(), chunk_docs=16,
+                                 doc_len=64)
+        r_p = ing.run_overlapped(corpus_dir, _cfg(result_wire="pair"),
+                                 chunk_docs=16, doc_len=64)
+        assert r_w.result_wire == "packed" and r_p.result_wire == "pair"
+        np.testing.assert_array_equal(r_w.topk_ids, r_p.topk_ids)
+        np.testing.assert_allclose(r_w.topk_vals, r_p.topk_vals,
+                                   rtol=FP16_RTOL, atol=1e-7)
+        np.testing.assert_array_equal(np.asarray(r_w.df),
+                                      np.asarray(r_p.df))
+        # the packed run's df is ALWAYS a host ndarray
+        assert isinstance(r_w.df, np.ndarray)
+        # byte receipt: one uint32 word vs (int32 id, float32 score)
+        assert r_w.bytes_off_wire == r_w.bytes_off_wire_pair // 2
+        assert r_p.bytes_off_wire > r_w.bytes_off_wire
+
+    @pytest.mark.parametrize("engine", ["sparse", "dense"])
+    def test_pipeline_run_packed(self, engine):
+        docs = [b"apple banana apple", b"", b"cherry date fig " * 8,
+                b"kiwi"]
+        corpus = Corpus(names=[f"doc{i}" for i in range(1, 5)],
+                        docs=docs)
+        cfg_w = _cfg(engine=engine, vocab_size=1 << 12, topk=4)
+        cfg_p = _cfg(engine=engine, vocab_size=1 << 12, topk=4,
+                     result_wire="pair")
+        r_w = TfidfPipeline(cfg_w).run_packed(pack_corpus(corpus, cfg_w))
+        r_p = TfidfPipeline(cfg_p).run_packed(pack_corpus(corpus, cfg_p))
+        np.testing.assert_array_equal(np.asarray(r_w.topk_ids),
+                                      np.asarray(r_p.topk_ids))
+        np.testing.assert_allclose(np.asarray(r_w.topk_vals),
+                                   np.asarray(r_p.topk_vals),
+                                   rtol=FP16_RTOL, atol=1e-7)
+        np.testing.assert_array_equal(np.asarray(r_w.df),
+                                      np.asarray(r_p.df))
+
+    def test_streaming_score(self):
+        from tfidf_tpu.streaming import StreamingTfidf
+        docs = [b"alpha beta alpha gamma", b"", b"delta " * 30]
+        corpus = Corpus(names=["doc1", "doc2", "doc3"], docs=docs)
+        cfg_w, cfg_p = _cfg(topk=3), _cfg(topk=3, result_wire="pair")
+        s_w, s_p = StreamingTfidf(cfg_w), StreamingTfidf(cfg_p)
+        b_w = s_w.pack(corpus, fixed_len=32)
+        b_p = s_p.pack(corpus, fixed_len=32)
+        s_w.update(b_w)
+        s_p.update(b_p)
+        v_w, i_w = s_w.score(b_w)
+        v_p, i_p = s_p.score(b_p)
+        # packed score() lands as host arrays, already decoded
+        assert isinstance(v_w, np.ndarray) and isinstance(i_w, np.ndarray)
+        np.testing.assert_array_equal(i_w, np.asarray(i_p))
+        np.testing.assert_allclose(v_w, np.asarray(v_p),
+                                   rtol=FP16_RTOL, atol=1e-7)
+
+
+class TestDrainAhead:
+    """_DrainAhead's contracts: chunk-major retirement regardless of
+    per-chunk unpack cost, bounded in-flight depth, and join-on-error
+    exception safety (context manager)."""
+
+    def test_results_chunk_major(self):
+        # chunk 0's unpack is the SLOWEST: a completion-ordered drain
+        # would retire 4..1 first. The single ordered worker must still
+        # hand results back chunk-major.
+        def unpack(arr):
+            i = int(arr[0])
+            time.sleep(0.03 if i == 0 else 0.001)
+            return i
+        with ing._DrainAhead(unpack, depth=8) as d:
+            for i in range(5):
+                d.put(i, jnp.full((4,), i, jnp.uint32))
+            assert d.results() == [0, 1, 2, 3, 4]
+
+    def test_depth_guard_bounds_in_flight(self):
+        done = []
+
+        def unpack(arr):
+            time.sleep(0.01)
+            done.append(int(arr[0]))
+            return int(arr[0])
+        with ing._DrainAhead(unpack, depth=1) as d:
+            for i in range(6):
+                d.put(i, jnp.full((2,), i, jnp.uint32))
+                if i >= 2:
+                    # past the depth window, put() blocked until the
+                    # oldest outstanding drain retired
+                    assert len(done) >= i - 1
+            assert d.results() == list(range(6))
+
+    def test_depth_validation(self, monkeypatch):
+        with pytest.raises(ValueError, match="TFIDF_TPU_FETCH_AHEAD"):
+            ing._DrainAhead(lambda a: a, depth=0)
+        monkeypatch.setenv("TFIDF_TPU_FETCH_AHEAD", "0")
+        with pytest.raises(ValueError, match="TFIDF_TPU_FETCH_AHEAD"):
+            ing._DrainAhead(lambda a: a)
+        monkeypatch.setenv("TFIDF_TPU_FETCH_AHEAD", "3")
+        with ing._DrainAhead(lambda a: a) as d:
+            assert d._depth == 3
+
+    def test_context_joins_on_error(self):
+        held = []
+        with pytest.raises(RuntimeError, match="boom"):
+            with ing._DrainAhead(lambda a: np.asarray(a)) as d:
+                held.append(d)
+                d.put(0, jnp.zeros((2,), jnp.uint32))
+                raise RuntimeError("boom")
+        assert held[0]._ex._shutdown  # worker joined, queue cancelled
+
+    def test_pack_ahead_context_joins_on_error(self):
+        held = []
+        with pytest.raises(RuntimeError, match="boom"):
+            with ing._PackAhead(lambda item: item, list(range(4))) as p:
+                held.append(p)
+                p.get(0)
+                raise RuntimeError("boom")
+        assert held[0]._ex._shutdown
+
+
+class TestDrainOverlap:
+    """Ordering contract of the chunked async drain on the real ingest
+    loops: every chunk's drain is submitted before the terminal fetch
+    stall, and drains retire in chunk order."""
+
+    def _trace_run(self, corpus_dir, **kw):
+        events = []
+        ing._overlap_trace = events.append
+        try:
+            ing.run_overlapped(corpus_dir, _cfg(), chunk_docs=10,
+                               doc_len=64, **kw)
+        finally:
+            ing._overlap_trace = None
+        return events
+
+    @pytest.mark.parametrize("regime", ["resident", "streaming"])
+    def test_drains_precede_fetch_stall(self, corpus_dir, regime,
+                                        monkeypatch):
+        if regime == "streaming":
+            monkeypatch.setenv("TFIDF_TPU_RESIDENT_ELEMS", "0")
+            monkeypatch.setenv("TFIDF_TPU_TRIPLE_CACHE_BYTES", "0")
+        events = self._trace_run(corpus_dir)
+        submits = [i for i, e in enumerate(events)
+                   if e[0] == "drain_submit"]
+        fetch_start = events.index(("fetch_start", -1))
+        assert len(submits) == 4  # 40 docs / 10-doc chunks
+        # chunk i's drain starts while later chunks still score: every
+        # submit precedes the terminal stall on the drain results.
+        assert all(s < fetch_start for s in submits)
+        # and the worker retires chunks in submission order
+        dones = [e[1] for e in events if e[0] == "drain_done"]
+        assert dones == sorted(dones) and len(dones) == 4
+
+    def test_pair_wire_has_no_drain(self, corpus_dir):
+        events = self._trace_run(corpus_dir)  # packed default
+        assert any(e[0] == "drain_submit" for e in events)
+        events = []
+        ing._overlap_trace = events.append
+        try:
+            ing.run_overlapped(corpus_dir, _cfg(result_wire="pair"),
+                               chunk_docs=10, doc_len=64)
+        finally:
+            ing._overlap_trace = None
+        assert not any(e[0] == "drain_submit" for e in events)

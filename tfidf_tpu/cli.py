@@ -72,6 +72,17 @@ def _build_parser() -> argparse.ArgumentParser:
                           "automatically for vocabs past 2^16 or chunks "
                           "whose flat stream would overflow the int32 "
                           "bucket bound")
+    run.add_argument("--result-wire", choices=["packed", "pair"],
+                     default="packed",
+                     help="device->host top-k result wire: 'packed' "
+                          "(default) ships one uint32 word per slot "
+                          "(16-bit score + uint16 id — half the bytes, "
+                          "chunked async drain on --doc-len runs; ids "
+                          "bit-exact, scores within fp16 rounding); "
+                          "'pair' forces the full-precision (id, score) "
+                          "pair wire — the bit-identical parity "
+                          "fallback, also selected automatically for "
+                          "vocabs past 2^16 or 64-bit score runs")
     run.add_argument("--exact-terms", action="store_true",
                      help="hashed+topk mode: re-rank the device top-k "
                           "on host with exact strings and DF, emitting "
@@ -203,6 +214,7 @@ def _run_tpu(args) -> int:
         use_pallas=args.pallas,
         mesh_shape=mesh_shape,
         wire=getattr(args, "wire", "ragged"),
+        result_wire=getattr(args, "result_wire", "packed"),
     )
     from tfidf_tpu.utils.timing import PhaseTimer, Throughput, phase_or_null
     timer = PhaseTimer() if args.timing else None

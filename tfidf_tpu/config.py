@@ -89,6 +89,16 @@ class PipelineConfig:
         when it cannot carry the run (vocab > 2^16, or a chunk whose
         aligned flat stream would overflow the int32/``_FLAT_BUCKET``
         offset bound — see ``ingest.use_ragged_wire``).
+      result_wire: device→host result wire for top-k selections.
+        "packed" (default) ships one uint32 word per selected slot —
+        16-bit score in the high half, uint16 vocab id in the low half
+        (half the pair wire's bytes; scores round to fp16/bf16, ids
+        stay bit-exact) and lets the chunked ingest drain results
+        asynchronously while later chunks score; "pair" forces the
+        full-precision (id, score) pair wire — the bit-identical
+        parity fallback, also selected automatically when the word
+        cannot carry the run (no topk, vocab > 2^16, or a 64-bit
+        score ask — see ``ops.downlink.use_packed_result_wire``).
     """
 
     vocab_mode: VocabMode = VocabMode.EXACT
@@ -112,11 +122,15 @@ class PipelineConfig:
     score_dtype: str = "float32"
     topk: Optional[int] = None
     wire: str = "ragged"
+    result_wire: str = "packed"
 
     def __post_init__(self):
         if self.wire not in ("ragged", "padded"):
             raise ValueError(f"unknown wire format {self.wire!r} "
                              f"(choose 'ragged' or 'padded')")
+        if self.result_wire not in ("packed", "pair"):
+            raise ValueError(f"unknown result wire {self.result_wire!r} "
+                             f"(choose 'packed' or 'pair')")
         if self.vocab_size <= 0:
             raise ValueError("vocab_size must be positive")
         lo, hi = self.ngram_range

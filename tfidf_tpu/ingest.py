@@ -7,19 +7,26 @@ of the link (tools/link_probe.py + tools/structure_sweep.py):
 ``device_put`` stages bytes and only moves them when a consuming program
 executes, and every D2H fetch costs ~100 ms of latency — so each chunk's
 program is dispatched the moment its wire buffer is staged (transfer +
-sort run behind the host's packing of the next chunk), and results
-travel as ONE packed buffer in one unfenced fetch. When the vocab fits
-uint16, the wire is a ragged FLAT id stream (no padding bytes;
-~25% smaller on the measured corpus) rebuilt into the padded batch by a
-single device gather.
+sort run behind the host's packing of the next chunk). When the vocab
+fits uint16, the upload wire is a ragged FLAT id stream (no padding
+bytes; ~25% smaller on the measured corpus) rebuilt into the padded
+batch by a single device gather, and the RESULT wire is its downlink
+twin (round 7, ``ops/downlink``): each top-k (score, id) pair packs
+into ONE uint32 word on device — half the drain bytes — and each
+chunk's word buffer rides ``copy_to_host_async`` while the next chunk
+scores (``_DrainAhead``), so the drain pipelines behind phase-B compute
+instead of serializing after the last FLOP. ``--result-wire=pair``
+keeps the bit-identical legacy wire: one fused finish program, one
+unfenced fetch.
 
 Two regimes, chosen by corpus size vs ``TFIDF_TPU_RESIDENT_ELEMS``:
 
 * **Resident** (fits on device): per chunk, one program sorts the rows
   into sparse triples and folds partial DF into a [V] accumulator; the
-  triples stay device-resident. A final program scores everything
-  against the corpus-wide IDF and packs (scores, topk ids) for the
-  single fetch. Nothing is ever re-read or re-sorted.
+  triples stay device-resident. Once the corpus-wide DF/IDF is final,
+  per-chunk scoring programs emit packed word buffers that drain
+  asynchronously (packed wire), or one fused program scores everything
+  for a single fetch (pair wire). Nothing is ever re-read or re-sorted.
 * **Streaming** (arbitrarily large): two passes, the reference's own
   reduce-then-rebroadcast choreography (``TFIDF.c:215-220``) —
   pass A folds each chunk's partial DF and keeps NOTHING else (device
@@ -48,6 +55,9 @@ from jax import lax
 from tfidf_tpu.config import PipelineConfig, TokenizerKind, VocabMode
 from tfidf_tpu.io import fast_tokenizer
 from tfidf_tpu.io.corpus import discover_names, pack_corpus
+from tfidf_tpu.ops.downlink import (pack_result_words, pack_words,
+                                    pair_slot_bytes, unpack_result_words,
+                                    use_packed_result_wire)
 from tfidf_tpu.ops.scoring import idf_from_df
 from tfidf_tpu.ops.sparse import (sorted_term_counts, sparse_df,
                                   sparse_forward, sparse_scores, sparse_topk)
@@ -283,6 +293,48 @@ def _phase_b_cached(ids, counts, head, lengths, idf, *, topk: int):
     return sparse_topk(scores, ids, head, topk)
 
 
+# Packed-wire twins of the pass-B kernels: same scoring, but the
+# (vals, tids) selection leaves the program as ONE [chunk, K] uint32
+# word buffer (ops/downlink) — contiguous, half the pair bytes, and
+# the unit the chunked async drain ships per chunk (_DrainAhead).
+@functools.partial(jax.jit, static_argnames=("topk",))
+def _phase_b_cached_packed(ids, counts, head, lengths, idf, *, topk: int):
+    scores = sparse_scores(ids, counts, head, lengths, idf)
+    return pack_result_words(*sparse_topk(scores, ids, head, topk))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,),
+                   static_argnames=("length", "topk", "align", "rebuild"))
+def _phase_b_ragged_packed(flat, lengths, idf, *, length: int, topk: int,
+                           align: int, rebuild: str = "xla"):
+    tok = _ragged_to_padded(flat, lengths, length, align, rebuild)
+    ids, counts, head = sorted_term_counts(tok, lengths)
+    scores = sparse_scores(ids, counts, head, lengths, idf)
+    return pack_result_words(*sparse_topk(scores, ids, head, topk))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,),
+                   static_argnames=("topk",))
+def _phase_b_padded_packed(token_ids, lengths, idf, *, topk: int):
+    ids, counts, head = sorted_term_counts(token_ids, lengths)
+    scores = sparse_scores(ids, counts, head, lengths, idf)
+    return pack_result_words(*sparse_topk(scores, ids, head, topk))
+
+
+# DF finisher of the packed-drain resident path when the chunk folds
+# were skipped (the sort-join fold-skip, _resident_df_mode): one global
+# sort over the concatenated triples derives the [V] DF vector —
+# identical counts to the per-chunk folds (DF is additive over chunks).
+# The fused _finish_wire derived this inside its own sort; with the
+# finish split back into per-chunk scoring dispatches, the derivation
+# stands alone.
+@functools.partial(jax.jit, static_argnames=("vocab_size",))
+def _df_from_trips(ids_parts, head_parts, *, vocab_size: int):
+    cat = (lambda parts: parts[0] if len(parts) == 1
+           else jnp.concatenate(parts, axis=0))
+    return sparse_df(cat(ids_parts), cat(head_parts), vocab_size)
+
+
 # Streaming triple cache budget: pass A keeps each chunk's sorted
 # triples (ids+counts int32 + head bool = 9 B/slot) device-resident up
 # to this many bytes, so pass B re-derives nothing for cached chunks.
@@ -407,7 +459,13 @@ class _PackAhead:
     ``get(i)`` blocks until chunk i's pack lands (the loop's only
     stall), then immediately queues the next chunk. Exceptions from
     the packer surface at ``get``. Single worker = packs retire in
-    submission order, which the exact-id intern table requires."""
+    submission order, which the exact-id intern table requires.
+
+    A context manager: ``with _PackAhead(...) as packer`` joins the
+    worker thread and cancels queued packs even when a chunk step
+    raises mid-loop — otherwise an in-flight pack could outlive the
+    loop holding its wire buffer (and, on the exact path, keep
+    mutating the shared intern table)."""
 
     def __init__(self, fn, items, depth: Optional[int] = None):
         import concurrent.futures as cf
@@ -453,6 +511,88 @@ class _PackAhead:
 
     def close(self) -> None:
         self._ex.shutdown(wait=True, cancel_futures=True)
+
+    def __enter__(self) -> "_PackAhead":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class _DrainAhead:
+    """Bounded asynchronous device→host result drain — the downlink
+    twin of :class:`_PackAhead`. ``put(i, words)`` starts the packed
+    buffer's ``copy_to_host_async`` on the main thread (so the transfer
+    of chunk i's words rides behind the device's scoring of chunk i+1)
+    and queues the host-side materialize+unpack on ONE worker thread;
+    ``results()`` returns the unpacked ``(vals, ids)`` per chunk.
+
+    Depth (``TFIDF_TPU_FETCH_AHEAD``, default 2 — one buffer landing
+    while the next chunk scores) bounds the copies in flight: past it,
+    ``put`` blocks on the oldest outstanding drain, which also bounds
+    the device-side dispatch queue (a chunk's copy can only complete
+    after its scoring does). The single worker retires chunks in
+    submission order, so results land CHUNK-MAJOR regardless of
+    completion order — the drain's ordering contract
+    (tests/test_downlink.py).
+
+    A context manager for the same exception-safety reason as
+    ``_PackAhead``: ``close()`` joins the worker and cancels queued
+    unpacks when the dispatch loop raises mid-drain."""
+
+    def __init__(self, unpack, depth: Optional[int] = None):
+        import concurrent.futures as cf
+        if depth is None:
+            depth = int(os.environ.get("TFIDF_TPU_FETCH_AHEAD", "2"))
+        if depth < 1:
+            raise ValueError(
+                f"TFIDF_TPU_FETCH_AHEAD must be >= 1, got {depth}")
+        self._unpack = unpack
+        self._depth = depth
+        self._ex = cf.ThreadPoolExecutor(max_workers=1)
+        self._futs: List = []
+        self._waited = 0
+        self._host_s = 0.0
+
+    def put(self, idx: int, words) -> None:
+        # Start the D2H copy NOW (async): the tunneled link moves the
+        # bytes while the device scores later chunks; the worker's
+        # np.asarray then mostly finds them already on host.
+        words.copy_to_host_async()
+        _trace("drain_submit", idx)
+
+        def job(words=words, idx=idx):
+            t0 = time.perf_counter()
+            out = self._unpack(np.asarray(words))
+            self._host_s += time.perf_counter() - t0
+            _trace("drain_done", idx)
+            return out
+
+        self._futs.append(self._ex.submit(job))
+        # Depth guard: never more than `depth` drains outstanding.
+        while len(self._futs) - self._waited > self._depth:
+            self._futs[self._waited].result()
+            self._waited += 1
+
+    def results(self) -> List:
+        """Block until every submitted drain lands; chunk-major."""
+        return [f.result() for f in self._futs]
+
+    @property
+    def host_seconds(self) -> float:
+        """Wall-clock the worker spent materializing+unpacking (thread
+        time — overlaps the main thread's scoring dispatches; phases
+        report it as ``fetch_host`` next to the stall-only ``fetch``)."""
+        return self._host_s
+
+    def close(self) -> None:
+        self._ex.shutdown(wait=True, cancel_futures=True)
+
+    def __enter__(self) -> "_DrainAhead":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 def _chunk_step(wire_arr, lens, df_acc, cfg: PipelineConfig, length: int,
@@ -696,16 +836,28 @@ def _run_overlapped_mesh_streaming(input_dir: str, cfg: PipelineConfig,
     ph["pass_b"] = time.perf_counter() - t_pass
 
     t0 = time.perf_counter()
-    df_host, vals, tids = _fetch_global(
-        (df_total, jnp.concatenate(vals_parts),
-         jnp.concatenate(ids_parts)))
+    cat_v, cat_t = jnp.concatenate(vals_parts), jnp.concatenate(ids_parts)
+    bytes_pair = cat_t.size * pair_slot_bytes(score_dtype)
+    # Packed result wire: the per-shard selections cross the link as
+    # uint32 words (elementwise device pack, no collective) — half the
+    # pair bytes on the same batched fetch.
+    if use_packed_result_wire(cfg):
+        words = pack_words(cat_v, cat_t)
+        df_host, words_h = _fetch_global((df_total, words))
+        vals, tids = unpack_result_words(words_h, score_dtype=score_dtype)
+        rw, bytes_off = "packed", words_h.nbytes
+    else:
+        df_host, vals, tids = _fetch_global((df_total, cat_v, cat_t))
+        rw, bytes_off = "pair", vals.nbytes + tids.nbytes
     ph["fetch"] = time.perf_counter() - t0
     return IngestResult(df=df_host, topk_vals=vals[:num_docs],
                         topk_ids=tids[:num_docs],
                         lengths=np.concatenate(all_lengths), names=names,
                         num_docs=num_docs,
                         df_occupied=int((df_host > 0).sum()),
-                        path="streaming-mesh", phases=ph)
+                        path="streaming-mesh", phases=ph,
+                        result_wire=rw, bytes_off_wire=int(bytes_off),
+                        bytes_off_wire_pair=int(bytes_pair))
 
 
 def _put_sharded(arr: np.ndarray, sh) -> jax.Array:
@@ -876,11 +1028,31 @@ def _run_overlapped_mesh(input_dir: str, cfg: PipelineConfig,
     # device — same contract as _score_pack_wire's ids-only wire,
     # except invalid slots keep their -1 (no bucket-0 stand-in). The
     # occupied-bucket scalar joins the same fetch (margin_check feed).
+    #
+    # Round 7: the [V] DF vector joins the SAME batched _fetch_global,
+    # so IngestResult.df is one type (a host ndarray) on every ingest
+    # path — the old mesh result held a live device array no other
+    # path produced. On the packed result wire the (vals, tids)
+    # selection crosses the link as uint32 words, packed ON DEVICE per
+    # shard (pack_words is elementwise, so each shard packs its own
+    # rows — no collective); the host-side shard-major reorder is
+    # unchanged, it just follows the unpack.
     occ_dev = (df_dev > 0).sum(dtype=jnp.int32)
-    if wire_vals:
-        vals, tids, occ = _fetch_global((vals, tids, occ_dev))
+    packed_wire = wire_vals and use_packed_result_wire(cfg)
+    bytes_pair = tids.size * pair_slot_bytes(score_dtype)
+    if packed_wire:
+        words = pack_words(vals, tids)
+        df_host, words_h, occ = _fetch_global((df_dev, words, occ_dev))
+        vals, tids = unpack_result_words(words_h, score_dtype=score_dtype)
+        bytes_off = words_h.nbytes
+    elif wire_vals:
+        df_host, vals, tids, occ = _fetch_global((df_dev, vals, tids,
+                                                  occ_dev))
+        bytes_off = vals.nbytes + tids.nbytes
     else:
-        vals, (tids, occ) = None, _fetch_global((tids, occ_dev))
+        vals = None
+        df_host, tids, occ = _fetch_global((df_dev, tids, occ_dev))
+        bytes_off = tids.nbytes
     ph["fetch"] = time.perf_counter() - t0
 
     # The sharded outputs come back shard-major (shard s's chunks are
@@ -891,13 +1063,16 @@ def _run_overlapped_mesh(input_dir: str, cfg: PipelineConfig,
                .transpose(1, 0, 2, 3).reshape(n_chunks * chunk_docs, -1))
     vals = reorder(vals) if vals is not None else None
     tids = reorder(tids)
-    return IngestResult(df=df_dev,
+    return IngestResult(df=df_host,
                         topk_vals=(vals[:num_docs]
                                    if vals is not None else None),
                         topk_ids=tids[:num_docs],
                         lengths=np.concatenate(all_lengths), names=names,
                         num_docs=num_docs, df_occupied=int(occ),
-                        path="resident-mesh", phases=ph)
+                        path="resident-mesh", phases=ph,
+                        result_wire="packed" if packed_wire else "pair",
+                        bytes_off_wire=int(bytes_off),
+                        bytes_off_wire_pair=int(bytes_pair))
 
 
 def _check_chunk_fits_int32(chunk_docs: int, length: int) -> None:
@@ -1210,8 +1385,10 @@ class IngestResult:
     should consume such results.
     """
 
-    df: np.ndarray            # [V] corpus DF (resident path: a device-
-                              # resident jax.Array; np.asarray fetches)
+    df: np.ndarray            # [V] corpus DF — a host ndarray on every
+                              # path except the pair-wire resident run,
+                              # which keeps its pre-round-7 device-
+                              # resident jax.Array (np.asarray fetches)
     topk_vals: Optional[np.ndarray]  # [D, K] top-k TF-IDF scores
                                      # (None when wire_vals=False)
     topk_ids: np.ndarray      # [D, K] matching vocab ids (-1 = no term;
@@ -1245,6 +1422,20 @@ class IngestResult:
     wire: str = ""
     bytes_on_wire: Optional[int] = None
     bytes_on_wire_padded: Optional[int] = None
+    # Device→host result wire this run resolved to ("packed" | "pair" —
+    # ops.downlink.use_packed_result_wire) and the actual result
+    # payload drained off the device: bytes_off_wire counts every
+    # shipped top-k result buffer (uint32 words, or the pair wire's
+    # packed byte buffer / raw (vals, ids) fetch); bytes_off_wire_pair
+    # is what the SAME selection costs as (int32 id, score_dtype
+    # score) pairs — the denominator of the bench's result_wire_ratio.
+    # On the packed wire, IngestResult.df is ALWAYS a host ndarray
+    # (the [V] vector rides an async copy overlapped with phase-B
+    # scoring); the pair-wire resident path keeps its device-resident
+    # lazy df, bit-identical to pre-packed-wire behavior.
+    result_wire: str = ""
+    bytes_off_wire: Optional[int] = None
+    bytes_off_wire_pair: Optional[int] = None
 
 
 def make_chunk_packer(input_dir: str, cfg: PipelineConfig, chunk_docs: int,
@@ -1409,9 +1600,9 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
         # chunk ahead, so chunk i+1's tokenize+hash overlaps chunk i's
         # device_put staging and dispatch (which themselves overlap the
         # device's transfer+sort of earlier chunks — see _PackAhead).
-        packer = _PackAhead(flat_pack if ragged else pack_chunk,
-                            [names[s:s + chunk_docs] for s in starts])
-        try:
+        with _PackAhead(flat_pack if ragged else pack_chunk,
+                        [names[s:s + chunk_docs] for s in starts]) \
+                as packer:
             for ci in range(len(starts)):
                 n_chunk = len(names[starts[ci]:starts[ci] + chunk_docs])
                 t0 = time.perf_counter()
@@ -1437,9 +1628,57 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
                 trip_h.append(h_)
                 len_parts.append(lens)
                 ph["put"] += time.perf_counter() - t0
-        finally:
-            packer.close()
         ph["pack_host"] = packer.host_seconds
+        d_padded = len(starts) * chunk_docs
+        common = dict(lengths=np.concatenate(all_lengths), names=names,
+                      num_docs=num_docs, path="resident",
+                      wire="ragged" if ragged else "padded",
+                      bytes_on_wire=bytes_wire,
+                      bytes_on_wire_padded=bytes_padded,
+                      bytes_off_wire_pair=(d_padded * k
+                                           * pair_slot_bytes(score_dtype)))
+        if wire_vals and use_packed_result_wire(cfg):
+            # Chunked async drain (round 7): the finish splits back into
+            # per-chunk scoring dispatches against the final IDF
+            # (_phase_b_cached_packed over the resident triples), and
+            # chunk i's packed word buffer rides copy_to_host_async
+            # while chunk i+1 scores — where the fused finish serialized
+            # the whole [D, K] drain behind the last FLOP.
+            t0 = time.perf_counter()
+            df_dev = (_df_from_trips(tuple(trip_i), tuple(trip_h),
+                                     vocab_size=cfg.vocab_size)
+                      if _resident_df_mode()[1] else df_acc)
+            idf = _final_idf(df_dev, jnp.int32(num_docs),
+                             score_dtype=score_dtype)
+            # The [V] DF rides its own async copy behind the scoring
+            # queue — the host read at the end finds it landed, where a
+            # synchronous fetch would charge a full link round trip.
+            df_dev.copy_to_host_async()
+            bytes_off = 0
+            with _DrainAhead(functools.partial(
+                    unpack_result_words, score_dtype=score_dtype)) \
+                    as drain:
+                for ci in range(len(starts)):
+                    words = _phase_b_cached_packed(
+                        trip_i[ci], trip_c[ci], trip_h[ci], len_parts[ci],
+                        idf, topk=k)
+                    bytes_off += words.nbytes
+                    drain.put(ci, words)
+                ph["score_b"] = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                _trace("fetch_start")
+                parts = drain.results()  # chunk-major by construction
+                _trace("fetch_done")
+            df_host = np.asarray(df_dev)
+            ph["fetch"] = time.perf_counter() - t0  # stall only
+            ph["fetch_host"] = drain.host_seconds
+            vals = np.concatenate([p[0] for p in parts])
+            tids = np.concatenate([p[1] for p in parts])
+            return IngestResult(df=df_host, topk_vals=vals[:num_docs],
+                                topk_ids=tids[:num_docs],
+                                df_occupied=int((df_host > 0).sum()),
+                                phases=ph, result_wire="packed",
+                                bytes_off_wire=bytes_off, **common)
         t0 = time.perf_counter()
         wide = cfg.vocab_size > (1 << 16)
         df_dev, wire = _finish_wire((trip_i, trip_c, trip_h), len_parts,
@@ -1452,20 +1691,15 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
         buf = np.asarray(jax.device_get(wire))
         _trace("fetch_done")
         ph["fetch"] = time.perf_counter() - t0
-        d_padded = len(starts) * chunk_docs
         vals, tids, occ = _decode_wire(buf, d_padded, k, wide, score_dtype,
                                        include_vals=wire_vals)
         return IngestResult(df=df_dev,
                             topk_vals=(vals[:num_docs]
                                        if vals is not None else None),
                             topk_ids=tids[:num_docs],
-                            lengths=np.concatenate(all_lengths),
-                            names=names, num_docs=num_docs,
                             df_occupied=occ,
-                            path="resident", phases=ph,
-                            wire="ragged" if ragged else "padded",
-                            bytes_on_wire=bytes_wire,
-                            bytes_on_wire_padded=bytes_padded)
+                            phases=ph, result_wire="pair",
+                            bytes_off_wire=buf.nbytes, **common)
 
     # Pass A: fold every chunk's partial DF into one device accumulator.
     # The loop packs chunk i+1 while the device still runs chunk i
@@ -1489,6 +1723,9 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
                  if ragged else None)
     align = _wire_align()
     rebuild = rebuild_method()
+    # Result-wire format, resolved once per run like the upload wire
+    # (streaming treats wire_vals as advisory and always ships scores).
+    packed_wire = use_packed_result_wire(cfg)
     ph = {"pack_a": 0.0, "pack_b": 0.0}
     padded_chunk_bytes = chunk_docs * length * itemsize
     bytes_wire = bytes_padded = 0
@@ -1520,16 +1757,17 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
 
     def phase_b_any(wire_arr, lens, idf):
         if flat_pack is not None:
-            return _phase_b_ragged(wire_arr, lens, idf, length=length,
-                                   topk=k, align=align, rebuild=rebuild)
-        return _phase_b(wire_arr, lens, idf, topk=k)
+            fn = _phase_b_ragged_packed if packed_wire else _phase_b_ragged
+            return fn(wire_arr, lens, idf, length=length,
+                      topk=k, align=align, rebuild=rebuild)
+        fn = _phase_b_padded_packed if packed_wire else _phase_b
+        return fn(wire_arr, lens, idf, topk=k)
 
     t_pass = time.perf_counter()
     # Pass A rides the same double-buffered packer thread as the
     # resident path: chunk i+1 packs while chunk i stages/dispatches.
-    packer = _PackAhead(pack_any,
-                        [names[s:s + chunk_docs] for s in starts])
-    try:
+    with _PackAhead(pack_any,
+                    [names[s:s + chunk_docs] for s in starts]) as packer:
         for ci, start in enumerate(starts):
             chunk_names = names[start:start + chunk_docs]
             t0 = time.perf_counter()
@@ -1560,8 +1798,6 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
             in_flight.append(df_acc)
             if len(in_flight) > max_ahead:
                 in_flight.pop(0).block_until_ready()
-    finally:
-        packer.close()
     ph["pack_host"] = packer.host_seconds
     df_acc.block_until_ready()
     ph["pass_a"] = time.perf_counter() - t_pass
@@ -1570,55 +1806,94 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
     idf = _final_idf(df_acc, jnp.int32(num_docs), score_dtype=score_dtype)
 
     # Pass B: rescore each chunk against the corpus-wide IDF. Same
-    # overlap structure; only the [chunk, K] selections accumulate on
-    # device, fetched in one transfer at the end. spill="reread"
-    # chunks ride their own pack-ahead pipeline (only the chunks the
-    # triple cache missed ever re-pack).
+    # overlap structure. On the packed result wire (the default,
+    # ops/downlink) each chunk's [chunk, K] selection leaves its
+    # scoring program as one uint32 word buffer whose async drain
+    # overlaps the NEXT chunk's scoring (_DrainAhead) — the two-pass
+    # regime's whole result fetch pipelines away; the pair wire keeps
+    # the legacy single device_get of the accumulated device parts.
+    # spill="reread" chunks ride their own pack-ahead pipeline (only
+    # the chunks the triple cache missed ever re-pack).
+    if packed_wire:
+        # The final [V] DF read is a plain host copy by then: start
+        # its transfer now, behind pass B's scoring.
+        df_acc.copy_to_host_async()
     vals_parts, ids_parts = [], []
+    bytes_off = 0
     t_pass = time.perf_counter()
     reread = ([ci for ci in range(len(starts)) if ci not in trip_cache]
               if spill == "reread" else [])
     packer_b = (_PackAhead(pack_any,
                            [names[starts[ci]:starts[ci] + chunk_docs]
                             for ci in reread]) if reread else None)
+    drain = (_DrainAhead(functools.partial(unpack_result_words,
+                                           score_dtype=score_dtype))
+             if packed_wire else None)
     bpos = 0
     try:
         for ci, start in enumerate(starts):
             if ci in trip_cache:
                 i_, c_, h_, lens_dev = trip_cache.pop(ci)
-                v, t = _phase_b_cached(i_, c_, h_, lens_dev, idf, topk=k)
-                vals_parts.append(v)
-                ids_parts.append(t)
-                continue
-            if spill == "host":
-                wire_arr, lengths = cached[ci]
+                if packed_wire:
+                    words = _phase_b_cached_packed(i_, c_, h_, lens_dev,
+                                                   idf, topk=k)
+                else:
+                    v, t = _phase_b_cached(i_, c_, h_, lens_dev, idf,
+                                           topk=k)
             else:
-                t0 = time.perf_counter()
-                wire_arr, lengths = packer_b.get(bpos)
-                bpos += 1
-                ph["pack_b"] += time.perf_counter() - t0  # stall only
-            bytes_wire += wire_arr.nbytes + lengths.nbytes
-            bytes_padded += padded_chunk_bytes + lengths.nbytes
-            v, t = phase_b_any(jax.device_put(wire_arr),
-                               jax.device_put(lengths), idf)
+                if spill == "host":
+                    wire_arr, lengths = cached[ci]
+                else:
+                    t0 = time.perf_counter()
+                    wire_arr, lengths = packer_b.get(bpos)
+                    bpos += 1
+                    ph["pack_b"] += time.perf_counter() - t0  # stall only
+                bytes_wire += wire_arr.nbytes + lengths.nbytes
+                bytes_padded += padded_chunk_bytes + lengths.nbytes
+                out = phase_b_any(jax.device_put(wire_arr),
+                                  jax.device_put(lengths), idf)
+                if packed_wire:
+                    words = out
+                else:
+                    v, t = out
+            if packed_wire:
+                bytes_off += words.nbytes
+                drain.put(ci, words)  # depth guard bounds in-flight
+                continue
             vals_parts.append(v)
             ids_parts.append(t)
             if ci >= max_ahead:  # same byte-budgeted lookahead as pass A
                 vals_parts[ci - max_ahead].block_until_ready()
+        if packed_wire:
+            ph["pass_b"] = time.perf_counter() - t_pass
+            t0 = time.perf_counter()
+            _trace("fetch_start")
+            parts = drain.results()  # chunk-major by construction
+            _trace("fetch_done")
+            df_host = np.asarray(df_acc)
+            ph["fetch"] = time.perf_counter() - t0  # stall only
+            ph["fetch_host"] = drain.host_seconds
     finally:
         if packer_b is not None:
             packer_b.close()
             ph["pack_host"] = (ph.get("pack_host", 0.0)
                                + packer_b.host_seconds)
-    jax.block_until_ready((vals_parts, ids_parts))
-    ph["pass_b"] = time.perf_counter() - t_pass
-
-    t0 = time.perf_counter()
-    _trace("fetch_start")
-    df_host, vals, tids = jax.device_get(
-        (df_acc, jnp.concatenate(vals_parts), jnp.concatenate(ids_parts)))
-    _trace("fetch_done")
-    ph["fetch"] = time.perf_counter() - t0
+        if drain is not None:
+            drain.close()
+    if packed_wire:
+        vals = np.concatenate([p[0] for p in parts])
+        tids = np.concatenate([p[1] for p in parts])
+    else:
+        jax.block_until_ready((vals_parts, ids_parts))
+        ph["pass_b"] = time.perf_counter() - t_pass
+        t0 = time.perf_counter()
+        _trace("fetch_start")
+        df_host, vals, tids = jax.device_get(
+            (df_acc, jnp.concatenate(vals_parts),
+             jnp.concatenate(ids_parts)))
+        _trace("fetch_done")
+        ph["fetch"] = time.perf_counter() - t0
+        bytes_off = vals.nbytes + tids.nbytes
     return IngestResult(df=df_host, topk_vals=vals[:num_docs],
                         topk_ids=tids[:num_docs],
                         lengths=np.concatenate(all_lengths), names=names,
@@ -1627,7 +1902,11 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
                         path="streaming", phases=ph,
                         wire="ragged" if ragged else "padded",
                         bytes_on_wire=bytes_wire,
-                        bytes_on_wire_padded=bytes_padded)
+                        bytes_on_wire_padded=bytes_padded,
+                        result_wire="packed" if packed_wire else "pair",
+                        bytes_off_wire=bytes_off,
+                        bytes_off_wire_pair=(len(starts) * chunk_docs * k
+                                             * pair_slot_bytes(score_dtype)))
 
 
 @dataclasses.dataclass
@@ -1727,9 +2006,9 @@ def run_overlapped_exact(input_dir: str,
         # path. The single worker keeps chunks in submission order,
         # which the intern table REQUIRES (ids are assigned in first-
         # appearance order across the whole corpus).
-        packer = _PackAhead(pack_exact,
-                            [names[s:s + chunk_docs] for s in starts])
-        try:
+        with _PackAhead(pack_exact,
+                        [names[s:s + chunk_docs] for s in starts]) \
+                as packer:
             for ci in range(len(starts)):
                 n_chunk = len(names[starts[ci]:starts[ci] + chunk_docs])
                 t0 = time.perf_counter()
@@ -1746,8 +2025,6 @@ def run_overlapped_exact(input_dir: str,
                 trip_h.append(h_)
                 len_parts.append(lens)
                 ph["put"] += time.perf_counter() - t0
-        finally:
-            packer.close()
         ph["pack_host"] = packer.host_seconds
         t0 = time.perf_counter()
         _, wire = _finish_wire((trip_i, trip_c, trip_h), len_parts,
@@ -1819,9 +2096,15 @@ def profile_resident(input_dir: str, config: Optional[PipelineConfig] = None,
     ph["upload"] = time.perf_counter() - t0
 
     # Compute fenced as one block: the production per-chunk programs
-    # plus the final score+pack — the same executables the resident
-    # path dispatches, so "compute" is its true device cost (plus the
-    # lazy transfers, see above).
+    # plus the finish — the same executables the resident path
+    # dispatches, so "compute" is its true device cost (plus the lazy
+    # transfers, see above). On the packed result wire the finish IS
+    # the per-chunk scoring dispatches (_phase_b_cached_packed); the
+    # pair wire keeps the fused _finish_wire — the profiler always
+    # mirrors the production program structure (cache-sharing
+    # doctrine, tests/test_ingest.py profiler test).
+    packed_wire = use_packed_result_wire(cfg)
+
     def compute_once():
         df_acc = jnp.zeros((cfg.vocab_size,), jnp.int32)
         trip_i, trip_c, trip_h = [], [], []
@@ -1832,6 +2115,15 @@ def profile_resident(input_dir: str, config: Optional[PipelineConfig] = None,
             trip_i.append(i_)
             trip_c.append(c_)
             trip_h.append(h_)
+        if packed_wire:
+            df_dev = (_df_from_trips(tuple(trip_i), tuple(trip_h),
+                                     vocab_size=cfg.vocab_size)
+                      if _resident_df_mode()[1] else df_acc)
+            idf = _final_idf(df_dev, jnp.int32(num_docs),
+                             score_dtype=score_dtype)
+            return [_phase_b_cached_packed(i_, c_, h_, lens, idf, topk=k)
+                    for i_, c_, h_, lens in zip(trip_i, trip_c, trip_h,
+                                                len_parts)]
         _, wire = _finish_wire((trip_i, trip_c, trip_h), len_parts,
                                df_acc, num_docs, k, score_dtype, cfg,
                                wire_vals=True)
@@ -1866,4 +2158,16 @@ def profile_resident(input_dir: str, config: Optional[PipelineConfig] = None,
     t0 = time.perf_counter()
     jax.device_get(wire)
     ph["fetch"] = time.perf_counter() - t0
+    # Steady-state drain cost: a second fetch of the identical result
+    # buffers — the link/transfer component alone, with any first-touch
+    # staging amortized (the downlink twin of compute_warm; the bench
+    # reports both next to the overlapped run's fetch stall).
+    t0 = time.perf_counter()
+    jax.device_get(wire)
+    ph["fetch_warm"] = time.perf_counter() - t0
+    ph["bytes_off_wire"] = float(
+        sum(w.nbytes for w in wire) if isinstance(wire, list)
+        else wire.nbytes)
+    ph["bytes_off_wire_pair"] = float(
+        len(starts) * chunk_docs * k * pair_slot_bytes(score_dtype))
     return ph

@@ -1,0 +1,150 @@
+"""Compact device→host result wire: one uint32 word per top-k slot.
+
+The downlink twin of the ragged upload wire (round 6). The reference's
+output phase is inherently serial (``TFIDF.c:273-282``); here the
+per-doc selection leaves the device as a single contiguous ``[D, K]``
+uint32 buffer — a 16-bit score in the high half and the uint16 vocab id
+in the low half — so the 32k-doc bench drain ships ~2 MB where the
+(int32 id, float32 score) pair wire ships ~4 MB, and the whole buffer
+can ride ``copy_to_host_async`` per chunk (``ingest._DrainAhead``).
+
+Word layout (little-endian on the host, XLA bitcast on the device)::
+
+    bits 31..16   score as float16 (bfloat16 when score_dtype is
+                  bfloat16 — then the bits are exactly the high half
+                  of the float32 score)
+    bits 15..0    vocab id as uint16
+
+Validity contract (the same one the pair wire encodes with score -1,
+``ingest._score_pack_wire``): valid scores are >= 0 by construction
+(idf >= 0, tf > 0 — the reference's invariant, ``TFIDF.c:243``), so a
+set SIGN BIT in the score half marks an invalid slot (sub-k docs /
+padding rows) and decodes back to the ``(0, -1)`` contract. A
+legitimate 0.0 score (word in every doc) survives; NaN scores pass
+through as NaN (sign test is False) rather than being misread as
+invalid. Scores round to the 16-bit wire format — the packed wire is
+bit-exact on ids and within fp16/bf16 rounding on scores; runs that
+need full-precision scores select the pair wire
+(``--result-wire=pair`` / ``TFIDF_TPU_RESULT_WIRE=pair``), which stays
+bit-identical to the pre-packed-wire behavior.
+
+The wire is valid whenever the vocab fits uint16 (``vocab_size <=
+2^16``, the bench default) and the canonical score dtype is 16/32-bit
+float; :func:`use_packed_result_wire` resolves the auto-fallback to the
+pair wire outside that envelope.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+# Bytes per selected slot on each wire: the packed word, and the
+# (int32 id, score_dtype score) pair the streaming/mesh fetches ship —
+# the denominators of the bench's result_wire_ratio artifact field.
+PACKED_SLOT_BYTES = 4
+
+
+def pair_slot_bytes(score_dtype) -> int:
+    """Bytes per slot of the (int32 id, score) pair wire — the
+    ``bytes_off_wire_pair`` accounting denominator."""
+    return 4 + jnp.dtype(jax.dtypes.canonicalize_dtype(
+        jnp.dtype(score_dtype))).itemsize
+
+
+def wire16_dtype(score_dtype):
+    """The 16-bit score format of the packed word: bfloat16 when the
+    (canonical) score dtype is bfloat16 — its bits are then exactly the
+    float32 high half — else float16, whose 10 mantissa bits carry the
+    tighter rounding for float32/float16 runs."""
+    dt = jax.dtypes.canonicalize_dtype(jnp.dtype(score_dtype))
+    return jnp.bfloat16 if dt == jnp.bfloat16 else jnp.float16
+
+
+def use_packed_result_wire(cfg, vocab_size: Optional[int] = None) -> bool:
+    """Resolve one run's device→host result wire from
+    ``config.result_wire`` (env override ``TFIDF_TPU_RESULT_WIRE``):
+    True = the packed uint32 word wire, False = the (id, score) pair
+    wire. ``"packed"`` (the default) degrades to the pair wire when the
+    word cannot carry the run: no top-k selection, vocab past 2^16
+    (ids overflow the uint16 half), or a 64-bit score ask under
+    ``jax_enable_x64`` (a 16-bit score half would butcher it).
+    ``"pair"`` forces the bit-identical legacy wire everywhere."""
+    choice = (os.environ.get("TFIDF_TPU_RESULT_WIRE")
+              or getattr(cfg, "result_wire", "packed"))
+    if choice not in ("packed", "pair"):
+        raise ValueError(
+            f"unknown result wire {choice!r} (TFIDF_TPU_RESULT_WIRE / "
+            f"--result-wire: choose 'packed' or 'pair')")
+    if choice == "pair" or cfg.topk is None:
+        return False
+    if (vocab_size if vocab_size is not None
+            else cfg.vocab_size) > (1 << 16):
+        return False  # the uint16 id half cannot carry the ids
+    dt = np.dtype(jax.dtypes.canonicalize_dtype(jnp.dtype(cfg.score_dtype)))
+    return dt.itemsize <= 4 and dt.kind == "f"
+
+
+def downlink_method(explicit: Optional[str] = None) -> str:
+    """The device-side word-pack lowering: ``"xla"`` (shift+or, the
+    default) or ``"pallas"`` (the Mosaic elementwise kernel,
+    ``ops.pallas_kernels.pack_words_pallas`` — in-tree A/B probe).
+    Override via ``TFIDF_TPU_DOWNLINK``; trace-time static like
+    ``ingest.rebuild_method``."""
+    if explicit is not None:
+        return explicit
+    method = os.environ.get("TFIDF_TPU_DOWNLINK") or "xla"
+    if method not in ("xla", "pallas"):
+        raise ValueError(f"unknown TFIDF_TPU_DOWNLINK method {method!r}")
+    return method
+
+
+def pack_result_words(vals: jax.Array, tids: jax.Array) -> jax.Array:
+    """Device-side pack (traceable): ``(vals, tids)`` per the
+    sparse_topk contract → uint32 words. Invalid slots (``tids < 0``)
+    pack as (score -1, id 0) — the sign-bit sentinel above."""
+    if downlink_method() == "pallas":
+        from tfidf_tpu.ops.pallas_kernels import (default_interpret,
+                                                  pack_words_pallas)
+        return pack_words_pallas(vals, tids,
+                                 interpret=default_interpret())
+    w16 = wire16_dtype(vals.dtype)
+    ok = tids >= 0
+    v16 = jnp.where(ok, vals, jnp.asarray(-1, vals.dtype)).astype(w16)
+    hi = lax.bitcast_convert_type(v16, jnp.uint16).astype(jnp.uint32)
+    lo = jnp.where(ok, tids, 0).astype(jnp.uint16).astype(jnp.uint32)
+    return (hi << jnp.uint32(16)) | lo
+
+
+# Module-level jit so every caller (ingest drain, pipeline fetch,
+# streaming score, mesh pre-fetch pack) shares one compiled program per
+# shape. Elementwise with no collectives, so it runs as-is on sharded
+# global arrays — each device packs its own rows.
+pack_words = jax.jit(pack_result_words)
+
+
+def unpack_result_words(words: np.ndarray, *, score_dtype=np.float32):
+    """Host-side decode of the packed word buffer (numpy, runs on the
+    drain worker thread): uint32 ``[..., K]`` → ``(vals, tids)`` with
+    vals in the canonical ``score_dtype`` and int32 ids. Invalid slots
+    (sign bit set in the score half) decode to ``(0, -1)`` — the same
+    contract as ``ingest._decode_wire``."""
+    words = np.ascontiguousarray(np.asarray(words))
+    dt = np.dtype(jax.dtypes.canonicalize_dtype(jnp.dtype(score_dtype)))
+    hi = (words >> np.uint32(16)).astype(np.uint16)
+    if wire16_dtype(score_dtype) == jnp.bfloat16:
+        # bf16 bits ARE the float32 high half: widen by shifting back.
+        vals = (hi.astype(np.uint32) << np.uint32(16)).view(np.float32)
+    else:
+        vals = hi.view(np.float16).astype(np.float32)
+    tids = (words & np.uint32(0xFFFF)).astype(np.int32)
+    bad = vals < 0  # sign-bit sentinel; NaN compares False and survives
+    vals = vals.astype(dt)
+    vals[bad] = 0
+    tids[bad] = -1
+    return vals, tids

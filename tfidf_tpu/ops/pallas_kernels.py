@@ -251,3 +251,55 @@ def ragged_rebuild_pallas(flat: jax.Array, lengths: jax.Array, *,
         interpret=interpret,
     )(offg, gran)
     return out[:, :length]
+
+
+# --- packed result wire ----------------------------------------------
+#
+# The downlink wire (ops/downlink.py) packs each top-k (score, id)
+# pair into one uint32 word on device before the drain. The production
+# lowering is the XLA shift+or (ops.downlink.pack_result_words); this
+# kernel is the Mosaic variant (TFIDF_TPU_DOWNLINK=pallas): a purely
+# elementwise pack over doc-tile blocks, the minimal demonstration of
+# emitting a compacted wire straight from a Pallas program.
+#
+# MEASURED SCOPE: the pack is a handful of VPU ops over [D, K] — XLA
+# fuses it into the scoring program for free, so this exists as the
+# in-tree A/B probe for the downlink path, like ragged_rebuild_pallas
+# for the uplink.
+
+
+def _pack_words_kernel(v_ref, t_ref, out_ref, *, w16):
+    ok = t_ref[...] >= 0
+    v16 = jnp.where(ok, v_ref[...],
+                    jnp.asarray(-1, v_ref.dtype)).astype(w16)
+    hi = jax.lax.bitcast_convert_type(v16, jnp.uint16).astype(jnp.uint32)
+    lo = jnp.where(ok, t_ref[...], 0).astype(jnp.uint16) \
+        .astype(jnp.uint32)
+    out_ref[...] = (hi << jnp.uint32(16)) | lo
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def pack_words_pallas(vals: jax.Array, tids: jax.Array, *,
+                      interpret: bool = False) -> jax.Array:
+    """Pallas twin of ``ops.downlink.pack_result_words`` (bit-identical
+    words, pinned by tests/test_downlink.py). Tiles the doc axis; the
+    [TILE_D, K] blocks keep whole rows per program."""
+    from tfidf_tpu.ops.downlink import wire16_dtype
+
+    d, k = vals.shape
+    dp = _pad_to(d, TILE_D)
+    v = jnp.zeros((dp, k), vals.dtype).at[:d].set(vals)
+    # Padding rows carry tid -1 so they pack as the invalid sentinel,
+    # identical to what the XLA pack emits for them.
+    t = jnp.full((dp, k), -1, jnp.int32).at[:d].set(tids)
+    out = pl.pallas_call(
+        functools.partial(_pack_words_kernel,
+                          w16=wire16_dtype(vals.dtype)),
+        grid=(dp // TILE_D,),
+        in_specs=[pl.BlockSpec((TILE_D, k), lambda i: (i, 0)),
+                  pl.BlockSpec((TILE_D, k), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((TILE_D, k), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((dp, k), jnp.uint32),
+        interpret=interpret,
+    )(v, t)
+    return out[:d]

@@ -27,6 +27,8 @@ from tfidf_tpu.formatter import (format_records, format_sparse_records,
                                  to_output_bytes)
 from tfidf_tpu.io.corpus import (Corpus, PackedBatch, RaggedBatch,
                                  pack_corpus)
+from tfidf_tpu.ops.downlink import (pack_words, unpack_result_words,
+                                    use_packed_result_wire)
 from tfidf_tpu.ops.histogram import df_from_counts, tf_counts, tf_counts_chunked
 from tfidf_tpu.ops.scoring import tfidf_dense
 from tfidf_tpu.ops.sparse import sparse_forward
@@ -268,6 +270,22 @@ class TfidfPipeline(PhaseTimedMixin):
                            getattr(self.config, "_engine_defaulted", False))
         return ShardedPipeline(plan, cfg, timer=self.timer)
 
+    def _fetch_topk(self, df_dev, tv_dev, ti_dev, vocab_size: int):
+        """One-round-trip fetch of (df, topk) — the minibatch twin of
+        the overlapped ingest's result wire. On the packed wire (the
+        default when the word can carry the run, ops/downlink) the
+        [D, K] selection crosses the link as uint32 words packed on
+        device — half the pair bytes; scores land within fp16/bf16
+        rounding, ids bit-exact. ``result_wire="pair"`` (or any
+        fallback condition) keeps the full-precision legacy fetch."""
+        if use_packed_result_wire(self.config, vocab_size=vocab_size):
+            words = pack_words(tv_dev, ti_dev)
+            df, words_h = jax.device_get((df_dev, words))
+            tv, ti = unpack_result_words(
+                words_h, score_dtype=self.config.score_dtype)
+            return df, tv, ti
+        return jax.device_get((df_dev, tv_dev, ti_dev))
+
     def _place(self, batch):
         """Device placement of either wire format. A PackedBatch ships
         the padded [D, L] ids verbatim; a RaggedBatch ships the flat
@@ -311,9 +329,13 @@ class TfidfPipeline(PhaseTimedMixin):
         # topk mode: neither counts nor scores cross the host boundary —
         # only DF [V] and the [D, K] selection do. One device_get for all
         # outputs: transfers pipeline into a single round trip, which
-        # matters when the device link is latency-bound.
+        # matters when the device link is latency-bound; the selection
+        # rides the packed word wire when it can (_fetch_topk).
         with self._phase("fetch"):
-            out = jax.device_get(out)
+            if cfg.topk is not None:
+                out = self._fetch_topk(*out, vocab_size=batch.vocab_size)
+            else:
+                out = jax.device_get(out)
         result = PipelineResult(
             counts=None if cfg.topk is not None else out[0],
             lengths=np.asarray(batch.lengths),
@@ -342,7 +364,10 @@ class TfidfPipeline(PhaseTimedMixin):
                 score_dtype=jnp.dtype(cfg.score_dtype), topk=cfg.topk)
             self._fence(out)
         with self._phase("fetch"):
-            out = jax.device_get(out)  # all outputs in one round trip
+            if cfg.topk is not None:  # packed word wire when it can
+                out = self._fetch_topk(*out, vocab_size=batch.vocab_size)
+            else:
+                out = jax.device_get(out)  # all outputs, one round trip
         result = PipelineResult(
             counts=None,
             lengths=np.asarray(batch.lengths),

@@ -34,6 +34,8 @@ from jax.sharding import PartitionSpec as P
 from tfidf_tpu.config import PipelineConfig, VocabMode
 from tfidf_tpu.io.corpus import (Corpus, PackedBatch, RaggedBatch,
                                  pack_corpus)
+from tfidf_tpu.ops.downlink import (pack_words, unpack_result_words,
+                                    use_packed_result_wire)
 from tfidf_tpu.ops.histogram import df_from_counts, tf_counts
 from tfidf_tpu.ops.scoring import idf_from_df, tfidf_dense
 from tfidf_tpu.ops.sparse import (sorted_term_counts, sparse_df,
@@ -251,6 +253,13 @@ class StreamingTfidf:
         per the sparse_topk contract, and k clamps to L (a doc cannot
         hold more than L distinct terms). topk=None always takes the
         dense lowering — the full [batch, V] score matrix IS the ask.
+
+        Top-k selections come back as HOST arrays, fetched over the
+        packed result wire when it can carry the run (ops/downlink —
+        one uint32 word per slot; ids bit-exact, scores within 16-bit
+        rounding; ``result_wire="pair"`` restores the full-precision
+        device-array return). On a mesh the words pack per shard
+        (elementwise, no collective) before the gathering fetch.
         """
         toks, lens = self._place(batch)
         topk = self.config.topk
@@ -260,10 +269,20 @@ class StreamingTfidf:
             if self.plan is not None:
                 fn = _mesh_score_sparse_fn(self.plan, self._vocab, k,
                                            score_dtype)
-                return fn(self._df, jnp.int32(self._docs_seen), toks, lens)
-            return _score_batch_sparse(
-                self._df, jnp.int32(self._docs_seen), toks, lens,
-                vocab_size=self._vocab, topk=k, score_dtype=score_dtype)
-        return _score_batch(self._df, jnp.int32(self._docs_seen), toks, lens,
-                            vocab_size=self._vocab, topk=topk,
-                            score_dtype=score_dtype)
+                out = fn(self._df, jnp.int32(self._docs_seen), toks, lens)
+            else:
+                out = _score_batch_sparse(
+                    self._df, jnp.int32(self._docs_seen), toks, lens,
+                    vocab_size=self._vocab, topk=k,
+                    score_dtype=score_dtype)
+        else:
+            out = _score_batch(self._df, jnp.int32(self._docs_seen),
+                               toks, lens, vocab_size=self._vocab,
+                               topk=topk, score_dtype=score_dtype)
+        # The padded mesh vocab is the id bound the wire must carry —
+        # a tail-padded bucket can be selected by sub-k docs.
+        if topk is not None and use_packed_result_wire(
+                self.config, vocab_size=self._vocab):
+            words = np.asarray(pack_words(*out))
+            return unpack_result_words(words, score_dtype=score_dtype)
+        return out
