@@ -122,6 +122,11 @@ def pytest_configure(config):
         "markers",
         "shard_map: test needs a working jax.shard_map; auto-skipped "
         "(with the probe's error) where the environment lacks it")
+    config.addinivalue_line(
+        "markers",
+        "slow: subprocess/IO-heavy test excluded from the tier-1 run "
+        "(-m 'not slow') so the hermetic suite stays fast; run "
+        "explicitly with -m slow")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -272,14 +272,17 @@ class TestDrainAhead:
 class TestDrainOverlap:
     """Ordering contract of the chunked async drain on the real ingest
     loops: every chunk's drain is submitted before the terminal fetch
-    stall, and drains retire in chunk order."""
+    stall, and drains retire in chunk order. Pinned to the CHUNKED
+    finish — the structure whose per-chunk drains these contracts
+    describe; the round-8 scanned finish (one dispatch, one drain) has
+    its own ordering pins in tests/test_finish.py."""
 
     def _trace_run(self, corpus_dir, **kw):
         events = []
         ing._overlap_trace = events.append
         try:
-            ing.run_overlapped(corpus_dir, _cfg(), chunk_docs=10,
-                               doc_len=64, **kw)
+            ing.run_overlapped(corpus_dir, _cfg(finish="chunked"),
+                               chunk_docs=10, doc_len=64, **kw)
         finally:
             ing._overlap_trace = None
         return events

@@ -1,0 +1,365 @@
+"""The one-dispatch scanned finish and the fused Pallas score/top-k
+(round 8): scan-vs-chunked value parity across regimes, wires, and
+uplink formats; the fused Mosaic kernel pinned against the XLA
+score+select lowering (tie and all-invalid-slot cases included); drain
+ordering under --finish=scan; finish resolution/fallback; the
+dispatch-count accounting the bench artifact reports; and the
+persistent compile cache (slow-marked subprocess smoke)."""
+
+import os
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tfidf_tpu import PipelineConfig
+from tfidf_tpu import ingest as ing
+from tfidf_tpu.cli import main
+from tfidf_tpu.config import VocabMode, apply_compile_cache
+from tfidf_tpu.ops.pallas_kernels import fused_score_topk_pallas
+from tfidf_tpu.ops.scoring import idf_from_df
+from tfidf_tpu.ops.sparse import (score_method, score_topk,
+                                  sorted_term_counts, sparse_scores,
+                                  sparse_topk)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# fp16 carries 11 significand bits: relative rounding error <= 2^-11
+# (the packed result wire's score precision, tests/test_downlink.py).
+FP16_RTOL = 1e-3
+
+
+def _cfg(**kw):
+    base = dict(vocab_mode=VocabMode.HASHED, vocab_size=1 << 10,
+                max_doc_len=64, doc_chunk=64, topk=5, engine="sparse")
+    base.update(kw)
+    return PipelineConfig(**base)
+
+
+@pytest.fixture
+def corpus_dir(tmp_path):
+    rng = np.random.default_rng(23)
+    for i in range(1, 41):
+        words = [f"w{rng.integers(0, 60)}"
+                 for _ in range(int(rng.integers(0, 40)))]
+        (tmp_path / f"doc{i}").write_text(" ".join(words))
+    return str(tmp_path)
+
+
+class TestFinishResolution:
+    def test_config_validates(self):
+        with pytest.raises(ValueError, match="finish"):
+            _cfg(finish="loop")
+
+    def test_default_is_scan(self):
+        assert ing.resolve_finish(_cfg()) == "scan"
+        assert ing.use_scan_finish(_cfg(), packed_wire=True)
+
+    def test_pair_wire_never_scans(self):
+        # the pair wire's fused finish is already one dispatch — the
+        # scan only ever applies to the packed word wire
+        assert not ing.use_scan_finish(_cfg(result_wire="pair"),
+                                       packed_wire=False)
+
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv("TFIDF_TPU_FINISH", "chunked")
+        assert ing.resolve_finish(_cfg()) == "chunked"
+        monkeypatch.setenv("TFIDF_TPU_FINISH", "recursive")
+        with pytest.raises(ValueError, match="TFIDF_TPU_FINISH"):
+            ing.resolve_finish(_cfg())
+
+
+class TestScanChunkedParity:
+    """--finish=scan is bit-identical on ids (and allclose on scores)
+    to the round-7 chunked finish, on every regime/wire combination
+    the scan can reach."""
+
+    @pytest.mark.parametrize("regime", ["resident", "streaming",
+                                        "streaming-nocache"])
+    @pytest.mark.parametrize("wire", ["ragged", "padded"])
+    def test_regime_wire_matrix(self, corpus_dir, regime, wire,
+                                monkeypatch):
+        if regime.startswith("streaming"):
+            monkeypatch.setenv("TFIDF_TPU_RESIDENT_ELEMS", "0")
+        if regime == "streaming-nocache":
+            monkeypatch.setenv("TFIDF_TPU_TRIPLE_CACHE_BYTES", "0")
+        r_s = ing.run_overlapped(corpus_dir, _cfg(wire=wire),
+                                 chunk_docs=10, doc_len=64)
+        r_c = ing.run_overlapped(corpus_dir,
+                                 _cfg(wire=wire, finish="chunked"),
+                                 chunk_docs=10, doc_len=64)
+        assert r_c.finish == "chunked"
+        if regime == "streaming-nocache":
+            # nothing cached = nothing for one program to see: the
+            # scan ask resolves to the pure chunked flow, honestly
+            # reported
+            assert r_s.finish == "chunked"
+        else:
+            assert r_s.finish == "scan"
+            assert r_s.n_finish_dispatches < r_c.n_finish_dispatches
+        np.testing.assert_array_equal(r_s.topk_ids, r_c.topk_ids)
+        np.testing.assert_allclose(r_s.topk_vals, r_c.topk_vals,
+                                   rtol=FP16_RTOL, atol=1e-7)
+        np.testing.assert_array_equal(np.asarray(r_s.df),
+                                      np.asarray(r_c.df))
+        assert r_s.bytes_off_wire == r_c.bytes_off_wire
+
+    def test_pair_wire_ignores_scan_ask(self, corpus_dir):
+        # both finishes on the pair wire take the fused single-dispatch
+        # program — bit-identical results, finish reported as "fused"
+        r_s = ing.run_overlapped(corpus_dir, _cfg(result_wire="pair"),
+                                 chunk_docs=10, doc_len=64)
+        r_c = ing.run_overlapped(corpus_dir,
+                                 _cfg(result_wire="pair",
+                                      finish="chunked"),
+                                 chunk_docs=10, doc_len=64)
+        assert r_s.finish == r_c.finish == "fused"
+        assert r_s.n_finish_dispatches == 1
+        np.testing.assert_array_equal(r_s.topk_ids, r_c.topk_ids)
+        np.testing.assert_array_equal(r_s.topk_vals, r_c.topk_vals)
+
+    def test_streaming_partial_cache_prefix(self, corpus_dir,
+                                            monkeypatch):
+        # budget for ONE cached chunk: the scan covers the cached
+        # prefix, the remaining chunks keep per-chunk dispatches, and
+        # results stay chunk-major (equality against the resident run
+        # pins the ordering end to end)
+        ref = ing.run_overlapped(corpus_dir, _cfg(), chunk_docs=10,
+                                 doc_len=64)
+        monkeypatch.setenv("TFIDF_TPU_RESIDENT_ELEMS", "0")
+        monkeypatch.setenv("TFIDF_TPU_TRIPLE_CACHE_BYTES",
+                           str(10 * 64 * 9 + 10 * 4 + 1))
+        r = ing.run_overlapped(corpus_dir, _cfg(), chunk_docs=10,
+                               doc_len=64)
+        assert r.finish == "scan"
+        assert r.phases["triple_cached_chunks"] == 1.0
+        assert r.n_finish_dispatches == 4  # 1 scan + 3 per-chunk
+        np.testing.assert_array_equal(r.topk_ids, ref.topk_ids)
+        np.testing.assert_allclose(r.topk_vals, ref.topk_vals,
+                                   rtol=FP16_RTOL, atol=1e-7)
+
+    def test_profiler_mirrors_finish(self, corpus_dir, monkeypatch):
+        # cache-sharing doctrine: the fenced profiler dispatches the
+        # same finish structure production resolved
+        ph_s = ing.profile_resident(corpus_dir, _cfg(), chunk_docs=10,
+                                    doc_len=64)
+        assert ph_s["n_phase_b_dispatches"] == 1.0
+        monkeypatch.setenv("TFIDF_TPU_FINISH", "chunked")
+        ph_c = ing.profile_resident(corpus_dir, _cfg(), chunk_docs=10,
+                                    doc_len=64)
+        assert ph_c["n_phase_b_dispatches"] == 4.0
+        assert ph_s["bytes_off_wire"] == ph_c["bytes_off_wire"]
+
+
+class TestScanDrainOrdering:
+    """Under --finish=scan the resident drain is ONE submit whose
+    worker unpacks the whole scanned buffer chunk-major, and it still
+    precedes the terminal fetch stall."""
+
+    def test_single_drain_chunk_major(self, corpus_dir):
+        events = []
+        ing._overlap_trace = events.append
+        try:
+            r = ing.run_overlapped(corpus_dir, _cfg(), chunk_docs=10,
+                                   doc_len=64)
+        finally:
+            ing._overlap_trace = None
+        assert r.finish == "scan"
+        submits = [i for i, e in enumerate(events)
+                   if e[0] == "drain_submit"]
+        assert len(submits) == 1  # the whole finish is one buffer
+        fetch_start = events.index(("fetch_start", -1))
+        assert submits[0] < fetch_start
+        # every chunk upload/dispatch preceded the finish submit
+        dispatches = [i for i, e in enumerate(events)
+                      if e[0] == "dispatch"]
+        assert len(dispatches) == 4
+        assert all(d < submits[0] for d in dispatches)
+        # chunk-major content: equality against the chunked finish
+        r_c = ing.run_overlapped(corpus_dir, _cfg(finish="chunked"),
+                                 chunk_docs=10, doc_len=64)
+        np.testing.assert_array_equal(r.topk_ids, r_c.topk_ids)
+
+
+def _triples(rng, d, length, vocab):
+    toks = rng.integers(0, vocab, (d, length)).astype(np.int32)
+    lens = rng.integers(0, length + 1, d).astype(np.int32)
+    ids, cnt, head = sorted_term_counts(jnp.asarray(toks),
+                                        jnp.asarray(lens))
+    df = rng.integers(0, d + 1, vocab).astype(np.int32)
+    idf = idf_from_df(jnp.asarray(df), jnp.int32(max(d, 1)),
+                      jnp.float32)
+    return ids, cnt, head, jnp.asarray(lens), idf
+
+
+class TestFusedScoreTopkPallas:
+    """The fused Mosaic score/top-k kernel against the XLA lowering:
+    ids bit-identical (same selection, same lax.top_k tie order),
+    scores allclose."""
+
+    def test_property_random(self):
+        rng = np.random.default_rng(3)
+        for _ in range(8):
+            d = int(rng.integers(1, 40))
+            length = int(rng.integers(4, 80))
+            k = int(rng.integers(1, 9))
+            ids, cnt, head, lens, idf = _triples(rng, d, length, 311)
+            sc = sparse_scores(ids, cnt, head, lens, idf)
+            v0, t0 = sparse_topk(sc, ids, head, k)
+            v1, t1 = fused_score_topk_pallas(ids, cnt, head, lens, idf,
+                                             k=min(k, length),
+                                             interpret=True)
+            np.testing.assert_array_equal(np.asarray(t0),
+                                          np.asarray(t1))
+            np.testing.assert_allclose(np.asarray(v0), np.asarray(v1),
+                                       rtol=1e-6, atol=1e-7)
+
+    def test_tie_breaks_toward_lower_slot(self):
+        # two distinct terms with identical counts and identical DF
+        # score EQUAL: lax.top_k picks the lower sorted-slot index
+        # first, and the kernel must agree exactly
+        toks = np.array([[5, 5, 9, 9, 3]], np.int32)
+        lens = np.array([4], np.int32)  # the trailing 3 is dead
+        ids, cnt, head = sorted_term_counts(jnp.asarray(toks),
+                                            jnp.asarray(lens))
+        idf = idf_from_df(jnp.asarray(np.ones(16, np.int32)),
+                          jnp.int32(4), jnp.float32)
+        sc = sparse_scores(ids, cnt, head, jnp.asarray(lens), idf)
+        v0, t0 = sparse_topk(sc, ids, head, 3)
+        v1, t1 = fused_score_topk_pallas(ids, cnt, head,
+                                         jnp.asarray(lens), idf, k=3,
+                                         interpret=True)
+        np.testing.assert_array_equal(np.asarray(t0), np.asarray(t1))
+        np.testing.assert_array_equal(np.asarray(v0), np.asarray(v1))
+
+    def test_all_invalid_slots(self):
+        # zero-length docs have NO head slots: every selection decodes
+        # to the (0, -1) contract on both lowerings
+        toks = np.array([[7, 7, 7], [1, 2, 3]], np.int32)
+        lens = np.array([0, 0], np.int32)
+        ids, cnt, head = sorted_term_counts(jnp.asarray(toks),
+                                            jnp.asarray(lens))
+        idf = idf_from_df(jnp.asarray(np.ones(8, np.int32)),
+                          jnp.int32(2), jnp.float32)
+        v1, t1 = fused_score_topk_pallas(ids, cnt, head,
+                                         jnp.asarray(lens), idf, k=2,
+                                         interpret=True)
+        np.testing.assert_array_equal(np.asarray(t1), -1)
+        np.testing.assert_array_equal(np.asarray(v1), 0)
+
+    def test_score_method_resolution(self, monkeypatch):
+        assert score_method() == "xla"
+        monkeypatch.setenv("TFIDF_TPU_SCORE", "pallas")
+        assert score_method() == "pallas"
+        monkeypatch.setenv("TFIDF_TPU_SCORE", "cuda")
+        with pytest.raises(ValueError, match="TFIDF_TPU_SCORE"):
+            score_method()
+
+    def test_score_topk_routes(self, monkeypatch):
+        rng = np.random.default_rng(9)
+        ids, cnt, head, lens, idf = _triples(rng, 12, 32, 101)
+        v0, t0 = score_topk(ids, cnt, head, lens, idf, 4)
+        monkeypatch.setenv("TFIDF_TPU_SCORE", "pallas")
+        v1, t1 = score_topk(ids, cnt, head, lens, idf, 4)
+        np.testing.assert_array_equal(np.asarray(t0), np.asarray(t1))
+        np.testing.assert_allclose(np.asarray(v0), np.asarray(v1),
+                                   rtol=1e-6, atol=1e-7)
+
+    def test_ingest_with_pallas_score(self, corpus_dir, monkeypatch):
+        ref = ing.run_overlapped(corpus_dir, _cfg(), chunk_docs=10,
+                                 doc_len=64)
+        monkeypatch.setenv("TFIDF_TPU_SCORE", "pallas")
+        r = ing.run_overlapped(corpus_dir, _cfg(), chunk_docs=10,
+                               doc_len=64)
+        np.testing.assert_array_equal(r.topk_ids, ref.topk_ids)
+        np.testing.assert_allclose(r.topk_vals, ref.topk_vals,
+                                   rtol=FP16_RTOL, atol=1e-7)
+
+
+class TestCliFinish:
+    def test_finish_flag_round_trip(self, toy_corpus_dir, tmp_path):
+        a, b = tmp_path / "a.txt", tmp_path / "b.txt"
+        common = ["run", "--input", toy_corpus_dir, "--backend", "tpu",
+                  "--vocab-mode", "hashed", "--topk", "2",
+                  "--doc-len", "32"]
+        assert main(common + ["--output", str(a),
+                              "--finish", "scan"]) == 0
+        assert main(common + ["--output", str(b),
+                              "--finish", "chunked"]) == 0
+        assert a.read_bytes() == b.read_bytes()
+
+    def test_explicit_scan_on_pair_wire_warns(self, toy_corpus_dir,
+                                              tmp_path, capsys):
+        rc = main(["run", "--input", toy_corpus_dir, "--backend", "tpu",
+                   "--vocab-mode", "hashed", "--topk", "2",
+                   "--doc-len", "32", "--result-wire", "pair",
+                   "--finish", "scan",
+                   "--output", str(tmp_path / "o.txt")])
+        assert rc == 0
+        assert "finish=scan" in capsys.readouterr().err
+
+    def test_default_pair_wire_does_not_warn(self, toy_corpus_dir,
+                                             tmp_path, capsys):
+        # the scan DEFAULT quietly rides the fused finish; only an
+        # explicit --finish=scan ask earns the fallback warning
+        rc = main(["run", "--input", toy_corpus_dir, "--backend", "tpu",
+                   "--vocab-mode", "hashed", "--topk", "2",
+                   "--doc-len", "32", "--result-wire", "pair",
+                   "--output", str(tmp_path / "o.txt")])
+        assert rc == 0
+        assert "finish=scan" not in capsys.readouterr().err
+
+    def test_help_epilog_documents_knobs(self, capsys):
+        with pytest.raises(SystemExit):
+            main(["run", "--help"])
+        out = capsys.readouterr().out
+        assert "--finish" in out and "--compile-cache" in out
+        assert "TFIDF_TPU_FINISH" in out
+        assert "TFIDF_TPU_COMPILE_CACHE" in out
+        assert "TFIDF_TPU_SCORE" in out
+
+
+class TestCompileCache:
+    def test_apply_is_noop_without_path(self, monkeypatch):
+        monkeypatch.delenv("TFIDF_TPU_COMPILE_CACHE", raising=False)
+        assert apply_compile_cache(None) is None
+
+    def test_apply_resolves_env(self, tmp_path, monkeypatch):
+        import jax
+        monkeypatch.setenv("TFIDF_TPU_COMPILE_CACHE",
+                           str(tmp_path / "cc"))
+        try:
+            assert apply_compile_cache(None) == str(tmp_path / "cc")
+            assert os.path.isdir(tmp_path / "cc")
+        finally:
+            # never leave the process-global cache pointed at a tmp
+            # dir the fixture is about to delete
+            jax.config.update("jax_compilation_cache_dir", None)
+
+    @pytest.mark.slow
+    def test_cache_persists_across_processes(self, tmp_path):
+        """Subprocess smoke: a cold process fills the cache directory;
+        a second fresh process compiles the same program measurably
+        using the persisted entries (asserted on the cache being read,
+        not on wall-clock — CI-safe)."""
+        cache = str(tmp_path / "cc")
+        prog = (
+            "import sys; sys.path.insert(0, %r)\n"
+            "from tfidf_tpu.config import apply_compile_cache\n"
+            "apply_compile_cache(%r)\n"
+            "import jax, jax.numpy as jnp\n"
+            "import numpy as np\n"
+            "x = np.zeros((64, 32), np.int32)\n"
+            "jax.jit(lambda a: jnp.sort(a, axis=1).sum())(x)\n"
+            "print('done')\n" % (REPO, cache))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        for _ in range(2):
+            out = subprocess.run([sys.executable, "-c", prog],
+                                 capture_output=True, text=True,
+                                 timeout=300, env=env)
+            assert out.returncode == 0, out.stderr[-2000:]
+            assert "done" in out.stdout
+        entries = os.listdir(cache)
+        assert entries, "persistent cache directory stayed empty"

@@ -19,10 +19,37 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 NATIVE_BIN = os.path.join(REPO_ROOT, "native", "tfidf_ref")
 
 
+# --help epilog of the run subcommand: the --inspect-style quick map
+# of the round-8 dispatch/compile knobs (full table: docs/CONFIG.md).
+_RUN_EPILOG = """\
+dispatch & compile knobs (round 8):
+  --finish scan|chunked   packed-wire phase-B finish: 'scan' (default)
+                          scores every resident chunk (and the
+                          streaming triple-cache prefix) in ONE
+                          donated lax.scan dispatch — no per-chunk
+                          launch tax; 'chunked' keeps the round-7
+                          per-chunk dispatches with the interleaved
+                          async drain (bit-identical fallback). Runs
+                          on the pair result wire ignore it (their
+                          fused finish is already one dispatch).
+                          Env: TFIDF_TPU_FINISH
+  --compile-cache DIR     persistent XLA compilation cache: repeat
+                          runs at the same (bucketed) wire shapes
+                          load executables from DIR instead of
+                          re-paying cold-start compiles.
+                          Env: TFIDF_TPU_COMPILE_CACHE
+  TFIDF_TPU_SCORE         xla|pallas — phase-B score+top-k lowering
+                          (pallas = the fused Mosaic kernel, A/B
+                          probe; ids bit-exact either way)
+"""
+
+
 def _build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="tfidf", description=__doc__)
     sub = p.add_subparsers(dest="cmd", required=True)
-    run = sub.add_parser("run", help="run the TF-IDF pipeline")
+    run = sub.add_parser(
+        "run", help="run the TF-IDF pipeline", epilog=_RUN_EPILOG,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
     run.add_argument("--input", required=True, help="document directory")
     run.add_argument("--output", default="output.txt",
                      help="output file (reference format)")
@@ -83,6 +110,25 @@ def _build_parser() -> argparse.ArgumentParser:
                           "pair wire — the bit-identical parity "
                           "fallback, also selected automatically for "
                           "vocabs past 2^16 or 64-bit score runs")
+    run.add_argument("--finish", choices=["scan", "chunked"],
+                     default=None,
+                     help="packed-wire phase-B finish structure "
+                          "(--doc-len runs): 'scan' (default) scores "
+                          "the whole resident corpus in ONE donated "
+                          "lax.scan dispatch — one program, one async "
+                          "drain, no per-chunk dispatch tax; 'chunked' "
+                          "keeps the round-7 per-chunk scoring "
+                          "dispatches with the interleaved async "
+                          "drain — the bit-identical fallback (also "
+                          "what effectively runs on the pair result "
+                          "wire, whose fused finish is already one "
+                          "dispatch)")
+    run.add_argument("--compile-cache", metavar="DIR", default=None,
+                     help="persistent XLA compilation cache directory: "
+                          "repeat runs at the same (bucketed) wire "
+                          "shapes load executables from disk instead "
+                          "of re-paying every cold-start compile "
+                          "(config.apply_compile_cache)")
     run.add_argument("--exact-terms", action="store_true",
                      help="hashed+topk mode: re-rank the device top-k "
                           "on host with exact strings and DF, emitting "
@@ -215,7 +261,13 @@ def _run_tpu(args) -> int:
         mesh_shape=mesh_shape,
         wire=getattr(args, "wire", "ragged"),
         result_wire=getattr(args, "result_wire", "packed"),
+        finish=getattr(args, "finish", None) or "scan",
+        compile_cache=getattr(args, "compile_cache", None),
     )
+    # Arm the persistent compile cache BEFORE any jitted work — the
+    # library entry points re-apply it idempotently.
+    from tfidf_tpu.config import apply_compile_cache
+    apply_compile_cache(cfg.compile_cache)
     from tfidf_tpu.utils.timing import PhaseTimer, Throughput, phase_or_null
     timer = PhaseTimer() if args.timing else None
     throughput = Throughput()
@@ -275,6 +327,19 @@ def _run_tpu(args) -> int:
                   and cfg.tokenizer is TokenizerKind.WHITESPACE
                   and mesh_ok and not args.pallas
                   and cfg.engine == "sparse")
+    # An EXPLICIT --finish=scan that cannot run warns once, mirroring
+    # the wire auto-fallback messages: the scan emits packed words, so
+    # a pair-wire run (forced or auto-degraded, e.g. vocab > 2^16)
+    # takes the fused _finish_wire program instead — already a single
+    # dispatch, but not the structure the flag named.
+    if getattr(args, "finish", None) == "scan" and overlapped:
+        from tfidf_tpu.ops.downlink import use_packed_result_wire
+        if not use_packed_result_wire(cfg) or exact_terms:
+            sys.stderr.write(
+                "warning: --finish=scan needs the packed result wire; "
+                "falling back to the chunked/fused finish (the pair "
+                "and exact wires' fused finish program is already one "
+                "dispatch)\n")
     if overlapped and exact_terms and not mesh_shape:
         # Exact-terms with automatic engine choice (rerank.exact_terms):
         # device-exact intern ids when the corpus fits the vocab (no

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import os
 from typing import Optional, Tuple
 
 
@@ -99,6 +100,24 @@ class PipelineConfig:
         parity fallback, also selected automatically when the word
         cannot carry the run (no topk, vocab > 2^16, or a 64-bit
         score ask — see ``ops.downlink.use_packed_result_wire``).
+      finish: structure of the packed-wire phase-B finish for the
+        overlapped ingest. "scan" (default) scores the whole resident
+        corpus (and the streaming triple-cache prefix) in ONE donated
+        ``lax.scan`` dispatch that emits the full [n_chunks, D, K]
+        word buffer — one program, one async drain, no per-chunk
+        dispatch tax; "chunked" keeps the round-7 per-chunk scoring
+        dispatches with the interleaved async drain — the
+        bit-identical fallback, also what effectively runs whenever
+        the packed result wire cannot carry the run (the pair wire's
+        fused finish is already a single dispatch). Env override
+        ``TFIDF_TPU_FINISH``; see ``ingest.use_scan_finish``.
+      compile_cache: directory for jax's persistent XLA compilation
+        cache (``apply_compile_cache``); None leaves it off. CLI
+        cold-starts re-pay every compile the warm bench never sees —
+        with the cache, a repeat run at the same wire shapes (the
+        bucketed flat sizes of ``ingest._FLAT_BUCKET`` exist exactly
+        so there are few of them) loads executables from disk
+        instead. Env override ``TFIDF_TPU_COMPILE_CACHE``.
     """
 
     vocab_mode: VocabMode = VocabMode.EXACT
@@ -123,6 +142,8 @@ class PipelineConfig:
     topk: Optional[int] = None
     wire: str = "ragged"
     result_wire: str = "packed"
+    finish: str = "scan"
+    compile_cache: Optional[str] = None
 
     def __post_init__(self):
         if self.wire not in ("ragged", "padded"):
@@ -131,6 +152,9 @@ class PipelineConfig:
         if self.result_wire not in ("packed", "pair"):
             raise ValueError(f"unknown result wire {self.result_wire!r} "
                              f"(choose 'packed' or 'pair')")
+        if self.finish not in ("scan", "chunked"):
+            raise ValueError(f"unknown finish {self.finish!r} "
+                             f"(choose 'scan' or 'chunked')")
         if self.vocab_size <= 0:
             raise ValueError("vocab_size must be positive")
         lo, hi = self.ngram_range
@@ -164,3 +188,32 @@ class PipelineConfig:
         a semantics to reproduce.
         """
         return PipelineConfig(vocab_mode=VocabMode.EXACT)
+
+
+def apply_compile_cache(path: Optional[str] = None) -> Optional[str]:
+    """Point jax's persistent XLA compilation cache at ``path`` (or
+    ``TFIDF_TPU_COMPILE_CACHE`` when ``path`` is None) and floor the
+    persistence thresholds so EVERY program persists — this pipeline's
+    executables are small but numerous (one per wire-shape bucket), and
+    jax's defaults would skip most of them as too-fast compiles.
+
+    The entry points that build jitted programs call this with their
+    config's ``compile_cache`` (cli, ``ingest.run_overlapped``,
+    ``TfidfPipeline``); repeat calls with the same directory are
+    no-ops. Returns the resolved directory, or None when caching stays
+    off. Threshold knobs missing from older jax versions are skipped
+    silently — the cache dir alone already persists the big programs.
+    """
+    resolved = path or os.environ.get("TFIDF_TPU_COMPILE_CACHE")
+    if not resolved:
+        return None
+    import jax
+    os.makedirs(resolved, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", resolved)
+    for knob, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                      ("jax_persistent_cache_min_entry_size_bytes", -1)):
+        try:
+            jax.config.update(knob, val)
+        except (AttributeError, ValueError):  # older jax: knob absent
+            pass
+    return resolved

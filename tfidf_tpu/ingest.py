@@ -52,15 +52,17 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from tfidf_tpu.config import PipelineConfig, TokenizerKind, VocabMode
+from tfidf_tpu.config import (PipelineConfig, TokenizerKind, VocabMode,
+                              apply_compile_cache)
 from tfidf_tpu.io import fast_tokenizer
 from tfidf_tpu.io.corpus import discover_names, pack_corpus
 from tfidf_tpu.ops.downlink import (pack_result_words, pack_words,
                                     pair_slot_bytes, unpack_result_words,
                                     use_packed_result_wire)
 from tfidf_tpu.ops.scoring import idf_from_df
-from tfidf_tpu.ops.sparse import (sorted_term_counts, sparse_df,
-                                  sparse_forward, sparse_scores, sparse_topk)
+from tfidf_tpu.ops.sparse import (score_topk, sorted_term_counts,
+                                  sparse_df, sparse_forward, sparse_scores,
+                                  sparse_topk)
 
 if TYPE_CHECKING:  # parallel imports stay lazy for single-device runs
     from tfidf_tpu.parallel.mesh import MeshPlan
@@ -273,14 +275,18 @@ def _phase_a_ragged(flat, lengths, df_acc, *, length: int, vocab_size: int,
     return df_acc + sparse_df(ids, head, vocab_size)
 
 
+# Every packed-wire phase-B kernel scores+selects through ONE
+# definition (ops.sparse.score_topk): the XLA sparse_scores→sparse_topk
+# pair by default, or the fused Mosaic score/top-k kernel under
+# TFIDF_TPU_SCORE=pallas — resolved at trace time, ids bit-identical
+# either way (tests/test_finish.py).
 @functools.partial(jax.jit, donate_argnums=(0,),
                    static_argnames=("length", "topk", "align", "rebuild"))
 def _phase_b_ragged(flat, lengths, idf, *, length: int, topk: int,
                     align: int, rebuild: str = "xla"):
     tok = _ragged_to_padded(flat, lengths, length, align, rebuild)
     ids, counts, head = sorted_term_counts(tok, lengths)
-    scores = sparse_scores(ids, counts, head, lengths, idf)
-    return sparse_topk(scores, ids, head, topk)
+    return score_topk(ids, counts, head, lengths, idf, topk)
 
 
 # Pass-B kernel for triple-cached chunks: score pre-sorted triples
@@ -289,8 +295,7 @@ def _phase_b_ragged(flat, lengths, idf, *, length: int, topk: int,
 # (``TFIDF.c:141-147``): scan once, keep the sorted form.
 @functools.partial(jax.jit, static_argnames=("topk",))
 def _phase_b_cached(ids, counts, head, lengths, idf, *, topk: int):
-    scores = sparse_scores(ids, counts, head, lengths, idf)
-    return sparse_topk(scores, ids, head, topk)
+    return score_topk(ids, counts, head, lengths, idf, topk)
 
 
 # Packed-wire twins of the pass-B kernels: same scoring, but the
@@ -299,8 +304,8 @@ def _phase_b_cached(ids, counts, head, lengths, idf, *, topk: int):
 # the unit the chunked async drain ships per chunk (_DrainAhead).
 @functools.partial(jax.jit, static_argnames=("topk",))
 def _phase_b_cached_packed(ids, counts, head, lengths, idf, *, topk: int):
-    scores = sparse_scores(ids, counts, head, lengths, idf)
-    return pack_result_words(*sparse_topk(scores, ids, head, topk))
+    return pack_result_words(*score_topk(ids, counts, head, lengths,
+                                         idf, topk))
 
 
 @functools.partial(jax.jit, donate_argnums=(0,),
@@ -309,16 +314,45 @@ def _phase_b_ragged_packed(flat, lengths, idf, *, length: int, topk: int,
                            align: int, rebuild: str = "xla"):
     tok = _ragged_to_padded(flat, lengths, length, align, rebuild)
     ids, counts, head = sorted_term_counts(tok, lengths)
-    scores = sparse_scores(ids, counts, head, lengths, idf)
-    return pack_result_words(*sparse_topk(scores, ids, head, topk))
+    return pack_result_words(*score_topk(ids, counts, head, lengths,
+                                         idf, topk))
 
 
 @functools.partial(jax.jit, donate_argnums=(0,),
                    static_argnames=("topk",))
 def _phase_b_padded_packed(token_ids, lengths, idf, *, topk: int):
     ids, counts, head = sorted_term_counts(token_ids, lengths)
-    scores = sparse_scores(ids, counts, head, lengths, idf)
-    return pack_result_words(*sparse_topk(scores, ids, head, topk))
+    return pack_result_words(*score_topk(ids, counts, head, lengths,
+                                         idf, topk))
+
+
+# THE one-dispatch finish (round 8, --finish=scan): where the chunked
+# finish pays one program launch/re-entry per chunk — measured at ~⅔
+# of warm phase-B device time at the bench shape (docs/SCALING.md
+# round 8) — this program stacks the chunk-major resident triples and
+# lax.scan's ONE compiled body over them, emitting the full
+# [n_chunks, D, K] packed word buffer from a single dispatch. The
+# device analog of the reference's single scoring pass over all
+# records (TFIDF.c:227-246). Triples (args 0-2) are donated — they are
+# dead after the finish, and donation lets XLA reuse their HBM for the
+# stacked scan operands; lengths are NOT (profile_resident re-passes
+# the same length buffers through every re-dispatch).
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2),
+                   static_argnames=("topk",))
+def _phase_b_scan_packed(ids_parts, cnt_parts, head_parts, lens_parts,
+                         idf, *, topk: int):
+    stack = (lambda parts: parts[0][None] if len(parts) == 1
+             else jnp.stack(parts))
+    ids, cnt = stack(ids_parts), stack(cnt_parts)
+    head, lens = stack(head_parts), stack(lens_parts)
+
+    def body(carry, chunk):
+        i_, c_, h_, l_ = chunk
+        words = pack_result_words(*score_topk(i_, c_, h_, l_, idf, topk))
+        return carry, words
+
+    _, words = lax.scan(body, 0, (ids, cnt, head, lens))
+    return words  # [n_chunks, chunk_docs, K] uint32
 
 
 # DF finisher of the packed-drain resident path when the chunk folds
@@ -412,6 +446,32 @@ def use_ragged_wire(cfg: PipelineConfig, chunk_docs: int,
         return False  # the uint16 wire cannot carry the ids
     per_doc = -(-length // _wire_align()) * _wire_align()
     return chunk_docs * per_doc <= _RAGGED_MAX_IDS
+
+
+def resolve_finish(cfg: PipelineConfig) -> str:
+    """Resolve one run's phase-B finish structure from ``config.finish``
+    (env override ``TFIDF_TPU_FINISH``): ``"scan"`` — one donated
+    ``lax.scan`` dispatch over the stacked chunk triples emitting the
+    whole packed word buffer — or ``"chunked"`` — the round-7
+    per-chunk scoring dispatches with the interleaved async drain, the
+    bit-identical fallback."""
+    choice = (os.environ.get("TFIDF_TPU_FINISH")
+              or getattr(cfg, "finish", "scan"))
+    if choice not in ("scan", "chunked"):
+        raise ValueError(
+            f"unknown finish {choice!r} (TFIDF_TPU_FINISH / --finish: "
+            f"choose 'scan' or 'chunked')")
+    return choice
+
+
+def use_scan_finish(cfg: PipelineConfig, packed_wire: bool) -> bool:
+    """True when this run's phase-B finish is the single scanned
+    dispatch. Only the packed result wire has a multi-dispatch finish
+    to collapse — the pair wire's fused ``_finish_wire`` program is
+    already one dispatch — so ``--finish=scan`` quietly rides the
+    chunked/fused structure there (the cli warns when that fallback
+    bites an explicit ask)."""
+    return packed_wire and resolve_finish(cfg) == "scan"
 
 
 def rebuild_method(explicit: Optional[str] = None) -> str:
@@ -593,6 +653,18 @@ class _DrainAhead:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+def _unpack_words_rows(words: np.ndarray, score_dtype):
+    """The drain worker's host decode: packed words of ANY leading
+    shape -> row-major 2-D ``(vals, tids)``. A per-chunk [D, K] buffer
+    decodes unchanged; the scanned finish's single [n_chunks, D, K]
+    buffer flattens to chunk-major [n_chunks*D, K] rows — the same
+    concatenation order the chunked drain produces, so both finishes
+    feed one result-assembly path."""
+    vals, tids = unpack_result_words(words, score_dtype=score_dtype)
+    return (vals.reshape(-1, vals.shape[-1]),
+            tids.reshape(-1, tids.shape[-1]))
 
 
 def _chunk_step(wire_arr, lens, df_acc, cfg: PipelineConfig, length: int,
@@ -1436,6 +1508,14 @@ class IngestResult:
     result_wire: str = ""
     bytes_off_wire: Optional[int] = None
     bytes_off_wire_pair: Optional[int] = None
+    # Phase-B finish structure this run resolved to ("scan" = the
+    # single lax.scan dispatch actually ran; "chunked" = per-chunk
+    # dispatches; "fused" = the pair wire's single _finish_wire
+    # program; "" on paths the knob does not reach, e.g. mesh) and the
+    # number of phase-B scoring dispatches the finish issued — the
+    # bench artifact's dispatch.n_phase_b_dispatches field.
+    finish: str = ""
+    n_finish_dispatches: Optional[int] = None
 
 
 def make_chunk_packer(input_dir: str, cfg: PipelineConfig, chunk_docs: int,
@@ -1523,6 +1603,10 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
         raise ValueError("overlapped ingest requires VocabMode.HASHED")
     if cfg.topk is None:
         raise ValueError("overlapped ingest requires a topk selection")
+    # Persistent XLA compile cache (round 8): repeat CLI runs at the
+    # same (bucketed) wire shapes load executables from disk instead of
+    # re-paying every cold-start compile. No-op when unconfigured.
+    apply_compile_cache(getattr(cfg, "compile_cache", None))
     if spill not in ("auto", "host", "reread"):
         raise ValueError(f"unknown spill policy {spill!r}")
     length = doc_len or cfg.max_doc_len
@@ -1638,12 +1722,16 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
                       bytes_off_wire_pair=(d_padded * k
                                            * pair_slot_bytes(score_dtype)))
         if wire_vals and use_packed_result_wire(cfg):
-            # Chunked async drain (round 7): the finish splits back into
-            # per-chunk scoring dispatches against the final IDF
-            # (_phase_b_cached_packed over the resident triples), and
-            # chunk i's packed word buffer rides copy_to_host_async
-            # while chunk i+1 scores — where the fused finish serialized
-            # the whole [D, K] drain behind the last FLOP.
+            # Packed-wire finish. --finish=scan (round 8, the default):
+            # ONE donated lax.scan dispatch scores every resident chunk
+            # and emits the whole [n_chunks, D, K] word buffer, fetched
+            # by a single copy_to_host_async the drain worker unpacks
+            # chunk-major — the per-chunk launch/re-entry tax (measured
+            # ~⅔ of warm phase-B device time, docs/SCALING.md round 8)
+            # collapses to one program. --finish=chunked keeps the
+            # round-7 per-chunk dispatches, whose drains interleave
+            # with later chunks' scoring (_DrainAhead).
+            scan_finish = use_scan_finish(cfg, True)
             t0 = time.perf_counter()
             df_dev = (_df_from_trips(tuple(trip_i), tuple(trip_h),
                                      vocab_size=cfg.vocab_size)
@@ -1656,14 +1744,21 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
             df_dev.copy_to_host_async()
             bytes_off = 0
             with _DrainAhead(functools.partial(
-                    unpack_result_words, score_dtype=score_dtype)) \
+                    _unpack_words_rows, score_dtype=score_dtype)) \
                     as drain:
-                for ci in range(len(starts)):
-                    words = _phase_b_cached_packed(
-                        trip_i[ci], trip_c[ci], trip_h[ci], len_parts[ci],
-                        idf, topk=k)
+                if scan_finish:
+                    words = _phase_b_scan_packed(
+                        tuple(trip_i), tuple(trip_c), tuple(trip_h),
+                        tuple(len_parts), idf, topk=k)
                     bytes_off += words.nbytes
-                    drain.put(ci, words)
+                    drain.put(0, words)
+                else:
+                    for ci in range(len(starts)):
+                        words = _phase_b_cached_packed(
+                            trip_i[ci], trip_c[ci], trip_h[ci],
+                            len_parts[ci], idf, topk=k)
+                        bytes_off += words.nbytes
+                        drain.put(ci, words)
                 ph["score_b"] = time.perf_counter() - t0
                 t0 = time.perf_counter()
                 _trace("fetch_start")
@@ -1678,7 +1773,12 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
                                 topk_ids=tids[:num_docs],
                                 df_occupied=int((df_host > 0).sum()),
                                 phases=ph, result_wire="packed",
-                                bytes_off_wire=bytes_off, **common)
+                                bytes_off_wire=bytes_off,
+                                finish="scan" if scan_finish
+                                else "chunked",
+                                n_finish_dispatches=(1 if scan_finish
+                                                     else len(starts)),
+                                **common)
         t0 = time.perf_counter()
         wide = cfg.vocab_size > (1 << 16)
         df_dev, wire = _finish_wire((trip_i, trip_c, trip_h), len_parts,
@@ -1699,7 +1799,9 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
                             topk_ids=tids[:num_docs],
                             df_occupied=occ,
                             phases=ph, result_wire="pair",
-                            bytes_off_wire=buf.nbytes, **common)
+                            bytes_off_wire=buf.nbytes,
+                            finish="fused", n_finish_dispatches=1,
+                            **common)
 
     # Pass A: fold every chunk's partial DF into one device accumulator.
     # The loop packs chunk i+1 while the device still runs chunk i
@@ -1818,6 +1920,15 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
         # The final [V] DF read is a plain host copy by then: start
         # its transfer now, behind pass B's scoring.
         df_acc.copy_to_host_async()
+    # --finish=scan (round 8): the triple-cached chunks — a chunk-major
+    # PREFIX by construction (the cache byte budget only ever ratchets
+    # shut) — score in ONE donated scan dispatch instead of one
+    # dispatch each; chunks past the cache keep their per-chunk
+    # re-upload programs (their wire buffers arrive incrementally, so
+    # a single program cannot see them all).
+    scan_finish = use_scan_finish(cfg, packed_wire)
+    n_scanned = len(trip_cache) if scan_finish else 0
+    n_dispatches = 0
     vals_parts, ids_parts = [], []
     bytes_off = 0
     t_pass = time.perf_counter()
@@ -1826,12 +1937,25 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
     packer_b = (_PackAhead(pack_any,
                            [names[starts[ci]:starts[ci] + chunk_docs]
                             for ci in reread]) if reread else None)
-    drain = (_DrainAhead(functools.partial(unpack_result_words,
+    drain = (_DrainAhead(functools.partial(_unpack_words_rows,
                                            score_dtype=score_dtype))
              if packed_wire else None)
     bpos = 0
     try:
+        if n_scanned:
+            cidx = sorted(trip_cache)
+            assert cidx == list(range(n_scanned))  # prefix by constr.
+            trips = [trip_cache.pop(ci) for ci in cidx]
+            words = _phase_b_scan_packed(
+                tuple(t[0] for t in trips), tuple(t[1] for t in trips),
+                tuple(t[2] for t in trips), tuple(t[3] for t in trips),
+                idf, topk=k)
+            bytes_off += words.nbytes
+            n_dispatches += 1
+            drain.put(n_scanned - 1, words)
         for ci, start in enumerate(starts):
+            if ci < n_scanned:
+                continue  # scored by the scanned prefix dispatch
             if ci in trip_cache:
                 i_, c_, h_, lens_dev = trip_cache.pop(ci)
                 if packed_wire:
@@ -1856,6 +1980,7 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
                     words = out
                 else:
                     v, t = out
+            n_dispatches += 1
             if packed_wire:
                 bytes_off += words.nbytes
                 drain.put(ci, words)  # depth guard bounds in-flight
@@ -1906,7 +2031,12 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
                         result_wire="packed" if packed_wire else "pair",
                         bytes_off_wire=bytes_off,
                         bytes_off_wire_pair=(len(starts) * chunk_docs * k
-                                             * pair_slot_bytes(score_dtype)))
+                                             * pair_slot_bytes(score_dtype)),
+                        # "scan" only when the scanned prefix actually
+                        # ran (an empty triple cache leaves nothing for
+                        # one program to see — pure chunked flow).
+                        finish="scan" if n_scanned else "chunked",
+                        n_finish_dispatches=n_dispatches)
 
 
 @dataclasses.dataclass
@@ -2098,12 +2228,18 @@ def profile_resident(input_dir: str, config: Optional[PipelineConfig] = None,
     # Compute fenced as one block: the production per-chunk programs
     # plus the finish — the same executables the resident path
     # dispatches, so "compute" is its true device cost (plus the lazy
-    # transfers, see above). On the packed result wire the finish IS
-    # the per-chunk scoring dispatches (_phase_b_cached_packed); the
-    # pair wire keeps the fused _finish_wire — the profiler always
-    # mirrors the production program structure (cache-sharing
-    # doctrine, tests/test_ingest.py profiler test).
+    # transfers, see above). On the packed result wire the finish
+    # mirrors the resolved --finish structure: ONE scanned dispatch
+    # (_phase_b_scan_packed) or the per-chunk scoring dispatches
+    # (_phase_b_cached_packed); the pair wire keeps the fused
+    # _finish_wire — the profiler always mirrors the production
+    # program structure (cache-sharing doctrine, tests/test_ingest.py
+    # profiler test).
     packed_wire = use_packed_result_wire(cfg)
+    scan_finish = use_scan_finish(cfg, packed_wire)
+    ph["n_phase_b_dispatches"] = float(1 if (scan_finish
+                                             or not packed_wire)
+                                       else len(starts))
 
     def compute_once():
         df_acc = jnp.zeros((cfg.vocab_size,), jnp.int32)
@@ -2121,6 +2257,10 @@ def profile_resident(input_dir: str, config: Optional[PipelineConfig] = None,
                       if _resident_df_mode()[1] else df_acc)
             idf = _final_idf(df_dev, jnp.int32(num_docs),
                              score_dtype=score_dtype)
+            if scan_finish:
+                return _phase_b_scan_packed(
+                    tuple(trip_i), tuple(trip_c), tuple(trip_h),
+                    tuple(len_parts), idf, topk=k)
             return [_phase_b_cached_packed(i_, c_, h_, lens, idf, topk=k)
                     for i_, c_, h_, lens in zip(trip_i, trip_c, trip_h,
                                                 len_parts)]

@@ -303,3 +303,103 @@ def pack_words_pallas(vals: jax.Array, tids: jax.Array, *,
         interpret=interpret,
     )(v, t)
     return out[:d]
+
+
+# --- fused score + top-k ---------------------------------------------
+#
+# The phase-B scoring step (ingest round 8): XLA lowers it as
+# sparse_scores (a [D, L] tf*idf materialization) feeding a separate
+# lax.top_k sort network over all L slots. This kernel fuses the whole
+# per-doc selection into one Mosaic program per doc tile: gather IDF
+# for the sorted triple stream, form tf*idf, and select the top k by k
+# rounds of max-reduce + mask — never materializing the score array
+# outside VMEM and never running the L-wide sort network (k << L).
+# Selection semantics are EXACTLY sparse_topk's: scores masked to
+# finfo.min off-head, ties broken toward the lower slot index (what
+# lax.top_k does), invalid survivors decode to (0, -1).
+#
+# MEASURED SCOPE: like ragged_rebuild_pallas and pack_words_pallas this
+# ships as the in-tree A/B probe (TFIDF_TPU_SCORE=pallas), pinned
+# bit-identical on ids / allclose on scores against the XLA lowering by
+# tests/test_finish.py. The in-kernel [V]-table gather is the op class
+# the round-5 trace indicted on this backend, so the XLA path (whose
+# sort-join avoids the gather entirely) stays the measured default.
+
+
+def _fused_score_topk_kernel(ids_ref, cnt_ref, head_ref, len_ref,
+                             idf_ref, vals_ref, tids_ref, *, k, length):
+    dtype = idf_ref.dtype
+    neg = jnp.finfo(dtype).min
+    ids = ids_ref[...]                          # [TILE_D, L] int32
+    head = head_ref[...] != 0                   # int32 mask -> bool
+    lens = jnp.maximum(len_ref[...], 1).astype(dtype)  # [TILE_D, 1]
+    safe = jnp.where(head, ids, 0)
+    # The IDF join, in-kernel: one gather from the [V] table resident
+    # in VMEM (256 KB at 2^16 f32 — far under the ~16 MB budget).
+    idf_slot = jnp.take(idf_ref[0, :], safe)
+    score = cnt_ref[...].astype(dtype) / lens * idf_slot
+    scores = jnp.where(head, score, neg)
+    pos = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+
+    def select(j, carry):
+        scores, vals, tids = carry
+        m = jnp.max(scores, axis=1)             # [TILE_D]
+        # lax.top_k tie order: the LOWEST index among equal scores
+        # wins each round.
+        hit = scores == m[:, None]
+        idx = jnp.min(jnp.where(hit, pos, length), axis=1)
+        one = pos == idx[:, None]
+        tid = jnp.sum(jnp.where(one, ids, 0), axis=1)
+        ok = m > neg
+        vals = jax.lax.dynamic_update_slice(
+            vals, jnp.where(ok, m, jnp.zeros((), dtype))[:, None], (0, j))
+        tids = jax.lax.dynamic_update_slice(
+            tids, jnp.where(ok, tid, -1)[:, None], (0, j))
+        return jnp.where(one, neg, scores), vals, tids
+
+    vals0 = jnp.zeros((scores.shape[0], k), dtype)
+    tids0 = jnp.full((scores.shape[0], k), -1, jnp.int32)
+    _, vals, tids = jax.lax.fori_loop(0, k, select,
+                                      (scores, vals0, tids0))
+    vals_ref[...] = vals
+    tids_ref[...] = tids
+
+
+@functools.partial(jax.jit, static_argnames=("k", "interpret"))
+def fused_score_topk_pallas(ids: jax.Array, counts: jax.Array,
+                            head: jax.Array, lengths: jax.Array,
+                            idf: jax.Array, *, k: int,
+                            interpret: bool = False):
+    """Fused tf*idf scoring + per-doc top-k over the sorted triple
+    stream (the ``sparse_scores`` -> ``sparse_topk`` pair as ONE Mosaic
+    kernel). Returns ``(vals [D, k], tids [D, k])`` per the sparse_topk
+    contract: ids bit-identical to the XLA lowering (same selection,
+    same tie order), scores the same float formula (allclose; the only
+    divergence is op-reassociation headroom Mosaic is allowed)."""
+    d, length = ids.shape
+    k = min(k, length)
+    dp = _pad_to(d, TILE_D)
+    pad2 = lambda a, fill: jnp.full((dp, length), fill, a.dtype) \
+        .at[:d].set(a)
+    ids_p = pad2(ids.astype(jnp.int32), 0)
+    cnt_p = pad2(counts.astype(jnp.int32), 0)
+    # head rides as int32: padding rows are all-zero = no head slots,
+    # so they select nothing and decode to the (0, -1) contract.
+    head_p = pad2(head.astype(jnp.int32), 0)
+    lens_p = jnp.zeros((dp, 1), jnp.int32).at[:d, 0].set(lengths)
+    idf2 = idf.reshape(1, -1)
+    vals, tids = pl.pallas_call(
+        functools.partial(_fused_score_topk_kernel, k=k, length=length),
+        grid=(dp // TILE_D,),
+        in_specs=[pl.BlockSpec((TILE_D, length), lambda i: (i, 0)),
+                  pl.BlockSpec((TILE_D, length), lambda i: (i, 0)),
+                  pl.BlockSpec((TILE_D, length), lambda i: (i, 0)),
+                  pl.BlockSpec((TILE_D, 1), lambda i: (i, 0)),
+                  pl.BlockSpec((1, idf2.shape[1]), lambda i: (0, 0))],
+        out_specs=[pl.BlockSpec((TILE_D, k), lambda i: (i, 0)),
+                   pl.BlockSpec((TILE_D, k), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((dp, k), idf.dtype),
+                   jax.ShapeDtypeStruct((dp, k), jnp.int32)],
+        interpret=interpret,
+    )(ids_p, cnt_p, head_p, lens_p, idf2)
+    return vals[:d], tids[:d]

@@ -233,6 +233,47 @@ def sparse_scores_joined(counts: jax.Array, head: jax.Array,
     return jnp.where(head, score, jnp.zeros((), dtype))
 
 
+def score_method(explicit: Optional[str] = None) -> str:
+    """Resolve the phase-B score+select lowering: ``"xla"`` (the
+    measured default — ``sparse_scores`` feeding ``sparse_topk``, which
+    XLA fuses into the scoring program) or ``"pallas"`` (the fused
+    Mosaic score/top-k kernel, ``ops.pallas_kernels.
+    fused_score_topk_pallas`` — in-tree A/B probe: IDF gather, tf*idf,
+    and k max-reduce selection rounds in one kernel, no [D, L] score
+    materialization outside VMEM and no L-wide top_k sort network).
+    Override via ``TFIDF_TPU_SCORE``; trace-time static like
+    :func:`join_method`."""
+    if explicit is not None:
+        return explicit
+    method = os.environ.get("TFIDF_TPU_SCORE") or "xla"
+    if method not in ("xla", "pallas"):
+        raise ValueError(f"unknown TFIDF_TPU_SCORE method {method!r}")
+    return method
+
+
+def score_topk(ids: jax.Array, counts: jax.Array, head: jax.Array,
+               lengths: jax.Array, idf: jax.Array, k: int,
+               method: Optional[str] = None
+               ) -> Tuple[jax.Array, jax.Array]:
+    """THE phase-B score+select step (single definition, traceable):
+    sorted triples + the final IDF -> per-doc top-k ``(vals, tids)``
+    per the :func:`sparse_topk` contract. Routed by
+    :func:`score_method`: the XLA lowering or the fused Pallas kernel
+    (ids bit-identical, scores allclose — pinned by
+    tests/test_finish.py). Every phase-B call site of the overlapped
+    ingest and the streaming scorer goes through here, so the
+    ``TFIDF_TPU_SCORE`` knob covers the whole stack; mesh bodies keep
+    the explicit XLA pair (a Pallas call inside shard_map is not part
+    of the probe's scope)."""
+    if score_method(method) == "pallas":
+        from tfidf_tpu.ops.pallas_kernels import (default_interpret,
+                                                  fused_score_topk_pallas)
+        return fused_score_topk_pallas(ids, counts, head, lengths, idf,
+                                       k=k, interpret=default_interpret())
+    scores = sparse_scores(ids, counts, head, lengths, idf)
+    return sparse_topk(scores, ids, head, k)
+
+
 def sparse_topk(scores: jax.Array, ids: jax.Array, head: jax.Array, k: int
                 ) -> Tuple[jax.Array, jax.Array]:
     """Per-doc top-k over the row-sparse axis (L candidates, not V)."""

@@ -16,17 +16,59 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+# lax.top_k over a flattened [D*V] stream returns int32 indices, and the
+# doc/vocab split (flat // v, flat % v) silently wraps past 2^31 slots —
+# the same int32 bound ingest._check_chunk_fits_int32 guards on the
+# upload side. Past it topk_global switches to a two-stage selection
+# that never builds the D*V flat index (see below).
+_INT32_SLOTS = 1 << 31
+
 
 def topk_per_doc(scores: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
     """Top-k (value, vocab-id) per document. [D, V] -> ([D, K], [D, K])."""
     return lax.top_k(scores, k)
 
 
-def topk_global(scores: jax.Array, k: int) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Global top-k (value, doc-id, vocab-id) over all [D, V] records."""
+def topk_global(scores: jax.Array, k: int
+                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Global top-k (value, doc-id, vocab-id) over all [D, V] records.
+
+    Within the int32 flat bound the lowering is one ``lax.top_k`` over
+    the flattened scores. At ``D*V >= 2^31`` that flat index would wrap
+    silently, so the selection runs in two stages instead: a per-doc
+    top-k first (each document can contribute at most k records to the
+    global winners), then a global top-k over the [D, k'] survivors —
+    doc ids come from the small k'-wide flat index and vocab ids ride
+    along from the per-doc stage, so no D*V index is ever built. Values
+    are identical; among EQUAL scores the survivor order may differ
+    from the single-stage lowering (both are valid top-k sets).
+    """
     d, v = scores.shape
-    vals, flat = lax.top_k(scores.reshape(-1), k)
-    return vals, flat // v, flat % v
+    k = min(k, d * v)
+    if d * v < _INT32_SLOTS:
+        vals, flat = lax.top_k(scores.reshape(-1), k)
+        return vals, flat // v, flat % v
+    return _topk_global_two_stage(scores, k)
+
+
+def _topk_global_two_stage(scores: jax.Array, k: int
+                           ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """The beyond-int32 lowering of :func:`topk_global` (also unit-
+    tested directly at small shapes, where allocating 2^31 slots is
+    impossible). Raises when even the per-doc survivors overflow the
+    int32 flat index — a corpus that large must shard the docs axis
+    (``parallel``) before selecting globally."""
+    d, v = scores.shape
+    kk = min(k, v)
+    if d * kk >= _INT32_SLOTS:
+        raise ValueError(
+            f"topk_global over {d} x {v} records: even the per-doc "
+            f"top-{kk} survivors ({d * kk} slots) overflow the int32 "
+            f"flat selection index (>= 2^31); shard the docs axis "
+            f"(parallel) or lower k")
+    per_vals, per_ids = lax.top_k(scores, kk)        # [D, kk]
+    vals, flat = lax.top_k(per_vals.reshape(-1), k)  # over D*kk < 2^31
+    return vals, flat // kk, per_ids.reshape(-1)[flat]
 
 
 def topk_terms(scores: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:

@@ -38,8 +38,8 @@ from tfidf_tpu.ops.downlink import (pack_words, unpack_result_words,
                                     use_packed_result_wire)
 from tfidf_tpu.ops.histogram import df_from_counts, tf_counts
 from tfidf_tpu.ops.scoring import idf_from_df, tfidf_dense
-from tfidf_tpu.ops.sparse import (sorted_term_counts, sparse_df,
-                                  sparse_scores, sparse_topk)
+from tfidf_tpu.ops.sparse import (score_topk, sorted_term_counts,
+                                  sparse_df, sparse_scores, sparse_topk)
 from tfidf_tpu.parallel.mesh import DOCS_AXIS, MeshPlan
 
 
@@ -78,11 +78,13 @@ def _score_batch(df_state, num_docs, token_ids, lengths, *,
 def _score_batch_sparse(df_state, num_docs, token_ids, lengths, *,
                         vocab_size: int, topk: int, score_dtype):
     """Sort+RLE scoring: the [batch, V] score matrix is never built —
-    per-doc candidates are the L row slots (sparse_topk)."""
+    per-doc candidates are the L row slots. Routed through
+    ``ops.sparse.score_topk`` like the ingest phase-B kernels, so
+    ``TFIDF_TPU_SCORE=pallas`` selects the fused Mosaic score/top-k
+    kernel here too (mesh bodies keep the explicit XLA pair)."""
     ids, counts, head = sorted_term_counts(token_ids, lengths)
     idf = idf_from_df(df_state, num_docs, score_dtype)
-    scores = sparse_scores(ids, counts, head, lengths, idf)
-    return sparse_topk(scores, ids, head, topk)
+    return score_topk(ids, counts, head, lengths, idf, topk)
 
 
 # Docs-sharded sort+RLE minibatch kernels: DF state rides replicated,

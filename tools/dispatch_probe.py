@@ -1,0 +1,116 @@
+"""A/B probe of the phase-B dispatch tax on the current backend.
+
+Times the SAME resident finish work two ways, warm, fenced once per
+protocol (the pipelined-chain methodology of tools/roofline.py):
+
+  a) chunked — n identical per-chunk scoring dispatches
+     (``ingest._phase_b_cached_packed``, the round-7 structure)
+  b) scan    — ONE donated ``lax.scan`` dispatch over the stacked
+     chunk triples (``ingest._phase_b_scan_packed``, round 8)
+
+Identical packed words out of both (asserted), so the wall delta is
+pure dispatch structure: per-program launch/re-entry cost × (n − 1),
+plus whatever fusion headroom the single program buys. On the tunneled
+backend each dispatch costs ~8 ms (docs/SCALING.md) — the fixed cost
+this probe makes visible; on CPU it measures the XLA callback floor.
+
+Usage: python tools/dispatch_probe.py [--docs 8192] [--len 256]
+       [--chunks 4] [--repeats 5] [--topk 16]
+Prints one JSON line, like the other tools.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+REPO = __file__.rsplit("/", 2)[0]
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from tfidf_tpu.ingest import (_phase_b_cached_packed,  # noqa: E402
+                              _phase_b_scan_packed)
+from tfidf_tpu.ops.scoring import idf_from_df  # noqa: E402
+from tfidf_tpu.ops.sparse import sorted_term_counts  # noqa: E402
+
+VOCAB = 1 << 16
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--docs", type=int, default=8192,
+                    help="docs per chunk")
+    ap.add_argument("--len", type=int, dest="length", default=256)
+    ap.add_argument("--chunks", type=int, default=4)
+    ap.add_argument("--topk", type=int, default=16)
+    ap.add_argument("--repeats", type=int, default=5)
+    args = ap.parse_args()
+    d, length, n, k = args.docs, args.length, args.chunks, args.topk
+
+    rng = np.random.default_rng(0)
+    trips, lens = [], []
+    df = np.zeros((VOCAB,), np.int64)
+    for _ in range(n):
+        toks = np.minimum(rng.zipf(1.3, (d, length)), VOCAB) - 1
+        ll = rng.integers(1, length + 1, d).astype(np.int32)
+        i_, c_, h_ = sorted_term_counts(jnp.asarray(toks, jnp.int32),
+                                        jnp.asarray(ll))
+        trips.append((i_, c_, h_))
+        lens.append(jnp.asarray(ll))
+    # any plausible DF serves — the probe times structure, not values
+    df = jnp.asarray(rng.integers(0, n * d, VOCAB).astype(np.int32))
+    idf = idf_from_df(df, jnp.int32(n * d), jnp.float32)
+    jax.block_until_ready((trips, lens, idf))
+
+    def chunked_once():
+        return [_phase_b_cached_packed(i_, c_, h_, ll, idf, topk=k)
+                for (i_, c_, h_), ll in zip(trips, lens)]
+
+    def fresh_trips():
+        # the scan donates its triple inputs, so every timed call gets
+        # pre-staged copies — copied and FENCED outside the timer, the
+        # way production triples already sit resident when the finish
+        # dispatches
+        f = [tuple(jnp.copy(t) for t in tr) for tr in trips]
+        jax.block_until_ready(f)
+        return f
+
+    def scan_once(fresh):
+        return _phase_b_scan_packed(
+            tuple(t[0] for t in fresh), tuple(t[1] for t in fresh),
+            tuple(t[2] for t in fresh), tuple(lens), idf, topk=k)
+
+    # warm both programs and pin value parity
+    words_c = jax.block_until_ready(chunked_once())
+    words_s = jax.block_until_ready(scan_once(fresh_trips()))
+    np.testing.assert_array_equal(
+        np.stack([np.asarray(w) for w in words_c]), np.asarray(words_s))
+
+    def best_of(fn, staged):
+        best = float("inf")
+        for _ in range(args.repeats):
+            arg = staged() if staged else None
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(arg) if staged else fn())
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    chunked_s = best_of(chunked_once, None)
+    scan_s = best_of(scan_once, fresh_trips)
+    print(json.dumps({
+        "backend": jax.default_backend(),
+        "chunks": n, "docs_per_chunk": d, "len": length, "topk": k,
+        "chunked_s": round(chunked_s, 4),
+        "scan_s": round(scan_s, 4),
+        "dispatch_tax_s": round(chunked_s - scan_s, 4),
+        "per_dispatch_s": round((chunked_s - scan_s) / max(n - 1, 1), 5),
+    }))
+
+
+if __name__ == "__main__":
+    main()
