@@ -10,7 +10,8 @@ from tfidf_tpu import PipelineConfig, TfidfPipeline
 from tfidf_tpu.config import VocabMode
 from tfidf_tpu.io.corpus import Corpus
 from tfidf_tpu.models import TfidfVectorizer
-from tfidf_tpu.utils import PhaseTimer, Throughput, trace_region
+from tfidf_tpu.utils import (LatencyHistogram, PhaseTimer, Throughput,
+                             trace_region)
 
 CFG = PipelineConfig(engine="dense", vocab_mode=VocabMode.HASHED,
                      vocab_size=256,
@@ -92,3 +93,56 @@ class TestUtils:
             pass
         with trace_region("x", enabled=True):
             pass  # must not raise with jax importable
+
+
+class TestLatencyHistogram:
+    def test_percentiles_within_bucket_resolution(self):
+        h = LatencyHistogram()
+        for ms in range(1, 101):  # 1..100 ms uniform
+            h.record(ms / 1e3)
+        assert h.count == 100
+        assert h.percentile(50) == pytest.approx(0.050, rel=0.05)
+        assert h.percentile(95) == pytest.approx(0.095, rel=0.05)
+        assert h.percentile(99) == pytest.approx(0.099, rel=0.05)
+        assert h.mean == pytest.approx(0.0505, rel=1e-6)
+
+    def test_min_max_exact_and_percentile_clamped(self):
+        h = LatencyHistogram()
+        for v in (0.003, 0.007, 0.011):
+            h.record(v)
+        assert h.min == 0.003 and h.max == 0.011
+        assert h.percentile(0) == pytest.approx(0.003, rel=0.05)
+        assert h.percentile(100) == 0.011  # clamped to exact max
+
+    def test_empty_and_reset(self):
+        h = LatencyHistogram()
+        assert h.percentile(99) == 0.0
+        assert h.as_dict()["count"] == 0
+        h.record(0.5)
+        h.reset()
+        assert h.count == 0 and h.max == 0.0
+
+    def test_as_dict_schema(self):
+        h = LatencyHistogram()
+        h.record(0.25)
+        d = h.as_dict()
+        assert set(d) == {"count", "mean", "min", "max",
+                          "p50", "p95", "p99"}
+        assert d["count"] == 1
+        assert d["p50"] == pytest.approx(0.25, rel=0.05)
+
+    def test_out_of_range_clamps_but_tracks_exact_extremes(self):
+        h = LatencyHistogram(lo=1e-3, hi=1.0)
+        h.record(1e-9)   # below lo -> underflow bucket
+        h.record(50.0)   # above hi -> top bucket
+        assert h.min == 1e-9 and h.max == 50.0
+        assert h.percentile(100) == 50.0
+        assert h.percentile(0) == 1e-9  # clamped to exact observed min
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            LatencyHistogram(lo=0)
+        with pytest.raises(ValueError):
+            LatencyHistogram(resolution=0)
+        with pytest.raises(ValueError):
+            LatencyHistogram().percentile(101)

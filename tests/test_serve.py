@@ -1,0 +1,629 @@
+"""Serving layer: micro-batching, cache, admission, SLOs, parity.
+
+The hard invariant everywhere: a served response is BIT-IDENTICAL to a
+direct ``TfidfRetriever.search`` of the same queries — under
+coalescing, caching, concurrent submission, and across hot index
+swaps. Per-query results are independent of batch composition (each
+query is one column of the [V, Q] block), so this is a real contract,
+not an approximation.
+"""
+
+import io
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tfidf_tpu import PipelineConfig, ServeConfig
+from tfidf_tpu.config import VocabMode
+from tfidf_tpu.io.corpus import Corpus
+from tfidf_tpu.models import TfidfRetriever
+from tfidf_tpu.serve import (DeadlineExceeded, MicroBatcher, Overloaded,
+                             ResultCache, ServeError, ServeMetrics,
+                             TfidfServer, normalize_query)
+
+CFG = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=512,
+                     max_doc_len=16, doc_chunk=16)
+CORPUS = Corpus(
+    names=["doc1", "doc2", "doc3", "doc4", "doc5"],
+    docs=[b"apple banana apple cherry",
+          b"banana banana date",
+          b"cherry date elder fig",
+          b"apple fig fig fig",
+          b"grape grape grape grape"])
+CORPUS_B = Corpus(
+    names=["doc1", "doc2", "doc3"],
+    docs=[b"zebra yak apple",
+          b"yak yak quokka",
+          b"quokka zebra grape"])
+QUERIES = ["apple cherry", "banana", "grape date", "fig", "elder",
+           "apple fig", "date banana cherry"]
+
+
+@pytest.fixture(scope="module")
+def retriever():
+    return TfidfRetriever(CFG).index(CORPUS)
+
+
+def quick_cfg(**kw):
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("max_wait_ms", 5)
+    kw.setdefault("queue_depth", 64)
+    kw.setdefault("cache_entries", 64)
+    return ServeConfig(**kw)
+
+
+def assert_identical(got, want):
+    gv, gi = got
+    wv, wi = want
+    np.testing.assert_array_equal(np.asarray(gv), np.asarray(wv))
+    np.testing.assert_array_equal(np.asarray(gi), np.asarray(wi))
+
+
+class TestMicroBatcher:
+    def _searcher(self, retriever, calls=None):
+        def fn(queries, k, group):
+            if calls is not None:
+                calls.append(list(queries))
+            return retriever.search(queries, k)
+        return fn
+
+    def test_single_request_parity(self, retriever):
+        b = MicroBatcher(self._searcher(retriever), max_batch=8,
+                         max_wait_ms=1)
+        try:
+            got = b.submit(QUERIES[:3], k=4).result(timeout=10)
+            assert_identical(got, retriever.search(QUERIES[:3], k=4))
+        finally:
+            b.close()
+
+    def test_coalesces_concurrent_submits(self, retriever):
+        calls = []
+        m = ServeMetrics()
+        # Long window: all three submits land before the first flush.
+        b = MicroBatcher(self._searcher(retriever, calls), max_batch=64,
+                         max_wait_ms=250, metrics=m)
+        try:
+            futs = [b.submit([q], k=3) for q in QUERIES[:3]]
+            for f, q in zip(futs, QUERIES[:3]):
+                assert_identical(f.result(timeout=10),
+                                 retriever.search([q], k=3))
+        finally:
+            b.close()
+        assert len(calls) == 1 and len(calls[0]) == 3
+        assert m.snapshot()["batch"]["count"] == 1
+
+    def test_full_batch_flushes_before_deadline(self, retriever):
+        calls = []
+        b = MicroBatcher(self._searcher(retriever, calls), max_batch=2,
+                         max_wait_ms=60_000)  # deadline would be "never"
+        try:
+            t0 = time.monotonic()
+            f1 = b.submit([QUERIES[0]], k=2)
+            f2 = b.submit([QUERIES[1]], k=2)
+            f1.result(timeout=10)
+            f2.result(timeout=10)
+            assert time.monotonic() - t0 < 30  # not the 60 s window
+        finally:
+            b.close()
+
+    def test_deadline_flushes_partial_batch(self, retriever):
+        b = MicroBatcher(self._searcher(retriever), max_batch=1024,
+                         max_wait_ms=20)
+        try:
+            t0 = time.monotonic()
+            got = b.submit([QUERIES[0]], k=2).result(timeout=10)
+            took = time.monotonic() - t0
+            assert_identical(got, retriever.search([QUERIES[0]], k=2))
+            assert took < 10  # flushed by the 20 ms window, not by fill
+        finally:
+            b.close()
+
+    def test_mixed_k_never_shares_a_batch(self, retriever):
+        calls = []
+        b = MicroBatcher(self._searcher(retriever, calls), max_batch=64,
+                         max_wait_ms=100)
+        try:
+            f2 = b.submit([QUERIES[0]], k=2)
+            f3 = b.submit([QUERIES[1]], k=3)
+            assert_identical(f2.result(timeout=10),
+                             retriever.search([QUERIES[0]], k=2))
+            assert_identical(f3.result(timeout=10),
+                             retriever.search([QUERIES[1]], k=3))
+        finally:
+            b.close()
+        assert len(calls) == 2  # one batch per k
+
+    def test_mixed_group_never_shares_a_batch(self, retriever):
+        calls = []
+        b = MicroBatcher(self._searcher(retriever, calls), max_batch=64,
+                         max_wait_ms=100)
+        try:
+            fa = b.submit([QUERIES[0]], k=2, group="epoch0")
+            fb = b.submit([QUERIES[1]], k=2, group="epoch1")
+            fa.result(timeout=10)
+            fb.result(timeout=10)
+        finally:
+            b.close()
+        assert len(calls) == 2
+
+    def test_oversize_request_stays_atomic(self, retriever):
+        calls = []
+        b = MicroBatcher(self._searcher(retriever, calls), max_batch=2,
+                         max_wait_ms=5)
+        try:
+            got = b.submit(QUERIES, k=3).result(timeout=10)  # 7 > 2
+            assert_identical(got, retriever.search(QUERIES, k=3))
+        finally:
+            b.close()
+        assert [len(c) for c in calls] == [len(QUERIES)]
+
+    def test_search_error_propagates_to_all_coalesced(self):
+        def boom(queries, k, group):
+            raise RuntimeError("kernel exploded")
+        b = MicroBatcher(boom, max_batch=64, max_wait_ms=100)
+        try:
+            futs = [b.submit(["x"], k=1) for _ in range(3)]
+            for f in futs:
+                with pytest.raises(RuntimeError, match="kernel exploded"):
+                    f.result(timeout=10)
+        finally:
+            b.close()
+
+    def test_expired_deadline_sheds_before_device(self, retriever):
+        calls = []
+        m = ServeMetrics()
+        b = MicroBatcher(self._searcher(retriever, calls), max_batch=8,
+                         max_wait_ms=20, metrics=m)
+        try:
+            f = b.submit([QUERIES[0]], k=2,
+                         deadline=time.monotonic())  # already expired
+            with pytest.raises(DeadlineExceeded):
+                f.result(timeout=10)
+        finally:
+            b.close()
+        assert calls == []  # never reached the search fn
+        assert m.snapshot()["shed"]["deadline"] == 1
+
+    def test_close_drains_queued_work(self, retriever):
+        b = MicroBatcher(self._searcher(retriever), max_batch=1024,
+                         max_wait_ms=60_000)
+        futs = [b.submit([q], k=2) for q in QUERIES[:3]]
+        b.close(drain=True)  # must not wait for the 60 s window
+        for f, q in zip(futs, QUERIES[:3]):
+            assert_identical(f.result(timeout=0),
+                             retriever.search([q], k=2))
+
+    def test_close_without_drain_fails_pending(self, retriever):
+        b = MicroBatcher(self._searcher(retriever), max_batch=1024,
+                         max_wait_ms=60_000)
+        f = b.submit([QUERIES[0]], k=2)
+        b.close(drain=False)
+        with pytest.raises(ServeError):
+            f.result(timeout=10)
+
+    def test_submit_after_close_raises(self, retriever):
+        b = MicroBatcher(self._searcher(retriever))
+        b.close()
+        with pytest.raises(ServeError):
+            b.submit(["x"], k=1)
+
+
+class TestResultCache:
+    def test_hit_miss_counters_and_lru_eviction(self):
+        c = ResultCache(entries=2)
+        row = (np.arange(3, dtype=np.float32), np.arange(3))
+        k1, k2, k3 = (c.key((b"a",), 3, 0), c.key((b"b",), 3, 0),
+                      c.key((b"c",), 3, 0))
+        assert c.get(k1) is None and c.misses == 1
+        c.put(k1, *row)
+        c.put(k2, *row)
+        assert c.get(k1) is not None  # touches k1: k2 becomes LRU
+        c.put(k3, *row)               # evicts k2
+        assert c.get(k2) is None
+        assert c.get(k3) is not None
+        assert c.hits == 2 and c.misses == 2
+        assert len(c) == 2
+
+    def test_normalization_collapses_whitespace(self):
+        assert (normalize_query("  apple\t cherry \n", CFG)
+                == normalize_query("apple cherry", CFG)
+                == (b"apple", b"cherry"))
+        # truncation participates: keys match scoring equality
+        cfg_trunc = PipelineConfig(vocab_mode=VocabMode.HASHED,
+                                   truncate_tokens_at=4)
+        assert (normalize_query("apples", cfg_trunc)
+                == normalize_query("appleXYZ", cfg_trunc))
+
+    def test_epoch_is_part_of_the_key(self):
+        c = ResultCache(entries=8)
+        row = (np.zeros(2, np.float32), np.zeros(2, np.int32))
+        c.put(c.key((b"a",), 2, epoch=0), *row)
+        assert c.get(c.key((b"a",), 2, epoch=1)) is None
+        assert c.get(c.key((b"a",), 2, epoch=0)) is not None
+
+    def test_disabled_cache_never_counts(self):
+        c = ResultCache(entries=0)
+        key = c.key((b"a",), 2, 0)
+        c.put(key, np.zeros(2, np.float32), np.zeros(2, np.int32))
+        assert c.get(key) is None
+        assert c.hits == 0 and c.misses == 0
+        assert not c.enabled
+
+    def test_cached_rows_are_immutable(self):
+        c = ResultCache(entries=4)
+        vals = np.arange(3, dtype=np.float32)
+        key = c.key((b"a",), 3, 0)
+        c.put(key, vals, np.arange(3))
+        vals[0] = 99  # caller mutates its own array after put
+        got = c.get(key)
+        assert got[0][0] == 0  # cache kept its own copy
+        with pytest.raises(ValueError):
+            got[0][0] = 7  # and hands out read-only views
+
+
+class TestTfidfServer:
+    def test_sequential_parity_mixed_sizes(self, retriever):
+        with TfidfServer(retriever, quick_cfg()) as srv:
+            for size in (1, 2, 3, 5, 7):
+                qs = QUERIES[:size]
+                assert_identical(srv.search(qs, k=4),
+                                 retriever.search(qs, k=4))
+
+    def test_stress_concurrent_parity(self, retriever):
+        """N threads x mixed-size requests; every response bit-identical
+        to a direct search of the same queries (the ISSUE's stress
+        pin)."""
+        srv = TfidfServer(retriever, quick_cfg(max_wait_ms=2))
+        results = {}
+        errors = []
+
+        def work(tid):
+            try:
+                rng = np.random.default_rng(tid)
+                out = []
+                for _ in range(5):
+                    qs = [QUERIES[i] for i in rng.integers(
+                        0, len(QUERIES), size=int(rng.integers(1, 6)))]
+                    out.append((qs, srv.search(qs, k=3, timeout=30)))
+                results[tid] = out
+            except Exception as e:  # noqa: BLE001 — surface in-main
+                errors.append(e)
+
+        threads = [threading.Thread(target=work, args=(t,))
+                   for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        srv.close()
+        assert not errors
+        assert len(results) == 8
+        for out in results.values():
+            for qs, got in out:
+                assert_identical(got, retriever.search(qs, k=3))
+
+    def test_cache_hit_is_bit_identical_and_counted(self, retriever):
+        srv = TfidfServer(retriever, quick_cfg())
+        try:
+            first = srv.search(QUERIES[:2], k=3)
+            before = srv.metrics_snapshot()["cache"]
+            second = srv.search(QUERIES[:2], k=3)
+            after = srv.metrics_snapshot()["cache"]
+            assert_identical(second, first)
+            assert_identical(second, retriever.search(QUERIES[:2], k=3))
+            assert after["hits"] == before["hits"] + 2
+            assert after["misses"] == before["misses"]
+        finally:
+            srv.close()
+
+    def test_partial_cache_hit_assembles_exactly(self, retriever):
+        srv = TfidfServer(retriever, quick_cfg())
+        try:
+            srv.search([QUERIES[0]], k=3)  # prime one of three
+            got = srv.search(QUERIES[:3], k=3)
+            assert_identical(got, retriever.search(QUERIES[:3], k=3))
+            assert srv.metrics_snapshot()["cache"]["hits"] >= 1
+        finally:
+            srv.close()
+
+    def test_overload_sheds_with_typed_error(self, retriever):
+        # Window long enough that submits stay queued: the 3rd of three
+        # 1-query requests exceeds queue_depth=2 at admission.
+        srv = TfidfServer(retriever, quick_cfg(
+            queue_depth=2, max_batch=1024, max_wait_ms=5_000,
+            cache_entries=0))
+        try:
+            f1 = srv.submit([QUERIES[0]], k=2)
+            f2 = srv.submit([QUERIES[1]], k=2)
+            with pytest.raises(Overloaded):
+                srv.submit([QUERIES[2]], k=2)
+            assert srv.metrics_snapshot()["shed"]["overload"] == 1
+        finally:
+            srv.close(drain=True)
+        # the admitted two still completed correctly on drain
+        assert_identical(f1.result(timeout=0),
+                         retriever.search([QUERIES[0]], k=2))
+        assert_identical(f2.result(timeout=0),
+                         retriever.search([QUERIES[1]], k=2))
+
+    def test_inflight_releases_after_completion(self, retriever):
+        srv = TfidfServer(retriever, quick_cfg(queue_depth=2,
+                                               cache_entries=0))
+        try:
+            srv.search([QUERIES[0]], k=2)
+            srv.search([QUERIES[1]], k=2)  # would raise if depth leaked
+            assert srv.metrics_snapshot()["queue"]["depth"] == 0
+        finally:
+            srv.close()
+
+    def test_deadline_shed_is_typed_and_counted(self, retriever):
+        srv = TfidfServer(retriever, quick_cfg(cache_entries=0))
+        try:
+            f = srv.submit([QUERIES[0]], k=2, deadline_ms=0)
+            with pytest.raises(DeadlineExceeded):
+                f.result(timeout=10)
+            assert srv.metrics_snapshot()["shed"]["deadline"] == 1
+        finally:
+            srv.close()
+
+    def test_default_deadline_from_config(self, retriever):
+        srv = TfidfServer(retriever, quick_cfg(default_deadline_ms=0,
+                                               cache_entries=0))
+        try:
+            with pytest.raises(DeadlineExceeded):
+                srv.search([QUERIES[0]], k=2, timeout=10)
+        finally:
+            srv.close()
+
+    def test_swap_index_serves_new_corpus(self, retriever):
+        new = TfidfRetriever(CFG).index(CORPUS_B)
+        srv = TfidfServer(retriever, quick_cfg())
+        try:
+            assert_identical(srv.search(["zebra yak"], k=2),
+                             retriever.search(["zebra yak"], k=2))
+            assert srv.swap_index(new) == 1
+            assert srv.epoch == 1
+            # post-swap responses are parity with the NEW index
+            assert_identical(srv.search(["zebra yak"], k=2),
+                             new.search(["zebra yak"], k=2))
+            assert srv.num_docs == 3
+        finally:
+            srv.close()
+
+    def test_swap_invalidates_cache(self, retriever):
+        # Swap to an identical index: bytes stay equal, but the cache
+        # must re-miss (epoch key + clear), never serve epoch-0 rows.
+        twin = TfidfRetriever(CFG).index(CORPUS)
+        srv = TfidfServer(retriever, quick_cfg())
+        try:
+            first = srv.search(QUERIES[:2], k=3)
+            srv.swap_index(twin)
+            before = srv.metrics_snapshot()["cache"]
+            again = srv.search(QUERIES[:2], k=3)
+            after = srv.metrics_snapshot()["cache"]
+            assert after["misses"] == before["misses"] + 2
+            assert after["hits"] == before["hits"]
+            assert_identical(again, first)  # identical index -> same bytes
+        finally:
+            srv.close()
+
+    def test_drain_on_shutdown_resolves_everything(self, retriever):
+        srv = TfidfServer(retriever, quick_cfg(max_batch=1024,
+                                               max_wait_ms=60_000,
+                                               cache_entries=0))
+        futs = [srv.submit([q], k=2) for q in QUERIES[:4]]
+        srv.close(drain=True)
+        for f, q in zip(futs, QUERIES[:4]):
+            assert_identical(f.result(timeout=0),
+                             retriever.search([q], k=2))
+        with pytest.raises(ServeError):
+            srv.submit(["x"], k=1)
+
+    def test_metrics_snapshot_schema(self, retriever):
+        srv = TfidfServer(retriever, quick_cfg())
+        try:
+            srv.search(QUERIES[:2], k=3)
+            snap = srv.metrics_snapshot()
+        finally:
+            srv.close()
+        json.dumps(snap)  # JSON-serializable end to end
+        assert snap["requests"] == 1 and snap["queries"] == 2
+        assert {"overload", "deadline", "rate"} <= snap["shed"].keys()
+        assert {"hits", "misses", "hit_rate"} <= snap["cache"].keys()
+        assert {"count", "mean_occupancy"} <= snap["batch"].keys()
+        lat = snap["latency_s"]
+        assert lat["count"] == 1 and lat["p99"] >= lat["p50"] > 0
+        assert 0 < snap["batch"]["mean_occupancy"] <= 1
+
+    def test_empty_request_resolves_immediately(self, retriever):
+        srv = TfidfServer(retriever, quick_cfg())
+        try:
+            vals, idx = srv.search([], k=3)
+            assert vals.shape == (0, 3) and idx.shape == (0, 3)
+        finally:
+            srv.close()
+
+    def test_unindexed_retriever_rejected(self):
+        with pytest.raises(ValueError):
+            TfidfServer(TfidfRetriever(CFG), quick_cfg())
+
+    def test_swap_unindexed_rejected(self, retriever):
+        with TfidfServer(retriever, quick_cfg()) as srv:
+            with pytest.raises(ValueError):
+                srv.swap_index(TfidfRetriever(CFG))
+
+    def test_serve_config_validation_and_env(self, monkeypatch):
+        with pytest.raises(ValueError):
+            ServeConfig(max_batch=0)
+        with pytest.raises(ValueError):
+            ServeConfig(queue_depth=0)
+        with pytest.raises(ValueError):
+            ServeConfig(cache_entries=-1)
+        monkeypatch.setenv("TFIDF_TPU_MAX_BATCH", "16")
+        monkeypatch.setenv("TFIDF_TPU_MAX_WAIT_MS", "7.5")
+        monkeypatch.setenv("TFIDF_TPU_QUEUE_DEPTH", "99")
+        monkeypatch.setenv("TFIDF_TPU_CACHE_ENTRIES", "3")
+        cfg = ServeConfig.from_env()
+        assert (cfg.max_batch, cfg.max_wait_ms,
+                cfg.queue_depth, cfg.cache_entries) == (16, 7.5, 99, 3)
+        # explicit overrides beat the env (the CLI resolution order)
+        assert ServeConfig.from_env(max_batch=4).max_batch == 4
+
+
+class TestSearchBucketing:
+    """Satellite: ad-hoc repeated searches must not re-jit per query
+    count — Q pads to power-of-two buckets inside search."""
+
+    def test_compile_count_pinned_across_counts(self):
+        from tfidf_tpu.models.retrieval import _search_bcoo
+        # Fresh shape signature (unique vocab+k) so other tests' cache
+        # entries can't mask or inflate the delta.
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=1024,
+                             max_doc_len=16, doc_chunk=16)
+        r = TfidfRetriever(cfg).index(CORPUS)
+        base = _search_bcoo._cache_size()
+        for n in (3, 4):           # same bucket (4)
+            r.search(["apple"] * n, k=5)
+        assert _search_bcoo._cache_size() == base + 1
+        for n in (5, 7, 6, 8):     # all bucket 8
+            r.search(["banana"] * n, k=5)
+        assert _search_bcoo._cache_size() == base + 2
+        for n in (1, 2, 3, 4, 5, 6, 7, 8):  # buckets 1,2 are new
+            r.search(["fig"] * n, k=5)
+        assert _search_bcoo._cache_size() == base + 4
+
+    def test_bucketed_results_match_per_count(self, retriever):
+        # Padded zero columns must stay inert: each query's row is the
+        # same whether searched alone or inside any batch size.
+        whole = retriever.search(QUERIES, k=4)
+        for i, q in enumerate(QUERIES):
+            alone = retriever.search([q], k=4)
+            np.testing.assert_array_equal(alone[0][0], whole[0][i])
+            np.testing.assert_array_equal(alone[1][0], whole[1][i])
+
+    def test_empty_query_list(self, retriever):
+        vals, idx = retriever.search([], k=3)
+        assert vals.shape == (0, 3) and idx.shape == (0, 3)
+
+
+class TestServeCli:
+    def _run(self, lines, argv, monkeypatch, capsys):
+        from tfidf_tpu.cli import main
+        monkeypatch.setattr("sys.stdin",
+                            io.StringIO("\n".join(lines) + "\n"))
+        rc = main(argv)
+        out = capsys.readouterr().out
+        return rc, [json.loads(l) for l in out.splitlines() if l]
+
+    @pytest.fixture
+    def distinct_corpus_dir(self, tmp_path):
+        d = tmp_path / "input"
+        d.mkdir()
+        for i, text in enumerate(
+                [b"apple banana", b"cherry date", b"elder fig grape",
+                 b"apple grape"], start=1):
+            (d / f"doc{i}").write_bytes(text)
+        return str(d)
+
+    def test_jsonl_request_loop(self, distinct_corpus_dir, monkeypatch,
+                                capsys):
+        rc, resp = self._run(
+            [json.dumps({"id": 1, "queries": ["cherry date"], "k": 2}),
+             json.dumps({"op": "metrics"}),
+             json.dumps({"op": "shutdown"})],
+            ["serve", "--input", distinct_corpus_dir,
+             "--vocab-size", "512", "--max-wait-ms", "1"],
+            monkeypatch, capsys)
+        assert rc == 0
+        by_id = {r.get("id"): r for r in resp if "results" in r}
+        hits = by_id[1]["results"][0]
+        assert hits and hits[0][0] == "doc2" and hits[0][1] > 0
+        metrics = next(r for r in resp if "metrics" in r)
+        assert "latency_s" in metrics["metrics"]
+
+    def test_bad_requests_get_error_lines(self, distinct_corpus_dir,
+                                          monkeypatch, capsys):
+        rc, resp = self._run(
+            ["this is not json",
+             json.dumps({"id": 7, "queries": "not-a-list"}),
+             json.dumps({"op": "nope"}),
+             json.dumps({"op": "shutdown"})],
+            ["serve", "--input", distinct_corpus_dir,
+             "--vocab-size", "512", "--max-wait-ms", "1"],
+            monkeypatch, capsys)
+        assert rc == 0
+        assert len(resp) == 3 and all("error" in r for r in resp)
+
+    def test_swap_index_op(self, distinct_corpus_dir, tmp_path,
+                           monkeypatch, capsys):
+        other = tmp_path / "other"
+        other.mkdir()
+        # two docs: a 1-doc corpus has idf = log(1/1) = 0 everywhere
+        (other / "doc1").write_bytes(b"zebra yak")
+        (other / "doc2").write_bytes(b"aardvark wolf")
+        rc, resp = self._run(
+            [json.dumps({"id": 1, "op": "swap_index",
+                         "input": str(other)}),
+             json.dumps({"id": 2, "queries": ["zebra"], "k": 1}),
+             json.dumps({"op": "shutdown"})],
+            ["serve", "--input", distinct_corpus_dir,
+             "--vocab-size", "512", "--max-wait-ms", "1"],
+            monkeypatch, capsys)
+        assert rc == 0
+        swap = next(r for r in resp if r.get("id") == 1)
+        assert swap == {"id": 1, "swapped": True, "epoch": 1}
+        hit = next(r for r in resp if r.get("id") == 2)
+        assert hit["results"][0][0][0] == "doc1"
+
+    def test_query_subcommand_takes_compile_cache(self, distinct_corpus_dir,
+                                                  tmp_path, capsys):
+        from tfidf_tpu.cli import main
+        cache_dir = tmp_path / "xla_cache"
+        rc = main(["query", "--input", distinct_corpus_dir,
+                   "--vocab-size", "512", "--query", "apple", "-k", "2",
+                   "--compile-cache", str(cache_dir)])
+        assert rc == 0
+        assert "doc1" in capsys.readouterr().out
+        assert cache_dir.is_dir()  # cache armed before the jitted work
+
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+class TestServeBenchSmoke:
+    """End-to-end: boot TfidfServer in-process via tools/serve_bench.py
+    and pin the SERVE artifact schema + sane ranges."""
+
+    def test_artifact_schema_and_zero_recompiles(self, tmp_path):
+        out = tmp_path / "SERVE_smoke.json"
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "serve_bench.py"),
+             "--requests", "64", "--docs", "128", "--doc-len", "32",
+             "--out", str(out)],
+            capture_output=True, text=True, timeout=540, env=env,
+            cwd=REPO)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        art = json.loads(out.read_text())
+        for key in ("metric", "mode", "requests", "queries", "wall_s",
+                    "throughput_rps", "throughput_qps", "latency_ms",
+                    "batch", "cache", "shed", "recompiles_after_warmup"):
+            assert key in art, key
+        assert art["metric"] == "serve_bench"
+        assert art["requests"] == 64
+        assert art["queries"] >= 64
+        assert art["throughput_qps"] > 0
+        lat = art["latency_ms"]
+        assert 0 < lat["p50"] <= lat["p95"] <= lat["p99"]
+        assert 0 < art["batch"]["mean_occupancy"] <= 1
+        assert 0 <= art["cache"]["hit_rate"] <= 1
+        assert 0 <= art["shed"]["rate"] <= 1
+        # steady-state serving re-jits nothing after bucket warmup
+        assert art["recompiles_after_warmup"] == 0

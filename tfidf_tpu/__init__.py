@@ -24,7 +24,8 @@ IDF, lexicographic ordering) are preserved by the golden path
 ``native/``, exposed as ``--backend=mpi`` in the CLI.
 """
 
-from tfidf_tpu.config import PipelineConfig, VocabMode, TokenizerKind
+from tfidf_tpu.config import (PipelineConfig, ServeConfig, VocabMode,
+                              TokenizerKind)
 from tfidf_tpu.pipeline import TfidfPipeline, PipelineResult
 from tfidf_tpu.io.corpus import (Corpus, discover_corpus, PackedBatch,
                                  RaggedBatch, pack_ragged)
@@ -36,6 +37,7 @@ __version__ = "0.1.0"
 
 __all__ = [
     "PipelineConfig",
+    "ServeConfig",
     "VocabMode",
     "TokenizerKind",
     "TfidfPipeline",

@@ -44,6 +44,21 @@ dispatch & compile knobs (round 8):
 """
 
 
+# --help epilog of the serve subcommand: the JSONL wire protocol.
+_SERVE_EPILOG = """\
+protocol (one JSON object per line):
+  {"id": 1, "queries": ["apple pie"], "k": 5}
+      -> {"id": 1, "results": [[["doc3", 0.81], ...]]}
+  {"id": 2, "queries": [...], "deadline_ms": 50}
+      -> {"id": 2, "error": "deadline_exceeded"} when shed
+  {"op": "metrics"}            -> {"metrics": {...}}  (SLO snapshot)
+  {"op": "swap_index", "input": DIR}
+      -> {"swapped": true, "epoch": N}  (hot re-index, no downtime)
+  {"op": "shutdown"}           -> drains in-flight work and exits
+overload responses carry {"error": "overloaded"}; back off and retry.
+"""
+
+
 def _build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="tfidf", description=__doc__)
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -207,7 +222,58 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="static tokens per document: index via the "
                         "overlapped chunked ingest (native loader; "
                         "longer docs truncated). Single-device only")
+    q.add_argument("--compile-cache", metavar="DIR", default=None,
+                   help="persistent XLA compilation cache directory "
+                        "(also env TFIDF_TPU_COMPILE_CACHE): repeat "
+                        "query cold-starts load the index/search "
+                        "executables from disk")
     q.add_argument("--no-strict", action="store_true")
+
+    sv = sub.add_parser(
+        "serve",
+        help="index a corpus and serve ranked retrieval online "
+             "(JSONL request loop; docs/SERVING.md)",
+        epilog=_SERVE_EPILOG,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sv.add_argument("--input", required=True, help="document directory")
+    sv.add_argument("--vocab-size", type=int, default=1 << 16)
+    sv.add_argument("--doc-len", type=int, default=None,
+                    help="static tokens per document: index via the "
+                         "overlapped chunked ingest (longer docs "
+                         "truncated); default whole-corpus batch path")
+    sv.add_argument("-k", type=int, default=10,
+                    help="default results per query (requests may "
+                         "override per line)")
+    sv.add_argument("--max-batch", type=int, default=None,
+                    help="most queries one coalesced device batch "
+                         "carries (default 64; env TFIDF_TPU_MAX_BATCH)")
+    sv.add_argument("--max-wait-ms", type=float, default=None,
+                    help="micro-batching window: the oldest queued "
+                         "request never waits longer than this for the "
+                         "batch to fill (default 2; env "
+                         "TFIDF_TPU_MAX_WAIT_MS)")
+    sv.add_argument("--queue-depth", type=int, default=None,
+                    help="admission bound in queries; past it requests "
+                         "shed with an 'overloaded' error (default "
+                         "256; env TFIDF_TPU_QUEUE_DEPTH)")
+    sv.add_argument("--cache-entries", type=int, default=None,
+                    help="LRU result-cache capacity in per-query rows; "
+                         "0 disables (default 4096; env "
+                         "TFIDF_TPU_CACHE_ENTRIES)")
+    sv.add_argument("--deadline-ms", type=float, default=None,
+                    help="default per-request deadline; requests still "
+                         "queued past it shed with 'deadline_exceeded' "
+                         "(default: no deadline)")
+    sv.add_argument("--port", type=int, default=None,
+                    help="serve JSONL over TCP on this port instead of "
+                         "stdin/stdout (one request per line, "
+                         "responses in completion order)")
+    sv.add_argument("--compile-cache", metavar="DIR", default=None,
+                    help="persistent XLA compilation cache directory "
+                         "(also env TFIDF_TPU_COMPILE_CACHE): serve "
+                         "cold-starts load the warmed search "
+                         "executables from disk")
+    sv.add_argument("--no-strict", action="store_true")
     return p
 
 
@@ -574,9 +640,13 @@ def _run_stream(args) -> int:
 
 def _run_query(args) -> int:
     """Index + search: `doc<i>\\tscore` per result line, tab-separated."""
-    from tfidf_tpu.config import PipelineConfig, VocabMode
+    from tfidf_tpu.config import (PipelineConfig, VocabMode,
+                                  apply_compile_cache)
     from tfidf_tpu.models import TfidfRetriever
 
+    # Arm the persistent compile cache BEFORE any jitted work — query
+    # cold-starts re-paid the index/search compiles until round 9.
+    apply_compile_cache(getattr(args, "compile_cache", None))
     cfg = PipelineConfig(vocab_mode=VocabMode.HASHED,
                          vocab_size=args.vocab_size)
     plan = None
@@ -604,6 +674,174 @@ def _run_query(args) -> int:
     return 0
 
 
+def _serve_handle_line(server, line, write, default_k, build_retriever):
+    """One JSONL request -> one JSON response line (written via
+    ``write``, possibly from a batcher callback thread). Returns False
+    when the line asked for shutdown."""
+    import json
+
+    from tfidf_tpu.serve import DeadlineExceeded, Overloaded, ServeError
+
+    line = line.strip()
+    if not line:
+        return True
+    try:
+        req = json.loads(line)
+        if not isinstance(req, dict):
+            raise ValueError("request must be a JSON object")
+    except ValueError as e:
+        write({"error": f"bad request: {e}"})
+        return True
+    op = req.get("op")
+    if op == "shutdown":
+        return False
+    if op == "metrics":
+        write({"id": req.get("id"), "metrics": server.metrics_snapshot()})
+        return True
+    if op == "swap_index":
+        try:
+            epoch = server.swap_index(build_retriever(req["input"]))
+            write({"id": req.get("id"), "swapped": True, "epoch": epoch})
+        except (KeyError, ValueError, OSError) as e:
+            write({"id": req.get("id"), "error": f"swap failed: {e}"})
+        return True
+    if op is not None:
+        write({"id": req.get("id"), "error": f"unknown op {op!r}"})
+        return True
+
+    rid = req.get("id")
+    queries = req.get("queries")
+    if not isinstance(queries, list) or not all(
+            isinstance(q, str) for q in queries):
+        write({"id": rid, "error": "bad request: 'queries' must be a "
+                                   "list of strings"})
+        return True
+    k = int(req.get("k", default_k))
+    names = server.doc_names()
+
+    def on_done(f):
+        err = f.exception()
+        if isinstance(err, Overloaded):
+            write({"id": rid, "error": "overloaded"})
+        elif isinstance(err, DeadlineExceeded):
+            write({"id": rid, "error": "deadline_exceeded"})
+        elif err is not None:
+            write({"id": rid, "error": str(err)})
+        else:
+            vals, idx = f.result()
+            write({"id": rid, "results": [
+                [[names[int(d)], float(v)]
+                 for v, d in zip(vrow, irow) if d >= 0]
+                for vrow, irow in zip(vals, idx)]})
+
+    try:
+        server.submit(queries, k,
+                      deadline_ms=req.get("deadline_ms")
+                      ).add_done_callback(on_done)
+    except (Overloaded, ServeError) as e:
+        write({"id": rid,
+               "error": "overloaded" if isinstance(e, Overloaded)
+               else str(e)})
+    return True
+
+
+def _run_serve(args) -> int:
+    """Online serving loop: JSONL requests over stdin/stdout (or TCP
+    with --port) against a TfidfServer (docs/SERVING.md). Responses
+    come back in COMPLETION order — clients correlate by "id"."""
+    import json
+    import threading
+
+    from tfidf_tpu.config import (PipelineConfig, ServeConfig, VocabMode,
+                                  apply_compile_cache)
+    from tfidf_tpu.models import TfidfRetriever
+    from tfidf_tpu.serve import TfidfServer
+
+    apply_compile_cache(args.compile_cache)
+    cfg = PipelineConfig(vocab_mode=VocabMode.HASHED,
+                         vocab_size=args.vocab_size,
+                         compile_cache=args.compile_cache)
+
+    def build_retriever(input_dir: str) -> TfidfRetriever:
+        return TfidfRetriever(cfg).index_dir(
+            input_dir, strict=not args.no_strict, doc_len=args.doc_len)
+
+    serve_cfg = ServeConfig.from_env(
+        max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+        queue_depth=args.queue_depth, cache_entries=args.cache_entries,
+        default_deadline_ms=args.deadline_ms)
+    server = TfidfServer(build_retriever(args.input), serve_cfg)
+    sys.stderr.write(f"serving {server.num_docs} docs "
+                     f"(max_batch={serve_cfg.max_batch}, "
+                     f"max_wait_ms={serve_cfg.max_wait_ms}, "
+                     f"queue_depth={serve_cfg.queue_depth}, "
+                     f"cache_entries={serve_cfg.cache_entries})\n")
+
+    if args.port is not None:
+        return _serve_tcp(server, args, build_retriever)
+    # Responses may be written from batcher callback threads while the
+    # main thread blocks on the next stdin line — one lock keeps the
+    # JSONL stream line-atomic.
+    wlock = threading.Lock()
+
+    def write(obj) -> None:
+        with wlock:
+            sys.stdout.write(json.dumps(obj) + "\n")
+            sys.stdout.flush()
+
+    try:
+        for line in sys.stdin:
+            if not _serve_handle_line(server, line, write, args.k,
+                                      build_retriever):
+                break
+    finally:
+        server.close(drain=True)
+    return 0
+
+
+def _serve_tcp(server, args, build_retriever) -> int:
+    """--port mode: the same JSONL protocol over TCP, one thread per
+    connection (socketserver), all feeding the one shared server —
+    which is the point: their queries coalesce into shared batches."""
+    import json
+    import socketserver
+    import threading
+
+    class Handler(socketserver.StreamRequestHandler):
+        def handle(self):
+            wlock = threading.Lock()
+
+            def write(obj):
+                with wlock:
+                    try:
+                        self.wfile.write((json.dumps(obj) + "\n").encode())
+                        self.wfile.flush()
+                    except OSError:
+                        pass  # client went away; drop the response
+
+            for raw in self.rfile:
+                if not _serve_handle_line(server, raw.decode("utf-8",
+                                                             "replace"),
+                                          write, args.k, build_retriever):
+                    threading.Thread(target=srv.shutdown,
+                                     daemon=True).start()
+                    return
+
+    class Srv(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+    with Srv(("127.0.0.1", args.port), Handler) as srv:
+        sys.stderr.write(f"listening on 127.0.0.1:{srv.server_address[1]}\n")
+        try:
+            srv.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            server.close(drain=True)
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = _build_parser().parse_args(argv)
     if args.cmd == "run":
@@ -614,6 +852,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _run_stream(args)
     if args.cmd == "query":
         return _run_query(args)
+    if args.cmd == "serve":
+        return _run_serve(args)
     return 2
 
 

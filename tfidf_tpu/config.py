@@ -190,6 +190,73 @@ class PipelineConfig:
         return PipelineConfig(vocab_mode=VocabMode.EXACT)
 
 
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Knobs for the online serving layer (``tfidf_tpu/serve``).
+
+    Attributes:
+      max_batch: most queries one coalesced device batch carries; a
+        single request larger than this stays atomic (one batch) —
+        :meth:`~tfidf_tpu.models.TfidfRetriever.search` already blocks
+        internally. CLI ``--max-batch`` / env ``TFIDF_TPU_MAX_BATCH``.
+      max_wait_ms: deadline-bounded coalescing window — the oldest
+        queued request never waits longer than this for the batch to
+        fill before it is flushed. CLI ``--max-wait-ms`` / env
+        ``TFIDF_TPU_MAX_WAIT_MS``.
+      queue_depth: admission bound in QUERIES across all in-flight
+        requests; past it :meth:`TfidfServer.submit` sheds with the
+        typed ``Overloaded`` error instead of growing an unbounded
+        backlog. CLI ``--queue-depth`` / env ``TFIDF_TPU_QUEUE_DEPTH``.
+      cache_entries: LRU result-cache capacity in per-query rows
+        (0 disables the cache). CLI ``--cache-entries`` / env
+        ``TFIDF_TPU_CACHE_ENTRIES``.
+      default_deadline_ms: per-request deadline applied when a submit
+        names none; None = requests without a deadline never expire.
+    """
+
+    max_batch: int = 64
+    max_wait_ms: float = 2.0
+    queue_depth: int = 256
+    cache_entries: int = 4096
+    default_deadline_ms: Optional[float] = None
+
+    def __post_init__(self):
+        if self.max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        if self.max_wait_ms < 0:
+            raise ValueError("max_wait_ms must be >= 0")
+        if self.queue_depth < 1:
+            raise ValueError("queue_depth must be >= 1")
+        if self.cache_entries < 0:
+            raise ValueError("cache_entries must be >= 0")
+        if (self.default_deadline_ms is not None
+                and self.default_deadline_ms < 0):
+            raise ValueError("default_deadline_ms must be >= 0")
+
+    @staticmethod
+    def from_env(**overrides) -> "ServeConfig":
+        """Defaults from the ``TFIDF_TPU_*`` env mirrors, keyword
+        overrides winning — the CLI's resolution order (flag > env >
+        default)."""
+        def pick(key, env, cast):
+            if key in overrides and overrides[key] is not None:
+                return overrides[key]
+            raw = os.environ.get(env)
+            return cast(raw) if raw else None
+        kw = {}
+        for key, env, cast in (
+                ("max_batch", "TFIDF_TPU_MAX_BATCH", int),
+                ("max_wait_ms", "TFIDF_TPU_MAX_WAIT_MS", float),
+                ("queue_depth", "TFIDF_TPU_QUEUE_DEPTH", int),
+                ("cache_entries", "TFIDF_TPU_CACHE_ENTRIES", int)):
+            val = pick(key, env, cast)
+            if val is not None:
+                kw[key] = val
+        if overrides.get("default_deadline_ms") is not None:
+            kw["default_deadline_ms"] = overrides["default_deadline_ms"]
+        return ServeConfig(**kw)
+
+
 def apply_compile_cache(path: Optional[str] = None) -> Optional[str]:
     """Point jax's persistent XLA compilation cache at ``path`` (or
     ``TFIDF_TPU_COMPILE_CACHE`` when ``path`` is None) and floor the

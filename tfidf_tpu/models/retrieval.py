@@ -231,11 +231,18 @@ class TfidfRetriever:
         return self._num_docs > 0
 
     # --- querying ---
-    def _query_matrix(self, queries: Sequence[Union[str, bytes]]) -> np.ndarray:
-        """Host-side packing of queries into a dense [V, Q] cosine block."""
+    def _query_matrix(self, queries: Sequence[Union[str, bytes]],
+                      pad_to: Optional[int] = None) -> np.ndarray:
+        """Host-side packing of queries into a dense [V, Q] cosine block.
+
+        ``pad_to`` widens the block with all-zero columns (the query-
+        count bucketing of :meth:`search`); a zero column scores 0
+        against every document, so padded rows fall out of results via
+        the existing ``vals > 0`` mask.
+        """
         cfg = self.config
         idf = np.asarray(self._idf)
-        q = np.zeros((cfg.vocab_size, len(queries)), np.float32)
+        q = np.zeros((cfg.vocab_size, pad_to or len(queries)), np.float32)
         for j, text in enumerate(queries):
             data = text.encode() if isinstance(text, str) else text
             words = whitespace_tokenize(data, cfg.truncate_tokens_at)
@@ -272,7 +279,15 @@ class TfidfRetriever:
                      for s in range(0, len(queries), block)]
             return (np.concatenate([p[0] for p in parts]),
                     np.concatenate([p[1] for p in parts]))
-        qmat = jnp.asarray(self._query_matrix(queries))
+        # Query-count bucketing: the compiled search program is shaped
+        # by Q, so ad-hoc repeated searches at arbitrary query counts
+        # would re-jit per count. Padding Q to the next power of two
+        # caps steady-state serving at log2(block)+1 programs per k
+        # (pinned by tests/test_serve.py); the zero padding columns
+        # score 0 everywhere and their rows are dropped before return.
+        nq = len(queries)
+        bucket = 1 << max(0, nq - 1).bit_length()
+        qmat = jnp.asarray(self._query_matrix(queries, pad_to=bucket))
         if self.plan is not None:
             fn = self._sharded_fn(k)
             vals, idx = fn(self._ids, self._weights, self._head, qmat)
@@ -283,10 +298,11 @@ class TfidfRetriever:
                                      k=min(k, self._ids.shape[0]))
         # Both paths produce >= min(k, num_docs) sorted columns (the
         # sharded one up to min(k, local_k * n_shards)); trim to the
-        # path-independent width so callers see the same shape.
+        # path-independent width so callers see the same shape. Rows
+        # past nq are the bucketing pad — dropped first.
         width = min(k, self._num_docs)
-        vals = np.asarray(vals)[:, :width]
-        idx = np.asarray(idx)[:, :width]
+        vals = np.asarray(vals)[:nq, :width]
+        idx = np.asarray(idx)[:nq, :width]
         ok = (vals > 0) & (idx < self._num_docs)
         return np.where(ok, vals, 0.0), np.where(ok, idx, -1)
 

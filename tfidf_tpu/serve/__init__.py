@@ -1,0 +1,37 @@
+"""Online serving layer: micro-batching, caching, admission, SLOs.
+
+The batch pipeline answers "index this corpus"; this package answers
+"keep answering queries about it, forever, under concurrent load" —
+the ROADMAP's serve-heavy-traffic leg. Four parts:
+
+* :mod:`~tfidf_tpu.serve.batcher` — deadline-bounded dynamic
+  micro-batching (submit queue -> futures -> coalesced device
+  batches);
+* :mod:`~tfidf_tpu.serve.cache` — epoch-keyed LRU result cache;
+* :mod:`~tfidf_tpu.serve.server` — :class:`TfidfServer`: admission
+  control, per-request deadlines, load shedding, hot index swap,
+  graceful drain;
+* :mod:`~tfidf_tpu.serve.metrics` — latency percentiles, batch
+  occupancy, queue depth, shed/cache counters.
+
+Entry points: the ``tfidf serve`` CLI subcommand (JSONL loop) and
+``tools/serve_bench.py`` (load generator + ``SERVE_r0x.json``
+artifact). docs/SERVING.md has the architecture notes.
+"""
+
+from tfidf_tpu.serve.batcher import (DeadlineExceeded, MicroBatcher,
+                                     Overloaded, ServeError)
+from tfidf_tpu.serve.cache import ResultCache, normalize_query
+from tfidf_tpu.serve.metrics import ServeMetrics
+from tfidf_tpu.serve.server import TfidfServer
+
+__all__ = [
+    "TfidfServer",
+    "MicroBatcher",
+    "ResultCache",
+    "ServeMetrics",
+    "ServeError",
+    "Overloaded",
+    "DeadlineExceeded",
+    "normalize_query",
+]

@@ -1,0 +1,221 @@
+"""Dynamic micro-batching: a thread-safe submit queue in front of the
+batch search kernel.
+
+The retrieval kernel is batch-shaped (one [V, Q] block per dispatch)
+but online traffic arrives as many small concurrent requests. The
+bridge is Clipper-style deadline-bounded coalescing: ``submit``
+enqueues a request and returns a ``concurrent.futures.Future``; a
+single worker thread drains the queue into device batches under the
+policy
+
+* flush when the coalesced batch reaches ``max_batch`` queries, or
+* when the OLDEST queued request has waited ``max_wait_ms`` —
+
+so a full system never waits and an idle system adds at most one wait
+window of latency. Batches group by ``(k, group)`` (the server passes
+its ``(epoch, retriever)`` snapshot as ``group``, so one batch never
+mixes indexes across a hot swap, and ``k`` is static in the compiled
+program). Query counts are power-of-two bucketed inside
+``TfidfRetriever.search`` itself, so steady-state serving re-uses a
+handful of compiled programs per k (the compile-count pin in
+tests/test_serve.py).
+
+Requests stay atomic: one request's queries always score in one batch
+(a request larger than ``max_batch`` overflows its batch alone —
+``search`` blocks internally), and per-query results are independent,
+so slicing a coalesced batch back per request is exact.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, Deque, List, Optional, Sequence, Tuple, Union
+
+from collections import deque
+
+import numpy as np
+
+
+class ServeError(RuntimeError):
+    """Base class for typed serving-layer failures."""
+
+
+class Overloaded(ServeError):
+    """Admission control shed the request: the in-flight query backlog
+    is at ``queue_depth``. Clients should back off and retry."""
+
+
+class DeadlineExceeded(ServeError):
+    """The request's deadline expired while it was still queued; it was
+    shed without touching the device."""
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(0, n - 1).bit_length()
+
+
+class _Pending:
+    __slots__ = ("queries", "k", "group", "future", "deadline",
+                 "enqueued_at")
+
+    def __init__(self, queries, k, group, deadline):
+        self.queries = queries
+        self.k = k
+        self.group = group
+        self.future: Future = Future()
+        self.deadline = deadline          # absolute monotonic, or None
+        self.enqueued_at = time.monotonic()
+
+
+class MicroBatcher:
+    """Coalesces concurrent submits into padded device batches.
+
+    Args:
+      search_fn: ``(queries, k, group) -> (vals, ids)`` — the batch
+        kernel (the server binds this to the epoch-snapshotted
+        retriever's ``search``).
+      max_batch: flush threshold in queries.
+      max_wait_ms: oldest-request wait bound before a partial flush.
+      metrics: optional :class:`~tfidf_tpu.serve.metrics.ServeMetrics`
+        for batch-occupancy and deadline-shed counters.
+    """
+
+    def __init__(self, search_fn: Callable, *, max_batch: int = 64,
+                 max_wait_ms: float = 2.0, metrics=None,
+                 thread_name: str = "tfidf-serve-batcher") -> None:
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        if max_wait_ms < 0:
+            raise ValueError("max_wait_ms must be >= 0")
+        self._search_fn = search_fn
+        self.max_batch = max_batch
+        self.max_wait = max_wait_ms / 1e3
+        self._metrics = metrics
+        self._queue: Deque[_Pending] = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        self._drain_on_close = True
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name=thread_name)
+        self._worker.start()
+
+    # --- submit side ---
+    def submit(self, queries: Sequence[Union[str, bytes]], k: int,
+               group=None, deadline: Optional[float] = None) -> Future:
+        """Enqueue one request; the Future resolves to the ``(vals,
+        ids)`` pair for exactly these queries (rows in submit order).
+        ``deadline`` is an absolute ``time.monotonic()`` instant; a
+        request still queued past it fails with
+        :class:`DeadlineExceeded`."""
+        p = _Pending(list(queries), int(k), group, deadline)
+        with self._cond:
+            if self._closed:
+                raise ServeError("batcher is closed")
+            self._queue.append(p)
+            self._cond.notify_all()
+        return p.future
+
+    def queued_queries(self) -> int:
+        with self._cond:
+            return sum(len(p.queries) for p in self._queue)
+
+    # --- worker side ---
+    def _take_batch(self) -> Optional[List[_Pending]]:
+        """Block until a batch is due under the deadline policy, then
+        pop it. Returns None only at close time with an empty queue."""
+        with self._cond:
+            while True:
+                if not self._queue:
+                    if self._closed:
+                        return None
+                    self._cond.wait()
+                    continue
+                head = self._queue[0]
+                now = time.monotonic()
+                flush_at = head.enqueued_at + self.max_wait
+                if (self._ready_queries(head) >= self.max_batch
+                        or now >= flush_at or self._closed):
+                    return self._pop_batch(head)
+                self._cond.wait(timeout=flush_at - now)
+
+    def _ready_queries(self, head: _Pending) -> int:
+        return sum(len(p.queries) for p in self._queue
+                   if p.k == head.k and p.group == head.group)
+
+    def _pop_batch(self, head: _Pending) -> List[_Pending]:
+        """Pop the head plus every queued request with the same (k,
+        group) until ``max_batch`` queries — FIFO within the key;
+        other keys keep their queue positions."""
+        batch: List[_Pending] = []
+        taken = 0
+        remaining: Deque[_Pending] = deque()
+        for p in self._queue:
+            compatible = p.k == head.k and p.group == head.group
+            if (compatible
+                    and (taken + len(p.queries) <= self.max_batch
+                         or not batch)):
+                batch.append(p)
+                taken += len(p.queries)
+            else:
+                remaining.append(p)
+        self._queue = remaining
+        return batch
+
+    def _run(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            self._execute(batch)
+
+    def _execute(self, batch: List[_Pending]) -> None:
+        now = time.monotonic()
+        live: List[_Pending] = []
+        for p in batch:
+            if self._closed and not self._drain_on_close:
+                p.future.set_exception(ServeError("server closed"))
+            elif p.deadline is not None and now >= p.deadline:
+                if self._metrics is not None:
+                    self._metrics.count("shed_deadline")
+                p.future.set_exception(DeadlineExceeded(
+                    f"deadline expired {now - p.deadline:.3f}s before "
+                    f"the batch formed"))
+            else:
+                live.append(p)
+        if not live:
+            return
+        queries: List = []
+        offsets = [0]
+        for p in live:
+            queries.extend(p.queries)
+            offsets.append(len(queries))
+        try:
+            vals, ids = self._search_fn(queries, live[0].k, live[0].group)
+        except BaseException as e:  # noqa: BLE001 — deliver, don't die
+            for p in live:
+                p.future.set_exception(e)
+            return
+        if self._metrics is not None:
+            self._metrics.observe_batch(len(queries), _pow2(len(queries)))
+        vals, ids = np.asarray(vals), np.asarray(ids)
+        for p, lo, hi in zip(live, offsets, offsets[1:]):
+            p.future.set_result((vals[lo:hi], ids[lo:hi]))
+
+    # --- shutdown ---
+    def close(self, drain: bool = True) -> None:
+        """Stop accepting work and join the worker. ``drain=True``
+        serves everything already queued first; ``drain=False`` fails
+        queued requests with :class:`ServeError`."""
+        with self._cond:
+            if self._closed:
+                self._cond.notify_all()
+            self._closed = True
+            self._drain_on_close = drain
+            self._cond.notify_all()
+        self._worker.join()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed

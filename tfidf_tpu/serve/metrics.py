@@ -1,0 +1,115 @@
+"""SLO metrics for the serving layer: latency, occupancy, shed/cache.
+
+The batch pipeline's observability is per-run (``utils/timing.py``
+phase walls); a server needs per-request distributions and counters
+that survive millions of requests at O(1) memory. One
+:class:`ServeMetrics` instance is shared by the server, batcher and
+cache; every mutator takes the instance lock, so any thread can read a
+consistent :meth:`snapshot` while traffic flows.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, Optional
+
+from tfidf_tpu.utils.timing import LatencyHistogram
+
+
+class ServeMetrics:
+    """Counters + latency histogram behind one lock.
+
+    Tracked: request/query/batch counts, request latency (submit to
+    resolution, :class:`~tfidf_tpu.utils.timing.LatencyHistogram`),
+    batch occupancy (real queries / padded device-batch width — the
+    coalescing efficiency), admission queue depth (current + peak),
+    shed counters split by cause (overload vs deadline), and cache
+    hit/miss counters.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.latency = LatencyHistogram()
+        self._counts: Dict[str, int] = {
+            "requests": 0, "queries": 0, "batches": 0,
+            "shed_overload": 0, "shed_deadline": 0,
+            "cache_hits": 0, "cache_misses": 0,
+        }
+        self._occupancy_sum = 0.0
+        self._queue_depth = 0
+        self._queue_peak = 0
+
+    def count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + n
+
+    def observe_request(self, seconds: float, queries: int) -> None:
+        with self._lock:
+            self._counts["requests"] += 1
+            self._counts["queries"] += queries
+            self.latency.record(seconds)
+
+    def observe_batch(self, real_queries: int, padded: int) -> None:
+        with self._lock:
+            self._counts["batches"] += 1
+            self._occupancy_sum += real_queries / max(padded, 1)
+
+    def set_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            self._queue_depth = depth
+            self._queue_peak = max(self._queue_peak, depth)
+
+    def snapshot(self) -> dict:
+        """JSON-serializable point-in-time view (the artifact shape
+        ``tools/serve_bench.py`` embeds and the CLI ``metrics`` op
+        returns)."""
+        with self._lock:
+            c = dict(self._counts)
+            batches = c.pop("batches")
+            hits, misses = c.pop("cache_hits"), c.pop("cache_misses")
+            lookups = hits + misses
+            shed = c["shed_overload"] + c["shed_deadline"]
+            return {
+                "requests": c["requests"],
+                "queries": c["queries"],
+                "shed": {
+                    "overload": c["shed_overload"],
+                    "deadline": c["shed_deadline"],
+                    "rate": round(shed / max(c["requests"] + shed, 1), 6),
+                },
+                "cache": {
+                    "hits": hits,
+                    "misses": misses,
+                    "hit_rate": round(hits / lookups, 6) if lookups else 0.0,
+                },
+                "batch": {
+                    "count": batches,
+                    "mean_occupancy": round(
+                        self._occupancy_sum / batches, 6) if batches else 0.0,
+                },
+                "queue": {"depth": self._queue_depth,
+                          "peak": self._queue_peak},
+                "latency_s": self.latency.as_dict(),
+            }
+
+    def render(self) -> str:
+        """Human-readable text snapshot (stderr/ops form)."""
+        s = self.snapshot()
+        lat = s["latency_s"]
+        return "\n".join([
+            f"requests={s['requests']} queries={s['queries']} "
+            f"shed={s['shed']['overload']}+{s['shed']['deadline']} "
+            f"(rate {s['shed']['rate']:.3f})",
+            f"latency p50={lat['p50'] * 1e3:.2f}ms "
+            f"p95={lat['p95'] * 1e3:.2f}ms p99={lat['p99'] * 1e3:.2f}ms "
+            f"mean={lat['mean'] * 1e3:.2f}ms n={lat['count']}",
+            f"batches={s['batch']['count']} "
+            f"occupancy={s['batch']['mean_occupancy']:.3f} "
+            f"queue depth={s['queue']['depth']} peak={s['queue']['peak']}",
+            f"cache hit_rate={s['cache']['hit_rate']:.3f} "
+            f"({s['cache']['hits']}/{s['cache']['hits'] + s['cache']['misses']})",
+        ])
+
+    def to_json(self, indent: Optional[int] = None) -> str:
+        return json.dumps(self.snapshot(), indent=indent, sort_keys=True)

@@ -1,0 +1,224 @@
+"""TfidfServer: the online query-serving front end over TfidfRetriever.
+
+Composition (docs/SERVING.md has the full picture)::
+
+    submit(queries, k, deadline) ── admission gate (queue_depth,
+      Overloaded) ── per-query cache probe (epoch-keyed LRU) ── misses
+      into the MicroBatcher ── coalesced TfidfRetriever.search on the
+      epoch's index ── rows sliced per request, cache filled, Future
+      resolved.
+
+Guarantees:
+
+* **Parity** — every response row is exactly what a direct
+  ``TfidfRetriever.search`` of the same queries returns (batching,
+  caching and concurrency never change bytes; pinned by
+  tests/test_serve.py).
+* **Bounded backlog** — at most ``queue_depth`` queries are admitted
+  and unresolved at once; past that ``submit`` raises the typed
+  :class:`Overloaded` instead of queueing unboundedly.
+* **Deadlines** — a request still queued past its deadline is shed
+  with :class:`DeadlineExceeded` before touching the device.
+* **Hot swap** — :meth:`swap_index` atomically installs a new indexed
+  retriever, bumps the epoch (cache keys include it) and clears the
+  cache; requests already in flight finish on the index they were
+  admitted under, so a streaming re-index goes live with zero
+  downtime and zero mixed-epoch batches.
+* **Graceful shutdown** — :meth:`close` drains in-flight work by
+  default; ``drain=False`` fails queued requests fast.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from tfidf_tpu.config import ServeConfig
+from tfidf_tpu.models.retrieval import TfidfRetriever
+from tfidf_tpu.serve.batcher import (DeadlineExceeded, MicroBatcher,
+                                     Overloaded, ServeError)
+from tfidf_tpu.serve.cache import ResultCache, normalize_query
+from tfidf_tpu.serve.metrics import ServeMetrics
+
+__all__ = ["TfidfServer", "ServeError", "Overloaded", "DeadlineExceeded"]
+
+
+class TfidfServer:
+    """Serve ranked retrieval online. See module docstring.
+
+    Args:
+      retriever: an INDEXED :class:`TfidfRetriever` (the server never
+        indexes; build/ingest stays the offline path).
+      config: :class:`~tfidf_tpu.config.ServeConfig`; default reads
+        the ``TFIDF_TPU_*`` env mirrors.
+      metrics: optional shared :class:`ServeMetrics` sink.
+    """
+
+    def __init__(self, retriever: TfidfRetriever,
+                 config: Optional[ServeConfig] = None,
+                 metrics: Optional[ServeMetrics] = None) -> None:
+        if not retriever.indexed:
+            raise ValueError("TfidfServer needs an indexed retriever; "
+                             "call index()/index_dir() first")
+        self.config = config or ServeConfig.from_env()
+        self.metrics = metrics or ServeMetrics()
+        self._retriever = retriever
+        self._epoch = 0
+        self._lock = threading.Lock()   # epoch/retriever swap + admission
+        self._inflight = 0              # admitted, unresolved queries
+        self._closed = False
+        self._cache = ResultCache(self.config.cache_entries)
+        self._batcher = MicroBatcher(
+            self._run_batch, max_batch=self.config.max_batch,
+            max_wait_ms=self.config.max_wait_ms, metrics=self.metrics)
+
+    # --- the batch kernel the batcher drives ---
+    def _run_batch(self, queries, k, group):
+        epoch, retriever = group
+        return retriever.search(queries, k)
+
+    # --- public API ---
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    @property
+    def num_docs(self) -> int:
+        return self._retriever._num_docs
+
+    def doc_names(self):
+        return self._retriever.names
+
+    def submit(self, queries: Sequence[Union[str, bytes]], k: int = 10,
+               deadline_ms: Optional[float] = None) -> Future:
+        """Admit one request; returns a Future resolving to ``(vals,
+        ids)`` — the exact arrays a direct ``retriever.search(queries,
+        k)`` returns. Raises :class:`Overloaded` when the admission
+        queue is full; the Future fails with
+        :class:`DeadlineExceeded` when the deadline expires first."""
+        t0 = time.monotonic()
+        queries = list(queries)
+        n = len(queries)
+        if deadline_ms is None:
+            deadline_ms = self.config.default_deadline_ms
+        deadline = None if deadline_ms is None else t0 + deadline_ms / 1e3
+        with self._lock:
+            if self._closed:
+                raise ServeError("server is closed")
+            if self._inflight + n > self.config.queue_depth:
+                self.metrics.count("shed_overload")
+                raise Overloaded(
+                    f"{self._inflight} queries in flight + {n} exceeds "
+                    f"queue_depth={self.config.queue_depth}")
+            self._inflight += n
+            self.metrics.set_queue_depth(self._inflight)
+            retriever, epoch = self._retriever, self._epoch
+        cfg = retriever.config
+
+        out: Future = Future()
+        if n == 0:
+            width = min(k, retriever._num_docs)
+            out.set_result((np.zeros((0, width), np.float32),
+                            np.zeros((0, width), np.int64)))
+            self.metrics.observe_request(time.monotonic() - t0, 0)
+            return out
+
+        keys = [self._cache.key(normalize_query(q, cfg), k, epoch)
+                for q in queries]
+        rows = [self._cache.get(key) for key in keys]
+        hits = sum(r is not None for r in rows)
+        self.metrics.count("cache_hits", hits)
+        self.metrics.count("cache_misses", n - hits)
+        miss_pos = [i for i, r in enumerate(rows) if r is None]
+
+        def resolve(vals: np.ndarray, ids: np.ndarray) -> None:
+            self._finish(n)
+            self.metrics.observe_request(time.monotonic() - t0, n)
+            out.set_result((vals, ids))
+
+        if not miss_pos:
+            resolve(np.stack([r[0] for r in rows]),
+                    np.stack([r[1] for r in rows]))
+            return out
+
+        inner = self._batcher.submit([queries[i] for i in miss_pos], k,
+                                     group=(epoch, retriever),
+                                     deadline=deadline)
+
+        def on_done(f: Future) -> None:
+            err = f.exception()
+            if err is not None:
+                self._finish(n)
+                out.set_exception(err)
+                return
+            mvals, mids = f.result()
+            for j, i in enumerate(miss_pos):
+                self._cache.put(keys[i], mvals[j], mids[j])
+            if len(miss_pos) == n:
+                resolve(mvals, mids)
+                return
+            vals = np.empty((n,) + mvals.shape[1:], mvals.dtype)
+            ids = np.empty((n,) + mids.shape[1:], mids.dtype)
+            for i, r in enumerate(rows):
+                if r is not None:
+                    vals[i], ids[i] = r
+            for j, i in enumerate(miss_pos):
+                vals[i], ids[i] = mvals[j], mids[j]
+            resolve(vals, ids)
+
+        inner.add_done_callback(on_done)
+        return out
+
+    def search(self, queries: Sequence[Union[str, bytes]], k: int = 10,
+               timeout: Optional[float] = None
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        """Blocking convenience wrapper over :meth:`submit`."""
+        return self.submit(queries, k).result(timeout=timeout)
+
+    def swap_index(self, retriever: TfidfRetriever) -> int:
+        """Hot-swap the serving index: new submissions score against
+        ``retriever`` immediately, in-flight requests finish on the
+        index they were admitted under, and the result cache is
+        invalidated (epoch bump + clear). Returns the new epoch."""
+        if not retriever.indexed:
+            raise ValueError("swap_index needs an indexed retriever")
+        with self._lock:
+            if self._closed:
+                raise ServeError("server is closed")
+            self._retriever = retriever
+            self._epoch += 1
+            epoch = self._epoch
+        self._cache.clear()
+        return epoch
+
+    def metrics_snapshot(self) -> dict:
+        return self.metrics.snapshot()
+
+    def close(self, drain: bool = True) -> None:
+        """Stop admitting; ``drain=True`` serves the queued backlog
+        before returning, ``drain=False`` fails it fast. Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._batcher.close(drain=drain)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def __enter__(self) -> "TfidfServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close(drain=True)
+
+    # --- internals ---
+    def _finish(self, n: int) -> None:
+        with self._lock:
+            self._inflight -= n
+            self.metrics.set_queue_depth(self._inflight)

@@ -7,6 +7,7 @@ phase can be timed (:class:`PhaseTimer`), throughput is first-class
 can wrap any region for TPU timeline inspection.
 """
 
-from tfidf_tpu.utils.timing import PhaseTimer, Throughput, trace_region
+from tfidf_tpu.utils.timing import (LatencyHistogram, PhaseTimer,
+                                    Throughput, trace_region)
 
-__all__ = ["PhaseTimer", "Throughput", "trace_region"]
+__all__ = ["LatencyHistogram", "PhaseTimer", "Throughput", "trace_region"]

@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import contextlib
+import math
 import time
 from typing import Dict, Iterator, List, Optional, Tuple
 
@@ -90,6 +91,111 @@ class Throughput:
     @property
     def docs(self) -> int:
         return self._docs
+
+
+class LatencyHistogram:
+    """Geometric-bucket latency histogram with percentile queries.
+
+    Samples land in buckets whose bounds grow by ``1 + resolution``
+    per step (default 2%), so ``percentile(p)`` is accurate to the
+    bucket resolution over the whole [lo, hi) range at O(1) memory —
+    the shape a long-running server needs (the serving layer records
+    every request into one of these; ``serve/metrics.py``). Count,
+    sum, min and max are tracked exactly; out-of-range samples clamp
+    into the edge buckets but still carry exact min/max.
+
+    Not thread-safe by itself; :class:`~tfidf_tpu.serve.metrics.
+    ServeMetrics` serializes access under its own lock.
+    """
+
+    def __init__(self, lo: float = 1e-6, hi: float = 1e3,
+                 resolution: float = 0.02) -> None:
+        if not (0 < lo < hi):
+            raise ValueError("need 0 < lo < hi")
+        if resolution <= 0:
+            raise ValueError("resolution must be positive")
+        self._lo = lo
+        self._log_step = math.log1p(resolution)
+        n = int(math.ceil(math.log(hi / lo) / self._log_step)) + 1
+        self._counts = [0] * (n + 1)  # +1: underflow bucket at index 0
+        self._count = 0
+        self._sum = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+
+    def record(self, seconds: float) -> None:
+        if seconds < 0:
+            seconds = 0.0
+        self._count += 1
+        self._sum += seconds
+        self._min = min(self._min, seconds)
+        self._max = max(self._max, seconds)
+        if seconds < self._lo:
+            idx = 0
+        else:
+            idx = 1 + int(math.log(seconds / self._lo) / self._log_step)
+            idx = min(idx, len(self._counts) - 1)
+        self._counts[idx] += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def mean(self) -> float:
+        return self._sum / self._count if self._count else 0.0
+
+    @property
+    def min(self) -> float:
+        return self._min if self._count else 0.0
+
+    @property
+    def max(self) -> float:
+        return self._max if self._count else 0.0
+
+    def percentile(self, p: float) -> float:
+        """Latency at percentile ``p`` in [0, 100] (nearest-rank over
+        buckets; within-bucket values report the bucket's geometric
+        midpoint, clamped to the exact observed min/max)."""
+        if not 0 <= p <= 100:
+            raise ValueError(f"percentile {p} outside [0, 100]")
+        if not self._count:
+            return 0.0
+        rank = max(1, int(math.ceil(p / 100.0 * self._count)))
+        # The extreme ranks are tracked exactly — no bucket rounding.
+        if rank <= 1:
+            return self._min
+        if rank >= self._count:
+            return self._max
+        seen = 0
+        for idx, c in enumerate(self._counts):
+            seen += c
+            if seen >= rank:
+                if idx == 0:
+                    mid = self._lo / 2
+                else:
+                    mid = self._lo * math.exp((idx - 0.5) * self._log_step)
+                return min(max(mid, self._min), self._max)
+        return self._max  # unreachable: ranks are <= count
+
+    def as_dict(self, ndigits: int = 6) -> Dict[str, float]:
+        """JSON-artifact form: count/mean/min/max plus p50/p95/p99."""
+        return {
+            "count": self._count,
+            "mean": round(self.mean, ndigits),
+            "min": round(self.min, ndigits),
+            "max": round(self.max, ndigits),
+            "p50": round(self.percentile(50), ndigits),
+            "p95": round(self.percentile(95), ndigits),
+            "p99": round(self.percentile(99), ndigits),
+        }
+
+    def reset(self) -> None:
+        self._counts = [0] * len(self._counts)
+        self._count = 0
+        self._sum = 0.0
+        self._min = math.inf
+        self._max = -math.inf
 
 
 def phase_or_null(timer: Optional["PhaseTimer"], name: str):

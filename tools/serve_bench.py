@@ -1,0 +1,214 @@
+"""Serving-layer load generator + SERVE_r0x.json artifact.
+
+Boots a TfidfServer in-process over a synthetic Zipf corpus (or
+--input) and drives it with either a CLOSED loop (N worker threads,
+back-to-back requests — peak-throughput shape) or an OPEN loop
+(Poisson-ish fixed arrival rate via --rate — latency-under-load
+shape, where queueing and shedding actually show). Queries draw from a
+Zipf-weighted pool so the result cache sees a realistic hot tail.
+
+Emits one JSON artifact with the SLO receipts: throughput (rps/qps),
+latency p50/p99, mean batch occupancy, cache hit rate, shed rate —
+plus a recompile receipt: after warmup (one search per power-of-two
+query bucket), steady-state serving must trigger ZERO fresh XLA
+compiles (`models.retrieval._search_bcoo` cache size is checked before
+and after the run). The slow-marked smoke in tests/test_serve.py runs
+this at --requests 64 and asserts the artifact schema.
+
+Usage: python tools/serve_bench.py --requests 256 --out SERVE_r01.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+REPO = __file__.rsplit("/", 2)[0]
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+
+def make_queries(rng, pool_size, n_words, qlen):
+    """Zipf-weighted query pool: a few hot queries, a long cold tail."""
+    pool = [" ".join(f"w{rng.integers(0, n_words)}" for _ in range(qlen))
+            for _ in range(pool_size)]
+
+    def draw():
+        idx = min(int(rng.zipf(1.3)) - 1, pool_size - 1)
+        return pool[idx]
+    return draw
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.split("\n")[0],
+        epilog="artifact keys: throughput_rps/qps, latency_ms "
+               "(p50/p95/p99/mean), batch.mean_occupancy, "
+               "cache.hit_rate, shed.rate, recompiles_after_warmup")
+    ap.add_argument("--requests", type=int, default=256)
+    ap.add_argument("--concurrency", type=int, default=8,
+                    help="closed-loop worker threads")
+    ap.add_argument("--rate", type=float, default=0.0,
+                    help="open-loop arrival rate in requests/sec "
+                         "(0 = closed loop)")
+    ap.add_argument("--queries-per-request", default="1,2,4",
+                    help="request sizes cycled through the load")
+    ap.add_argument("--k", type=int, default=10)
+    ap.add_argument("--pool", type=int, default=64,
+                    help="distinct-query pool size (Zipf-weighted)")
+    ap.add_argument("--docs", type=int, default=2048,
+                    help="synthetic corpus size (ignored with --input)")
+    ap.add_argument("--doc-len", type=int, default=64)
+    ap.add_argument("--input", default=None,
+                    help="serve an existing corpus dir instead")
+    ap.add_argument("--max-batch", type=int, default=64)
+    ap.add_argument("--max-wait-ms", type=float, default=2.0)
+    ap.add_argument("--queue-depth", type=int, default=512)
+    ap.add_argument("--cache-entries", type=int, default=4096)
+    ap.add_argument("--deadline-ms", type=float, default=None)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--out", default="SERVE_r01.json")
+    args = ap.parse_args()
+
+    import bench as benchmod
+    benchmod.N_DOCS = args.docs
+    benchmod.DOC_LEN = args.doc_len
+
+    import jax
+
+    from tfidf_tpu.config import PipelineConfig, ServeConfig, VocabMode
+    from tfidf_tpu.models import TfidfRetriever
+    from tfidf_tpu.models.retrieval import _search_bcoo
+    from tfidf_tpu.serve import Overloaded, ServeError, TfidfServer
+
+    print(f"backend={jax.default_backend()}", file=sys.stderr)
+    tmp = None
+    if args.input is None:
+        tmp = tempfile.mkdtemp(prefix="serve_bench_")
+        print(f"generating {args.docs}-doc corpus...", file=sys.stderr)
+        input_dir = benchmod.make_corpus(tmp)
+    else:
+        input_dir = args.input
+    try:
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED,
+                             vocab_size=benchmod.VOCAB,
+                             max_doc_len=args.doc_len)
+        t0 = time.perf_counter()
+        retriever = TfidfRetriever(cfg).index_dir(input_dir, strict=False)
+        index_s = time.perf_counter() - t0
+        print(f"indexed {retriever._num_docs} docs in {index_s:.2f}s",
+              file=sys.stderr)
+
+        server = TfidfServer(retriever, ServeConfig(
+            max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+            queue_depth=args.queue_depth, cache_entries=args.cache_entries,
+            default_deadline_ms=args.deadline_ms))
+
+        rng = np.random.default_rng(args.seed)
+        draw = make_queries(rng, args.pool, benchmod.N_WORDS, qlen=4)
+        sizes = [int(s) for s in args.queries_per_request.split(",")]
+
+        # Warmup: touch every power-of-two query bucket this load can
+        # produce (plus max_batch itself — full coalesced batches), so
+        # steady state re-jits nothing.
+        buckets, b = set(), 1
+        while b < max(args.max_batch, max(sizes)):
+            buckets.add(b)
+            b *= 2
+        buckets.add(b)
+        for nb in sorted(buckets):
+            retriever.search([draw() for _ in range(nb)], k=args.k)
+        compiles_warm = _search_bcoo._cache_size()
+
+        shed = [0]
+        lock = threading.Lock()
+
+        def one_request(i):
+            qs = [draw() for _ in range(sizes[i % len(sizes)])]
+            try:
+                server.search(qs, k=args.k)
+            except (Overloaded, ServeError):
+                with lock:
+                    shed[0] += 1
+
+        t0 = time.perf_counter()
+        if args.rate > 0:  # open loop: fire-and-forget at fixed arrivals
+            pending = []
+            for i in range(args.requests):
+                th = threading.Thread(target=one_request, args=(i,))
+                th.start()
+                pending.append(th)
+                time.sleep(1.0 / args.rate)
+            for th in pending:
+                th.join()
+        else:  # closed loop: each worker runs back-to-back requests
+            counter = [0]
+
+            def worker():
+                while True:
+                    with lock:
+                        if counter[0] >= args.requests:
+                            return
+                        i = counter[0]
+                        counter[0] += 1
+                    one_request(i)
+
+            workers = [threading.Thread(target=worker)
+                       for _ in range(args.concurrency)]
+            for th in workers:
+                th.start()
+            for th in workers:
+                th.join()
+        wall = time.perf_counter() - t0
+        server.close(drain=True)
+        recompiles = _search_bcoo._cache_size() - compiles_warm
+
+        snap = server.metrics_snapshot()
+        lat = snap["latency_s"]
+        artifact = {
+            "metric": "serve_bench",
+            "mode": "open" if args.rate > 0 else "closed",
+            "backend": jax.default_backend(),
+            "docs": retriever._num_docs,
+            "k": args.k,
+            "requests": args.requests,
+            "queries": snap["queries"],
+            "concurrency": args.concurrency,
+            "rate_rps": args.rate,
+            "max_batch": args.max_batch,
+            "max_wait_ms": args.max_wait_ms,
+            "wall_s": round(wall, 4),
+            "throughput_rps": round(snap["requests"] / wall, 2),
+            "throughput_qps": round(snap["queries"] / wall, 2),
+            "latency_ms": {p: round(lat[p] * 1e3, 3)
+                           for p in ("p50", "p95", "p99", "mean", "max")
+                           if p in lat},
+            "batch": snap["batch"],
+            "cache": snap["cache"],
+            "shed": snap["shed"],
+            "queue_peak": snap["queue"]["peak"],
+            "index_s": round(index_s, 3),
+            "recompiles_after_warmup": recompiles,
+        }
+        with open(args.out, "w") as f:
+            json.dump(artifact, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(json.dumps(artifact, sort_keys=True))
+        if recompiles:
+            print(f"warning: {recompiles} recompiles after warmup "
+                  f"(expected 0)", file=sys.stderr)
+            return 1
+        return 0
+    finally:
+        if tmp is not None:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
