@@ -207,8 +207,20 @@ def profile_phases(input_dir: str, cfg, chunk: int, result):
     sides' fixed overhead (compute_warm − compute_marginal) from the
     same session. The chunked twin's first compute includes its
     per-chunk programs' compile; only its warm/marginal fields feed
-    the dispatch comparison."""
-    phases = dict(result.phases or {})
+    the dispatch comparison.
+
+    Round 10: the overlapped run's phase seconds fold through ONE
+    accumulator — ``PhaseTimer.add``, the same definition the CLI's
+    ``--timing`` report uses and the same intervals the span tracer
+    records (``utils.timing._TimedSpan``) — instead of a hand-copied
+    dict, so the bench phases and a ``TFIDF_TPU_TRACE`` timeline of
+    the same run cannot drift apart."""
+    from tfidf_tpu.utils.timing import PhaseTimer
+
+    timer = PhaseTimer()
+    for name, secs in (result.phases or {}).items():
+        timer.add(name, secs)
+    phases = {n: s for n, s in timer.items()}
     if result.path == "resident":
         from tfidf_tpu.ingest import profile_resident
         phases["serialized"] = {
@@ -358,6 +370,16 @@ def main() -> None:
             return
         if backend != "tpu":
             record["error"] = f"TPU unavailable; measured on {backend}"
+
+        # Host span timeline (TFIDF_TPU_TRACE): when armed, the timed
+        # runs record onto one trace, the artifact carries its path,
+        # and tools/trace_check.py can assert the overlap this JSON
+        # line claims. Guarded on the env var so the degraded no-
+        # backend paths never import tfidf_tpu just for a no-op.
+        if os.environ.get("TFIDF_TPU_TRACE"):
+            from tfidf_tpu import obs
+            if obs.configure() is not None:
+                record["trace_path"] = obs.trace_path()
 
         log(f"generating {N_DOCS}-doc corpus...")
         input_dir = make_corpus(tmp)
@@ -556,6 +578,14 @@ def main() -> None:
     except Exception:
         record["error"] = traceback.format_exc(limit=20)[-2000:]
     finally:
+        if os.environ.get("TFIDF_TPU_TRACE"):
+            try:  # write whatever spans the run recorded, even on error
+                from tfidf_tpu import obs
+                path = obs.export()
+                if path:
+                    log(f"trace written to {path}")
+            except Exception:
+                pass  # tracing must never break the artifact line
         shutil.rmtree(tmp, ignore_errors=True)
         print(json.dumps(record), flush=True)
 

@@ -52,6 +52,8 @@ protocol (one JSON object per line):
   {"id": 2, "queries": [...], "deadline_ms": 50}
       -> {"id": 2, "error": "deadline_exceeded"} when shed
   {"op": "metrics"}            -> {"metrics": {...}}  (SLO snapshot)
+  {"op": "metrics_prom"}       -> {"metrics_prom": "..."}  (Prometheus
+      text exposition incl. request-latency histogram buckets)
   {"op": "swap_index", "input": DIR}
       -> {"swapped": true, "epoch": N}  (hot re-index, no downtime)
   {"op": "shutdown"}           -> drains in-flight work and exits
@@ -179,6 +181,7 @@ def _build_parser() -> argparse.ArgumentParser:
                      help="print per-phase wall-clock (discover/pack/"
                           "transfer/compute/fetch/emit) and docs/sec "
                           "to stderr")
+    _add_trace_flag(run)
 
     st = sub.add_parser(
         "stream",
@@ -208,6 +211,7 @@ def _build_parser() -> argparse.ArgumentParser:
     st.add_argument("--timing", action="store_true",
                     help="print per-phase wall-clock (pass1/pass2/emit) "
                          "and docs/sec to stderr")
+    _add_trace_flag(st)
 
     q = sub.add_parser(
         "query", help="index a corpus and run ranked cosine retrieval")
@@ -228,6 +232,7 @@ def _build_parser() -> argparse.ArgumentParser:
                         "query cold-starts load the index/search "
                         "executables from disk")
     q.add_argument("--no-strict", action="store_true")
+    _add_trace_flag(q)
 
     sv = sub.add_parser(
         "serve",
@@ -274,7 +279,18 @@ def _build_parser() -> argparse.ArgumentParser:
                          "cold-starts load the warmed search "
                          "executables from disk")
     sv.add_argument("--no-strict", action="store_true")
+    _add_trace_flag(sv)
     return p
+
+
+def _add_trace_flag(sub) -> None:
+    sub.add_argument("--trace", metavar="OUT.json", default=None,
+                     help="record a host span timeline and write "
+                          "Chrome trace-event JSON here on exit (open "
+                          "in Perfetto / chrome://tracing; lanes: "
+                          "main, packer, drainer, batcher). Also env "
+                          "TFIDF_TPU_TRACE; validate with "
+                          "tools/trace_check.py; docs/OBSERVABILITY.md")
 
 
 def _run_mpi(args) -> int:
@@ -329,6 +345,7 @@ def _run_tpu(args) -> int:
         result_wire=getattr(args, "result_wire", "packed"),
         finish=getattr(args, "finish", None) or "scan",
         compile_cache=getattr(args, "compile_cache", None),
+        trace=getattr(args, "trace", None),
     )
     # Arm the persistent compile cache BEFORE any jitted work — the
     # library entry points re-apply it idempotently.
@@ -698,6 +715,10 @@ def _serve_handle_line(server, line, write, default_k, build_retriever):
     if op == "metrics":
         write({"id": req.get("id"), "metrics": server.metrics_snapshot()})
         return True
+    if op == "metrics_prom":
+        write({"id": req.get("id"),
+               "metrics_prom": server.metrics_prom()})
+        return True
     if op == "swap_index":
         try:
             epoch = server.swap_index(build_retriever(req["input"]))
@@ -844,17 +865,29 @@ def _serve_tcp(server, args, build_retriever) -> int:
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = _build_parser().parse_args(argv)
-    if args.cmd == "run":
-        if args.backend == "mpi":
-            return _run_mpi(args)
-        return _run_tpu(args)
-    if args.cmd == "stream":
-        return _run_stream(args)
-    if args.cmd == "query":
-        return _run_query(args)
-    if args.cmd == "serve":
-        return _run_serve(args)
-    return 2
+    if args.cmd == "run" and args.backend == "mpi":
+        return _run_mpi(args)  # native oracle: no jax, no host spans
+    # Arm the span tracer first (--trace / TFIDF_TPU_TRACE; no-op when
+    # neither is set) so every span of the run lands on one timeline,
+    # and export whatever was recorded on ANY exit — a crashed run's
+    # partial trace is exactly when you want the timeline.
+    from tfidf_tpu import obs
+    obs.configure(getattr(args, "trace", None))
+    try:
+        if args.cmd == "run":
+            return _run_tpu(args)
+        if args.cmd == "stream":
+            return _run_stream(args)
+        if args.cmd == "query":
+            return _run_query(args)
+        if args.cmd == "serve":
+            return _run_serve(args)
+        return 2
+    finally:
+        path = obs.export()
+        if path:
+            sys.stderr.write(f"trace written to {path} (open in "
+                             f"Perfetto; check: tools/trace_check.py)\n")
 
 
 if __name__ == "__main__":

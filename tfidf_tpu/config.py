@@ -118,6 +118,14 @@ class PipelineConfig:
         bucketed flat sizes of ``ingest._FLAT_BUCKET`` exist exactly
         so there are few of them) loads executables from disk
         instead. Env override ``TFIDF_TPU_COMPILE_CACHE``.
+      trace: output path for the span tracer's Chrome trace-event
+        JSON (``tfidf_tpu.obs``) — the run's host timeline (main /
+        packer / drainer / batcher lanes), loadable in Perfetto.
+        None leaves tracing off (near-zero overhead). The library
+        entry points arm the tracer (``obs.configure``); exporting is
+        the caller's final step (the CLI's ``--trace`` does both).
+        Env override ``TFIDF_TPU_TRACE``; ring capacity
+        ``TFIDF_TPU_TRACE_CAP``. See docs/OBSERVABILITY.md.
     """
 
     vocab_mode: VocabMode = VocabMode.EXACT
@@ -144,6 +152,7 @@ class PipelineConfig:
     result_wire: str = "packed"
     finish: str = "scan"
     compile_cache: Optional[str] = None
+    trace: Optional[str] = None
 
     def __post_init__(self):
         if self.wire not in ("ragged", "padded"):

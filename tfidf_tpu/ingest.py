@@ -52,6 +52,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from tfidf_tpu import obs
 from tfidf_tpu.config import (PipelineConfig, TokenizerKind, VocabMode,
                               apply_compile_cache)
 from tfidf_tpu.io import fast_tokenizer
@@ -535,7 +536,10 @@ class _PackAhead:
         self._fn = fn
         self._items = list(items)
         self._host_s = 0.0
-        self._ex = cf.ThreadPoolExecutor(max_workers=1)
+        # The thread name is the packer's trace lane (obs.tracer keys
+        # Chrome-trace tids on thread identity).
+        self._ex = cf.ThreadPoolExecutor(max_workers=1,
+                                         thread_name_prefix="tfidf-packer")
         self._futs = {}
         self._next = 0
         for _ in range(min(depth, len(self._items))):
@@ -547,9 +551,11 @@ class _PackAhead:
             return
         _trace("pack_submit", i)
 
-        def job(item=self._items[i]):
+        def job(item=self._items[i], i=i):
+            obs.name_thread("packer")
             t0 = time.perf_counter()
-            out = self._fn(item)
+            with obs.span("pack", chunk=i):
+                out = self._fn(item)
             self._host_s += time.perf_counter() - t0
             return out
 
@@ -609,7 +615,9 @@ class _DrainAhead:
                 f"TFIDF_TPU_FETCH_AHEAD must be >= 1, got {depth}")
         self._unpack = unpack
         self._depth = depth
-        self._ex = cf.ThreadPoolExecutor(max_workers=1)
+        # The thread name is the drainer's trace lane (obs.tracer).
+        self._ex = cf.ThreadPoolExecutor(max_workers=1,
+                                         thread_name_prefix="tfidf-drainer")
         self._futs: List = []
         self._waited = 0
         self._host_s = 0.0
@@ -622,8 +630,10 @@ class _DrainAhead:
         _trace("drain_submit", idx)
 
         def job(words=words, idx=idx):
+            obs.name_thread("drainer")
             t0 = time.perf_counter()
-            out = self._unpack(np.asarray(words))
+            with obs.span("drain", chunk=idx):
+                out = self._unpack(np.asarray(words))
             self._host_s += time.perf_counter() - t0
             _trace("drain_done", idx)
             return out
@@ -1607,6 +1617,9 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
     # same (bucketed) wire shapes load executables from disk instead of
     # re-paying every cold-start compile. No-op when unconfigured.
     apply_compile_cache(getattr(cfg, "compile_cache", None))
+    # Arm the span tracer the same way (config.trace / TFIDF_TPU_TRACE;
+    # no-op when unconfigured). Export stays with the caller.
+    obs.configure(getattr(cfg, "trace", None))
     if spill not in ("auto", "host", "reread"):
         raise ValueError(f"unknown spill policy {spill!r}")
     length = doc_len or cfg.max_doc_len
@@ -1690,23 +1703,26 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
             for ci in range(len(starts)):
                 n_chunk = len(names[starts[ci]:starts[ci] + chunk_docs])
                 t0 = time.perf_counter()
-                packed = packer.get(ci)  # stall only; pack rides ahead
+                with obs.span("pack_wait", chunk=ci):
+                    packed = packer.get(ci)  # stall; pack rides ahead
                 ph["pack"] += time.perf_counter() - t0
                 wire_arr, lengths = packed[0], packed[1]
                 all_lengths.append(lengths[:n_chunk])
                 bytes_wire += wire_arr.nbytes + lengths.nbytes
                 bytes_padded += padded_chunk_bytes + lengths.nbytes
                 t0 = time.perf_counter()
-                lens = jax.device_put(lengths)
-                # Sort + DF-fold this chunk NOW (async dispatch): the
-                # transfer+sort runs behind the host's packing of the
-                # next chunk, and the wire buffer is dead once consumed.
-                _trace("upload", ci)
-                i_, c_, h_, df_acc = _chunk_step(
-                    jax.device_put(wire_arr), lens, df_acc, cfg, length,
-                    ragged=ragged,
-                    fold_df=not _resident_df_mode()[1])
-                _trace("dispatch", ci)
+                with obs.span("dispatch", chunk=ci):
+                    lens = jax.device_put(lengths)
+                    # Sort + DF-fold this chunk NOW (async dispatch):
+                    # the transfer+sort runs behind the host's packing
+                    # of the next chunk, and the wire buffer is dead
+                    # once consumed.
+                    _trace("upload", ci)
+                    i_, c_, h_, df_acc = _chunk_step(
+                        jax.device_put(wire_arr), lens, df_acc, cfg,
+                        length, ragged=ragged,
+                        fold_df=not _resident_df_mode()[1])
+                    _trace("dispatch", ci)
                 trip_i.append(i_)
                 trip_c.append(c_)
                 trip_h.append(h_)
@@ -1747,22 +1763,26 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
                     _unpack_words_rows, score_dtype=score_dtype)) \
                     as drain:
                 if scan_finish:
-                    words = _phase_b_scan_packed(
-                        tuple(trip_i), tuple(trip_c), tuple(trip_h),
-                        tuple(len_parts), idf, topk=k)
+                    with obs.device_span("phase_b", finish="scan",
+                                         chunks=len(starts)):
+                        words = _phase_b_scan_packed(
+                            tuple(trip_i), tuple(trip_c), tuple(trip_h),
+                            tuple(len_parts), idf, topk=k)
                     bytes_off += words.nbytes
                     drain.put(0, words)
                 else:
                     for ci in range(len(starts)):
-                        words = _phase_b_cached_packed(
-                            trip_i[ci], trip_c[ci], trip_h[ci],
-                            len_parts[ci], idf, topk=k)
+                        with obs.device_span("phase_b", chunk=ci):
+                            words = _phase_b_cached_packed(
+                                trip_i[ci], trip_c[ci], trip_h[ci],
+                                len_parts[ci], idf, topk=k)
                         bytes_off += words.nbytes
                         drain.put(ci, words)
                 ph["score_b"] = time.perf_counter() - t0
                 t0 = time.perf_counter()
                 _trace("fetch_start")
-                parts = drain.results()  # chunk-major by construction
+                with obs.span("fetch_wait"):
+                    parts = drain.results()  # chunk-major by constr.
                 _trace("fetch_done")
             df_host = np.asarray(df_dev)
             ph["fetch"] = time.perf_counter() - t0  # stall only
@@ -1781,14 +1801,16 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
                                 **common)
         t0 = time.perf_counter()
         wide = cfg.vocab_size > (1 << 16)
-        df_dev, wire = _finish_wire((trip_i, trip_c, trip_h), len_parts,
-                                    df_acc, num_docs, k, score_dtype, cfg,
-                                    wire_vals)
+        with obs.device_span("phase_b", finish="fused"):
+            df_dev, wire = _finish_wire((trip_i, trip_c, trip_h),
+                                        len_parts, df_acc, num_docs, k,
+                                        score_dtype, cfg, wire_vals)
         # ONE unfenced fetch = one link round trip: drain + transfer.
         # DF stays on device (jax.Array acts array-like; np.asarray
         # fetches it on first real read — no hot-path consumer does).
         _trace("fetch_start")
-        buf = np.asarray(jax.device_get(wire))
+        with obs.span("fetch"):
+            buf = np.asarray(jax.device_get(wire))
         _trace("fetch_done")
         ph["fetch"] = time.perf_counter() - t0
         vals, tids, occ = _decode_wire(buf, d_padded, k, wide, score_dtype,
@@ -1873,29 +1895,31 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
         for ci, start in enumerate(starts):
             chunk_names = names[start:start + chunk_docs]
             t0 = time.perf_counter()
-            wire_arr, lengths = packer.get(ci)
+            with obs.span("pack_wait", chunk=ci):
+                wire_arr, lengths = packer.get(ci)
             ph["pack_a"] += time.perf_counter() - t0  # stall only
             all_lengths.append(lengths[:len(chunk_names)])
             bytes_wire += wire_arr.nbytes + lengths.nbytes
             bytes_padded += padded_chunk_bytes + lengths.nbytes
             _trace("upload", ci)
-            if cache_bytes + chunk_cache_bytes <= cache_budget:
-                # Sort once, keep the triples: pass B scores these
-                # directly (_phase_b_cached) — no host cache, no
-                # re-pack, no re-sort for this chunk.
-                lens_dev = jax.device_put(lengths)
-                i_, c_, h_, df_acc = _chunk_step(
-                    jax.device_put(wire_arr), lens_dev, df_acc, cfg,
-                    length, ragged=ragged)
-                trip_cache[ci] = (i_, c_, h_, lens_dev)
-                cache_bytes += chunk_cache_bytes
-                if spill == "host":
-                    cached.append(None)  # pass B won't read the host copy
-            else:
-                if spill == "host":
-                    cached.append((wire_arr, lengths))
-                df_acc = phase_a_any(jax.device_put(wire_arr),
-                                     jax.device_put(lengths), df_acc)
+            with obs.span("dispatch", chunk=ci):
+                if cache_bytes + chunk_cache_bytes <= cache_budget:
+                    # Sort once, keep the triples: pass B scores these
+                    # directly (_phase_b_cached) — no host cache, no
+                    # re-pack, no re-sort for this chunk.
+                    lens_dev = jax.device_put(lengths)
+                    i_, c_, h_, df_acc = _chunk_step(
+                        jax.device_put(wire_arr), lens_dev, df_acc, cfg,
+                        length, ragged=ragged)
+                    trip_cache[ci] = (i_, c_, h_, lens_dev)
+                    cache_bytes += chunk_cache_bytes
+                    if spill == "host":
+                        cached.append(None)  # pass B skips the host copy
+                else:
+                    if spill == "host":
+                        cached.append((wire_arr, lengths))
+                    df_acc = phase_a_any(jax.device_put(wire_arr),
+                                         jax.device_put(lengths), df_acc)
             _trace("dispatch", ci)
             in_flight.append(df_acc)
             if len(in_flight) > max_ahead:
@@ -1946,10 +1970,13 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
             cidx = sorted(trip_cache)
             assert cidx == list(range(n_scanned))  # prefix by constr.
             trips = [trip_cache.pop(ci) for ci in cidx]
-            words = _phase_b_scan_packed(
-                tuple(t[0] for t in trips), tuple(t[1] for t in trips),
-                tuple(t[2] for t in trips), tuple(t[3] for t in trips),
-                idf, topk=k)
+            with obs.device_span("phase_b", finish="scan",
+                                 chunks=n_scanned):
+                words = _phase_b_scan_packed(
+                    tuple(t[0] for t in trips),
+                    tuple(t[1] for t in trips),
+                    tuple(t[2] for t in trips),
+                    tuple(t[3] for t in trips), idf, topk=k)
             bytes_off += words.nbytes
             n_dispatches += 1
             drain.put(n_scanned - 1, words)
@@ -1958,24 +1985,27 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
                 continue  # scored by the scanned prefix dispatch
             if ci in trip_cache:
                 i_, c_, h_, lens_dev = trip_cache.pop(ci)
-                if packed_wire:
-                    words = _phase_b_cached_packed(i_, c_, h_, lens_dev,
-                                                   idf, topk=k)
-                else:
-                    v, t = _phase_b_cached(i_, c_, h_, lens_dev, idf,
-                                           topk=k)
+                with obs.device_span("phase_b", chunk=ci):
+                    if packed_wire:
+                        words = _phase_b_cached_packed(
+                            i_, c_, h_, lens_dev, idf, topk=k)
+                    else:
+                        v, t = _phase_b_cached(i_, c_, h_, lens_dev,
+                                               idf, topk=k)
             else:
                 if spill == "host":
                     wire_arr, lengths = cached[ci]
                 else:
                     t0 = time.perf_counter()
-                    wire_arr, lengths = packer_b.get(bpos)
+                    with obs.span("pack_wait", chunk=ci):
+                        wire_arr, lengths = packer_b.get(bpos)
                     bpos += 1
                     ph["pack_b"] += time.perf_counter() - t0  # stall only
                 bytes_wire += wire_arr.nbytes + lengths.nbytes
                 bytes_padded += padded_chunk_bytes + lengths.nbytes
-                out = phase_b_any(jax.device_put(wire_arr),
-                                  jax.device_put(lengths), idf)
+                with obs.device_span("phase_b", chunk=ci):
+                    out = phase_b_any(jax.device_put(wire_arr),
+                                      jax.device_put(lengths), idf)
                 if packed_wire:
                     words = out
                 else:
@@ -1993,7 +2023,8 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
             ph["pass_b"] = time.perf_counter() - t_pass
             t0 = time.perf_counter()
             _trace("fetch_start")
-            parts = drain.results()  # chunk-major by construction
+            with obs.span("fetch_wait"):
+                parts = drain.results()  # chunk-major by construction
             _trace("fetch_done")
             df_host = np.asarray(df_acc)
             ph["fetch"] = time.perf_counter() - t0  # stall only
@@ -2013,9 +2044,10 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
         ph["pass_b"] = time.perf_counter() - t_pass
         t0 = time.perf_counter()
         _trace("fetch_start")
-        df_host, vals, tids = jax.device_get(
-            (df_acc, jnp.concatenate(vals_parts),
-             jnp.concatenate(ids_parts)))
+        with obs.span("fetch"):
+            df_host, vals, tids = jax.device_get(
+                (df_acc, jnp.concatenate(vals_parts),
+                 jnp.concatenate(ids_parts)))
         _trace("fetch_done")
         ph["fetch"] = time.perf_counter() - t0
         bytes_off = vals.nbytes + tids.nbytes

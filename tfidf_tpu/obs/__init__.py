@@ -1,0 +1,51 @@
+"""Observability layer: end-to-end tracing + unified telemetry.
+
+The reference program has zero timing or logging (SURVEY §6). This
+package is the production answer the ROADMAP's serve-heavy-traffic
+north star requires — one timeline from submit to drain:
+
+* :mod:`~tfidf_tpu.obs.tracer` — thread-safe, near-zero-overhead-when-
+  disabled span tracer recording to a ring buffer and exporting Chrome
+  trace-event JSON (one ``tid`` lane per thread: main, packer,
+  drainer, batcher) that Perfetto / ``chrome://tracing`` opens
+  directly. Armed by ``--trace out.json`` on the CLI subcommands or
+  ``TFIDF_TPU_TRACE``.
+* :mod:`~tfidf_tpu.obs.registry` — unified counter/gauge/histogram
+  registry with Prometheus text exposition and JSON snapshot;
+  ``ServeMetrics`` lives on one, and ``serve``'s ``metrics_prom`` op
+  renders it.
+
+The tracer API is re-exported here (``from tfidf_tpu import obs;
+obs.span(...)``) because product code calls it on hot paths; the
+registry loads lazily to keep ``import tfidf_tpu.obs`` free of any
+further dependencies.
+
+Validation tooling: ``tools/trace_check.py`` asserts a captured
+trace's structural invariants (the overlap the bench artifacts claim);
+``tools/trace_capture.py --host-trace`` merges host spans with a real
+``jax.profiler`` device capture. docs/OBSERVABILITY.md walks a trace.
+"""
+
+from tfidf_tpu.obs.tracer import (SpanHandle, Tracer, begin, configure,
+                                  device_op_table, device_span, enabled,
+                                  end, export, get_tracer, instant,
+                                  load_chrome_trace, name_thread,
+                                  set_tracer, span, span_totals,
+                                  spans_by_thread, trace_path)
+
+__all__ = [
+    "Tracer", "SpanHandle", "configure", "enabled", "export",
+    "get_tracer", "set_tracer", "span", "device_span", "begin", "end",
+    "instant", "name_thread", "span_totals", "trace_path",
+    "load_chrome_trace", "spans_by_thread", "device_op_table",
+    # lazy (tfidf_tpu.obs.registry):
+    "MetricsRegistry", "Counter", "Gauge", "Histogram",
+]
+
+
+def __getattr__(name):  # PEP 562: registry instruments load on demand
+    if name in ("MetricsRegistry", "Counter", "Gauge", "Histogram",
+                "DEFAULT_BUCKETS"):
+        from tfidf_tpu.obs import registry
+        return getattr(registry, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

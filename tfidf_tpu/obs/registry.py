@@ -1,0 +1,264 @@
+"""Unified metrics registry: counters, gauges, histograms, one sink.
+
+Before this module every layer kept private dicts — ``ServeMetrics``
+its ``_counts``, the bench its phase dicts — with no common way to
+read them out. The registry is the single sink: product code creates
+named instruments once (get-or-create, so shared components can't
+collide) and every instrument renders two ways:
+
+* :meth:`MetricsRegistry.snapshot` — the JSON-artifact form the bench
+  and the CLI ``metrics`` op embed;
+* :meth:`MetricsRegistry.render_prom` — Prometheus text exposition
+  (``# TYPE``/``# HELP`` + samples, histogram ``le`` buckets included)
+  for the ``serve`` CLI's ``metrics_prom`` op and anything scraping a
+  long-running server.
+
+Instruments are individually lock-protected (mutators are a few ns;
+contention is per-instrument, not global). Histograms reuse
+:class:`~tfidf_tpu.utils.timing.LatencyHistogram` — O(1) memory at 2%
+resolution, the shape a server that lives for millions of requests
+needs — and expose a coarse fixed ``le`` ladder for Prometheus (the
+geometric buckets themselves would be hundreds of lines).
+
+Gauges track a resettable PEAK next to the current value — the fix for
+the round-9 queue-depth wart where ``ServeMetrics`` could never reset
+its high-water mark between snapshots (``snapshot(reset_peaks=True)``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from tfidf_tpu.utils.timing import LatencyHistogram
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "DEFAULT_BUCKETS"]
+
+# Prometheus ``le`` ladder for latency histograms: 100 µs to 10 s, the
+# band online retrieval actually lives in; +Inf is appended at render.
+DEFAULT_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                   0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                   10.0)
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample value: integers render bare, floats as repr."""
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int) or (isinstance(v, float) and v.is_integer()):
+        return str(int(v))
+    return repr(float(v))
+
+
+class Counter:
+    """Monotonically-increasing count (floats allowed — occupancy sums
+    ride one too)."""
+
+    __slots__ = ("name", "help", "_v", "_lock")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n=1) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name} cannot decrease")
+        with self._lock:
+            self._v += n
+
+    @property
+    def value(self):
+        return self._v
+
+    def prom_lines(self) -> List[str]:
+        return [f"# HELP {self.name} {self.help}" if self.help else
+                f"# HELP {self.name} {self.name}",
+                f"# TYPE {self.name} counter",
+                f"{self.name} {_fmt(self._v)}"]
+
+    def snapshot_value(self):
+        return self._v
+
+    def reset(self) -> None:
+        with self._lock:
+            self._v = 0
+
+
+class Gauge:
+    """Point-in-time value with a resettable high-water mark."""
+
+    __slots__ = ("name", "help", "_v", "_peak", "_lock")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._v = 0
+        self._peak = 0
+        self._lock = threading.Lock()
+
+    def set(self, v) -> None:
+        with self._lock:
+            self._v = v
+            if v > self._peak:
+                self._peak = v
+
+    def add(self, n) -> None:
+        with self._lock:
+            self._v += n
+            if self._v > self._peak:
+                self._peak = self._v
+
+    @property
+    def value(self):
+        return self._v
+
+    @property
+    def peak(self):
+        return self._peak
+
+    def reset_peak(self) -> None:
+        """Restart the high-water mark AT the current value — the next
+        snapshot's peak reflects only what happened since this one."""
+        with self._lock:
+            self._peak = self._v
+
+    def prom_lines(self) -> List[str]:
+        h = self.help or self.name
+        return [f"# HELP {self.name} {h}",
+                f"# TYPE {self.name} gauge",
+                f"{self.name} {_fmt(self._v)}",
+                f"# HELP {self.name}_peak peak of {self.name} since "
+                f"the last reset",
+                f"# TYPE {self.name}_peak gauge",
+                f"{self.name}_peak {_fmt(self._peak)}"]
+
+    def snapshot_value(self):
+        return {"value": self._v, "peak": self._peak}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._v = 0
+            self._peak = 0
+
+
+class Histogram:
+    """Latency distribution: a locked :class:`LatencyHistogram` plus a
+    fixed ``le`` ladder for Prometheus exposition."""
+
+    __slots__ = ("name", "help", "_h", "_lock", "buckets")
+
+    def __init__(self, name: str, help: str = "",
+                 buckets=DEFAULT_BUCKETS, lo: float = 1e-6,
+                 hi: float = 1e3, resolution: float = 0.02):
+        self.name = name
+        self.help = help
+        self.buckets = tuple(sorted(buckets))
+        self._h = LatencyHistogram(lo=lo, hi=hi, resolution=resolution)
+        self._lock = threading.Lock()
+
+    def observe(self, seconds: float) -> None:
+        with self._lock:
+            self._h.record(seconds)
+
+    @property
+    def count(self) -> int:
+        return self._h.count
+
+    def percentile(self, p: float) -> float:
+        with self._lock:
+            return self._h.percentile(p)
+
+    def prom_lines(self) -> List[str]:
+        h = self.help or self.name
+        with self._lock:
+            cum = self._h.cumulative(list(self.buckets))
+            count, total = self._h.count, self._h.sum_seconds
+        lines = [f"# HELP {self.name} {h}",
+                 f"# TYPE {self.name} histogram"]
+        for le, c in zip(self.buckets, cum):
+            lines.append(f'{self.name}_bucket{{le="{_fmt(le)}"}} {c}')
+        lines.append(f'{self.name}_bucket{{le="+Inf"}} {count}')
+        lines.append(f"{self.name}_sum {repr(float(total))}")
+        lines.append(f"{self.name}_count {count}")
+        return lines
+
+    def snapshot_value(self):
+        with self._lock:
+            return self._h.as_dict()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._h.reset()
+
+
+class MetricsRegistry:
+    """Named instruments behind one get-or-create map.
+
+    Creation takes the registry lock; mutation takes only the
+    instrument's own. Re-requesting a name returns the SAME instrument
+    (shared components converge on one counter) — asking for an
+    existing name as a different kind raises.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._instruments: "Dict[str, object]" = {}
+
+    def _get(self, name: str, kind, factory):
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = factory()
+                self._instruments[name] = inst
+            elif not isinstance(inst, kind):
+                raise ValueError(
+                    f"metric {name!r} already registered as "
+                    f"{type(inst).__name__}, not {kind.__name__}")
+            return inst
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(name, Counter, lambda: Counter(name, help))
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(name, Gauge, lambda: Gauge(name, help))
+
+    def histogram(self, name: str, help: str = "",
+                  buckets=DEFAULT_BUCKETS, **kw) -> Histogram:
+        return self._get(name, Histogram,
+                         lambda: Histogram(name, help, buckets, **kw))
+
+    def get(self, name: str):
+        return self._instruments.get(name)
+
+    def snapshot(self, reset_peaks: bool = False) -> dict:
+        """JSON-serializable view of every instrument, keyed by name.
+        ``reset_peaks=True`` restarts every gauge's high-water mark at
+        its current value AFTER reading — peaks become per-snapshot-
+        window, the semantics a scraped dashboard expects."""
+        with self._lock:
+            items = list(self._instruments.items())
+        out = {}
+        for name, inst in items:
+            out[name] = inst.snapshot_value()
+            if reset_peaks and isinstance(inst, Gauge):
+                inst.reset_peak()
+        return out
+
+    def render_prom(self) -> str:
+        """Prometheus text exposition format 0.0.4 of every
+        instrument (ends with a newline, as scrapers expect)."""
+        with self._lock:
+            items = sorted(self._instruments.items())
+        lines: List[str] = []
+        for _name, inst in items:
+            lines.extend(inst.prom_lines())
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        with self._lock:
+            items = list(self._instruments.values())
+        for inst in items:
+            inst.reset()

@@ -1,0 +1,461 @@
+"""Span tracer: one timeline from submit to drain (Chrome trace JSON).
+
+The reference MPI program has literally zero timing or logging (SURVEY
+§6); four rounds of overlap machinery later the repro has *invisible*
+concurrency — ``_PackAhead``/``_DrainAhead`` worker threads, a scanned
+finish, and a concurrent serving layer whose interleavings the bench
+can only summarize as derived scalars (``overlap``,
+``fetch_hidden_frac``). This module records what actually happened:
+named spans on every participating thread, exported as Chrome
+trace-event JSON that Perfetto / ``chrome://tracing`` opens directly —
+one ``pid`` (the host process), one ``tid`` lane per thread (``main``,
+``packer``, ``drainer``, ``batcher``, ...).
+
+Design constraints, in priority order:
+
+* **Near-zero overhead when disabled.** Product code calls the
+  module-level :func:`span`/:func:`begin`/:func:`end` unconditionally;
+  with no tracer configured they cost one global load, one ``is None``
+  test and (for ``span``) a shared no-op context manager — pinned
+  below 150 ns/span by tests/test_obs.py. No locks, no allocation.
+* **Thread-safe when enabled.** Events append to a bounded ring buffer
+  (``collections.deque(maxlen=...)`` — appends are atomic under the
+  GIL, so the hot path takes no lock; only tid assignment and export
+  do). When the ring overflows, the OLDEST spans drop — a long serve
+  session keeps its most recent window instead of dying of memory.
+* **Cross-thread spans.** ``with span(...)`` covers the common
+  same-thread case; :func:`begin`/:func:`end` pair across threads for
+  lifecycles like a served request (begun on the submitting thread,
+  finished on the batcher's callback thread). The event lands on the
+  lane of the thread that BEGAN it — the lifecycle reads top-to-bottom
+  on the submitter's lane.
+* **Device correlation.** :func:`device_span` additionally enters a
+  ``jax.profiler.TraceAnnotation``, so the same names show up on the
+  device lanes of a real ``jax.profiler.trace`` capture
+  (tools/trace_capture.py ``--host-trace`` merges both).
+
+Wire-up: ``--trace out.json`` on the CLI subcommands, or the
+``TFIDF_TPU_TRACE`` env var (path), both through :func:`configure`;
+ring capacity via ``TFIDF_TPU_TRACE_CAP`` (spans, default 2^16).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "Tracer", "SpanHandle", "configure", "enabled", "export",
+    "get_tracer", "set_tracer", "span", "begin", "end", "instant",
+    "device_span", "name_thread", "span_totals", "trace_path",
+    "load_chrome_trace", "device_op_table", "spans_by_thread",
+]
+
+_DEFAULT_CAP = 1 << 16
+
+
+class _NullSpan:
+    """The shared disabled-path context manager. Stateless, so one
+    instance serves every caller; explicit 3-arg ``__exit__`` keeps it
+    the cheapest pure-Python ``with`` target."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, et, ev, tb):
+        return False
+
+
+_NULL = _NullSpan()
+
+
+class SpanHandle:
+    """Open span returned by :meth:`Tracer.begin` — carries the start
+    stamp, the beginning thread's lane, and the args dict that
+    :meth:`Tracer.end` may extend (e.g. the request outcome, known
+    only at resolution time)."""
+
+    __slots__ = ("name", "t0", "tid", "args")
+
+    def __init__(self, name: str, t0: int, tid: int,
+                 args: Optional[Dict[str, Any]]):
+        self.name = name
+        self.t0 = t0
+        self.tid = tid
+        self.args = args
+
+
+class _Span:
+    """Same-thread ``with`` span (one allocation per enabled span)."""
+
+    __slots__ = ("_tracer", "_name", "_args", "_t0", "_tid")
+
+    def __init__(self, tracer: "Tracer", name: str,
+                 args: Optional[Dict[str, Any]]):
+        self._tracer = tracer
+        self._name = name
+        self._args = args
+
+    def __enter__(self):
+        self._tid = self._tracer._tid()
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, et, ev, tb):
+        t = self._tracer
+        t._events.append((self._name, self._tid, self._t0,
+                          time.perf_counter_ns() - self._t0, self._args))
+        return False
+
+
+class _DeviceSpan:
+    """Host span + ``jax.profiler.TraceAnnotation`` under one name, so
+    the host lane and the device lanes of a profiler capture carry the
+    same marker. jax imports lazily — only when tracing is on."""
+
+    __slots__ = ("_span", "_ann", "_name")
+
+    def __init__(self, tracer: "Tracer", name: str,
+                 args: Optional[Dict[str, Any]]):
+        self._span = _Span(tracer, name, args)
+        self._name = name
+
+    def __enter__(self):
+        self._span.__enter__()
+        try:
+            import jax.profiler
+            self._ann = jax.profiler.TraceAnnotation(self._name)
+            self._ann.__enter__()
+        except Exception:  # jax absent/old: host span still records
+            self._ann = None
+        return self
+
+    def __exit__(self, et, ev, tb):
+        if self._ann is not None:
+            self._ann.__exit__(et, ev, tb)
+        return self._span.__exit__(et, ev, tb)
+
+
+class Tracer:
+    """Thread-safe span recorder with a bounded ring buffer.
+
+    Events are ``(name, tid, t0_ns, dur_ns, args)`` tuples relative to
+    the tracer's construction instant; :meth:`chrome_events` converts
+    to Chrome trace-event dicts (µs timestamps) and :meth:`export`
+    writes the ``{"traceEvents": [...]}`` JSON Perfetto loads.
+    """
+
+    def __init__(self, capacity: int = _DEFAULT_CAP):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = capacity
+        self._events: deque = deque(maxlen=capacity)
+        self._t0 = time.perf_counter_ns()
+        self._lock = threading.Lock()
+        self._next_tid = 0
+        self._names: Dict[int, str] = {}     # tid -> thread name
+        self._labels: Dict[int, str] = {}    # tid -> explicit lane label
+        self._local = threading.local()
+
+    # --- recording ---
+    def _tid(self) -> int:
+        """Lane id of the calling thread (cached thread-locally; the
+        lock is taken once per thread's lifetime). Lanes are NOT keyed
+        on ``thread.ident`` — the OS reuses idents of dead threads
+        (e.g. the pass-B packer after the pass-A packer exits), and a
+        reused ident must not splice two threads onto one lane."""
+        try:
+            return self._local.tid
+        except AttributeError:
+            pass
+        th = threading.current_thread()
+        with self._lock:
+            tid = self._next_tid
+            self._next_tid += 1
+            name = th.name
+            if name == "MainThread":
+                name = "main"
+            self._names[tid] = name
+        self._local.tid = tid
+        return tid
+
+    def name_thread(self, label: str) -> None:
+        """Give the calling thread's lane an explicit label (``packer``,
+        ``drainer``, ``batcher``...). Idempotent and cheap enough to
+        call from a worker's per-item job."""
+        tid = self._tid()
+        if self._labels.get(tid) != label:
+            with self._lock:
+                self._labels[tid] = label
+
+    def span(self, name: str, **args) -> _Span:
+        return _Span(self, name, args or None)
+
+    def device_span(self, name: str, **args) -> _DeviceSpan:
+        return _DeviceSpan(self, name, args or None)
+
+    def begin(self, name: str, **args) -> SpanHandle:
+        return SpanHandle(name, time.perf_counter_ns(), self._tid(),
+                          args or None)
+
+    def end(self, handle: SpanHandle, **args) -> None:
+        dur = time.perf_counter_ns() - handle.t0
+        merged = handle.args
+        if args:
+            merged = dict(merged or ()); merged.update(args)
+        self._events.append((handle.name, handle.tid, handle.t0, dur,
+                             merged))
+
+    def instant(self, name: str, **args) -> None:
+        """Zero-duration marker on the calling thread's lane."""
+        self._events.append((name, self._tid(),
+                             time.perf_counter_ns(), -1, args or None))
+
+    # --- reading ---
+    def events(self) -> List[Tuple]:
+        """Snapshot of the raw ring (name, tid, t0_ns, dur_ns, args)."""
+        return list(self._events)
+
+    def span_totals(self) -> Dict[str, float]:
+        """Total seconds per span name — the tracer-side twin of
+        ``PhaseTimer.as_dict`` (bench cross-check; instants excluded)."""
+        out: Dict[str, float] = {}
+        for name, _tid, _t0, dur, _args in list(self._events):
+            if dur >= 0:
+                out[name] = out.get(name, 0.0) + dur / 1e9
+        return out
+
+    def thread_label(self, tid: int) -> str:
+        return self._labels.get(tid) or self._names.get(tid, f"t{tid}")
+
+    def chrome_events(self, pid: int = 1) -> List[dict]:
+        """Chrome trace-event dicts: ``M`` metadata naming the process
+        and each thread lane, then one ``X`` (complete) event per span
+        (``ts``/``dur`` in microseconds) and ``i`` events for instants.
+        """
+        with self._lock:
+            labels = {tid: self.thread_label(tid) for tid in self._names}
+        events: List[dict] = [{
+            "ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+            "args": {"name": "tfidf_tpu host"},
+        }]
+        for tid in sorted(labels):
+            events.append({"ph": "M", "pid": pid, "tid": tid,
+                           "name": "thread_name",
+                           "args": {"name": labels[tid]}})
+            events.append({"ph": "M", "pid": pid, "tid": tid,
+                           "name": "thread_sort_index",
+                           "args": {"sort_index": tid}})
+        for name, tid, t0, dur, args in list(self._events):
+            ev = {"ph": "X" if dur >= 0 else "i", "pid": pid, "tid": tid,
+                  "name": name, "ts": (t0 - self._t0) / 1e3}
+            if dur >= 0:
+                ev["dur"] = dur / 1e3
+            else:
+                ev["s"] = "t"  # instant scope: thread
+            if args:
+                ev["args"] = args
+            events.append(ev)
+        return events
+
+    def export(self, path: str) -> str:
+        """Write the Chrome trace JSON; returns ``path``. Load it in
+        Perfetto (https://ui.perfetto.dev) or ``chrome://tracing``."""
+        doc = {"traceEvents": self.chrome_events(),
+               "displayTimeUnit": "ms"}
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        return path
+
+    def clear(self) -> None:
+        self._events.clear()
+
+
+# --- module-level global tracer -------------------------------------
+#
+# Product code traces through THESE functions so the disabled path is
+# one global load + None test. ``_tracer is None`` == tracing off.
+
+_tracer: Optional[Tracer] = None
+_path: Optional[str] = None
+
+
+def configure(path: Optional[str] = None,
+              capacity: Optional[int] = None) -> Optional[str]:
+    """Arm the global tracer. ``path`` is where :func:`export` will
+    write (``None`` falls back to ``TFIDF_TPU_TRACE``; empty/absent
+    leaves tracing OFF). Idempotent: re-configuring with the same or
+    no path keeps the live tracer and its recorded spans — the entry
+    points call this the way they call ``apply_compile_cache``."""
+    global _tracer, _path
+    resolved = path or os.environ.get("TFIDF_TPU_TRACE")
+    if not resolved:
+        return _path
+    if _tracer is not None and resolved == _path:
+        return _path
+    if capacity is None:
+        capacity = int(os.environ.get("TFIDF_TPU_TRACE_CAP",
+                                      str(_DEFAULT_CAP)))
+    _path = resolved
+    _tracer = Tracer(capacity)
+    return _path
+
+
+def enabled() -> bool:
+    return _tracer is not None
+
+
+def get_tracer() -> Optional[Tracer]:
+    return _tracer
+
+
+def set_tracer(tracer: Optional[Tracer],
+               path: Optional[str] = None) -> None:
+    """Install (or, with ``None``, disarm) the global tracer — the
+    test seam, and how embedders route spans into their own sink."""
+    global _tracer, _path
+    _tracer = tracer
+    _path = path
+
+
+def trace_path() -> Optional[str]:
+    """The armed export path, or None when tracing is off."""
+    return _path if _tracer is not None else None
+
+
+def export(path: Optional[str] = None) -> Optional[str]:
+    """Write the global tracer's trace to ``path`` (default: the
+    configured path). Returns the written path, or None when tracing
+    is off — callers can report it unconditionally."""
+    t = _tracer
+    if t is None:
+        return None
+    resolved = path or _path
+    if not resolved:
+        return None
+    return t.export(resolved)
+
+
+def span(name: str, **args):
+    """Context manager recording one span on the calling thread's lane
+    (no-op when tracing is off)."""
+    t = _tracer
+    if t is None:
+        return _NULL
+    return _Span(t, name, args or None)
+
+
+def device_span(name: str, **args):
+    """Like :func:`span`, additionally wrapped in a
+    ``jax.profiler.TraceAnnotation`` so a concurrent profiler capture
+    carries the same name on its device lanes."""
+    t = _tracer
+    if t is None:
+        return _NULL
+    return _DeviceSpan(t, name, args or None)
+
+
+def begin(name: str, **args) -> Optional[SpanHandle]:
+    """Open a cross-thread span; pair with :func:`end`. Returns None
+    when tracing is off (``end(None)`` is a no-op)."""
+    t = _tracer
+    if t is None:
+        return None
+    return t.begin(name, **args)
+
+
+def end(handle: Optional[SpanHandle], **args) -> None:
+    t = _tracer
+    if t is None or handle is None:
+        return
+    t.end(handle, **args)
+
+
+def instant(name: str, **args) -> None:
+    t = _tracer
+    if t is not None:
+        t.instant(name, **args)
+
+
+def name_thread(label: str) -> None:
+    t = _tracer
+    if t is not None:
+        t.name_thread(label)
+
+
+def span_totals() -> Dict[str, float]:
+    t = _tracer
+    return t.span_totals() if t is not None else {}
+
+
+# --- Chrome-trace reading (shared by tools/trace_capture.py,
+#     tools/trace_check.py and the tests) --------------------------------
+
+def load_chrome_trace(path: str) -> List[dict]:
+    """Load a Chrome trace-event file — ours, or a ``jax.profiler``
+    ``*.trace.json.gz`` — and return its ``traceEvents`` list."""
+    if path.endswith(".gz"):
+        import gzip
+        with gzip.open(path, "rt") as f:
+            doc = json.load(f)
+    else:
+        with open(path) as f:
+            doc = json.load(f)
+    if isinstance(doc, list):  # bare event-array form is also legal
+        return doc
+    return doc.get("traceEvents", [])
+
+
+def spans_by_thread(events: Iterable[dict]) -> Dict[str, List[dict]]:
+    """Group ``X`` events by their lane's ``thread_name`` metadata
+    (falling back to ``pid/tid``)."""
+    names: Dict[Tuple[Any, Any], str] = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            names[(e.get("pid"), e.get("tid"))] = \
+                e.get("args", {}).get("name", "")
+    out: Dict[str, List[dict]] = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        key = (e.get("pid"), e.get("tid"))
+        label = names.get(key) or f"{key[0]}/{key[1]}"
+        out.setdefault(label, []).append(e)
+    return out
+
+
+def device_op_table(events: Iterable[dict], top: int = 25):
+    """Aggregate device-lane op durations from a ``jax.profiler``
+    capture: ``(rows, total_us)`` where rows are ``(name, total_us,
+    calls)`` sorted by total — the table tools/trace_capture.py
+    prints. Device lanes are pids whose ``process_name`` mentions the
+    accelerator."""
+    import collections
+    proc_names: Dict[Any, str] = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            proc_names[e["pid"]] = e.get("args", {}).get("name", "")
+    dev_pids = {p for p, n in proc_names.items()
+                if "TPU" in n or "/device" in n.lower() or "Device" in n}
+    agg: Dict[str, float] = collections.defaultdict(float)
+    cnt: Dict[str, int] = collections.defaultdict(int)
+    total = 0.0
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in dev_pids:
+            continue
+        name = e.get("name", "?")
+        dur = float(e.get("dur", 0.0))  # microseconds
+        agg[name] += dur
+        cnt[name] += 1
+        total += dur
+    rows = [(name, us, cnt[name])
+            for name, us in sorted(agg.items(), key=lambda kv: -kv[1])]
+    return rows[:top], total

@@ -235,12 +235,17 @@ class TfidfPipeline(PhaseTimedMixin):
 
     def __init__(self, config: Optional[PipelineConfig] = None,
                  timer: Optional["PhaseTimer"] = None):
+        from tfidf_tpu import obs
         from tfidf_tpu.config import apply_compile_cache
         self.config = config or PipelineConfig()
         self.timer = timer
         # Persistent XLA compile cache (round 8): the batch path's
         # forward programs persist across CLI cold-starts too.
         apply_compile_cache(getattr(self.config, "compile_cache", None))
+        # Span tracer, same wiring shape (config.trace /
+        # TFIDF_TPU_TRACE): every _phase marker then lands on the
+        # trace timeline as well as the PhaseTimer.
+        obs.configure(getattr(self.config, "trace", None))
 
     def pack(self, corpus: Corpus, pad_docs_to: Optional[int] = None) -> PackedBatch:
         with self._phase("pack"):

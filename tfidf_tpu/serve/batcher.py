@@ -37,6 +37,8 @@ from collections import deque
 
 import numpy as np
 
+from tfidf_tpu import obs
+
 
 class ServeError(RuntimeError):
     """Base class for typed serving-layer failures."""
@@ -58,7 +60,7 @@ def _pow2(n: int) -> int:
 
 class _Pending:
     __slots__ = ("queries", "k", "group", "future", "deadline",
-                 "enqueued_at")
+                 "enqueued_at", "obs")
 
     def __init__(self, queries, k, group, deadline):
         self.queries = queries
@@ -67,6 +69,11 @@ class _Pending:
         self.future: Future = Future()
         self.deadline = deadline          # absolute monotonic, or None
         self.enqueued_at = time.monotonic()
+        # Queue-wait span: opens at submit, closes when the batch forms
+        # (batch-id attributed) or the request sheds — the "queued"
+        # stage of the request lifecycle chain (docs/OBSERVABILITY.md).
+        self.obs = obs.begin("queued", queries=len(self.queries),
+                             k=self.k)
 
 
 class MicroBatcher:
@@ -93,6 +100,7 @@ class MicroBatcher:
         self.max_batch = max_batch
         self.max_wait = max_wait_ms / 1e3
         self._metrics = metrics
+        self._batch_seq = 0   # trace batch-id; worker thread only
         self._queue: Deque[_Pending] = deque()
         self._cond = threading.Condition()
         self._closed = False
@@ -171,14 +179,17 @@ class MicroBatcher:
             self._execute(batch)
 
     def _execute(self, batch: List[_Pending]) -> None:
+        obs.name_thread("batcher")
         now = time.monotonic()
         live: List[_Pending] = []
         for p in batch:
             if self._closed and not self._drain_on_close:
+                obs.end(p.obs, outcome="rejected")
                 p.future.set_exception(ServeError("server closed"))
             elif p.deadline is not None and now >= p.deadline:
                 if self._metrics is not None:
                     self._metrics.count("shed_deadline")
+                obs.end(p.obs, outcome="shed_deadline")
                 p.future.set_exception(DeadlineExceeded(
                     f"deadline expired {now - p.deadline:.3f}s before "
                     f"the batch formed"))
@@ -186,22 +197,33 @@ class MicroBatcher:
                 live.append(p)
         if not live:
             return
+        bid = self._batch_seq
+        self._batch_seq += 1
         queries: List = []
         offsets = [0]
         for p in live:
+            obs.end(p.obs, outcome="batched", batch=bid)
             queries.extend(p.queries)
             offsets.append(len(queries))
-        try:
-            vals, ids = self._search_fn(queries, live[0].k, live[0].group)
-        except BaseException as e:  # noqa: BLE001 — deliver, don't die
-            for p in live:
-                p.future.set_exception(e)
-            return
-        if self._metrics is not None:
-            self._metrics.observe_batch(len(queries), _pow2(len(queries)))
-        vals, ids = np.asarray(vals), np.asarray(ids)
-        for p, lo, hi in zip(live, offsets, offsets[1:]):
-            p.future.set_result((vals[lo:hi], ids[lo:hi]))
+        with obs.span("batched", batch=bid, queries=len(queries),
+                      requests=len(live)):
+            try:
+                # TraceAnnotation-wrapped: the device lanes of a
+                # profiler capture carry the same batch id.
+                with obs.device_span("device", batch=bid,
+                                     queries=len(queries)):
+                    vals, ids = self._search_fn(queries, live[0].k,
+                                                live[0].group)
+            except BaseException as e:  # noqa: BLE001 — deliver
+                for p in live:
+                    p.future.set_exception(e)
+                return
+            if self._metrics is not None:
+                self._metrics.observe_batch(len(queries),
+                                            _pow2(len(queries)))
+            vals, ids = np.asarray(vals), np.asarray(ids)
+            for p, lo, hi in zip(live, offsets, offsets[1:]):
+                p.future.set_result((vals[lo:hi], ids[lo:hi]))
 
     # --- shutdown ---
     def close(self, drain: bool = True) -> None:

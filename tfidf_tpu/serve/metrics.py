@@ -2,96 +2,131 @@
 
 The batch pipeline's observability is per-run (``utils/timing.py``
 phase walls); a server needs per-request distributions and counters
-that survive millions of requests at O(1) memory. One
-:class:`ServeMetrics` instance is shared by the server, batcher and
-cache; every mutator takes the instance lock, so any thread can read a
-consistent :meth:`snapshot` while traffic flows.
+that survive millions of requests at O(1) memory. Since round 10 the
+counters live on a unified :class:`~tfidf_tpu.obs.registry.
+MetricsRegistry` instead of a private dict, which buys two things for
+free: Prometheus text exposition (:meth:`ServeMetrics.render_prom`,
+the CLI ``serve`` loop's ``metrics_prom`` op — request-latency
+histogram buckets included) and resettable gauge peaks
+(``snapshot(reset_peaks=True)`` restarts the queue-depth high-water
+mark per snapshot window; the old private ``_queue_peak`` could never
+reset, so a dashboard scraping every minute saw the all-time peak
+forever).
+
+One :class:`ServeMetrics` instance is shared by the server, batcher
+and cache; instruments are individually locked, so any thread can
+read a :meth:`snapshot` while traffic flows.
 """
 
 from __future__ import annotations
 
 import json
-import threading
-from typing import Dict, Optional
+from typing import Optional
 
-from tfidf_tpu.utils.timing import LatencyHistogram
+from tfidf_tpu.obs.registry import MetricsRegistry
+
+_COUNTERS = {
+    "requests": ("serve_requests_total", "requests resolved"),
+    "queries": ("serve_queries_total", "queries resolved"),
+    "batches": ("serve_batches_total", "coalesced device batches"),
+    "shed_overload": ("serve_shed_overload_total",
+                      "requests shed at admission (queue_depth)"),
+    "shed_deadline": ("serve_shed_deadline_total",
+                      "requests shed on an expired deadline"),
+    "cache_hits": ("serve_cache_hits_total", "result-cache hits"),
+    "cache_misses": ("serve_cache_misses_total", "result-cache misses"),
+}
 
 
 class ServeMetrics:
-    """Counters + latency histogram behind one lock.
+    """Counters + latency histogram on one metrics registry.
 
     Tracked: request/query/batch counts, request latency (submit to
-    resolution, :class:`~tfidf_tpu.utils.timing.LatencyHistogram`),
-    batch occupancy (real queries / padded device-batch width — the
-    coalescing efficiency), admission queue depth (current + peak),
-    shed counters split by cause (overload vs deadline), and cache
-    hit/miss counters.
+    resolution — a geometric-bucket histogram, O(1) memory), batch
+    occupancy (real queries / padded device-batch width — the
+    coalescing efficiency), admission queue depth (current + a
+    resettable peak), shed counters split by cause (overload vs
+    deadline), and cache hit/miss counters. :meth:`snapshot` keeps the
+    exact JSON schema the round-9 artifacts pinned;
+    :meth:`render_prom` is the new Prometheus face of the same data.
     """
 
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self.latency = LatencyHistogram()
-        self._counts: Dict[str, int] = {
-            "requests": 0, "queries": 0, "batches": 0,
-            "shed_overload": 0, "shed_deadline": 0,
-            "cache_hits": 0, "cache_misses": 0,
-        }
-        self._occupancy_sum = 0.0
-        self._queue_depth = 0
-        self._queue_peak = 0
+    def __init__(self, registry: Optional[MetricsRegistry] = None) -> None:
+        self.registry = registry or MetricsRegistry()
+        self._counters = {
+            short: self.registry.counter(name, help)
+            for short, (name, help) in _COUNTERS.items()}
+        self._occupancy = self.registry.counter(
+            "serve_batch_occupancy_sum",
+            "sum of per-batch occupancy (real/padded)")
+        self._queue = self.registry.gauge(
+            "serve_queue_depth", "admitted, unresolved queries")
+        self._latency = self.registry.histogram(
+            "serve_request_latency_seconds",
+            "request latency, submit to resolution")
+
+    # Kept for callers that poke the histogram directly (the round-9
+    # attribute name); the instrument's inner LatencyHistogram.
+    @property
+    def latency(self):
+        return self._latency._h
 
     def count(self, name: str, n: int = 1) -> None:
-        with self._lock:
-            self._counts[name] = self._counts.get(name, 0) + n
+        c = self._counters.get(name)
+        if c is None:  # unknown names get ad-hoc registry counters
+            c = self.registry.counter(f"serve_{name}_total", name)
+            self._counters[name] = c
+        c.inc(n)
 
     def observe_request(self, seconds: float, queries: int) -> None:
-        with self._lock:
-            self._counts["requests"] += 1
-            self._counts["queries"] += queries
-            self.latency.record(seconds)
+        self._counters["requests"].inc()
+        self._counters["queries"].inc(queries)
+        self._latency.observe(seconds)
 
     def observe_batch(self, real_queries: int, padded: int) -> None:
-        with self._lock:
-            self._counts["batches"] += 1
-            self._occupancy_sum += real_queries / max(padded, 1)
+        self._counters["batches"].inc()
+        self._occupancy.inc(real_queries / max(padded, 1))
 
     def set_queue_depth(self, depth: int) -> None:
-        with self._lock:
-            self._queue_depth = depth
-            self._queue_peak = max(self._queue_peak, depth)
+        self._queue.set(depth)
 
-    def snapshot(self) -> dict:
+    def snapshot(self, reset_peaks: bool = False) -> dict:
         """JSON-serializable point-in-time view (the artifact shape
         ``tools/serve_bench.py`` embeds and the CLI ``metrics`` op
-        returns)."""
-        with self._lock:
-            c = dict(self._counts)
-            batches = c.pop("batches")
-            hits, misses = c.pop("cache_hits"), c.pop("cache_misses")
-            lookups = hits + misses
-            shed = c["shed_overload"] + c["shed_deadline"]
-            return {
-                "requests": c["requests"],
-                "queries": c["queries"],
-                "shed": {
-                    "overload": c["shed_overload"],
-                    "deadline": c["shed_deadline"],
-                    "rate": round(shed / max(c["requests"] + shed, 1), 6),
-                },
-                "cache": {
-                    "hits": hits,
-                    "misses": misses,
-                    "hit_rate": round(hits / lookups, 6) if lookups else 0.0,
-                },
-                "batch": {
-                    "count": batches,
-                    "mean_occupancy": round(
-                        self._occupancy_sum / batches, 6) if batches else 0.0,
-                },
-                "queue": {"depth": self._queue_depth,
-                          "peak": self._queue_peak},
-                "latency_s": self.latency.as_dict(),
-            }
+        returns). ``reset_peaks=True`` restarts the queue-depth peak
+        at its current value AFTER reading, so each snapshot's peak
+        covers only its own window."""
+        c = {short: inst.value for short, inst in self._counters.items()}
+        batches = c["batches"]
+        hits, misses = c["cache_hits"], c["cache_misses"]
+        lookups = hits + misses
+        shed = c["shed_overload"] + c["shed_deadline"]
+        occupancy = self._occupancy.value
+        snap = {
+            "requests": c["requests"],
+            "queries": c["queries"],
+            "shed": {
+                "overload": c["shed_overload"],
+                "deadline": c["shed_deadline"],
+                "rate": round(shed / max(c["requests"] + shed, 1), 6),
+            },
+            "cache": {
+                "hits": hits,
+                "misses": misses,
+                "hit_rate": round(hits / lookups, 6) if lookups else 0.0,
+            },
+            "batch": {
+                "count": batches,
+                "mean_occupancy": round(
+                    occupancy / batches, 6) if batches else 0.0,
+            },
+            "queue": {"depth": self._queue.value,
+                      "peak": self._queue.peak},
+            "latency_s": self._latency.snapshot_value(),
+        }
+        if reset_peaks:
+            self._queue.reset_peak()
+        return snap
 
     def render(self) -> str:
         """Human-readable text snapshot (stderr/ops form)."""
@@ -110,6 +145,12 @@ class ServeMetrics:
             f"cache hit_rate={s['cache']['hit_rate']:.3f} "
             f"({s['cache']['hits']}/{s['cache']['hits'] + s['cache']['misses']})",
         ])
+
+    def render_prom(self) -> str:
+        """Prometheus text exposition of every serve instrument —
+        request-latency ``le`` buckets, counters, queue gauge + peak.
+        The ``serve`` CLI's ``metrics_prom`` op returns this."""
+        return self.registry.render_prom()
 
     def to_json(self, indent: Optional[int] = None) -> str:
         return json.dumps(self.snapshot(), indent=indent, sort_keys=True)

@@ -37,6 +37,7 @@ from typing import Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+from tfidf_tpu import obs
 from tfidf_tpu.config import ServeConfig
 from tfidf_tpu.models.retrieval import TfidfRetriever
 from tfidf_tpu.serve.batcher import (DeadlineExceeded, MicroBatcher,
@@ -103,14 +104,22 @@ class TfidfServer:
         t0 = time.monotonic()
         queries = list(queries)
         n = len(queries)
+        # The request lifecycle span: begun on the submitting thread,
+        # ended (cross-thread) wherever the request resolves, with the
+        # outcome as an arg — every submitted request appears exactly
+        # once in a trace as drained / cache_hit / shed_* / error
+        # (pinned by tests/test_obs.py).
+        req = obs.begin("request", queries=n, k=k)
         if deadline_ms is None:
             deadline_ms = self.config.default_deadline_ms
         deadline = None if deadline_ms is None else t0 + deadline_ms / 1e3
         with self._lock:
             if self._closed:
+                obs.end(req, outcome="rejected")
                 raise ServeError("server is closed")
             if self._inflight + n > self.config.queue_depth:
                 self.metrics.count("shed_overload")
+                obs.end(req, outcome="shed_overload")
                 raise Overloaded(
                     f"{self._inflight} queries in flight + {n} exceeds "
                     f"queue_depth={self.config.queue_depth}")
@@ -125,6 +134,7 @@ class TfidfServer:
             out.set_result((np.zeros((0, width), np.float32),
                             np.zeros((0, width), np.int64)))
             self.metrics.observe_request(time.monotonic() - t0, 0)
+            obs.end(req, outcome="empty")
             return out
 
         keys = [self._cache.key(normalize_query(q, cfg), k, epoch)
@@ -135,14 +145,16 @@ class TfidfServer:
         self.metrics.count("cache_misses", n - hits)
         miss_pos = [i for i, r in enumerate(rows) if r is None]
 
-        def resolve(vals: np.ndarray, ids: np.ndarray) -> None:
+        def resolve(vals: np.ndarray, ids: np.ndarray,
+                    outcome: str) -> None:
             self._finish(n)
             self.metrics.observe_request(time.monotonic() - t0, n)
+            obs.end(req, outcome=outcome, cache_hits=hits)
             out.set_result((vals, ids))
 
         if not miss_pos:
             resolve(np.stack([r[0] for r in rows]),
-                    np.stack([r[1] for r in rows]))
+                    np.stack([r[1] for r in rows]), "cache_hit")
             return out
 
         inner = self._batcher.submit([queries[i] for i in miss_pos], k,
@@ -153,13 +165,17 @@ class TfidfServer:
             err = f.exception()
             if err is not None:
                 self._finish(n)
+                obs.end(req, outcome=(
+                    "shed_deadline" if isinstance(err, DeadlineExceeded)
+                    else "shed_overload" if isinstance(err, Overloaded)
+                    else "error"))
                 out.set_exception(err)
                 return
             mvals, mids = f.result()
             for j, i in enumerate(miss_pos):
                 self._cache.put(keys[i], mvals[j], mids[j])
             if len(miss_pos) == n:
-                resolve(mvals, mids)
+                resolve(mvals, mids, "drained")
                 return
             vals = np.empty((n,) + mvals.shape[1:], mvals.dtype)
             ids = np.empty((n,) + mids.shape[1:], mids.dtype)
@@ -168,7 +184,7 @@ class TfidfServer:
                     vals[i], ids[i] = r
             for j, i in enumerate(miss_pos):
                 vals[i], ids[i] = mvals[j], mids[j]
-            resolve(vals, ids)
+            resolve(vals, ids, "drained")
 
         inner.add_done_callback(on_done)
         return out
@@ -195,8 +211,14 @@ class TfidfServer:
         self._cache.clear()
         return epoch
 
-    def metrics_snapshot(self) -> dict:
-        return self.metrics.snapshot()
+    def metrics_snapshot(self, reset_peaks: bool = False) -> dict:
+        return self.metrics.snapshot(reset_peaks=reset_peaks)
+
+    def metrics_prom(self) -> str:
+        """Prometheus text exposition of the serve metrics (request
+        latency histogram buckets included) — the ``metrics_prom``
+        JSONL op and anything scraping a long-running server."""
+        return self.metrics.render_prom()
 
     def close(self, drain: bool = True) -> None:
         """Stop admitting; ``drain=True`` serves the queued backlog

@@ -31,6 +31,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from tfidf_tpu import obs
 from tfidf_tpu.config import PipelineConfig, VocabMode
 from tfidf_tpu.io.corpus import (Corpus, PackedBatch, RaggedBatch,
                                  pack_corpus)
@@ -234,6 +235,10 @@ class StreamingTfidf:
     # --- the two phases ---
     def update(self, batch: PackedBatch) -> None:
         """Fold one minibatch into the DF state (incremental psum)."""
+        with obs.device_span("stream_update", docs=batch.num_docs):
+            self._update(batch)
+
+    def _update(self, batch: PackedBatch) -> None:
         toks, lens = self._place(batch)
         if self._engine == "sparse":
             if self.plan is not None:
@@ -263,6 +268,10 @@ class StreamingTfidf:
         device-array return). On a mesh the words pack per shard
         (elementwise, no collective) before the gathering fetch.
         """
+        with obs.device_span("stream_score", docs=batch.num_docs):
+            return self._score(batch)
+
+    def _score(self, batch: PackedBatch):
         toks, lens = self._place(batch)
         topk = self.config.topk
         score_dtype = jnp.dtype(self.config.score_dtype)

@@ -142,6 +142,11 @@ class LatencyHistogram:
         return self._count
 
     @property
+    def sum_seconds(self) -> float:
+        """Exact sum of every recorded sample (Prometheus ``_sum``)."""
+        return self._sum
+
+    @property
     def mean(self) -> float:
         return self._sum / self._count if self._count else 0.0
 
@@ -178,6 +183,22 @@ class LatencyHistogram:
                 return min(max(mid, self._min), self._max)
         return self._max  # unreachable: ranks are <= count
 
+    def cumulative(self, bounds: List[float]) -> List[int]:
+        """Cumulative sample counts at each upper bound — the shape a
+        Prometheus histogram exposition needs (``le`` buckets). A
+        sample counts toward bound ``b`` when its geometric bucket's
+        upper edge is <= ``b``, so counts are monotone in ``bounds``
+        and accurate to the bucket resolution; the clamped top bucket
+        (and the exact total) only ever land on ``+Inf``, which the
+        caller appends itself (``obs.registry``)."""
+        uppers = [self._lo * math.exp(i * self._log_step)
+                  for i in range(len(self._counts) - 1)]
+        out = []
+        for b in bounds:
+            out.append(sum(c for up, c in zip(uppers, self._counts)
+                           if up <= b))
+        return out
+
     def as_dict(self, ndigits: int = 6) -> Dict[str, float]:
         """JSON-artifact form: count/mean/min/max plus p50/p95/p99."""
         return {
@@ -198,12 +219,43 @@ class LatencyHistogram:
         self._max = -math.inf
 
 
-def phase_or_null(timer: Optional["PhaseTimer"], name: str):
-    """``timer.phase(name)`` when a timer is attached, else a no-op.
+class _TimedSpan:
+    """One context, two sinks: the phase's wall-clock accumulates into
+    the :class:`PhaseTimer` AND the same interval records as a tracer
+    span — so ``--timing`` phase reports and ``--trace`` timelines can
+    never drift apart (they are one measurement)."""
 
-    Lets product code sprinkle phase markers unconditionally; without a
-    timer the only cost is a nullcontext enter/exit.
+    __slots__ = ("_timer", "_name", "_sp", "_t0")
+
+    def __init__(self, timer, name, sp):
+        self._timer = timer
+        self._name = name
+        self._sp = sp
+
+    def __enter__(self):
+        self._sp.__enter__()
+        if self._timer is not None:
+            self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, et, ev, tb):
+        if self._timer is not None:
+            self._timer.add(self._name, time.perf_counter() - self._t0)
+        return self._sp.__exit__(et, ev, tb)
+
+
+def phase_or_null(timer: Optional["PhaseTimer"], name: str):
+    """``timer.phase(name)`` when a timer is attached, a tracer span
+    when the global tracer is armed (``obs.configure``), both when
+    both — else a no-op.
+
+    Lets product code sprinkle phase markers unconditionally; with
+    neither sink armed the only cost is one enabled-check and a shared
+    no-op context enter/exit.
     """
+    from tfidf_tpu import obs
+    if obs.enabled():
+        return _TimedSpan(timer, name, obs.span(name))
     return timer.phase(name) if timer is not None else contextlib.nullcontext()
 
 

@@ -1,0 +1,27 @@
+"""Shared bootstrap for the ``tools/`` scripts.
+
+Every tool used to carry its own copy-pasted ``sys.path.insert`` so
+``import tfidf_tpu`` works when run as ``python tools/<name>.py`` from
+anywhere; this module is the single copy. Importing it is enough —
+the script's own directory (``tools/``) is already on ``sys.path``
+when Python runs the file, so ``import _common`` resolves, and the
+import side effect puts the repo root ahead of it::
+
+    import _common  # noqa: F401  repo-root sys.path bootstrap
+    from _common import REPO
+
+``REPO`` is the absolute repo root for tools that build paths off it.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def repo_root() -> str:
+    return REPO

@@ -19,8 +19,8 @@ import time
 
 import numpy as np
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
+import _common  # noqa: E402,F401  repo-root sys.path bootstrap
+from _common import REPO  # noqa: E402
 
 MAX_BYTES = 4096       # per-file cap: keeps batches rectangular-ish
 BATCH = 1024           # dense [BATCH, 2^16] int32 counts = 256 MB

@@ -17,8 +17,7 @@ import argparse
 import sys
 import time
 
-REPO = __file__.rsplit("/", 2)[0]
-sys.path.insert(0, REPO)
+import _common  # noqa: E402,F401  repo-root sys.path bootstrap
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402

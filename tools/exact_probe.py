@@ -17,8 +17,7 @@ import sys
 import tempfile
 import time
 
-REPO = __file__.rsplit("/", 2)[0]
-sys.path.insert(0, REPO)
+import _common  # noqa: E402,F401  repo-root sys.path bootstrap
 
 import numpy as np  # noqa: E402
 
@@ -30,7 +29,6 @@ def main() -> None:
     ap.add_argument("--repeats", type=int, default=3)
     args = ap.parse_args()
 
-    sys.path.insert(0, REPO)
     import bench as benchmod
     benchmod.N_DOCS = args.docs
     benchmod.DOC_LEN = args.length

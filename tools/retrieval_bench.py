@@ -18,8 +18,7 @@ import sys
 import tempfile
 import time
 
-REPO = __file__.rsplit("/", 2)[0]
-sys.path.insert(0, REPO)
+import _common  # noqa: E402,F401  repo-root sys.path bootstrap
 
 import numpy as np  # noqa: E402
 

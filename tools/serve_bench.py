@@ -28,8 +28,7 @@ import tempfile
 import threading
 import time
 
-REPO = __file__.rsplit("/", 2)[0]
-sys.path.insert(0, REPO)
+import _common  # noqa: E402,F401  repo-root sys.path bootstrap
 
 import numpy as np  # noqa: E402
 
@@ -74,6 +73,12 @@ def main() -> int:
     ap.add_argument("--deadline-ms", type=float, default=None)
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--out", default="SERVE_r01.json")
+    ap.add_argument("--trace", metavar="OUT.json", default=None,
+                    help="record the host span timeline (request "
+                         "lifecycle chain + batcher lane) and write "
+                         "Chrome trace-event JSON here; also env "
+                         "TFIDF_TPU_TRACE. Validate with "
+                         "tools/trace_check.py")
     args = ap.parse_args()
 
     import bench as benchmod
@@ -82,12 +87,14 @@ def main() -> int:
 
     import jax
 
+    from tfidf_tpu import obs
     from tfidf_tpu.config import PipelineConfig, ServeConfig, VocabMode
     from tfidf_tpu.models import TfidfRetriever
     from tfidf_tpu.models.retrieval import _search_bcoo
     from tfidf_tpu.serve import Overloaded, ServeError, TfidfServer
 
     print(f"backend={jax.default_backend()}", file=sys.stderr)
+    obs.configure(args.trace)  # no-op unless --trace/TFIDF_TPU_TRACE
     tmp = None
     if args.input is None:
         tmp = tempfile.mkdtemp(prefix="serve_bench_")
@@ -196,6 +203,10 @@ def main() -> int:
             "index_s": round(index_s, 3),
             "recompiles_after_warmup": recompiles,
         }
+        trace_path = obs.export()
+        if trace_path:
+            artifact["trace_path"] = trace_path
+            print(f"trace written to {trace_path}", file=sys.stderr)
         with open(args.out, "w") as f:
             json.dump(artifact, f, indent=2, sort_keys=True)
             f.write("\n")

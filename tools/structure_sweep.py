@@ -30,7 +30,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _common  # noqa: E402,F401  repo-root sys.path bootstrap
 
 from tfidf_tpu.config import PipelineConfig, VocabMode
 from tfidf_tpu.ingest import _FLAT_BUCKET, _chunk_step, _finish_wire

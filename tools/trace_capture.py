@@ -2,12 +2,20 @@
 
 Runs the resident device program (sparse_forward and the production
 chunked structure) at the bench shape under ``jax.profiler.trace``,
-then parses the emitted ``*.trace.json.gz`` and aggregates device-lane
-op durations — which XLA ops actually dominate the compute the bench
-charges to the chip (sort vs DF vs score vs top-k vs gather/pack).
+then parses the emitted ``*.trace.json.gz`` — via the shared
+Chrome-trace helpers in ``tfidf_tpu.obs.tracer`` — and aggregates
+device-lane op durations: which XLA ops actually dominate the compute
+the bench charges to the chip (sort vs DF vs score vs top-k vs
+gather/pack).
+
+``--host-trace`` additionally arms the host span tracer for the timed
+section and writes ``<out>/host_trace.json`` into the SAME output dir,
+so one Perfetto session can hold the device capture and the host
+timeline side by side (the ``device_span`` TraceAnnotations carry the
+same names on both).
 
 Usage: python tools/trace_capture.py [--docs 32768] [--len 256]
-       [--out /tmp/tfidf_trace]
+       [--out /tmp/tfidf_trace] [--host-trace]
 Prints a per-op table to stdout; the raw trace dir is left for
 inspection (point TensorBoard or Perfetto at it).
 """
@@ -15,20 +23,17 @@ inspection (point TensorBoard or Perfetto at it).
 from __future__ import annotations
 
 import argparse
-import collections
 import glob
-import gzip
-import json
 import os
 import sys
 
-REPO = __file__.rsplit("/", 2)[0]
-sys.path.insert(0, REPO)
+import _common  # noqa: E402,F401  repo-root sys.path bootstrap
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
+from tfidf_tpu import obs  # noqa: E402
 from tfidf_tpu.config import PipelineConfig, VocabMode  # noqa: E402
 from tfidf_tpu.ingest import (_chunk_step, _finish_wire,  # noqa: E402
                               _resident_df_mode, flatten_aligned)
@@ -44,8 +49,14 @@ def main() -> None:
     ap.add_argument("--len", type=int, dest="length", default=256)
     ap.add_argument("--out", default="/tmp/tfidf_trace")
     ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--host-trace", action="store_true",
+                    help="also record the host span timeline and "
+                         "write <out>/host_trace.json next to the "
+                         "device capture")
     args = ap.parse_args()
     d, length = args.docs, args.length
+    if args.host_trace:
+        obs.configure(os.path.join(args.out, "host_trace.json"))
 
     print(f"backend={jax.default_backend()}", file=sys.stderr)
     rng = np.random.default_rng(0)
@@ -89,10 +100,15 @@ def main() -> None:
 
     os.makedirs(args.out, exist_ok=True)
     with jax.profiler.trace(args.out):
-        for _ in range(args.iters):
-            jax.device_get(fwd(tok_dev, len_dev))
-        for _ in range(args.iters):
-            jax.device_get(prod())
+        for i in range(args.iters):
+            with obs.span("fwd", iter=i):
+                jax.device_get(fwd(tok_dev, len_dev))
+        for i in range(args.iters):
+            with obs.device_span("prod", iter=i):
+                jax.device_get(prod())
+    host_path = obs.export()
+    if host_path:
+        print(f"host trace: {host_path}", file=sys.stderr)
 
     traces = sorted(glob.glob(os.path.join(
         args.out, "**", "*.trace.json.gz"), recursive=True))
@@ -104,35 +120,16 @@ def main() -> None:
             print("  " + p, file=sys.stderr)
         sys.exit(1)
     path = traces[-1]
-    with gzip.open(path, "rt") as f:
-        trace = json.load(f)
-    events = trace.get("traceEvents", [])
-
-    # Device lanes: pid/tid whose process name mentions the accelerator.
-    proc_names = {}
-    for e in events:
-        if e.get("ph") == "M" and e.get("name") == "process_name":
-            proc_names[e["pid"]] = e["args"].get("name", "")
-    dev_pids = {p for p, n in proc_names.items()
-                if "TPU" in n or "/device" in n.lower() or "Device" in n}
-    agg: dict = collections.defaultdict(float)
-    cnt: dict = collections.defaultdict(int)
-    total = 0.0
-    for e in events:
-        if e.get("ph") != "X" or e.get("pid") not in dev_pids:
-            continue
-        name = e.get("name", "?")
-        dur = float(e.get("dur", 0.0))  # microseconds
-        agg[name] += dur
-        cnt[name] += 1
-        total += dur
+    # The shared Chrome-trace reader/aggregator (tfidf_tpu.obs.tracer)
+    # — one definition of "device lane" and one table shape for this
+    # tool, trace_check and the tests.
+    events = obs.load_chrome_trace(path)
+    rows, total = obs.device_op_table(events, top=25)
     print(f"trace: {path}")
-    print(f"device pids: "
-          f"{ {p: proc_names[p] for p in dev_pids} }", file=sys.stderr)
     print(f"\n| op | total ms | calls | % of device time |")
     print("|---|---|---|---|")
-    for name, us in sorted(agg.items(), key=lambda kv: -kv[1])[:25]:
-        print(f"| {name[:60]} | {us / 1e3:9.2f} | {cnt[name]:5d} | "
+    for name, us, calls in rows:
+        print(f"| {name[:60]} | {us / 1e3:9.2f} | {calls:5d} | "
               f"{100 * us / max(total, 1e-9):5.1f}% |")
     print(f"\ntotal device-lane time: {total / 1e3:.1f} ms over "
           f"{2 * args.iters} timed calls")

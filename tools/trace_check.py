@@ -1,0 +1,241 @@
+"""CI-friendly validator of an emitted host trace.
+
+The bench artifacts CLAIM overlap (``overlap.pack_hidden_frac``,
+``downlink.fetch_hidden_frac``); a trace lets a human SEE it — and
+this tool lets CI assert it. It loads a Chrome trace-event file
+written by ``--trace`` / ``TFIDF_TPU_TRACE`` (``tfidf_tpu.obs``) and
+checks the structural invariants the pipeline is built around:
+
+schema (always):
+  * every complete event has a name, numeric ``ts`` and ``dur >= 0``;
+  * every lane used by a span carries ``thread_name`` metadata;
+  * at least ``--min-threads`` distinct lanes recorded spans (the
+    overlap machinery IS threads — a single-lane trace means the
+    instrumentation or the workers are broken).
+
+ingest traces (auto-detected by ``pack`` spans):
+  * pack spans live on a non-main lane, dispatch/phase_b on main;
+  * with ``pack_ahead`` on (``TFIDF_TPU_PACK_AHEAD`` >= 2, the
+    default) and >= 2 chunks: some packer-lane ``pack`` span overlaps
+    a main-lane ``dispatch``/``phase_b`` span in wall time — the
+    double-buffered upload actually double-buffered;
+  * with ``fetch_ahead`` on (``TFIDF_TPU_FETCH_AHEAD`` >= 1, the
+    default) and >= 2 drain + >= 2 ``phase_b`` spans (the chunked
+    finish): some drainer-lane ``drain`` span overlaps a later
+    chunk's ``phase_b`` — the async drain actually hid behind
+    scoring. (The scanned finish emits ONE drain; the check is then
+    vacuous and says so.)
+
+serve traces (auto-detected by ``request`` spans):
+  * every ``request`` span carries an ``outcome`` in the known set —
+    the span-chain parity the serving layer promises (each submitted
+    request appears exactly once as drained / cache_hit / shed / ...);
+  * every ``queued`` span that reached a batch carries its batch id.
+
+Pure stdlib — runnable under ``JAX_PLATFORMS=cpu`` (or no jax at
+all). Exit 0 = all checks passed/vacuous, 1 = a violated invariant,
+2 = unreadable input.
+
+Usage: python tools/trace_check.py TRACE.json [--mode auto|ingest|serve]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Dict, List, Tuple
+
+import _common  # noqa: E402,F401  repo-root sys.path bootstrap
+
+# The shared Chrome-trace reader lives in tfidf_tpu/obs/tracer.py, but
+# importing it THROUGH the package would pull in jax (the package
+# __init__ imports the pipeline). The tracer module itself is stdlib-
+# only by design, so load it standalone — this tool stays runnable in
+# a bare CI interpreter with no jax at all.
+import importlib.util as _ilu  # noqa: E402
+
+_spec = _ilu.spec_from_file_location(
+    "_obs_tracer", os.path.join(_common.REPO, "tfidf_tpu", "obs",
+                                "tracer.py"))
+_tracer = _ilu.module_from_spec(_spec)
+_spec.loader.exec_module(_tracer)
+load_chrome_trace = _tracer.load_chrome_trace
+spans_by_thread = _tracer.spans_by_thread
+
+_OUTCOMES = {"drained", "cache_hit", "shed_overload", "shed_deadline",
+             "rejected", "error", "empty"}
+
+
+def _overlaps(a: dict, b: dict) -> bool:
+    return (a["ts"] < b["ts"] + b.get("dur", 0.0)
+            and b["ts"] < a["ts"] + a.get("dur", 0.0))
+
+
+def check_trace(path: str, mode: str = "auto",
+                min_threads: int = 1) -> Tuple[List[str], List[str]]:
+    """Returns ``(errors, notes)`` — empty errors == pass."""
+    errors: List[str] = []
+    notes: List[str] = []
+    events = load_chrome_trace(path)
+    xs = [e for e in events if e.get("ph") == "X"]
+    if not xs:
+        return ["trace contains no complete (ph=X) span events"], notes
+
+    # --- schema ---
+    for e in xs:
+        if not e.get("name"):
+            errors.append(f"span without a name: {e!r}")
+            break
+        if not isinstance(e.get("ts"), (int, float)) \
+                or not isinstance(e.get("dur"), (int, float)) \
+                or e["dur"] < 0:
+            errors.append(f"span with bad ts/dur: {e!r}")
+            break
+    lanes = spans_by_thread(events)
+    named = {(e.get("pid"), e.get("tid"))
+             for e in events
+             if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    for e in xs:
+        if (e.get("pid"), e.get("tid")) not in named:
+            errors.append(
+                f"lane {e.get('pid')}/{e.get('tid')} has spans but no "
+                f"thread_name metadata")
+            break
+    if len(lanes) < min_threads:
+        errors.append(f"{len(lanes)} lane(s) recorded spans; expected "
+                      f">= {min_threads}")
+    notes.append(f"lanes: {sorted(lanes)} "
+                 f"({sum(len(v) for v in lanes.values())} spans)")
+
+    by_name: Dict[str, List[dict]] = {}
+    for label, evs in lanes.items():
+        for e in evs:
+            by_name.setdefault(e["name"], []).append(e)
+
+    if mode == "auto":
+        mode = ("serve" if "request" in by_name
+                else "ingest" if "pack" in by_name else "schema")
+        notes.append(f"mode: {mode} (auto)")
+
+    if mode == "ingest":
+        errors += _check_ingest(lanes, by_name, notes)
+    elif mode == "serve":
+        errors += _check_serve(by_name, notes)
+    return errors, notes
+
+
+def _check_ingest(lanes, by_name, notes) -> List[str]:
+    errors: List[str] = []
+    packs = [e for e in lanes.get("packer", [])
+             if e["name"] == "pack"]
+    main_disp = [e for e in lanes.get("main", [])
+                 if e["name"] in ("dispatch", "phase_b")]
+    drains = [e for e in lanes.get("drainer", [])
+              if e["name"] == "drain"]
+    phase_b = by_name.get("phase_b", [])
+    if by_name.get("pack") and not packs:
+        errors.append("pack spans exist but none on a 'packer' lane "
+                      "(worker thread not labeled / pack on main?)")
+    if not main_disp:
+        errors.append("no dispatch/phase_b spans on the 'main' lane")
+
+    # Overlap checks arm only when some span carries chunk >= 1: a
+    # trace may hold SEVERAL sequential single-chunk runs (bench
+    # warmup + timed runs), whose spans can never overlap each other —
+    # only a genuinely multi-chunk run makes the claim testable.
+    def multi_chunk(evs):
+        return any((e.get("args") or {}).get("chunk", 0) >= 1
+                   for e in evs)
+
+    pack_ahead = int(os.environ.get("TFIDF_TPU_PACK_AHEAD", "2"))
+    if pack_ahead >= 2 and multi_chunk(packs) and main_disp:
+        hit = any(_overlaps(p, d) for p in packs for d in main_disp)
+        if not hit:
+            errors.append(
+                "pack_ahead is on but NO packer-lane pack span "
+                "overlaps a main-lane dispatch/phase_b span — the "
+                "double-buffered upload did not overlap")
+        else:
+            notes.append("ok: pack spans overlap dispatch/scoring "
+                         "(pack_ahead)")
+    else:
+        notes.append("pack-overlap check vacuous "
+                     f"(pack_ahead={pack_ahead}, packs={len(packs)})")
+
+    fetch_ahead = int(os.environ.get("TFIDF_TPU_FETCH_AHEAD", "2"))
+    if fetch_ahead >= 1 and multi_chunk(drains) and len(phase_b) >= 2:
+        hit = any(_overlaps(d, s) for d in drains for s in phase_b)
+        if not hit:
+            errors.append(
+                "fetch_ahead is on but NO drainer-lane drain span "
+                "overlaps a phase_b scoring span — the async drain "
+                "did not hide behind compute")
+        else:
+            notes.append("ok: drain spans overlap phase-B scoring "
+                         "(fetch_ahead)")
+    else:
+        notes.append(
+            "drain-overlap check vacuous (scanned finish emits one "
+            f"drain; drains={len(drains)}, phase_b={len(phase_b)})")
+    return errors
+
+
+def _check_serve(by_name, notes) -> List[str]:
+    errors: List[str] = []
+    requests = by_name.get("request", [])
+    for e in requests:
+        outcome = (e.get("args") or {}).get("outcome")
+        if outcome not in _OUTCOMES:
+            errors.append(f"request span without a known outcome: "
+                          f"{e.get('args')!r}")
+            break
+    from collections import Counter
+    outcomes = Counter((e.get("args") or {}).get("outcome")
+                       for e in requests)
+    notes.append(f"request outcomes: {dict(outcomes)}")
+    for e in by_name.get("queued", []):
+        args = e.get("args") or {}
+        if args.get("outcome") == "batched" and "batch" not in args:
+            errors.append("queued span reached a batch without a "
+                          "batch id")
+            break
+    batches = by_name.get("batched", [])
+    if batches:
+        bids = {(e.get("args") or {}).get("batch") for e in batches}
+        notes.append(f"batches: {len(batches)} ({len(bids)} ids)")
+    return errors
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.split("\n")[0],
+        epilog="exit 0 = invariants hold, 1 = violated, 2 = unreadable")
+    ap.add_argument("trace", help="Chrome trace-event JSON "
+                                  "(--trace / TFIDF_TPU_TRACE output)")
+    ap.add_argument("--mode", choices=["auto", "ingest", "serve",
+                                       "schema"], default="auto")
+    ap.add_argument("--min-threads", type=int, default=3,
+                    help="fewest distinct lanes the trace must carry "
+                         "(default 3: main + packer + drainer, or "
+                         "main + submitters + batcher)")
+    args = ap.parse_args()
+    try:
+        errors, notes = check_trace(args.trace, args.mode,
+                                    args.min_threads)
+    except (OSError, ValueError) as e:
+        print(f"trace_check: cannot read {args.trace}: {e}",
+              file=sys.stderr)
+        return 2
+    for n in notes:
+        print(f"  {n}")
+    if errors:
+        for err in errors:
+            print(f"FAIL: {err}", file=sys.stderr)
+        return 1
+    print(f"trace_check: {args.trace} OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
