@@ -63,7 +63,11 @@ MARGIN = 4 * TOPK
 
 
 def log(msg: str) -> None:
-    print(msg, file=sys.stderr, flush=True)
+    # Structured progress event: the stderr echo keeps the old
+    # "print to stderr" behavior, and the ring keeps the last window
+    # of progress for the flight recorder if the run dies (obs/log.py).
+    from tfidf_tpu.obs import log as obs_log
+    obs_log.log_event("info", "bench_progress", msg=msg)
 
 
 def preflight_backend(retries: int = 2) -> str:

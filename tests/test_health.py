@@ -1,0 +1,557 @@
+"""Self-watching serving (ISSUE 6): health watchdog, canary parity
+probes, flight recorder.
+
+The acceptance pins: ``healthz`` reports ``degraded`` within one
+watchdog period under fault-injected saturation / worker stall and
+recovers to ``ok``, with the admission bound visibly shrunk while
+degraded; the canary prober detects a deliberately corrupted index
+(flipped DF-derived IDF entry post-swap) via ``parity < 1.0`` while
+normal stress holds ``parity == 1.0``; and a SIGTERM'd serve
+subprocess leaves a complete flight-recorder dump + trace on disk,
+validated by the extended ``tools/trace_check.py``.
+"""
+
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tfidf_tpu import obs
+from tfidf_tpu.config import PipelineConfig, ServeConfig, VocabMode
+from tfidf_tpu.io.corpus import Corpus
+from tfidf_tpu.models import TfidfRetriever
+from tfidf_tpu.obs.health import (DEGRADED, OK, UNHEALTHY, HealthMonitor,
+                                  HealthThresholds, beat, set_monitor)
+from tfidf_tpu.obs.log import EventLog
+from tfidf_tpu.serve import (CanaryProber, Overloaded, TfidfServer,
+                             pinned_queries_from_dir)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CFG = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=512,
+                     max_doc_len=16, doc_chunk=16)
+CORPUS = Corpus(
+    names=["doc1", "doc2", "doc3", "doc4", "doc5"],
+    docs=[b"apple banana apple cherry",
+          b"banana banana date",
+          b"cherry date elder fig",
+          b"apple fig fig fig",
+          b"grape grape grape grape"])
+QUERIES = ["apple cherry", "banana date", "grape", "fig elder"]
+
+
+@pytest.fixture(scope="module")
+def retriever():
+    return TfidfRetriever(CFG).index(CORPUS)
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    """Tests get a private event log and no global health monitor, and
+    never leak either (or a flight path) into the rest of the suite."""
+    import tfidf_tpu.obs.log as obs_log
+    obs.set_log(EventLog(echo="off"))
+    set_monitor(None)
+    prev_flight = obs_log._flight
+    obs_log._flight = None
+    yield
+    obs_log._flight = prev_flight
+    set_monitor(None)
+    obs.set_log(None)
+
+
+def quick_cfg(**kw):
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("max_wait_ms", 5)
+    kw.setdefault("queue_depth", 64)
+    kw.setdefault("cache_entries", 64)
+    return ServeConfig(**kw)
+
+
+class TestHealthMonitor:
+    def test_ok_with_no_signals(self):
+        m = HealthMonitor()
+        status = m.evaluate()
+        assert status.state == OK and status.ok and status.reasons == []
+
+    def test_stall_detected_and_recovers(self):
+        m = HealthMonitor(thresholds=HealthThresholds(stall_after_s=0.5))
+        m.register("worker", busy_fn=lambda: True)
+        m.heartbeat("worker")
+        now = time.monotonic()
+        assert m.evaluate(now=now).state == OK
+        # One stall_after_s with pending work and no beat: unhealthy,
+        # with the worker named in the reason.
+        status = m.evaluate(now=now + 1.0)
+        assert status.state == UNHEALTHY
+        assert any("worker" in r for r in status.reasons)
+        assert status.checks["workers"]["worker"]["stalled"]
+        m.heartbeat("worker")
+        assert m.evaluate().state == OK  # beat resumed -> recovered
+
+    def test_idle_worker_never_stalls(self):
+        m = HealthMonitor(thresholds=HealthThresholds(stall_after_s=0.1))
+        m.register("worker", busy_fn=lambda: False)  # no pending work
+        m.heartbeat("worker")
+        assert m.evaluate(now=time.monotonic() + 99).state == OK
+
+    def test_queue_saturation_degrades_and_recovers(self):
+        depth = [10]
+        snap = lambda: {"requests": 0, "queue": {"depth": depth[0]},
+                        "shed": {"overload": 0, "deadline": 0}}
+        m = HealthMonitor(snapshot_fn=snap, queue_bound=10)
+        status = m.evaluate()
+        assert status.state == DEGRADED
+        assert status.checks["queue_saturation"] == 1.0
+        depth[0] = 1
+        assert m.evaluate().state == OK
+
+    def test_windowed_shed_rate_degrades(self):
+        state = {"requests": 0, "over": 0}
+        snap = lambda: {"requests": state["requests"],
+                        "queue": {"depth": 0},
+                        "shed": {"overload": state["over"], "deadline": 0}}
+        m = HealthMonitor(snapshot_fn=snap, queue_bound=100)
+        assert m.evaluate().state == OK      # seeds the window
+        state.update(requests=10, over=10)   # 50% shed since last look
+        status = m.evaluate()
+        assert status.state == DEGRADED
+        assert status.checks["shed_rate"] == 0.5
+        # A clean window (no new traffic) decays the rate back to ok.
+        assert m.evaluate().state == OK
+
+    def test_deadline_miss_rate_is_its_own_signal(self):
+        state = {"requests": 0, "dead": 0}
+        snap = lambda: {"requests": state["requests"],
+                        "queue": {"depth": 0},
+                        "shed": {"overload": 0,
+                                 "deadline": state["dead"]}}
+        m = HealthMonitor(snapshot_fn=snap, queue_bound=100)
+        m.evaluate()
+        state.update(requests=90, dead=10)
+        status = m.evaluate()
+        assert status.state == DEGRADED
+        assert status.checks["deadline_miss_rate"] == 0.1
+
+    def test_admission_bound_shrinks_only_while_not_ok(self):
+        m = HealthMonitor(thresholds=HealthThresholds(
+            degraded_admission_factor=0.25))
+        assert m.admission_bound(100) == 100
+        m._status.state = DEGRADED
+        assert m.admission_bound(100) == 25
+        m._status.state = UNHEALTHY
+        assert m.admission_bound(100) == 25
+        assert m.admission_bound(2) == 1  # floor: progress possible
+
+    def test_gauges_published(self):
+        from tfidf_tpu.obs.registry import MetricsRegistry
+        reg = MetricsRegistry()
+        m = HealthMonitor(
+            snapshot_fn=lambda: {"requests": 0, "queue": {"depth": 9},
+                                 "shed": {"overload": 0, "deadline": 0}},
+            queue_bound=10, registry=reg)
+        m.evaluate()
+        snap = reg.snapshot()
+        assert snap["serve_health_state"]["value"] == 1  # degraded
+        assert snap["serve_admission_bound"]["value"] == 5
+        assert snap["serve_queue_saturation_milli"]["value"] == 900
+
+    def test_state_change_logged(self):
+        log = EventLog(echo="off")
+        obs.set_log(log)
+        m = HealthMonitor(
+            snapshot_fn=lambda: {"requests": 0, "queue": {"depth": 10},
+                                 "shed": {"overload": 0, "deadline": 0}},
+            queue_bound=10)
+        m.evaluate()
+        evs = [e for e in log.events()
+               if e["event"] == "health_state_change"]
+        assert evs and evs[-1]["to"] == DEGRADED
+
+    def test_module_hook_routes_beats(self):
+        m = HealthMonitor()
+        beat("packer")                 # no monitor installed: no-op
+        assert "packer" not in m._workers
+        set_monitor(m)
+        beat("packer")
+        assert m._workers["packer"].beats == 1
+
+    def test_background_thread_evaluates_within_period(self):
+        m = HealthMonitor(
+            snapshot_fn=lambda: {"requests": 0, "queue": {"depth": 10},
+                                 "shed": {"overload": 0, "deadline": 0}},
+            queue_bound=10, period_s=0.02)
+        m.start()
+        try:
+            deadline = time.monotonic() + 2.0
+            while (m.status().state != DEGRADED
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            assert m.status().state == DEGRADED
+        finally:
+            m.stop()
+
+
+class TestServerHealth:
+    def test_healthz_ok_schema(self, retriever):
+        with TfidfServer(retriever, quick_cfg()) as srv:
+            srv.search(QUERIES[:2], k=3)
+            hz = srv.healthz()
+        json.dumps(hz)
+        assert hz["status"] == OK and hz["reasons"] == []
+        assert hz["admission_bound"] == srv.config.queue_depth
+        assert "batcher" in hz["checks"]["workers"]
+        assert hz["uptime_s"] >= 0
+
+    def test_saturation_degrades_and_shrinks_admission(self, retriever):
+        # Fault injection: a huge batching window keeps 4 admitted
+        # queries parked, saturating queue_depth=4.
+        srv = TfidfServer(retriever, quick_cfg(
+            queue_depth=4, max_batch=1024, max_wait_ms=60_000,
+            cache_entries=0))
+        try:
+            f1 = srv.submit(QUERIES[:2], k=2)
+            f2 = srv.submit(QUERIES[2:4], k=2)
+            hz = srv.healthz()
+            assert hz["status"] == DEGRADED
+            assert any("saturation" in r for r in hz["reasons"])
+            # Admission bound visibly shrinks: 4 -> 2, so even a
+            # 1-query request sheds while 4 are parked.
+            assert hz["admission_bound"] == 2
+            with pytest.raises(Overloaded, match="admission bound 2"):
+                srv.submit([QUERIES[0]], k=2)
+        finally:
+            srv.close(drain=True)
+        assert f1.result(timeout=0) and f2.result(timeout=0)
+        # Recovery: backlog drained; the shed window decays over two
+        # evaluations (the first still sees the shed delta).
+        srv.health.evaluate()
+        status = srv.health.evaluate()
+        assert status.state == OK
+        assert srv.health.admission_bound(4) == 4
+
+    def test_worker_stall_flips_readyz(self, retriever):
+        srv = TfidfServer(retriever, quick_cfg(stall_after_ms=50))
+        try:
+            srv.health.register("fake", busy_fn=lambda: True)
+            srv.health.heartbeat("fake")
+            assert srv.readyz()["ready"]
+            time.sleep(0.12)           # one stall window, no beat
+            rz = srv.readyz()
+            assert not rz["ready"] and rz["status"] == UNHEALTHY
+            srv.health.heartbeat("fake")
+            assert srv.readyz()["ready"]  # recovered
+        finally:
+            srv.close()
+
+    def test_background_watchdog_runs_when_configured(self, retriever):
+        srv = TfidfServer(retriever, quick_cfg(
+            health_period_ms=20, stall_after_ms=40))
+        try:
+            srv.health.register("fake", busy_fn=lambda: True)
+            deadline = time.monotonic() + 2.0
+            # No manual evaluate: the watchdog thread must notice the
+            # stalled worker by itself, within its own cadence.
+            while (srv.health.status().state != UNHEALTHY
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert srv.health.status().state == UNHEALTHY
+        finally:
+            srv.close()
+
+    def test_batcher_heartbeats_recorded(self, retriever):
+        srv = TfidfServer(retriever, quick_cfg())
+        try:
+            srv.search(QUERIES[:2], k=2)
+            assert srv.health._workers["batcher"].beats > 0
+        finally:
+            srv.close()
+
+    def test_ingest_workers_beat_into_monitor(self, toy_corpus_dir):
+        from tfidf_tpu.ingest import run_overlapped
+        m = HealthMonitor()
+        set_monitor(m)
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, topk=4,
+                             vocab_size=1 << 12)
+        run_overlapped(toy_corpus_dir, cfg, doc_len=16, chunk_docs=4)
+        assert m._workers["packer"].beats > 0
+        assert m._workers["drainer"].beats > 0
+
+
+class TestCanary:
+    def _server(self, retriever, **kw):
+        return TfidfServer(retriever, quick_cfg(**kw))
+
+    def test_parity_one_on_healthy_index(self, retriever):
+        srv = self._server(retriever)
+        try:
+            canary = CanaryProber(srv, QUERIES, k=3)
+            assert canary.probe() == 1.0
+            assert canary.parity == 1.0
+            snap = srv.metrics.registry.snapshot()
+            assert snap["serve_canary_parity_milli"]["value"] == 1000
+            assert snap["serve_canary_probes_total"] == 1
+            assert snap["serve_canary_failures_total"] == 0
+        finally:
+            srv.close()
+
+    def test_detects_corrupted_index_after_swap(self, retriever):
+        import jax.numpy as jnp
+
+        from tfidf_tpu.ops.hashing import words_to_ids
+        log = EventLog(echo="off")
+        obs.set_log(log)
+        twin = TfidfRetriever(CFG).index(CORPUS)
+        srv = self._server(retriever)
+        try:
+            canary = CanaryProber(srv, QUERIES, k=3)
+            srv.swap_index(twin)       # oracle re-captures in the swap
+            assert canary.probe() == 1.0
+            # Silent post-swap corruption: flip the DF-derived IDF
+            # entry of a canary query term ("apple") — exactly the
+            # failure a bad segment merge / hot-swap bug would plant.
+            tid = int(words_to_ids([b"apple"], CFG.vocab_size,
+                                   CFG.hash_seed)[0])
+            idf = np.asarray(twin._idf).copy()
+            idf[tid] *= 7.0
+            twin._idf = jnp.asarray(idf)
+            parity = canary.probe()
+            assert parity is not None and parity < 1.0
+            snap = srv.metrics.registry.snapshot()
+            assert snap["serve_canary_parity_milli"]["value"] < 1000
+            assert snap["serve_canary_failures_total"] == 1
+            evs = [e for e in log.events()
+                   if e["event"] == "canary_parity_failure"]
+            assert evs and evs[0]["queries"]  # failing query indices
+        finally:
+            srv.close()
+
+    def test_stress_holds_parity(self, retriever):
+        srv = self._server(retriever, max_wait_ms=2)
+        errors = []
+
+        def work(tid):
+            try:
+                rng = np.random.default_rng(tid)
+                for _ in range(6):
+                    qs = [QUERIES[i] for i in rng.integers(
+                        0, len(QUERIES), size=int(rng.integers(1, 4)))]
+                    srv.search(qs, k=3, timeout=30)
+            except Exception as e:  # noqa: BLE001 — surface in main
+                errors.append(e)
+
+        try:
+            canary = CanaryProber(srv, QUERIES, k=3)
+            threads = [threading.Thread(target=work, args=(t,))
+                       for t in range(4)]
+            for t in threads:
+                t.start()
+            parities = [canary.probe() for _ in range(5)]
+            for t in threads:
+                t.join()
+        finally:
+            srv.close()
+        assert not errors
+        compared = [p for p in parities if p is not None]
+        assert compared and all(p == 1.0 for p in compared)
+
+    def test_missing_oracle_skips_not_fails(self, retriever):
+        srv = self._server(retriever)
+        try:
+            canary = CanaryProber(srv, QUERIES, k=3)
+            canary._oracle.clear()     # simulate a capture race
+            assert canary.probe() is None
+            snap = srv.metrics.registry.snapshot()
+            assert snap["serve_canary_skipped_total"] == 1
+            assert snap["serve_canary_failures_total"] == 0
+        finally:
+            srv.close()
+
+    def test_probe_bypasses_cache(self, retriever):
+        srv = self._server(retriever)
+        try:
+            canary = CanaryProber(srv, QUERIES, k=3)
+            before = srv.metrics.snapshot()["cache"]
+            canary.probe()
+            after = srv.metrics.snapshot()["cache"]
+            # Neither probes nor fills: a memoized row must never mask
+            # device-path corruption.
+            assert after == before
+        finally:
+            srv.close()
+
+    def test_pinned_queries_from_dir(self, toy_corpus_dir):
+        qs = pinned_queries_from_dir(toy_corpus_dir, n=4, tokens=3)
+        assert 0 < len(qs) <= 4
+        assert all(isinstance(q, str) and q for q in qs)
+        # Pinned: same corpus, same queries.
+        assert qs == pinned_queries_from_dir(toy_corpus_dir, n=4,
+                                             tokens=3)
+
+
+class TestFlightRecorder:
+    def test_ring_keeps_newest(self):
+        log = EventLog(capacity=3, echo="off")
+        for i in range(7):
+            log.log("info", f"e{i}")
+        assert [e["event"] for e in log.events()] == ["e4", "e5", "e6"]
+
+    def test_rate_limit_per_event_with_suppression_receipt(self):
+        log = EventLog(rate_per_s=0.001, burst=2, echo="off")
+        admitted = [log.log("info", "hot", i=i) for i in range(10)]
+        assert admitted.count(True) == 2       # burst, then throttled
+        assert log.suppressed()["hot"] == 8
+        assert all(log.log("info", f"cold{i}") for i in range(5))
+        # The suppressed count surfaces on the next admitted event.
+        log2 = EventLog(rate_per_s=1000.0, burst=1, echo="off")
+        log2.log("info", "x")
+        log2.log("info", "x")                  # throttled (burst 1)
+        time.sleep(0.01)                       # refill >= 1 token
+        assert log2.log("info", "x")
+        assert log2.events()[-1]["suppressed"] >= 1
+
+    def test_echo_threshold(self, capsys):
+        log = EventLog(echo="warning")
+        log.info("quiet", msg="should not echo")
+        log.warning("loud", msg="should echo")
+        err = capsys.readouterr().err
+        assert "should echo" in err and "should not echo" not in err
+
+    def test_dump_is_atomic_and_valid(self, tmp_path):
+        log = EventLog(echo="off")
+        log.info("boot", msg="hello", n=1)
+        log.error("crashish", detail="xyz")
+        log.digest(outcome="drained", queries=2, k=3, ms=1.5)
+        path = str(tmp_path / "flight.jsonl")
+        assert log.dump(path) == path
+        assert not os.path.exists(path + ".tmp")  # renamed into place
+        tc = _load_trace_check()
+        errors, notes = tc.check_flight(path)
+        assert errors == [], (errors, notes)
+        lines = [json.loads(l) for l in open(path)]
+        assert lines[0]["schema"] == "tfidf-flight/1"
+        assert lines[0]["events"] == 2 and lines[0]["digests"] == 1
+        assert lines[-1]["kind"] == "digest"
+
+    def test_check_flight_catches_torn_dump(self, tmp_path):
+        log = EventLog(echo="off")
+        log.info("a")
+        log.info("b")
+        path = str(tmp_path / "flight.jsonl")
+        log.dump(path)
+        with open(path) as f:
+            lines = f.readlines()
+        with open(path, "w") as f:            # drop the last line
+            f.writelines(lines[:-1])
+        tc = _load_trace_check()
+        errors, _ = tc.check_flight(path)
+        assert errors and "torn" in errors[0]
+
+    def test_server_records_request_digests(self, retriever):
+        log = EventLog(echo="off")
+        obs.set_log(log)
+        srv = TfidfServer(retriever, quick_cfg(cache_entries=0))
+        try:
+            srv.search(QUERIES[:2], k=3)
+            with pytest.raises(Exception):
+                srv.submit([QUERIES[0]], k=2, deadline_ms=0
+                           ).result(timeout=10)
+        finally:
+            srv.close()
+        outcomes = [d["outcome"] for d in log.digests()]
+        assert "drained" in outcomes and "shed_deadline" in outcomes
+        d = log.digests()[0]
+        assert d["queries"] == 2 and d["k"] == 3 and d["ms"] >= 0
+        assert "epoch" in d
+
+    def test_server_close_dumps_when_armed(self, retriever, tmp_path):
+        log = EventLog(echo="off")
+        obs.set_log(log)
+        path = str(tmp_path / "close.flight.jsonl")
+        obs.configure_flight(path)
+        srv = TfidfServer(retriever, quick_cfg())
+        srv.search([QUERIES[0]], k=2)
+        srv.close()
+        assert os.path.exists(path)
+        tc = _load_trace_check()
+        errors, _ = tc.check_flight(path)
+        assert errors == []
+
+    def test_flight_path_derives_from_trace(self, tmp_path):
+        assert obs.flight_path() is None       # nothing armed
+        obs.set_tracer(obs.Tracer(), str(tmp_path / "t.json"))
+        try:
+            assert obs.flight_path() == str(tmp_path / "t.json") \
+                + ".flight.jsonl"
+        finally:
+            obs.set_tracer(None)
+
+
+def _load_trace_check():
+    tools = os.path.join(REPO, "tools")
+    if tools not in sys.path:
+        sys.path.append(tools)
+    spec = importlib.util.spec_from_file_location(
+        "trace_check", os.path.join(tools, "trace_check.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestSigtermLeavesEvidence:
+    """Acceptance: SIGTERM to a serving subprocess leaves a complete
+    flight-recorder dump AND a trace on disk (atomic writes from the
+    signal handler), both validated by tools/trace_check.py."""
+
+    def test_sigterm_dumps_flight_and_trace(self, tmp_path):
+        input_dir = tmp_path / "input"
+        input_dir.mkdir()
+        for i, text in enumerate([b"apple banana", b"cherry date",
+                                  b"elder fig", b"grape apple"], 1):
+            (input_dir / f"doc{i}").write_bytes(text)
+        trace = str(tmp_path / "serve_trace.json")
+        flight = str(tmp_path / "serve.flight.jsonl")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tfidf_tpu.cli", "serve",
+             "--input", str(input_dir), "--vocab-size", "512",
+             "--max-wait-ms", "1", "--canary-period-ms", "0",
+             "--trace", trace, "--flight", flight],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, env=env, cwd=REPO, text=True)
+        try:
+            # One served request so the dump carries a digest and the
+            # trace carries the request span chain.
+            proc.stdin.write(json.dumps(
+                {"id": 1, "queries": ["cherry date"], "k": 2}) + "\n")
+            proc.stdin.flush()
+            deadline = time.monotonic() + 120
+            line = proc.stdout.readline()      # the id-1 response
+            assert line, "server never answered before SIGTERM"
+            assert json.loads(line)["id"] == 1
+            proc.send_signal(signal.SIGTERM)
+            rc = proc.wait(timeout=60)
+            assert time.monotonic() < deadline
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+        assert rc == 143                       # 128 + SIGTERM
+        assert os.path.exists(flight), proc.stderr.read()[-2000:]
+        assert os.path.exists(trace)
+        tc = _load_trace_check()
+        errors, notes = tc.check_flight(flight)
+        assert errors == [], (errors, notes)
+        errors, notes = tc.check_trace(trace, mode="serve",
+                                       min_threads=2)
+        assert errors == [], (errors, notes)
+        digests = [json.loads(l) for l in open(flight)][1:]
+        assert any(d.get("kind") == "digest"
+                   and d.get("outcome") == "drained" for d in digests)

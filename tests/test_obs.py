@@ -293,6 +293,144 @@ class TestRegistry:
         assert m.snapshot()["queue"]["peak"] == 1
 
 
+class TestMerge:
+    """Satellite (ISSUE 6): LatencyHistogram.merge + registry-level
+    merge — the per-replica aggregation primitive ROADMAP item 5
+    needs, and what lets perf_gate pool multi-run samples."""
+
+    def test_latency_histogram_merge_exact_and_percentiles(self):
+        from tfidf_tpu.utils.timing import LatencyHistogram
+        a, b, ref = (LatencyHistogram() for _ in range(3))
+        for v in (0.001, 0.002, 0.005, 0.5):
+            a.record(v)
+            ref.record(v)
+        for v in (0.010, 0.020, 0.100):
+            b.record(v)
+            ref.record(v)
+        a.merge(b)
+        assert a.count == ref.count == 7
+        assert a.sum_seconds == pytest.approx(ref.sum_seconds)
+        assert a.min == ref.min and a.max == ref.max
+        for p in (50, 95, 99):
+            assert a.percentile(p) == ref.percentile(p)
+
+    def test_merge_empty_sides(self):
+        from tfidf_tpu.utils.timing import LatencyHistogram
+        a, b = LatencyHistogram(), LatencyHistogram()
+        b.record(0.25)
+        a.merge(b)                       # empty <- data
+        assert a.count == 1 and a.min == 0.25 and a.max == 0.25
+        a.merge(LatencyHistogram())      # data <- empty
+        assert a.count == 1 and a.min == 0.25
+
+    def test_merge_rejects_geometry_mismatch(self):
+        from tfidf_tpu.utils.timing import LatencyHistogram
+        with pytest.raises(ValueError, match="geometry"):
+            LatencyHistogram().merge(LatencyHistogram(lo=1e-3))
+        with pytest.raises(ValueError, match="geometry"):
+            LatencyHistogram().merge(LatencyHistogram(resolution=0.05))
+
+    def test_registry_merge_aggregates_replicas(self):
+        a, b = MetricsRegistry(), MetricsRegistry()
+        a.counter("reqs").inc(10)
+        b.counter("reqs").inc(5)
+        b.counter("only_b").inc(2)       # missing in a: created
+        ga, gb = a.gauge("depth"), b.gauge("depth")
+        ga.set(3)
+        gb.set(9)
+        gb.set(4)
+        a.histogram("lat").observe(0.01)
+        b.histogram("lat").observe(0.10)
+        a.merge(b)
+        snap = a.snapshot()
+        assert snap["reqs"] == 15
+        assert snap["only_b"] == 2
+        # Gauges sum values and peaks (fleet depth; peak upper bound).
+        assert snap["depth"] == {"value": 7, "peak": 12}
+        assert snap["lat"]["count"] == 2
+        # b is untouched.
+        assert b.snapshot()["reqs"] == 5
+
+    def test_registry_merge_kind_clash_raises(self):
+        a, b = MetricsRegistry(), MetricsRegistry()
+        a.counter("x")
+        b.gauge("x").set(1)
+        with pytest.raises(ValueError, match="already registered"):
+            a.merge(b)
+
+    def test_serve_metrics_merge_via_registry(self):
+        a, b = ServeMetrics(), ServeMetrics()
+        a.observe_request(0.01, 1)
+        b.observe_request(0.02, 3)
+        b.count("shed_overload")
+        a.registry.merge(b.registry)
+        snap = a.snapshot()
+        assert snap["requests"] == 2 and snap["queries"] == 4
+        assert snap["shed"]["overload"] == 1
+        assert snap["latency_s"]["count"] == 2
+
+
+class TestPromUnderConcurrentMutation:
+    """Satellite (ISSUE 6): Prometheus exposition while 8 threads
+    hammer the registry — no tearing, no exceptions, parseable text
+    on every render."""
+
+    def test_render_prom_while_8_threads_mutate(self):
+        r = MetricsRegistry()
+        stop = threading.Event()
+        errors = []
+
+        def writer(tid):
+            try:
+                c = r.counter("hot_total")
+                g = r.gauge("depth")
+                h = r.histogram("lat_seconds")
+                i = 0
+                while not stop.is_set():
+                    c.inc()
+                    g.set(i % 32)
+                    h.observe(0.001 * (1 + i % 100))
+                    if i % 50 == 0:  # registry map churns too
+                        r.counter(f"t{tid}_{i // 50}_total").inc()
+                    i += 1
+            except Exception as e:  # noqa: BLE001 — surface in main
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(8)]
+        for t in threads:
+            t.start()
+        try:
+            deadline = time.monotonic() + 0.5
+            renders = 0
+            while time.monotonic() < deadline:
+                text = r.render_prom()
+                snap = r.snapshot(reset_peaks=True)
+                json.dumps(snap)
+                assert text.endswith("\n")
+                for line in text.splitlines():
+                    if line.startswith("#") or not line:
+                        continue
+                    name, value = line.rsplit(" ", 1)
+                    assert name
+                    float(value)          # every sample parses
+                renders += 1
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+        assert not errors
+        assert renders >= 3
+        # Quiesced totals are exact: no lost increments under load.
+        text = r.render_prom()
+        hot = next(l for l in text.splitlines()
+                   if l.startswith("hot_total "))
+        assert int(hot.split()[1]) == r.get("hot_total").value
+        counts = [int(l.rsplit(" ", 1)[1]) for l in text.splitlines()
+                  if l.startswith("lat_seconds_bucket")]
+        assert counts == sorted(counts)  # le-buckets stay cumulative
+
+
 class TestServeSpanParity:
     def _retriever(self, corpus_dir):
         from tfidf_tpu.models import TfidfRetriever

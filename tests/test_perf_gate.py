@@ -1,0 +1,240 @@
+"""Perf ledger + gate (ISSUE 6): the bench trajectory record and the
+regression tripwire over it.
+
+Pins: the backfill ingests the repo's archived BENCH_r0X/SERVE_r0X
+artifacts (idempotently, schema-versioned, skipping the rc=1 round-1
+crash artifact), the gate flags an injected 2x latency regression
+against that ledger, and — the false-positive floor — passes the same
+artifact re-run unchanged.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    tools = os.path.join(REPO, "tools")
+    if tools not in sys.path:
+        sys.path.append(tools)
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(tools, f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    # perf_gate does `import perf_ledger`; make the sibling visible
+    # under its plain name first.
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+perf_ledger = _load_tool("perf_ledger")
+perf_gate = _load_tool("perf_gate")
+
+
+@pytest.fixture
+def ledger(tmp_path):
+    """A tmp ledger backfilled from the repo's archived artifacts."""
+    path = str(tmp_path / "LEDGER.jsonl")
+    appended, skipped = perf_ledger.append(
+        perf_ledger.backfill_paths(), path, quiet=True)
+    assert appended >= 3   # r02-r05 bench + SERVE_r01 at minimum
+    return path
+
+
+class TestLedger:
+    def test_backfill_contents_and_schema(self, ledger):
+        records = perf_ledger.load_ledger(ledger)
+        assert all(r["schema"] == perf_ledger.SCHEMA for r in records)
+        kinds = {r["source"]: r["kind"] for r in records}
+        assert kinds.get("SERVE_r01.json") == "serve_bench"
+        assert kinds.get("BENCH_r05.json") == "bench"
+        # The rc=1 round-1 crash artifact carries no measurements.
+        assert "BENCH_r01.json" not in kinds
+        r05 = next(r for r in records
+                   if r["source"] == "BENCH_r05.json")
+        assert r05["metrics"]["docs_per_sec"] == 31273.1
+        assert r05["context"]["n_docs"] == 32768
+        assert "captured_at" in r05
+
+    def test_backfill_is_idempotent(self, ledger):
+        before = perf_ledger.load_ledger(ledger)
+        appended, skipped = perf_ledger.append(
+            perf_ledger.backfill_paths(), ledger, quiet=True)
+        assert appended == 0 and skipped == len(before) + 1  # +r01
+        assert perf_ledger.load_ledger(ledger) == before
+
+    def test_changed_metrics_append_as_new_record(self, ledger,
+                                                  tmp_path):
+        doc = json.load(open(os.path.join(REPO, "SERVE_r01.json")))
+        doc["throughput_qps"] *= 1.1
+        fresh = tmp_path / "SERVE_r01.json"  # same source NAME
+        fresh.write_text(json.dumps(doc))
+        appended, _ = perf_ledger.append([str(fresh)], ledger,
+                                         quiet=True)
+        assert appended == 1  # dedup is by content, not filename
+
+    def test_schema_mismatch_refuses_to_load(self, tmp_path):
+        path = tmp_path / "bad.jsonl"
+        path.write_text(json.dumps({"schema": 99, "kind": "bench"})
+                        + "\n")
+        with pytest.raises(ValueError, match="schema"):
+            perf_ledger.load_ledger(str(path))
+
+    def test_wrapped_and_bare_artifacts_normalize_identically(
+            self, tmp_path):
+        wrapped = json.load(open(os.path.join(REPO, "BENCH_r05.json")))
+        bare = tmp_path / "bare.json"
+        bare.write_text(json.dumps(wrapped["parsed"]))
+        rec_w, _ = perf_ledger.normalize(
+            os.path.join(REPO, "BENCH_r05.json"))
+        rec_b, _ = perf_ledger.normalize(str(bare))
+        assert rec_w["metrics"] == rec_b["metrics"]
+        assert rec_w["context"] == rec_b["context"]
+
+
+class TestGate:
+    def test_unchanged_artifact_passes(self, ledger):
+        for source in ("SERVE_r01.json", "BENCH_r05.json"):
+            cand, _ = perf_ledger.normalize(os.path.join(REPO, source))
+            verdict = perf_gate.gate(
+                cand, perf_ledger.load_ledger(ledger))
+            assert verdict["ok"], (source, verdict)
+            assert verdict["baseline_runs"] >= 1
+
+    def test_flags_2x_latency_regression(self, ledger, tmp_path):
+        doc = json.load(open(os.path.join(REPO, "SERVE_r01.json")))
+        doc["latency_ms"]["p50"] *= 2
+        doc["latency_ms"]["p99"] *= 2
+        bad = tmp_path / "regressed.json"
+        bad.write_text(json.dumps(doc))
+        cand, _ = perf_ledger.normalize(str(bad))
+        verdict = perf_gate.gate(cand, perf_ledger.load_ledger(ledger))
+        assert not verdict["ok"]
+        regressed = {c["metric"] for c in verdict["checks"]
+                     if c["verdict"] == "REGRESSED"}
+        assert {"p50_ms", "p99_ms"} <= regressed
+
+    def test_flags_halved_bench_throughput(self, ledger, tmp_path):
+        doc = json.load(open(os.path.join(REPO, "BENCH_r05.json")))
+        doc["parsed"]["value"] /= 2
+        doc["parsed"]["vs_baseline"] /= 2
+        bad = tmp_path / "slow_bench.json"
+        bad.write_text(json.dumps(doc))
+        cand, _ = perf_ledger.normalize(str(bad))
+        verdict = perf_gate.gate(cand, perf_ledger.load_ledger(ledger))
+        assert not verdict["ok"]
+        assert any(c["metric"] == "docs_per_sec"
+                   and c["verdict"] == "REGRESSED"
+                   for c in verdict["checks"])
+
+    def test_recompiles_gate_is_absolute(self, ledger, tmp_path):
+        doc = json.load(open(os.path.join(REPO, "SERVE_r01.json")))
+        doc["recompiles_after_warmup"] = 3
+        bad = tmp_path / "recompiling.json"
+        bad.write_text(json.dumps(doc))
+        cand, _ = perf_ledger.normalize(str(bad))
+        verdict = perf_gate.gate(cand, perf_ledger.load_ledger(ledger))
+        assert any(c["metric"] == "recompiles_after_warmup"
+                   and c["verdict"] == "REGRESSED"
+                   for c in verdict["checks"])
+
+    def test_incomparable_context_means_no_baseline(self, ledger,
+                                                    tmp_path):
+        doc = json.load(open(os.path.join(REPO, "SERVE_r01.json")))
+        doc["docs"] = 999_999          # different corpus size
+        other = tmp_path / "other_shape.json"
+        other.write_text(json.dumps(doc))
+        cand, _ = perf_ledger.normalize(str(other))
+        verdict = perf_gate.gate(cand, perf_ledger.load_ledger(ledger))
+        assert verdict["baseline_runs"] == 0
+        assert all(c["verdict"] == "skipped"
+                   for c in verdict["checks"])
+
+    def test_noise_widens_tolerance(self):
+        # Three noisy baseline runs: the spread-derived tolerance must
+        # beat the base 30%, so a value inside the band passes.
+        runs = []
+        for i, qps in enumerate((1000.0, 2000.0, 3000.0)):
+            runs.append({"schema": 1, "kind": "serve_bench",
+                         "source": f"r{i}.json", "captured_at": "x",
+                         "context": {"backend": "cpu", "docs": 1,
+                                     "k": 1, "max_batch": 1},
+                         "metrics": {"throughput_qps": qps}})
+        cand = dict(runs[0], metrics={"throughput_qps": 1000.0})
+        verdict = perf_gate.gate(cand, runs)
+        check = next(c for c in verdict["checks"]
+                     if c["metric"] == "throughput_qps")
+        # median 2000, spread (3000-1000)/2/2000 = 0.5 -> tol 0.75:
+        # the 50% drop to 1000 stays inside the observed noise band.
+        assert check["tolerance"] == 0.75
+        assert verdict["ok"]
+
+    def test_cli_roundtrip(self, tmp_path):
+        """The two tools as a pipeline, the way CI runs them — pure
+        stdlib subprocesses, no jax import."""
+        ledger = str(tmp_path / "L.jsonl")
+        env = dict(os.environ)
+        rc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "perf_ledger.py"),
+             "--backfill", "--ledger", ledger],
+            capture_output=True, text=True, timeout=120, env=env,
+            cwd=REPO)
+        assert rc.returncode == 0, rc.stderr
+        ok = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "perf_gate.py"),
+             os.path.join(REPO, "SERVE_r01.json"), "--ledger", ledger,
+             "--json"],
+            capture_output=True, text=True, timeout=120, env=env,
+            cwd=REPO)
+        assert ok.returncode == 0, ok.stdout + ok.stderr
+        assert json.loads(ok.stdout)["ok"] is True
+        doc = json.load(open(os.path.join(REPO, "SERVE_r01.json")))
+        doc["latency_ms"]["p99"] *= 2
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps(doc))
+        fail = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "perf_gate.py"),
+             str(bad), "--ledger", ledger],
+            capture_output=True, text=True, timeout=120, env=env,
+            cwd=REPO)
+        assert fail.returncode == 1
+        assert "REGRESSED" in fail.stdout
+
+
+@pytest.mark.slow
+class TestQuickBenchGateSmoke:
+    """End-to-end CPU smoke: run a tiny serve_bench, append its
+    artifact to a fresh ledger, and gate a re-run of the same artifact
+    — the tier-1-runnable form of the ledger/gate workflow."""
+
+    def test_serve_bench_feeds_ledger_and_gate(self, tmp_path):
+        out = tmp_path / "SERVE_smoke.json"
+        ledger = str(tmp_path / "L.jsonl")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "serve_bench.py"),
+             "--requests", "48", "--docs", "128", "--doc-len", "32",
+             "--out", str(out)],
+            capture_output=True, text=True, timeout=540, env=env,
+            cwd=REPO)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        appended, _ = perf_ledger.append([str(out)], ledger,
+                                         quiet=True)
+        assert appended == 1
+        gate_rc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "perf_gate.py"),
+             str(out), "--ledger", ledger, "--require-baseline"],
+            capture_output=True, text=True, timeout=120, env=env,
+            cwd=REPO)
+        assert gate_rc.returncode == 0, gate_rc.stdout + gate_rc.stderr

@@ -441,6 +441,59 @@ class TestTfidfServer:
         assert lat["count"] == 1 and lat["p99"] >= lat["p50"] > 0
         assert 0 < snap["batch"]["mean_occupancy"] <= 1
 
+    def test_snapshot_superset_of_pr4_pinned_schema(self, retriever):
+        """Satellite (ISSUE 6): the serve metrics snapshot keys must
+        stay a SUPERSET of the round-9 documented schema — the perf
+        ledger normalizes by these exact paths, so a silent field
+        rename would corrupt the trajectory record. Growing the
+        snapshot is fine; renaming/removing is the regression."""
+        PR4_SCHEMA = {
+            "requests": None, "queries": None,
+            "shed": {"overload", "deadline", "rate"},
+            "cache": {"hits", "misses", "hit_rate"},
+            "batch": {"count", "mean_occupancy"},
+            "queue": {"depth", "peak"},
+            "latency_s": {"count", "mean", "min", "max",
+                          "p50", "p95", "p99"},
+        }
+        srv = TfidfServer(retriever, quick_cfg())
+        try:
+            srv.search(QUERIES[:2], k=3)
+            snap = srv.metrics_snapshot()
+        finally:
+            srv.close()
+        for key, inner in PR4_SCHEMA.items():
+            assert key in snap, f"pinned key {key!r} disappeared"
+            if inner is not None:
+                assert inner <= snap[key].keys(), (
+                    f"pinned inner keys of {key!r} shrank: "
+                    f"{inner - snap[key].keys()}")
+
+    def test_snapshot_is_self_describing(self, retriever):
+        """Satellite (ISSUE 6): uptime_s / epoch / build fingerprint
+        ride every snapshot, so a ledgered artifact says what it
+        measured."""
+        twin = TfidfRetriever(CFG).index(CORPUS)
+        srv = TfidfServer(retriever, quick_cfg())
+        try:
+            srv.search(QUERIES[:1], k=2)
+            snap = srv.metrics_snapshot()
+            assert snap["uptime_s"] >= 0
+            assert snap["epoch"] == 0
+            fp = snap["fingerprint"]
+            assert set(fp) == {"config_sha", "backend", "num_docs",
+                               "vocab_size"}
+            assert len(fp["config_sha"]) == 12
+            assert fp["num_docs"] == 5 and fp["vocab_size"] == 512
+            # Stable across snapshots, bumps with a swap.
+            assert srv.metrics_snapshot()["fingerprint"] == fp
+            srv.swap_index(twin)
+            snap2 = srv.metrics_snapshot()
+            assert snap2["epoch"] == 1
+            json.dumps(snap2)  # still artifact-serializable
+        finally:
+            srv.close()
+
     def test_empty_request_resolves_immediately(self, retriever):
         srv = TfidfServer(retriever, quick_cfg())
         try:
@@ -580,6 +633,44 @@ class TestServeCli:
         assert swap == {"id": 1, "swapped": True, "epoch": 1}
         hit = next(r for r in resp if r.get("id") == 2)
         assert hit["results"][0][0][0] == "doc1"
+
+    def test_healthz_readyz_canary_ops(self, distinct_corpus_dir,
+                                       monkeypatch, capsys):
+        rc, resp = self._run(
+            [json.dumps({"id": 1, "queries": ["apple"], "k": 2}),
+             json.dumps({"id": 2, "op": "healthz"}),
+             json.dumps({"id": 3, "op": "readyz"}),
+             json.dumps({"id": 4, "op": "canary"}),
+             json.dumps({"id": 5, "op": "metrics"}),
+             json.dumps({"op": "shutdown"})],
+            ["serve", "--input", distinct_corpus_dir,
+             "--vocab-size", "512", "--max-wait-ms", "1"],
+            monkeypatch, capsys)
+        assert rc == 0
+        by_id = {r.get("id"): r for r in resp}
+        hz = by_id[2]["healthz"]
+        assert hz["status"] == "ok"
+        assert hz["admission_bound"] == hz["queue_depth"]
+        assert "batcher" in hz["checks"]["workers"]
+        rz = by_id[3]["readyz"]
+        assert rz["ready"] is True and rz["epoch"] == 0
+        # The CLI's default canary (pinned doc-prefix queries) probes
+        # on demand and reports full parity on the healthy index.
+        assert by_id[4]["canary"] == {"parity": 1.0}
+        metrics = by_id[5]["metrics"]
+        assert {"uptime_s", "epoch", "fingerprint"} <= metrics.keys()
+
+    def test_canary_op_reports_disabled(self, distinct_corpus_dir,
+                                        monkeypatch, capsys):
+        rc, resp = self._run(
+            [json.dumps({"id": 1, "op": "canary"}),
+             json.dumps({"op": "shutdown"})],
+            ["serve", "--input", distinct_corpus_dir,
+             "--vocab-size", "512", "--max-wait-ms", "1",
+             "--canary-period-ms", "0"],
+            monkeypatch, capsys)
+        assert rc == 0
+        assert "disabled" in resp[0]["error"]
 
     def test_query_subcommand_takes_compile_cache(self, distinct_corpus_dir,
                                                   tmp_path, capsys):

@@ -51,11 +51,22 @@ protocol (one JSON object per line):
       -> {"id": 1, "results": [[["doc3", 0.81], ...]]}
   {"id": 2, "queries": [...], "deadline_ms": 50}
       -> {"id": 2, "error": "deadline_exceeded"} when shed
-  {"op": "metrics"}            -> {"metrics": {...}}  (SLO snapshot)
+  {"op": "metrics"}            -> {"metrics": {...}}  (SLO snapshot +
+      uptime_s / epoch / build fingerprint — self-describing for the
+      perf ledger, tools/perf_ledger.py)
   {"op": "metrics_prom"}       -> {"metrics_prom": "..."}  (Prometheus
       text exposition incl. request-latency histogram buckets)
+  {"op": "healthz"}            -> {"healthz": {"status": "ok" |
+      "degraded" | "unhealthy", "reasons": [...], "checks": {...},
+      "admission_bound": N}}  (one watchdog evaluation; the bound
+      shrinks below queue_depth while degraded)
+  {"op": "readyz"}             -> {"readyz": {"ready": true, ...}}
+  {"op": "canary"}             -> {"canary": {"parity": 1.0}}  (one
+      parity probe vs the swap-time oracle; "skipped": true when shed
+      under load or raced by a swap)
   {"op": "swap_index", "input": DIR}
-      -> {"swapped": true, "epoch": N}  (hot re-index, no downtime)
+      -> {"swapped": true, "epoch": N}  (hot re-index, no downtime;
+      the canary oracle re-captures inside the swap)
   {"op": "shutdown"}           -> drains in-flight work and exits
 overload responses carry {"error": "overloaded"}; back off and retry.
 """
@@ -269,6 +280,32 @@ def _build_parser() -> argparse.ArgumentParser:
                     help="default per-request deadline; requests still "
                          "queued past it shed with 'deadline_exceeded' "
                          "(default: no deadline)")
+    sv.add_argument("--health-period-ms", type=float, default=250.0,
+                    help="watchdog cadence: every period the server "
+                         "re-derives ok|degraded|unhealthy from worker "
+                         "heartbeats, queue saturation and shed rates "
+                         "(healthz/readyz ops; degraded shrinks the "
+                         "admission bound). 0 disables the background "
+                         "thread (default 250; env "
+                         "TFIDF_TPU_HEALTH_PERIOD_MS)")
+    sv.add_argument("--canary-period-ms", type=float, default=5000.0,
+                    help="canary parity-probe cadence: replay pinned "
+                         "golden queries through the batched path and "
+                         "bit-compare against the swap-time oracle "
+                         "(serve_canary_parity gauge — the live index-"
+                         "corruption detector). 0 disables (default "
+                         "5000)")
+    sv.add_argument("--canary-queries", type=int, default=8,
+                    help="pinned golden queries drawn from the corpus "
+                         "(first tokens of the first N docs)")
+    sv.add_argument("--flight", metavar="OUT.jsonl", default=None,
+                    help="flight-recorder dump path: the structured "
+                         "event ring + last-N request digests write "
+                         "here atomically on shutdown, crash or "
+                         "SIGTERM (also env TFIDF_TPU_FLIGHT; with "
+                         "--trace and no --flight the dump lands next "
+                         "to the trace as <trace>.flight.jsonl). "
+                         "Validate with tools/trace_check.py --flight")
     sv.add_argument("--port", type=int, default=None,
                     help="serve JSONL over TCP on this port instead of "
                          "stdin/stdout (one request per line, "
@@ -691,7 +728,8 @@ def _run_query(args) -> int:
     return 0
 
 
-def _serve_handle_line(server, line, write, default_k, build_retriever):
+def _serve_handle_line(server, line, write, default_k, build_retriever,
+                       canary=None):
     """One JSONL request -> one JSON response line (written via
     ``write``, possibly from a batcher callback thread). Returns False
     when the line asked for shutdown."""
@@ -718,6 +756,23 @@ def _serve_handle_line(server, line, write, default_k, build_retriever):
     if op == "metrics_prom":
         write({"id": req.get("id"),
                "metrics_prom": server.metrics_prom()})
+        return True
+    if op == "healthz":
+        write({"id": req.get("id"), "healthz": server.healthz()})
+        return True
+    if op == "readyz":
+        write({"id": req.get("id"), "readyz": server.readyz()})
+        return True
+    if op == "canary":
+        if canary is None:
+            write({"id": req.get("id"),
+                   "error": "canary prober disabled "
+                            "(--canary-period-ms 0)"})
+        else:
+            parity = canary.probe()
+            write({"id": req.get("id"), "canary": (
+                {"skipped": True} if parity is None
+                else {"parity": parity})})
         return True
     if op == "swap_index":
         try:
@@ -790,37 +845,100 @@ def _run_serve(args) -> int:
     serve_cfg = ServeConfig.from_env(
         max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
         queue_depth=args.queue_depth, cache_entries=args.cache_entries,
-        default_deadline_ms=args.deadline_ms)
+        default_deadline_ms=args.deadline_ms,
+        health_period_ms=args.health_period_ms)
     server = TfidfServer(build_retriever(args.input), serve_cfg)
+    # The serve process's monitor is THE process monitor: reindex
+    # pack/drain workers (swap_index) heartbeat into the same health
+    # view as the batcher (obs/health.py module hook).
+    from tfidf_tpu.obs import health as obs_health
+    obs_health.set_monitor(server.health)
+    canary = None
+    if args.canary_period_ms and args.canary_period_ms > 0:
+        from tfidf_tpu.serve import CanaryProber, pinned_queries_from_dir
+        pinned = pinned_queries_from_dir(args.input,
+                                         n=args.canary_queries,
+                                         strict=not args.no_strict)
+        if pinned:
+            canary = CanaryProber(
+                server, pinned, k=args.k,
+                period_s=args.canary_period_ms / 1e3).start()
     sys.stderr.write(f"serving {server.num_docs} docs "
                      f"(max_batch={serve_cfg.max_batch}, "
                      f"max_wait_ms={serve_cfg.max_wait_ms}, "
                      f"queue_depth={serve_cfg.queue_depth}, "
-                     f"cache_entries={serve_cfg.cache_entries})\n")
+                     f"cache_entries={serve_cfg.cache_entries}, "
+                     f"health_period_ms={serve_cfg.health_period_ms}, "
+                     f"canary={'on' if canary else 'off'})\n")
 
-    if args.port is not None:
-        return _serve_tcp(server, args, build_retriever)
-    # Responses may be written from batcher callback threads while the
-    # main thread blocks on the next stdin line — one lock keeps the
-    # JSONL stream line-atomic.
-    wlock = threading.Lock()
+    prev_term = _install_sigterm_dump()
+    try:
+        if args.port is not None:
+            return _serve_tcp(server, args, build_retriever, canary)
+        # Responses may be written from batcher callback threads while
+        # the main thread blocks on the next stdin line — one lock
+        # keeps the JSONL stream line-atomic.
+        wlock = threading.Lock()
 
-    def write(obj) -> None:
-        with wlock:
-            sys.stdout.write(json.dumps(obj) + "\n")
-            sys.stdout.flush()
+        def write(obj) -> None:
+            with wlock:
+                sys.stdout.write(json.dumps(obj) + "\n")
+                sys.stdout.flush()
+
+        try:
+            for line in sys.stdin:
+                if not _serve_handle_line(server, line, write, args.k,
+                                          build_retriever, canary):
+                    break
+        finally:
+            if canary is not None:
+                canary.close()
+            server.close(drain=True)
+        return 0
+    finally:
+        _restore_sigterm(prev_term)
+        obs_health.set_monitor(None)
+
+
+def _install_sigterm_dump():
+    """SIGTERM must leave evidence: dump the flight recorder and the
+    trace (atomic writes), then exit 143 — the crash-consistent
+    shutdown the ISSUE's incident story needs. Returns the previous
+    handler (restored by the caller — in-process test runs must not
+    leak a handler into the host process). No-op off the main thread
+    or on platforms without signals."""
+    import signal
+    import threading as _threading
+
+    if _threading.current_thread() is not _threading.main_thread():
+        return None
+
+    def _on_term(signum, frame):
+        from tfidf_tpu import obs
+        obs.get_log().warning("sigterm",
+                              msg="SIGTERM: dumping flight recorder "
+                                  "and trace")
+        obs.dump_flight()
+        obs.export()
+        os._exit(143)
 
     try:
-        for line in sys.stdin:
-            if not _serve_handle_line(server, line, write, args.k,
-                                      build_retriever):
-                break
-    finally:
-        server.close(drain=True)
-    return 0
+        return signal.signal(signal.SIGTERM, _on_term)
+    except (ValueError, OSError):  # non-main interpreter contexts
+        return None
 
 
-def _serve_tcp(server, args, build_retriever) -> int:
+def _restore_sigterm(prev) -> None:
+    if prev is None:
+        return
+    import signal
+    try:
+        signal.signal(signal.SIGTERM, prev)
+    except (ValueError, OSError):
+        pass
+
+
+def _serve_tcp(server, args, build_retriever, canary=None) -> int:
     """--port mode: the same JSONL protocol over TCP, one thread per
     connection (socketserver), all feeding the one shared server —
     which is the point: their queries coalesce into shared batches."""
@@ -843,7 +961,8 @@ def _serve_tcp(server, args, build_retriever) -> int:
             for raw in self.rfile:
                 if not _serve_handle_line(server, raw.decode("utf-8",
                                                              "replace"),
-                                          write, args.k, build_retriever):
+                                          write, args.k, build_retriever,
+                                          canary):
                     threading.Thread(target=srv.shutdown,
                                      daemon=True).start()
                     return
@@ -859,6 +978,8 @@ def _serve_tcp(server, args, build_retriever) -> int:
         except KeyboardInterrupt:
             pass
         finally:
+            if canary is not None:
+                canary.close()
             server.close(drain=True)
     return 0
 
@@ -870,9 +991,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     # Arm the span tracer first (--trace / TFIDF_TPU_TRACE; no-op when
     # neither is set) so every span of the run lands on one timeline,
     # and export whatever was recorded on ANY exit — a crashed run's
-    # partial trace is exactly when you want the timeline.
+    # partial trace is exactly when you want the timeline. The flight
+    # recorder (--flight / TFIDF_TPU_FLIGHT, or derived from the trace
+    # path) dumps on the same exits: trace + flight are one incident's
+    # evidence (docs/OBSERVABILITY.md).
     from tfidf_tpu import obs
     obs.configure(getattr(args, "trace", None))
+    obs.configure_flight(getattr(args, "flight", None))
     try:
         if args.cmd == "run":
             return _run_tpu(args)
@@ -888,6 +1013,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         if path:
             sys.stderr.write(f"trace written to {path} (open in "
                              f"Perfetto; check: tools/trace_check.py)\n")
+        fpath = obs.dump_flight()
+        if fpath:
+            sys.stderr.write(f"flight recorder dumped to {fpath} "
+                             f"(check: tools/trace_check.py "
+                             f"--flight)\n")
 
 
 if __name__ == "__main__":

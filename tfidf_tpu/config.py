@@ -221,6 +221,22 @@ class ServeConfig:
         ``TFIDF_TPU_CACHE_ENTRIES``.
       default_deadline_ms: per-request deadline applied when a submit
         names none; None = requests without a deadline never expire.
+      health_period_ms: background watchdog cadence — every period the
+        server's :class:`~tfidf_tpu.obs.health.HealthMonitor`
+        re-derives ``ok | degraded | unhealthy`` from worker
+        heartbeats, queue saturation and windowed shed rates, and
+        publishes the health gauges. None = no background thread (the
+        ``healthz`` op still evaluates on demand) — the library
+        default, so embedded test servers carry no timer; the serve
+        CLI arms it (default 250 ms). CLI ``--health-period-ms`` (0
+        disables) / env ``TFIDF_TPU_HEALTH_PERIOD_MS``.
+      stall_after_ms: a worker with pending work that has not
+        heartbeat for this long marks the server ``unhealthy``.
+        Env ``TFIDF_TPU_STALL_AFTER_MS``.
+      degraded_admission_factor: while degraded/unhealthy the
+        admission bound shrinks to ``queue_depth * factor`` (floor 1)
+        — backpressure that drains the backlog instead of compounding
+        it. Env ``TFIDF_TPU_DEGRADED_FACTOR``.
     """
 
     max_batch: int = 64
@@ -228,6 +244,9 @@ class ServeConfig:
     queue_depth: int = 256
     cache_entries: int = 4096
     default_deadline_ms: Optional[float] = None
+    health_period_ms: Optional[float] = None
+    stall_after_ms: float = 1000.0
+    degraded_admission_factor: float = 0.5
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -241,6 +260,15 @@ class ServeConfig:
         if (self.default_deadline_ms is not None
                 and self.default_deadline_ms < 0):
             raise ValueError("default_deadline_ms must be >= 0")
+        if (self.health_period_ms is not None
+                and self.health_period_ms <= 0):
+            raise ValueError("health_period_ms must be positive "
+                             "(None disables the watchdog thread)")
+        if self.stall_after_ms <= 0:
+            raise ValueError("stall_after_ms must be positive")
+        if not 0 < self.degraded_admission_factor <= 1:
+            raise ValueError(
+                "degraded_admission_factor must be in (0, 1]")
 
     @staticmethod
     def from_env(**overrides) -> "ServeConfig":
@@ -257,12 +285,22 @@ class ServeConfig:
                 ("max_batch", "TFIDF_TPU_MAX_BATCH", int),
                 ("max_wait_ms", "TFIDF_TPU_MAX_WAIT_MS", float),
                 ("queue_depth", "TFIDF_TPU_QUEUE_DEPTH", int),
-                ("cache_entries", "TFIDF_TPU_CACHE_ENTRIES", int)):
+                ("cache_entries", "TFIDF_TPU_CACHE_ENTRIES", int),
+                ("stall_after_ms", "TFIDF_TPU_STALL_AFTER_MS", float),
+                ("degraded_admission_factor",
+                 "TFIDF_TPU_DEGRADED_FACTOR", float)):
             val = pick(key, env, cast)
             if val is not None:
                 kw[key] = val
         if overrides.get("default_deadline_ms") is not None:
             kw["default_deadline_ms"] = overrides["default_deadline_ms"]
+        # health_period_ms: an explicit 0 means "watchdog off" (None).
+        hp = overrides.get("health_period_ms")
+        if hp is None:
+            raw = os.environ.get("TFIDF_TPU_HEALTH_PERIOD_MS")
+            hp = float(raw) if raw else None
+        if hp is not None:
+            kw["health_period_ms"] = hp if hp > 0 else None
         return ServeConfig(**kw)
 
 

@@ -57,6 +57,7 @@ from tfidf_tpu.config import (PipelineConfig, TokenizerKind, VocabMode,
                               apply_compile_cache)
 from tfidf_tpu.io import fast_tokenizer
 from tfidf_tpu.io.corpus import discover_names, pack_corpus
+from tfidf_tpu.obs.health import beat as _health_beat
 from tfidf_tpu.ops.downlink import (pack_result_words, pack_words,
                                     pair_slot_bytes, unpack_result_words,
                                     use_packed_result_wire)
@@ -553,6 +554,7 @@ class _PackAhead:
 
         def job(item=self._items[i], i=i):
             obs.name_thread("packer")
+            _health_beat("packer")  # no-op unless a monitor is armed
             t0 = time.perf_counter()
             with obs.span("pack", chunk=i):
                 out = self._fn(item)
@@ -631,6 +633,7 @@ class _DrainAhead:
 
         def job(words=words, idx=idx):
             obs.name_thread("drainer")
+            _health_beat("drainer")  # no-op unless a monitor is armed
             t0 = time.perf_counter()
             with obs.span("drain", chunk=idx):
                 out = self._unpack(np.asarray(words))

@@ -14,11 +14,19 @@ north star requires — one timeline from submit to drain:
   registry with Prometheus text exposition and JSON snapshot;
   ``ServeMetrics`` lives on one, and ``serve``'s ``metrics_prom`` op
   renders it.
+* :mod:`~tfidf_tpu.obs.log` — rate-limited structured event log +
+  flight recorder: a bounded ring of leveled events and last-N
+  request digests, dumped atomically (with the trace) on
+  crash/SIGTERM/close — every incident ships its own evidence.
+* :mod:`~tfidf_tpu.obs.health` — the consumption layer: a watchdog
+  deriving ``ok | degraded | unhealthy`` from worker heartbeats and
+  windowed SLO rates, feeding back into serve admission control.
 
 The tracer API is re-exported here (``from tfidf_tpu import obs;
-obs.span(...)``) because product code calls it on hot paths; the
-registry loads lazily to keep ``import tfidf_tpu.obs`` free of any
-further dependencies.
+obs.span(...)``) because product code calls it on hot paths, and the
+flight-recorder dump helpers ride along (stdlib-only); the registry
+and health modules load lazily to keep ``import tfidf_tpu.obs`` free
+of any further dependencies.
 
 Validation tooling: ``tools/trace_check.py`` asserts a captured
 trace's structural invariants (the overlap the bench artifacts claim);
@@ -26,6 +34,9 @@ trace's structural invariants (the overlap the bench artifacts claim);
 ``jax.profiler`` device capture. docs/OBSERVABILITY.md walks a trace.
 """
 
+from tfidf_tpu.obs.log import (EventLog, configure_flight, dump_flight,
+                               flight_path, get_log, log_event,
+                               record_digest, set_log)
 from tfidf_tpu.obs.tracer import (SpanHandle, Tracer, begin, configure,
                                   device_op_table, device_span, enabled,
                                   end, export, get_tracer, instant,
@@ -38,14 +49,20 @@ __all__ = [
     "get_tracer", "set_tracer", "span", "device_span", "begin", "end",
     "instant", "name_thread", "span_totals", "trace_path",
     "load_chrome_trace", "spans_by_thread", "device_op_table",
-    # lazy (tfidf_tpu.obs.registry):
+    "EventLog", "get_log", "set_log", "log_event", "record_digest",
+    "configure_flight", "flight_path", "dump_flight",
+    # lazy (tfidf_tpu.obs.registry / tfidf_tpu.obs.health):
     "MetricsRegistry", "Counter", "Gauge", "Histogram",
+    "HealthMonitor", "HealthThresholds", "HealthStatus",
 ]
 
 
-def __getattr__(name):  # PEP 562: registry instruments load on demand
+def __getattr__(name):  # PEP 562: heavier members load on demand
     if name in ("MetricsRegistry", "Counter", "Gauge", "Histogram",
                 "DEFAULT_BUCKETS"):
         from tfidf_tpu.obs import registry
         return getattr(registry, name)
+    if name in ("HealthMonitor", "HealthThresholds", "HealthStatus"):
+        from tfidf_tpu.obs import health
+        return getattr(health, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

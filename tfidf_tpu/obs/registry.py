@@ -82,6 +82,10 @@ class Counter:
     def snapshot_value(self):
         return self._v
 
+    def merge(self, other: "Counter") -> None:
+        """Fold another replica's count in (totals add)."""
+        self.inc(other.value)
+
     def reset(self) -> None:
         with self._lock:
             self._v = 0
@@ -138,6 +142,17 @@ class Gauge:
     def snapshot_value(self):
         return {"value": self._v, "peak": self._peak}
 
+    def merge(self, other: "Gauge") -> None:
+        """Fold another replica's gauge in: values and peaks SUM (the
+        aggregated queue depth across N replicas is the sum of theirs;
+        the summed peak is an upper bound on the true peak of the sum —
+        the per-replica peaks need not have coincided in time)."""
+        with self._lock:
+            self._v += other._v
+            self._peak += other._peak
+            if self._v > self._peak:
+                self._peak = self._v
+
     def reset(self) -> None:
         with self._lock:
             self._v = 0
@@ -148,7 +163,7 @@ class Histogram:
     """Latency distribution: a locked :class:`LatencyHistogram` plus a
     fixed ``le`` ladder for Prometheus exposition."""
 
-    __slots__ = ("name", "help", "_h", "_lock", "buckets")
+    __slots__ = ("name", "help", "_h", "_lock", "buckets", "_geometry")
 
     def __init__(self, name: str, help: str = "",
                  buckets=DEFAULT_BUCKETS, lo: float = 1e-6,
@@ -156,6 +171,8 @@ class Histogram:
         self.name = name
         self.help = help
         self.buckets = tuple(sorted(buckets))
+        # Kept so a registry merge can create a compatible twin.
+        self._geometry = {"lo": lo, "hi": hi, "resolution": resolution}
         self._h = LatencyHistogram(lo=lo, hi=hi, resolution=resolution)
         self._lock = threading.Lock()
 
@@ -188,6 +205,13 @@ class Histogram:
     def snapshot_value(self):
         with self._lock:
             return self._h.as_dict()
+
+    def merge(self, other: "Histogram") -> None:
+        """Fold another replica's distribution in
+        (:meth:`LatencyHistogram.merge` — identical geometry required,
+        bucket counts add, count/sum/min/max exact)."""
+        with self._lock, other._lock:
+            self._h.merge(other._h)
 
     def reset(self) -> None:
         with self._lock:
@@ -246,6 +270,27 @@ class MetricsRegistry:
             if reset_peaks and isinstance(inst, Gauge):
                 inst.reset_peak()
         return out
+
+    def merge(self, other: "MetricsRegistry") -> "MetricsRegistry":
+        """Fold another registry's instruments into this one by name —
+        the per-replica aggregation ROADMAP item 5 needs (one front
+        merging N worker registries into a fleet view) and what lets
+        the perf gate pool multi-run samples. Counters add, gauges sum
+        values and peaks, histograms merge bucket-wise; instruments
+        missing here are created as same-kind twins first. A name
+        registered as a DIFFERENT kind on the two sides raises (same
+        contract as get-or-create). Returns ``self``."""
+        with other._lock:
+            items = list(other._instruments.items())
+        for name, inst in items:
+            if isinstance(inst, Counter):
+                self.counter(name, inst.help).merge(inst)
+            elif isinstance(inst, Gauge):
+                self.gauge(name, inst.help).merge(inst)
+            elif isinstance(inst, Histogram):
+                self.histogram(name, inst.help, inst.buckets,
+                               **inst._geometry).merge(inst)
+        return self
 
     def render_prom(self) -> str:
         """Prometheus text exposition format 0.0.4 of every

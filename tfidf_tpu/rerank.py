@@ -36,6 +36,7 @@ import numpy as np
 
 from tfidf_tpu.config import PipelineConfig, TokenizerKind
 from tfidf_tpu.io import fast_tokenizer
+from tfidf_tpu.obs import log as obs_log
 from tfidf_tpu.ops.hashing import words_to_ids
 from tfidf_tpu.ops.tokenize import whitespace_tokenize
 
@@ -193,8 +194,6 @@ def exact_terms(input_dir: str, cfg: PipelineConfig, k: int, *,
     Returns ``(per_doc, engine)`` where engine is "device-exact" or
     "hashed-rerank".
     """
-    import sys
-
     from tfidf_tpu.io import fast_tokenizer as ft
 
     # The truncation the ingest applies (ingest length rule) — the
@@ -214,12 +213,16 @@ def exact_terms(input_dir: str, cfg: PipelineConfig, k: int, *,
                                          chunk_docs=chunk_docs,
                                          doc_len=doc_len, strict=strict)
         except (ft.ExactVocabOverflow, ValueError) as e:
-            sys.stderr.write(f"exact-terms: device-exact path "
-                             f"unavailable ({e}); using hashed re-rank "
-                             f"engine\n")
+            obs_log.log_event(
+                "info", "exact_engine_fallback",
+                msg=f"exact-terms: device-exact path unavailable "
+                    f"({e}); using hashed re-rank engine",
+                error=str(e))
     else:
-        sys.stderr.write("exact-terms: native intern table not built; "
-                         "using hashed re-rank engine\n")
+        obs_log.log_event(
+            "info", "exact_engine_fallback",
+            msg="exact-terms: native intern table not built; using "
+                "hashed re-rank engine", error="no-intern")
     if exact is not None:
         return (exact_topk_from_wire(exact, k, input_dir, cfg,
                                      max_tokens=length),
@@ -265,8 +268,6 @@ def exact_terms_lines(input_dir: str, cfg: PipelineConfig, k: int, *,
     lazily builds the per-doc ``[(word, score), ...]`` lists for a doc
     subset (recall measurement) without paying the full-corpus dict.
     """
-    import sys
-
     from tfidf_tpu.io import fast_tokenizer as ft
 
     length = doc_len or cfg.max_doc_len  # the ingest truncation cap
@@ -282,9 +283,11 @@ def exact_terms_lines(input_dir: str, cfg: PipelineConfig, k: int, *,
                                              doc_len=doc_len,
                                              strict=strict, session=sess)
             except (ft.ExactVocabOverflow, ValueError) as e:
-                sys.stderr.write(f"exact-terms: device-exact path "
-                                 f"unavailable ({e}); using hashed "
-                                 f"re-rank engine\n")
+                obs_log.log_event(
+                    "info", "exact_engine_fallback",
+                    msg=f"exact-terms: device-exact path unavailable "
+                        f"({e}); using hashed re-rank engine",
+                    error=str(e))
                 exact = None
             if exact is not None:
                 lines, per_doc, offs, lens, scores, wblob = sess.emit(
@@ -309,8 +312,10 @@ def exact_terms_lines(input_dir: str, cfg: PipelineConfig, k: int, *,
 
                 return lines, "device-exact", sample_fn
     else:
-        sys.stderr.write("exact-terms: native intern table not built; "
-                         "using hashed re-rank engine\n")
+        obs_log.log_event(
+            "info", "exact_engine_fallback",
+            msg="exact-terms: native intern table not built; using "
+                "hashed re-rank engine", error="no-intern")
 
     per_doc_dict, engine = _exact_terms_fallback(input_dir, cfg, k,
                                                  doc_len=doc_len,
@@ -402,8 +407,8 @@ def exact_topk(input_dir: str, names: Sequence[str], topk_ids: np.ndarray,
         else:
             warn = margin_check(df, m)
         if warn is not None:
-            import sys
-            sys.stderr.write(f"warning: {warn}\n")
+            obs_log.log_event("warning", "margin_pressure",
+                              msg=f"warning: {warn}")
 
     # Padding rows (mesh/chunk pad_docs_to) carry '' names and all -1
     # topk ids — skip them everywhere, like pass 2 always did; opening

@@ -12,16 +12,27 @@ the ROADMAP's serve-heavy-traffic leg. Four parts:
   control, per-request deadlines, load shedding, hot index swap,
   graceful drain;
 * :mod:`~tfidf_tpu.serve.metrics` — latency percentiles, batch
-  occupancy, queue depth, shed/cache counters.
+  occupancy, queue depth, shed/cache counters;
+* :mod:`~tfidf_tpu.serve.canary` — background parity probes replaying
+  pinned golden queries against the swap-time oracle, the live
+  index-corruption detector (``serve_canary_parity`` gauge).
+
+The server also watches itself: every :class:`TfidfServer` carries a
+:class:`~tfidf_tpu.obs.health.HealthMonitor` deriving
+``ok | degraded | unhealthy`` from worker heartbeats, queue
+saturation and windowed shed rates (``healthz``/``readyz`` ops), with
+``degraded`` shrinking the admission bound.
 
 Entry points: the ``tfidf serve`` CLI subcommand (JSONL loop) and
 ``tools/serve_bench.py`` (load generator + ``SERVE_r0x.json``
-artifact). docs/SERVING.md has the architecture notes.
+artifact). docs/SERVING.md has the architecture notes;
+docs/OBSERVABILITY.md the health/canary/flight-recorder story.
 """
 
 from tfidf_tpu.serve.batcher import (DeadlineExceeded, MicroBatcher,
                                      Overloaded, ServeError)
 from tfidf_tpu.serve.cache import ResultCache, normalize_query
+from tfidf_tpu.serve.canary import CanaryProber, pinned_queries_from_dir
 from tfidf_tpu.serve.metrics import ServeMetrics
 from tfidf_tpu.serve.server import TfidfServer
 
@@ -30,8 +41,10 @@ __all__ = [
     "MicroBatcher",
     "ResultCache",
     "ServeMetrics",
+    "CanaryProber",
     "ServeError",
     "Overloaded",
     "DeadlineExceeded",
     "normalize_query",
+    "pinned_queries_from_dir",
 ]

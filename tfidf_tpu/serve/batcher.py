@@ -87,10 +87,15 @@ class MicroBatcher:
       max_wait_ms: oldest-request wait bound before a partial flush.
       metrics: optional :class:`~tfidf_tpu.serve.metrics.ServeMetrics`
         for batch-occupancy and deadline-shed counters.
+      heartbeat: optional zero-arg liveness callback the worker thread
+        invokes every loop wake and around every batch — the
+        :class:`~tfidf_tpu.obs.health.HealthMonitor` stall signal (a
+        busy batcher that stops beating is a wedged pipeline).
     """
 
     def __init__(self, search_fn: Callable, *, max_batch: int = 64,
                  max_wait_ms: float = 2.0, metrics=None,
+                 heartbeat: Optional[Callable[[], None]] = None,
                  thread_name: str = "tfidf-serve-batcher") -> None:
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
@@ -100,6 +105,7 @@ class MicroBatcher:
         self.max_batch = max_batch
         self.max_wait = max_wait_ms / 1e3
         self._metrics = metrics
+        self._heartbeat = heartbeat
         self._batch_seq = 0   # trace batch-id; worker thread only
         self._queue: Deque[_Pending] = deque()
         self._cond = threading.Condition()
@@ -135,6 +141,8 @@ class MicroBatcher:
         pop it. Returns None only at close time with an empty queue."""
         with self._cond:
             while True:
+                if self._heartbeat is not None:
+                    self._heartbeat()
                 if not self._queue:
                     if self._closed:
                         return None
@@ -173,10 +181,14 @@ class MicroBatcher:
 
     def _run(self) -> None:
         while True:
+            if self._heartbeat is not None:
+                self._heartbeat()
             batch = self._take_batch()
             if batch is None:
                 return
             self._execute(batch)
+            if self._heartbeat is not None:
+                self._heartbeat()
 
     def _execute(self, batch: List[_Pending]) -> None:
         obs.name_thread("batcher")

@@ -30,16 +30,21 @@ Guarantees:
 
 from __future__ import annotations
 
+import dataclasses
+import hashlib
+import json
 import threading
 import time
 from concurrent.futures import Future
-from typing import Optional, Sequence, Tuple, Union
+from typing import Callable, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
 from tfidf_tpu import obs
 from tfidf_tpu.config import ServeConfig
 from tfidf_tpu.models.retrieval import TfidfRetriever
+from tfidf_tpu.obs import log as obs_log
+from tfidf_tpu.obs.health import HealthMonitor, HealthThresholds
 from tfidf_tpu.serve.batcher import (DeadlineExceeded, MicroBatcher,
                                      Overloaded, ServeError)
 from tfidf_tpu.serve.cache import ResultCache, normalize_query
@@ -72,10 +77,33 @@ class TfidfServer:
         self._lock = threading.Lock()   # epoch/retriever swap + admission
         self._inflight = 0              # admitted, unresolved queries
         self._closed = False
+        self._t0 = time.monotonic()     # uptime_s anchor
+        self._swap_listeners: List[Callable] = []
         self._cache = ResultCache(self.config.cache_entries)
+        # The health watchdog: batcher liveness + queue saturation +
+        # windowed shed rates -> ok|degraded|unhealthy, with degraded
+        # feeding back into admission (docstring of obs/health.py).
+        # Always constructed (healthz/readyz evaluate on demand); the
+        # background thread only runs when config.health_period_ms is
+        # set (the serve CLI's default — library embedders opt in).
+        self.health = HealthMonitor(
+            snapshot_fn=self.metrics.snapshot,
+            queue_bound=self.config.queue_depth,
+            thresholds=HealthThresholds(
+                stall_after_s=self.config.stall_after_ms / 1e3,
+                degraded_admission_factor=(
+                    self.config.degraded_admission_factor)),
+            period_s=(self.config.health_period_ms / 1e3
+                      if self.config.health_period_ms else 0.25),
+            registry=self.metrics.registry)
         self._batcher = MicroBatcher(
             self._run_batch, max_batch=self.config.max_batch,
-            max_wait_ms=self.config.max_wait_ms, metrics=self.metrics)
+            max_wait_ms=self.config.max_wait_ms, metrics=self.metrics,
+            heartbeat=lambda: self.health.heartbeat("batcher"))
+        self.health.register(
+            "batcher", busy_fn=lambda: self._batcher.queued_queries() > 0)
+        if self.config.health_period_ms is not None:
+            self.health.start()
 
     # --- the batch kernel the batcher drives ---
     def _run_batch(self, queries, k, group):
@@ -95,12 +123,16 @@ class TfidfServer:
         return self._retriever.names
 
     def submit(self, queries: Sequence[Union[str, bytes]], k: int = 10,
-               deadline_ms: Optional[float] = None) -> Future:
+               deadline_ms: Optional[float] = None, *,
+               use_cache: bool = True) -> Future:
         """Admit one request; returns a Future resolving to ``(vals,
         ids)`` — the exact arrays a direct ``retriever.search(queries,
         k)`` returns. Raises :class:`Overloaded` when the admission
         queue is full; the Future fails with
-        :class:`DeadlineExceeded` when the deadline expires first."""
+        :class:`DeadlineExceeded` when the deadline expires first.
+        ``use_cache=False`` bypasses the result cache on both probe
+        and fill — the canary prober's lever: its parity check must
+        exercise the device path, not a memoized row."""
         t0 = time.monotonic()
         queries = list(queries)
         n = len(queries)
@@ -113,16 +145,23 @@ class TfidfServer:
         if deadline_ms is None:
             deadline_ms = self.config.default_deadline_ms
         deadline = None if deadline_ms is None else t0 + deadline_ms / 1e3
+        # The EFFECTIVE admission bound: the configured queue_depth
+        # while healthy, shrunk while the watchdog says degraded /
+        # unhealthy — shedding earlier at the gate is how a degraded
+        # server drains its backlog instead of compounding it.
+        bound = self.health.admission_bound(self.config.queue_depth)
         with self._lock:
             if self._closed:
                 obs.end(req, outcome="rejected")
                 raise ServeError("server is closed")
-            if self._inflight + n > self.config.queue_depth:
+            if self._inflight + n > bound:
                 self.metrics.count("shed_overload")
                 obs.end(req, outcome="shed_overload")
+                self._digest(t0, n, k, "shed_overload")
                 raise Overloaded(
                     f"{self._inflight} queries in flight + {n} exceeds "
-                    f"queue_depth={self.config.queue_depth}")
+                    f"admission bound {bound} (configured queue_depth="
+                    f"{self.config.queue_depth})")
             self._inflight += n
             self.metrics.set_queue_depth(self._inflight)
             retriever, epoch = self._retriever, self._epoch
@@ -137,12 +176,15 @@ class TfidfServer:
             obs.end(req, outcome="empty")
             return out
 
-        keys = [self._cache.key(normalize_query(q, cfg), k, epoch)
-                for q in queries]
-        rows = [self._cache.get(key) for key in keys]
-        hits = sum(r is not None for r in rows)
-        self.metrics.count("cache_hits", hits)
-        self.metrics.count("cache_misses", n - hits)
+        if use_cache:
+            keys = [self._cache.key(normalize_query(q, cfg), k, epoch)
+                    for q in queries]
+            rows = [self._cache.get(key) for key in keys]
+            hits = sum(r is not None for r in rows)
+            self.metrics.count("cache_hits", hits)
+            self.metrics.count("cache_misses", n - hits)
+        else:  # canary probes neither read nor skew the cache
+            keys, rows, hits = [], [None] * n, 0
         miss_pos = [i for i, r in enumerate(rows) if r is None]
 
         def resolve(vals: np.ndarray, ids: np.ndarray,
@@ -150,6 +192,8 @@ class TfidfServer:
             self._finish(n)
             self.metrics.observe_request(time.monotonic() - t0, n)
             obs.end(req, outcome=outcome, cache_hits=hits)
+            self._digest(t0, n, k, outcome, epoch=epoch,
+                         cache_hits=hits)
             out.set_result((vals, ids))
 
         if not miss_pos:
@@ -165,15 +209,20 @@ class TfidfServer:
             err = f.exception()
             if err is not None:
                 self._finish(n)
-                obs.end(req, outcome=(
+                outcome = (
                     "shed_deadline" if isinstance(err, DeadlineExceeded)
                     else "shed_overload" if isinstance(err, Overloaded)
-                    else "error"))
+                    else "error")
+                obs.end(req, outcome=outcome)
+                self._digest(t0, n, k, outcome, epoch=epoch,
+                             error=(None if outcome != "error"
+                                    else repr(err)))
                 out.set_exception(err)
                 return
             mvals, mids = f.result()
-            for j, i in enumerate(miss_pos):
-                self._cache.put(keys[i], mvals[j], mids[j])
+            if use_cache:
+                for j, i in enumerate(miss_pos):
+                    self._cache.put(keys[i], mvals[j], mids[j])
             if len(miss_pos) == n:
                 resolve(mvals, mids, "drained")
                 return
@@ -199,7 +248,10 @@ class TfidfServer:
         """Hot-swap the serving index: new submissions score against
         ``retriever`` immediately, in-flight requests finish on the
         index they were admitted under, and the result cache is
-        invalidated (epoch bump + clear). Returns the new epoch."""
+        invalidated (epoch bump + clear). Swap listeners (the canary
+        prober's oracle re-capture) run synchronously BEFORE the epoch
+        returns, so the swap is observable the instant it is live.
+        Returns the new epoch."""
         if not retriever.indexed:
             raise ValueError("swap_index needs an indexed retriever")
         with self._lock:
@@ -209,10 +261,87 @@ class TfidfServer:
             self._epoch += 1
             epoch = self._epoch
         self._cache.clear()
+        obs_log.log_event("info", "index_swap",
+                          msg=f"index swapped to epoch {epoch} "
+                              f"({retriever._num_docs} docs)",
+                          epoch=epoch, docs=retriever._num_docs)
+        for listener in list(self._swap_listeners):
+            listener(epoch, retriever)
         return epoch
 
+    def add_swap_listener(self, fn: Callable) -> None:
+        """Register ``fn(epoch, retriever)`` to run synchronously after
+        every :meth:`swap_index` — how the canary prober re-captures
+        its oracle at the only moment the new index is known-good."""
+        self._swap_listeners.append(fn)
+
+    def remove_swap_listener(self, fn: Callable) -> None:
+        try:
+            self._swap_listeners.remove(fn)
+        except ValueError:
+            pass
+
+    def current_index(self) -> Tuple[int, TfidfRetriever]:
+        """The (epoch, retriever) pair new submissions would score on."""
+        with self._lock:
+            return self._epoch, self._retriever
+
+    def healthz(self) -> dict:
+        """One watchdog evaluation, as the ``healthz`` op payload:
+        typed status + reasons + raw checks + the effective admission
+        bound (visibly below ``queue_depth`` while degraded)."""
+        status = self.health.evaluate()
+        out = status.as_dict()
+        out["admission_bound"] = self.health.admission_bound(
+            self.config.queue_depth)
+        out["queue_depth"] = self.config.queue_depth
+        out["uptime_s"] = round(time.monotonic() - self._t0, 3)
+        return out
+
+    def readyz(self) -> dict:
+        """Readiness: serving is possible (indexed, not closed, not
+        wedged). ``degraded`` stays ready — it still serves, just
+        sheds earlier; ``unhealthy`` (a stalled worker) does not."""
+        status = self.health.evaluate()
+        ready = (not self._closed and self._retriever.indexed
+                 and status.state != "unhealthy")
+        return {"ready": ready, "status": status.state,
+                "epoch": self._epoch}
+
+    def fingerprint(self) -> dict:
+        """Build/config identity for artifact provenance: a stable
+        hash over the pipeline + serve configs plus corpus shape and
+        backend — what makes a metrics snapshot self-describing in the
+        perf ledger (two snapshots compare only if these match)."""
+        import jax  # deferred; retriever already initialized a backend
+        cfg = self._retriever.config
+        ident = {
+            "pipeline": {k: (v.value if hasattr(v, "value") else v)
+                         for k, v in dataclasses.asdict(cfg).items()},
+            "serve": dataclasses.asdict(self.config),
+            "num_docs": self._retriever._num_docs,
+            "backend": jax.default_backend(),
+        }
+        sha = hashlib.sha256(
+            json.dumps(ident, sort_keys=True, default=str).encode()
+        ).hexdigest()[:12]
+        return {"config_sha": sha,
+                "backend": ident["backend"],
+                "num_docs": ident["num_docs"],
+                "vocab_size": cfg.vocab_size}
+
     def metrics_snapshot(self, reset_peaks: bool = False) -> dict:
-        return self.metrics.snapshot(reset_peaks=reset_peaks)
+        """The ``metrics`` op / artifact snapshot: the pinned round-9
+        ``ServeMetrics`` schema (tests assert a superset, guarding the
+        ledger against silent renames) plus the self-describing keys —
+        ``uptime_s``, current ``epoch`` and the build/config
+        ``fingerprint`` — so a snapshot dropped into BENCH_LEDGER.jsonl
+        still says what it measured."""
+        snap = self.metrics.snapshot(reset_peaks=reset_peaks)
+        snap["uptime_s"] = round(time.monotonic() - self._t0, 3)
+        snap["epoch"] = self._epoch
+        snap["fingerprint"] = self.fingerprint()
+        return snap
 
     def metrics_prom(self) -> str:
         """Prometheus text exposition of the serve metrics (request
@@ -222,12 +351,18 @@ class TfidfServer:
 
     def close(self, drain: bool = True) -> None:
         """Stop admitting; ``drain=True`` serves the queued backlog
-        before returning, ``drain=False`` fails it fast. Idempotent."""
+        before returning, ``drain=False`` fails it fast. Stops the
+        health watchdog and — when a flight path is armed (``--flight``
+        / ``TFIDF_TPU_FLIGHT``, or derived from an armed tracer) —
+        dumps the flight recorder, so a clean shutdown leaves the same
+        evidence a crash does. Idempotent."""
         with self._lock:
             if self._closed:
                 return
             self._closed = True
         self._batcher.close(drain=drain)
+        self.health.stop()
+        obs_log.dump_flight()  # no-op unless a dump path is armed
 
     @property
     def closed(self) -> bool:
@@ -244,3 +379,20 @@ class TfidfServer:
         with self._lock:
             self._inflight -= n
             self.metrics.set_queue_depth(self._inflight)
+
+    def _digest(self, t0: float, n: int, k: int, outcome: str,
+                epoch: Optional[int] = None,
+                cache_hits: Optional[int] = None,
+                error: Optional[str] = None) -> None:
+        """One request digest into the flight recorder's last-N ring —
+        sizes, outcome and latency, never query text (the dump may
+        leave the machine). Cheap enough to record unconditionally."""
+        rec = {"outcome": outcome, "queries": n, "k": k,
+               "ms": round((time.monotonic() - t0) * 1e3, 3)}
+        if epoch is not None:
+            rec["epoch"] = epoch
+        if cache_hits:
+            rec["cache_hits"] = cache_hits
+        if error:
+            rec["error"] = error
+        obs_log.record_digest(**rec)

@@ -199,6 +199,33 @@ class LatencyHistogram:
                            if up <= b))
         return out
 
+    def merge(self, other: "LatencyHistogram") -> "LatencyHistogram":
+        """Fold ``other``'s samples into this histogram in place — the
+        aggregation primitive per-replica metrics need (ROADMAP item 5:
+        N server processes each keep their own histogram; a front
+        merges them into one distribution) and what lets the perf gate
+        pool samples across runs. Exact for count/sum/min/max; bucket
+        counts add elementwise, so percentiles of the merge are as
+        accurate as either input's bucket resolution. Requires
+        identical bucket geometry (same lo/resolution/range) — merging
+        across geometries would need resampling, which silently loses
+        resolution, so it raises instead. Returns ``self``."""
+        if (self._lo != other._lo
+                or self._log_step != other._log_step
+                or len(self._counts) != len(other._counts)):
+            raise ValueError(
+                "cannot merge LatencyHistograms with different bucket "
+                f"geometry (lo {self._lo} vs {other._lo}, step "
+                f"{self._log_step:.6g} vs {other._log_step:.6g}, "
+                f"buckets {len(self._counts)} vs {len(other._counts)})")
+        for i, c in enumerate(other._counts):
+            self._counts[i] += c
+        self._count += other._count
+        self._sum += other._sum
+        self._min = min(self._min, other._min)
+        self._max = max(self._max, other._max)
+        return self
+
     def as_dict(self, ndigits: int = 6) -> Dict[str, float]:
         """JSON-artifact form: count/mean/min/max plus p50/p95/p99."""
         return {

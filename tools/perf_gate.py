@@ -1,0 +1,196 @@
+"""Perf gate: hold a fresh artifact against the ledger's baseline.
+
+``tools/perf_ledger.py`` records the trajectory; this tool CONSUMES
+it: given a fresh bench/serve artifact, find the comparable ledger
+records (same kind, same backend, same corpus size), build a rolling
+baseline (per-metric median over the last N), and fail — exit 1 —
+when any gated metric regresses past its tolerance. This is the CI
+tripwire the five BENCH rounds never had: a 2x latency regression or
+a halved throughput now fails a command instead of waiting for a
+human to eyeball two JSON files.
+
+Noise-awareness, because a tripwire that cries wolf gets deleted:
+
+* the baseline is a MEDIAN over up to ``--window`` runs, not the last
+  run — one lucky/unlucky round does not move the bar;
+* each metric has a direction (higher-is-better throughput vs
+  lower-is-better latency) and a base relative tolerance sized to its
+  observed round-to-round noise (latency percentiles on a loaded box
+  jitter far more than docs/sec medians);
+* when the window holds >= 3 samples the tolerance WIDENS to the
+  observed relative spread of the baseline itself (half the min-max
+  band, x ``--noise-mult``) if that is larger — a metric the ledger
+  shows to be noisy cannot fail the gate inside its own noise band;
+* a candidate identical to a ledger record passes by construction
+  (zero delta <= any tolerance) — re-running the gate on an unchanged
+  artifact is a no-op, the false-positive floor tests pin.
+
+Usage::
+
+    python tools/perf_gate.py FRESH.json [--ledger BENCH_LEDGER.jsonl]
+    python tools/perf_gate.py SERVE_r01.json --json   # machine verdict
+
+Exit codes: 0 = pass (or no comparable baseline — warned, unless
+``--require-baseline``), 1 = regression, 2 = unusable input.
+Stdlib-only; runnable with no jax at all.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+from typing import Dict, List, Optional
+
+import _common  # noqa: E402,F401  repo-root sys.path bootstrap
+
+import perf_ledger  # noqa: E402  (sibling tool: shared normalization)
+
+# metric -> (direction, base relative tolerance). Directions: "higher"
+# fails when the candidate drops below baseline*(1-tol); "lower" fails
+# past baseline*(1+tol). Tolerances are the measured round-to-round
+# noise bands (BENCH_r02-r05 docs/sec IQR ~8%, serve p99 on a shared
+# CPU box swings ~40%), padded to stay quiet inside normal jitter.
+_GATES = {
+    "bench": {
+        "docs_per_sec": ("higher", 0.25),
+        "vs_baseline": ("higher", 0.25),
+        "device_docs_per_sec": ("higher", 0.30),
+        "pack_s": ("lower", 0.40),
+        "link_tax_s": ("lower", 0.40),
+        "recall_at_k": ("higher", 0.02),
+    },
+    "serve_bench": {
+        "throughput_qps": ("higher", 0.30),
+        "throughput_rps": ("higher", 0.30),
+        "p50_ms": ("lower", 0.60),
+        "p99_ms": ("lower", 0.60),
+        "cache_hit_rate": ("higher", 0.10),
+        "recompiles_after_warmup": ("lower", 0.0),
+    },
+}
+# Context keys that must MATCH for two records to be comparable.
+_MATCH_KEYS = {"bench": ("backend", "n_docs"),
+               "serve_bench": ("backend", "docs", "k", "max_batch")}
+
+
+def comparable(rec: dict, cand: dict) -> bool:
+    if rec["kind"] != cand["kind"]:
+        return False
+    for key in _MATCH_KEYS[cand["kind"]]:
+        if rec["context"].get(key) != cand["context"].get(key):
+            return False
+    return True
+
+
+def gate(cand: dict, ledger: List[dict], window: int = 5,
+         noise_mult: float = 1.5) -> Dict:
+    """Compare one normalized candidate record against the ledger.
+    Returns the verdict dict (``ok``, ``baseline_runs``, ``checks``)."""
+    base_recs = [r for r in ledger if comparable(r, cand)][-window:]
+    checks = []
+    ok = True
+    for name, (direction, base_tol) in _GATES[cand["kind"]].items():
+        value = cand["metrics"].get(name)
+        samples = [r["metrics"][name] for r in base_recs
+                   if name in r["metrics"]]
+        if value is None or not samples:
+            checks.append({"metric": name, "verdict": "skipped",
+                           "reason": ("missing in candidate"
+                                      if value is None
+                                      else "missing in baseline")})
+            continue
+        baseline = statistics.median(samples)
+        tol = base_tol
+        if len(samples) >= 3 and baseline:
+            spread = (max(samples) - min(samples)) / 2 / abs(baseline)
+            tol = max(tol, noise_mult * spread)
+        if baseline == 0:
+            # Zero baselines gate absolutely (e.g. recompiles must
+            # stay 0 for lower-is-better; a zero throughput baseline
+            # could never fail anything relative).
+            regressed = (value > 0 if direction == "lower" else False)
+            delta = value
+        elif direction == "lower":
+            delta = value / baseline - 1.0
+            regressed = delta > tol
+        else:
+            delta = 1.0 - value / baseline
+            regressed = delta > tol
+        ok &= not regressed
+        checks.append({
+            "metric": name, "direction": direction,
+            "baseline": baseline, "value": value,
+            "delta": round(delta, 4), "tolerance": round(tol, 4),
+            "samples": len(samples),
+            "verdict": "REGRESSED" if regressed else "ok",
+        })
+    return {"ok": ok, "kind": cand["kind"],
+            "baseline_runs": len(base_recs),
+            "window": window, "checks": checks}
+
+
+def render(verdict: Dict, source: str) -> str:
+    lines = [f"perf_gate: {source} [{verdict['kind']}] vs "
+             f"{verdict['baseline_runs']} baseline run(s)"]
+    for c in verdict["checks"]:
+        if c["verdict"] == "skipped":
+            lines.append(f"  {c['metric']:<24} skipped "
+                         f"({c['reason']})")
+            continue
+        arrow = "v" if c["direction"] == "lower" else "^"
+        lines.append(
+            f"  {c['metric']:<24}{arrow} {c['value']:>12.4g} vs "
+            f"{c['baseline']:>12.4g} (delta {c['delta']:+.1%}, tol "
+            f"{c['tolerance']:.0%}, n={c['samples']}) {c['verdict']}")
+    lines.append("PASS" if verdict["ok"] else "FAIL: regression past "
+                 "tolerance — see REGRESSED rows")
+    return "\n".join(lines)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.split("\n")[0],
+        epilog="exit 0 = pass, 1 = regression, 2 = unusable input")
+    ap.add_argument("artifact", help="fresh bench/serve_bench JSON")
+    ap.add_argument("--ledger", default=perf_ledger.DEFAULT_LEDGER)
+    ap.add_argument("--window", type=int, default=5,
+                    help="baseline = median over the last N "
+                         "comparable ledger runs (default 5)")
+    ap.add_argument("--noise-mult", type=float, default=1.5,
+                    help="multiplier on the observed baseline spread "
+                         "when widening tolerances (>=3 samples)")
+    ap.add_argument("--require-baseline", action="store_true",
+                    help="fail (exit 1) when the ledger holds no "
+                         "comparable runs instead of warning")
+    ap.add_argument("--json", action="store_true",
+                    help="print the machine-readable verdict")
+    args = ap.parse_args()
+
+    cand, reason = perf_ledger.normalize(args.artifact)
+    if cand is None:
+        print(f"perf_gate: cannot read {args.artifact}: {reason}",
+              file=sys.stderr)
+        return 2
+    try:
+        ledger = perf_ledger.load_ledger(args.ledger)
+    except ValueError as e:
+        print(f"perf_gate: {e}", file=sys.stderr)
+        return 2
+    verdict = gate(cand, ledger, window=args.window,
+                   noise_mult=args.noise_mult)
+    if args.json:
+        print(json.dumps(verdict, sort_keys=True))
+    else:
+        print(render(verdict, cand["source"]))
+    if verdict["baseline_runs"] == 0:
+        print("perf_gate: no comparable baseline in the ledger "
+              f"({args.ledger}) — run tools/perf_ledger.py first",
+              file=sys.stderr)
+        return 1 if args.require_baseline else 0
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,245 @@
+"""Perf ledger: append bench/serve artifacts to BENCH_LEDGER.jsonl.
+
+The repo accumulates one-shot artifacts (``BENCH_r0X.json``,
+``SERVE_r0X.json``) but had no TRAJECTORY — five rounds of numbers sat
+on disk with nothing relating them, so a perf regression between
+rounds would ship unnoticed. The ledger is the append-only record:
+one normalized JSONL line per artifact, schema-versioned, deduped by
+content, with enough context (backend, corpus size, config) that
+``tools/perf_gate.py`` can decide which records are comparable and
+hold a fresh run against the rolling baseline.
+
+Record shape (``schema: 1``)::
+
+    {"schema": 1, "kind": "bench" | "serve_bench",
+     "source": "BENCH_r05.json", "captured_at": "...Z",
+     "context": {"backend": ..., "n_docs": ..., ...},
+     "metrics": {"docs_per_sec": ..., "vs_baseline": ..., ...}}
+
+``kind`` is detected from the artifact itself; wrapped driver
+artifacts (``{"n", "cmd", "rc", "tail", "parsed"}``) unwrap to their
+``parsed`` payload, so both the raw ``bench.py`` stdout JSON and the
+archived round files append identically. Artifacts that carry no
+parsed metrics (a failed run, e.g. ``BENCH_r01.json``'s rc=1 crash)
+are skipped with a note — the ledger records measurements, not stack
+traces.
+
+Usage::
+
+    python tools/perf_ledger.py ARTIFACT.json [...]
+    python tools/perf_ledger.py --backfill        # BENCH_r*/SERVE_r*
+    python tools/perf_ledger.py --list            # print the ledger
+
+Stdlib-only; runnable with no jax at all.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import glob
+import json
+import os
+import sys
+from typing import List, Optional, Tuple
+
+import _common  # noqa: E402,F401  repo-root sys.path bootstrap
+
+SCHEMA = 1
+DEFAULT_LEDGER = os.path.join(_common.REPO, "BENCH_LEDGER.jsonl")
+
+# metric name -> path into the artifact (dots descend into objects)
+_BENCH_METRICS = {
+    "docs_per_sec": "value",
+    "vs_baseline": "vs_baseline",
+    "device_docs_per_sec": "device_docs_per_sec",
+    "pack_s": "pack_s",
+    "link_tax_s": "link_tax_s",
+    "tpu_s": "tpu_s",
+    "cpu_s": "cpu_s",
+    "recall_at_k": "recall_at_k",
+}
+_SERVE_METRICS = {
+    "throughput_qps": "throughput_qps",
+    "throughput_rps": "throughput_rps",
+    "p50_ms": "latency_ms.p50",
+    "p95_ms": "latency_ms.p95",
+    "p99_ms": "latency_ms.p99",
+    "mean_occupancy": "batch.mean_occupancy",
+    "cache_hit_rate": "cache.hit_rate",
+    "shed_rate": "shed.rate",
+    "recompiles_after_warmup": "recompiles_after_warmup",
+}
+_BENCH_CONTEXT = {"backend": "backend", "n_docs": "n_docs",
+                  "engine": "engine", "ingest_path": "ingest_path",
+                  "repeats": "repeats"}
+_SERVE_CONTEXT = {"backend": "backend", "docs": "docs", "k": "k",
+                  "requests": "requests", "mode": "mode",
+                  "concurrency": "concurrency",
+                  "max_batch": "max_batch",
+                  "fingerprint": "fingerprint.config_sha"}
+
+
+def _dig(doc: dict, path: str):
+    cur = doc
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def unwrap(doc: dict) -> Optional[dict]:
+    """Driver-wrapped artifacts ({n, cmd, rc, tail, parsed}) yield
+    their parsed payload; bare artifacts pass through. None when the
+    artifact carries no measurements (failed run)."""
+    if "parsed" in doc and "cmd" in doc:
+        return doc["parsed"]  # may be None: rc != 0 rounds
+    return doc
+
+
+def classify(payload: dict) -> Optional[str]:
+    if payload.get("metric") == "serve_bench":
+        return "serve_bench"
+    if payload.get("unit") == "docs/sec" or "vs_baseline" in payload:
+        return "bench"
+    return None
+
+
+def normalize(path: str) -> Tuple[Optional[dict], Optional[str]]:
+    """Artifact file -> (ledger record, skip_reason). Exactly one of
+    the pair is None."""
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        return None, "artifact is not a JSON object"
+    payload = unwrap(doc)
+    if not isinstance(payload, dict):
+        return None, ("no parsed metrics payload (failed run, rc="
+                      f"{doc.get('rc')!r})")
+    kind = classify(payload)
+    if kind is None:
+        return None, "unrecognized artifact shape (not bench/serve)"
+    metric_paths = (_SERVE_METRICS if kind == "serve_bench"
+                    else _BENCH_METRICS)
+    ctx_paths = (_SERVE_CONTEXT if kind == "serve_bench"
+                 else _BENCH_CONTEXT)
+    metrics = {name: v for name, p in metric_paths.items()
+               if (v := _dig(payload, p)) is not None}
+    if not metrics:
+        return None, "artifact carries none of the known metrics"
+    context = {name: v for name, p in ctx_paths.items()
+               if (v := _dig(payload, p)) is not None}
+    captured = datetime.datetime.fromtimestamp(
+        os.path.getmtime(path), tz=datetime.timezone.utc)
+    return {
+        "schema": SCHEMA,
+        "kind": kind,
+        "source": os.path.basename(path),
+        "captured_at": captured.isoformat(timespec="seconds"),
+        "context": context,
+        "metrics": metrics,
+    }, None
+
+
+def load_ledger(path: str) -> List[dict]:
+    if not os.path.exists(path):
+        return []
+    records = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                raise ValueError(f"{path}:{i}: bad ledger line: {e}")
+            if rec.get("schema") != SCHEMA:
+                raise ValueError(
+                    f"{path}:{i}: ledger schema "
+                    f"{rec.get('schema')!r} != {SCHEMA} — migrate or "
+                    f"regenerate (--backfill onto a fresh file)")
+            records.append(rec)
+    return records
+
+
+def _identity(rec: dict) -> str:
+    """Content identity for dedup: same source + same numbers is the
+    same measurement, regardless of when it was appended."""
+    return json.dumps([rec["kind"], rec["source"], rec["metrics"]],
+                      sort_keys=True)
+
+
+def append(paths: List[str], ledger_path: str,
+           quiet: bool = False) -> Tuple[int, int]:
+    """Normalize + append each artifact; returns (appended, skipped).
+    Re-appending an unchanged artifact is a dedup no-op, so the
+    backfill is idempotent."""
+    existing = {_identity(r) for r in load_ledger(ledger_path)}
+    appended = skipped = 0
+    with open(ledger_path, "a") as f:
+        for path in paths:
+            rec, reason = normalize(path)
+            if rec is None:
+                skipped += 1
+                if not quiet:
+                    print(f"skip {os.path.basename(path)}: {reason}",
+                          file=sys.stderr)
+                continue
+            ident = _identity(rec)
+            if ident in existing:
+                skipped += 1
+                if not quiet:
+                    print(f"skip {rec['source']}: already in ledger",
+                          file=sys.stderr)
+                continue
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+            existing.add(ident)
+            appended += 1
+            if not quiet:
+                print(f"append {rec['source']} [{rec['kind']}] "
+                      f"{sorted(rec['metrics'])}", file=sys.stderr)
+    return appended, skipped
+
+
+def backfill_paths() -> List[str]:
+    """The repo's archived round artifacts, oldest first."""
+    return (sorted(glob.glob(os.path.join(_common.REPO, "BENCH_r*.json")))
+            + sorted(glob.glob(os.path.join(_common.REPO,
+                                            "SERVE_r*.json"))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.split("\n")[0],
+        epilog="exit 0 = appended/deduped, 2 = nothing usable")
+    ap.add_argument("artifacts", nargs="*",
+                    help="bench/serve_bench artifact JSON files")
+    ap.add_argument("--ledger", default=DEFAULT_LEDGER,
+                    help=f"ledger path (default {DEFAULT_LEDGER})")
+    ap.add_argument("--backfill", action="store_true",
+                    help="append every BENCH_r*.json / SERVE_r*.json "
+                         "in the repo root (idempotent)")
+    ap.add_argument("--list", action="store_true",
+                    help="print the ledger records and exit")
+    args = ap.parse_args()
+    if args.list:
+        for rec in load_ledger(args.ledger):
+            print(json.dumps(rec, sort_keys=True))
+        return 0
+    paths = list(args.artifacts)
+    if args.backfill:
+        paths += backfill_paths()
+    if not paths:
+        print("nothing to append (pass artifacts or --backfill)",
+              file=sys.stderr)
+        return 2
+    appended, skipped = append(paths, args.ledger)
+    print(f"{appended} appended, {skipped} skipped -> {args.ledger}",
+          file=sys.stderr)
+    return 0 if (appended or skipped) else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

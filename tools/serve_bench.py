@@ -93,12 +93,16 @@ def main() -> int:
     from tfidf_tpu.models.retrieval import _search_bcoo
     from tfidf_tpu.serve import Overloaded, ServeError, TfidfServer
 
-    print(f"backend={jax.default_backend()}", file=sys.stderr)
+    # Structured diagnostics: the stderr echo preserves the old print
+    # behavior; the events also land in the flight-recorder ring.
+    log = obs.get_log()
+    log.info("serve_bench", msg=f"backend={jax.default_backend()}")
     obs.configure(args.trace)  # no-op unless --trace/TFIDF_TPU_TRACE
     tmp = None
     if args.input is None:
         tmp = tempfile.mkdtemp(prefix="serve_bench_")
-        print(f"generating {args.docs}-doc corpus...", file=sys.stderr)
+        log.info("serve_bench",
+                 msg=f"generating {args.docs}-doc corpus...")
         input_dir = benchmod.make_corpus(tmp)
     else:
         input_dir = args.input
@@ -109,8 +113,9 @@ def main() -> int:
         t0 = time.perf_counter()
         retriever = TfidfRetriever(cfg).index_dir(input_dir, strict=False)
         index_s = time.perf_counter() - t0
-        print(f"indexed {retriever._num_docs} docs in {index_s:.2f}s",
-              file=sys.stderr)
+        log.info("serve_bench",
+                 msg=f"indexed {retriever._num_docs} docs "
+                     f"in {index_s:.2f}s")
 
         server = TfidfServer(retriever, ServeConfig(
             max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
@@ -206,14 +211,17 @@ def main() -> int:
         trace_path = obs.export()
         if trace_path:
             artifact["trace_path"] = trace_path
-            print(f"trace written to {trace_path}", file=sys.stderr)
+            log.info("serve_bench",
+                     msg=f"trace written to {trace_path}")
         with open(args.out, "w") as f:
             json.dump(artifact, f, indent=2, sort_keys=True)
             f.write("\n")
         print(json.dumps(artifact, sort_keys=True))
         if recompiles:
-            print(f"warning: {recompiles} recompiles after warmup "
-                  f"(expected 0)", file=sys.stderr)
+            log.warning("serve_bench_recompiles",
+                        msg=f"warning: {recompiles} recompiles after "
+                            f"warmup (expected 0)",
+                        recompiles=recompiles)
             return 1
         return 0
     finally:

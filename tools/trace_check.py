@@ -32,11 +32,19 @@ serve traces (auto-detected by ``request`` spans):
     request appears exactly once as drained / cache_hit / shed / ...);
   * every ``queued`` span that reached a batch carries its batch id.
 
+flight recorder (``--flight DUMP.jsonl``, round 11):
+  * line 1 is a ``tfidf-flight/1`` schema header whose ``events`` /
+    ``digests`` counts match the body exactly (an atomic dump is
+    complete or absent — a mismatch means a torn writer);
+  * every event line carries ``t``/``level``/``event`` with a known
+    level; every digest line carries ``t`` and an ``outcome``.
+
 Pure stdlib — runnable under ``JAX_PLATFORMS=cpu`` (or no jax at
 all). Exit 0 = all checks passed/vacuous, 1 = a violated invariant,
 2 = unreadable input.
 
 Usage: python tools/trace_check.py TRACE.json [--mode auto|ingest|serve]
+                                              [--flight DUMP.jsonl]
 """
 
 from __future__ import annotations
@@ -207,6 +215,65 @@ def _check_serve(by_name, notes) -> List[str]:
     return errors
 
 
+_FLIGHT_SCHEMA = "tfidf-flight/1"
+_FLIGHT_LEVELS = {"debug", "info", "warning", "error"}
+
+
+def check_flight(path: str) -> Tuple[List[str], List[str]]:
+    """Validate a flight-recorder dump (``--flight`` /
+    ``TFIDF_TPU_FLIGHT`` / ``<trace>.flight.jsonl``): header schema,
+    header counts == body counts (completeness — the atomicity
+    contract's observable half), per-line event/digest shape. Returns
+    ``(errors, notes)``."""
+    import json
+    errors: List[str] = []
+    notes: List[str] = []
+    with open(path) as f:
+        lines = [l for l in (ln.strip() for ln in f) if l]
+    if not lines:
+        return ["flight dump is empty"], notes
+    try:
+        header = json.loads(lines[0])
+    except ValueError as e:
+        return [f"flight header is not JSON: {e}"], notes
+    if header.get("schema") != _FLIGHT_SCHEMA:
+        return [f"flight schema {header.get('schema')!r} != "
+                f"{_FLIGHT_SCHEMA!r}"], notes
+    n_events = n_digests = 0
+    for i, line in enumerate(lines[1:], 2):
+        try:
+            rec = json.loads(line)
+        except ValueError as e:
+            errors.append(f"line {i}: not JSON: {e}")
+            break
+        kind = rec.get("kind")
+        if kind == "event":
+            n_events += 1
+            if not isinstance(rec.get("t"), (int, float)) \
+                    or rec.get("level") not in _FLIGHT_LEVELS \
+                    or not rec.get("event"):
+                errors.append(f"line {i}: malformed event: {rec!r}")
+                break
+        elif kind == "digest":
+            n_digests += 1
+            if not isinstance(rec.get("t"), (int, float)) \
+                    or not rec.get("outcome"):
+                errors.append(f"line {i}: malformed digest: {rec!r}")
+                break
+        else:
+            errors.append(f"line {i}: unknown kind {kind!r}")
+            break
+    if (n_events, n_digests) != (header.get("events"),
+                                 header.get("digests")):
+        errors.append(
+            f"header promises {header.get('events')} events / "
+            f"{header.get('digests')} digests, body carries "
+            f"{n_events} / {n_digests} — torn dump")
+    notes.append(f"flight: {n_events} events, {n_digests} digests, "
+                 f"suppressed={header.get('suppressed', {})}")
+    return errors, notes
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(
         description=__doc__.split("\n")[0],
@@ -219,6 +286,10 @@ def main() -> int:
                     help="fewest distinct lanes the trace must carry "
                          "(default 3: main + packer + drainer, or "
                          "main + submitters + batcher)")
+    ap.add_argument("--flight", metavar="DUMP.jsonl", default=None,
+                    help="also validate this flight-recorder dump "
+                         "(schema header, completeness, event/digest "
+                         "shape)")
     args = ap.parse_args()
     try:
         errors, notes = check_trace(args.trace, args.mode,
@@ -227,13 +298,23 @@ def main() -> int:
         print(f"trace_check: cannot read {args.trace}: {e}",
               file=sys.stderr)
         return 2
+    if args.flight:
+        try:
+            ferrors, fnotes = check_flight(args.flight)
+        except OSError as e:
+            print(f"trace_check: cannot read {args.flight}: {e}",
+                  file=sys.stderr)
+            return 2
+        errors += ferrors
+        notes += fnotes
     for n in notes:
         print(f"  {n}")
     if errors:
         for err in errors:
             print(f"FAIL: {err}", file=sys.stderr)
         return 1
-    print(f"trace_check: {args.trace} OK")
+    print(f"trace_check: {args.trace} OK"
+          + (f" (+ flight {args.flight})" if args.flight else ""))
     return 0
 
 
