@@ -393,6 +393,17 @@ def main() -> None:
         # sides of each ratio sample alike. The artifact ratio is the
         # paired median with its IQR — prose can no longer quote a
         # better run than the artifact records.
+        # Device-truth receipts (round 12): a CompileWatch counts every
+        # XLA backend compile across the whole bench (warm + steady),
+        # and a DeviceMonitor samples HBM peaks after the timed runs —
+        # both land in the artifact so tools/perf_gate.py can hold
+        # memory/compile regressions against the ledger the way it
+        # already holds latency ones. On CPU memory_stats() is None
+        # and the HBM keys are simply absent.
+        from tfidf_tpu.obs import devmon as obs_devmon
+        compile_watch = obs_devmon.CompileWatch()
+        obs_devmon.set_watch(compile_watch)
+        hbm_mon = obs_devmon.DeviceMonitor()
         log("warming TPU path (compile)...")
         tpu_once, pack_s, result, cfg_tpu, chunk = bench_tpu(input_dir)
         cpu_times, tpu_times, ratios = [], [], []
@@ -407,6 +418,12 @@ def main() -> None:
             log(f"  pair {i + 1}/{REPEATS}: cpu {c:.2f}s tpu {t:.2f}s "
                 f"ratio {c / t:.2f}")
         cpu_s, tpu_s = min(cpu_times), min(tpu_times)
+        hbm_mon.sample()   # peak covers warm-up + every timed run
+        record["xla_compiles"] = compile_watch.compiles
+        record["xla_compile_s"] = round(compile_watch.compile_seconds, 3)
+        if hbm_mon.peak_bytes:
+            record["peak_hbm_bytes"] = hbm_mon.peak_bytes
+            record["memory_pressure"] = hbm_mon.memory_pressure
         phases = profile_phases(input_dir, cfg_tpu, chunk, result)
         log(f"paired median ratio {float(np.median(ratios)):.2f} "
             f"(pack-only {pack_s:.2f}s); exact mode...")

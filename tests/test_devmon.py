@@ -1,0 +1,684 @@
+"""Device-truth telemetry (ISSUE 7): HBM accounting, compile watchdog,
+per-span cost attribution, and the one-shot doctor.
+
+The acceptance pins: the monitor runs its FULL path on a backend whose
+``memory_stats()`` is None/partial (CPU tier-1) with gauges absent and
+zero crashes; a fault-injected low HBM watermark degrades health and
+visibly shrinks the admission bound, recovering to ok; the compile
+watchdog counts real backend compiles and flags fingerprinted
+recompiles after ``mark_warm`` as flight events with a windowed
+degraded reason; byte-stamped spans export finite achieved GB/s; and
+``tools/doctor.py`` reconciles its phase attribution with
+``PhaseTimer`` to within 5%, reports zero recompiles on a clean serve
+trace, and exits non-zero on fixture evidence with an injected
+recompile or HBM-watermark breach.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tfidf_tpu import obs
+from tfidf_tpu.config import PipelineConfig, ServeConfig, VocabMode
+from tfidf_tpu.io.corpus import Corpus
+from tfidf_tpu.models import TfidfRetriever
+from tfidf_tpu.obs import costmodel, devmon
+from tfidf_tpu.obs.health import DEGRADED, OK, HealthMonitor
+from tfidf_tpu.obs.log import EventLog
+from tfidf_tpu.obs.registry import MetricsRegistry
+from tfidf_tpu.serve import Overloaded, TfidfServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOCTOR = os.path.join(REPO, "tools", "doctor.py")
+
+CFG = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=512,
+                     max_doc_len=16, doc_chunk=16)
+CORPUS = Corpus(
+    names=["doc1", "doc2", "doc3", "doc4", "doc5"],
+    docs=[b"apple banana apple cherry",
+          b"banana banana date",
+          b"cherry date elder fig",
+          b"apple fig fig fig",
+          b"grape grape grape grape"])
+QUERIES = ["apple cherry", "banana date", "grape", "fig elder"]
+
+
+@pytest.fixture(scope="module")
+def retriever():
+    return TfidfRetriever(CFG).index(CORPUS)
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    """Private event log, no global tracer/monitor/watch — and none
+    leaked back into the rest of the suite."""
+    obs.set_log(EventLog(echo="off"))
+    obs.set_tracer(None)
+    devmon.set_watch(None)
+    devmon.set_monitor(None)
+    yield
+    devmon.set_watch(None)
+    devmon.set_monitor(None)
+    obs.set_tracer(None)
+    obs.set_log(None)
+
+
+def quick_cfg(**kw):
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("max_wait_ms", 5)
+    kw.setdefault("queue_depth", 64)
+    kw.setdefault("cache_entries", 64)
+    return ServeConfig(**kw)
+
+
+def _events(name=None):
+    evs = obs.get_log().events()
+    return [e for e in evs if name is None or e["event"] == name]
+
+
+# ---------------------------------------------------------------------
+class TestCostModel:
+    def test_stage_bytes_matches_retired_roofline_model(self):
+        # The exact arithmetic tools/roofline.py carried privately
+        # before round 12 (d=32768, L=256, k=16, 4-byte elements).
+        s = costmodel.stage_bytes(32768, 256, topk=16)
+        n = 32768 * 256
+        assert s["row_sort"] == n * 4 * 2 * (8 * 9 // 2)
+        assert s["rle"] == n * 4 * 6
+        assert s["df_global_sort"] == n * 4 * 2 * (23 * 24 // 2)
+        assert s["score_topk"] == n * 4 * 4 + 32768 * 16 * 8
+        model = costmodel.bytes_model(32768, 256, topk=16)
+        assert model["total_gb"] == pytest.approx(21.2777, rel=1e-3)
+        assert model["hbm_bound_s"] == pytest.approx(
+            model["total_gb"] / costmodel.HBM_PEAK_GBS_DEFAULT)
+
+    def test_hbm_peak_lookup(self):
+        assert costmodel.hbm_peak_gbs("TPU v5 lite") == 819.0
+        assert costmodel.hbm_peak_gbs("TPU v4") == 1228.0
+        assert costmodel.hbm_peak_gbs("TPU v99") == \
+            costmodel.HBM_PEAK_GBS_DEFAULT  # unknown TPU -> default
+        assert costmodel.hbm_peak_gbs("cpu") is None
+        assert costmodel.hbm_peak_gbs(None) is None
+
+    def test_achieved_gbps_degenerate_is_none_not_inf(self):
+        assert costmodel.achieved_gbps(1 << 20, 0.0) is None
+        assert costmodel.achieved_gbps(-1, 0.5) is None
+        assert costmodel.achieved_gbps(2e9, 2.0) == pytest.approx(1.0)
+
+    def test_span_gbps_reads_chrome_event(self):
+        ev = {"ph": "X", "dur": 1000.0,  # 1 ms
+              "args": {"bytes": 1_000_000}}
+        assert costmodel.span_gbps(ev) == pytest.approx(1.0)
+        assert costmodel.span_gbps({"ph": "X", "dur": 5.0}) is None
+
+
+class TestTracerCostExport:
+    def test_byte_stamped_span_exports_finite_gbps(self, tmp_path):
+        t = obs.Tracer()
+        obs.set_tracer(t, str(tmp_path / "t.json"))
+        with obs.span("dispatch", bytes=1 << 20):
+            time.sleep(0.002)
+        evs = [e for e in t.chrome_events() if e.get("ph") == "X"]
+        assert len(evs) == 1
+        gb_s = evs[0]["args"]["gb_s"]
+        assert 0 < gb_s < 1e6 and gb_s == gb_s
+        assert gb_s == pytest.approx(
+            (1 << 20) / (evs[0]["dur"] * 1e3), rel=0.01)
+        # The ring's own args dict stays unannotated (export copies).
+        _name, _tid, _t0, _dur, args = t.events()[0]
+        assert "gb_s" not in args
+        # And the export is valid JSON end to end.
+        json.dumps(t.chrome_events())
+
+    def test_ingest_spans_carry_bytes(self, tmp_path, toy_corpus_dir):
+        from tfidf_tpu.ingest import run_overlapped
+        obs.set_tracer(obs.Tracer())
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, topk=4,
+                             vocab_size=1 << 12)
+        run_overlapped(toy_corpus_dir, cfg, doc_len=16, chunk_docs=2)
+        path = str(tmp_path / "t.json")
+        obs.export(path)
+        by_name = {}
+        for e in obs.load_chrome_trace(path):
+            if e.get("ph") == "X":
+                by_name.setdefault(e["name"], []).append(e)
+        for name in ("dispatch", "drain"):
+            assert by_name.get(name), f"no {name} spans"
+            for e in by_name[name]:
+                assert e["args"]["bytes"] > 0
+
+
+# ---------------------------------------------------------------------
+class TestDeviceMonitor:
+    def test_cpu_full_path_with_gauges_absent(self):
+        """The graceful-degradation contract: CPU memory_stats() is
+        None, yet sample/census/watermark/health all run — with no
+        gauges ever created."""
+        reg = MetricsRegistry()
+        mon = devmon.DeviceMonitor(registry=reg)
+        snap = mon.sample()
+        snap2 = mon.sample()
+        assert snap["memory_pressure"] == 0.0
+        assert len(snap["devices"]) == len(jax.devices())
+        for dev in snap["devices"]:
+            assert "bytes_in_use" not in dev  # CPU reports nothing
+        assert reg.snapshot() == {}           # gauges absent
+        assert snap2["samples"] == 2
+        assert mon.peak_bytes == 0
+        value, reason = mon.health_signal()
+        assert value == 0.0 and reason is None
+        json.dumps(mon.census())              # serializable, no crash
+
+    def test_partial_stats_publish_only_present_keys(self):
+        reg = MetricsRegistry()
+        mon = devmon.DeviceMonitor(
+            registry=reg, stats_fn=lambda d: {"bytes_in_use": 128})
+        snap = mon.sample()
+        names = set(reg.snapshot())
+        assert any(n.startswith("hbm_bytes_in_use_d") for n in names)
+        assert not any(n.startswith("hbm_peak_bytes") for n in names)
+        assert not any(n.startswith("hbm_bytes_limit") for n in names)
+        # No limit -> pressure undefined -> stays 0.0, never a crash.
+        assert snap["memory_pressure"] == 0.0
+
+    def test_census_attributes_owners_and_skips_broken_ones(self):
+        mon = devmon.DeviceMonitor()
+        x = jnp.zeros((64, 32), jnp.float32)
+        y = jnp.ones((16,), jnp.int32)
+        jax.block_until_ready((x, y))
+        mon.register_owner("index", lambda: [x, None])
+        mon.register_owner("broken", lambda: 1 / 0)
+        c = mon.census()
+        assert c["owners"]["index"]["bytes"] == x.nbytes
+        assert c["owners"]["index"]["arrays"] == 1
+        assert "broken" not in c["owners"]
+        assert c["total_bytes"] >= x.nbytes + y.nbytes
+        assert c["owners"]["other"]["bytes"] >= y.nbytes
+        assert any(tuple(s["shape"]) == (64, 32)
+                   for s in c["top_shapes"])
+        # log_census lands the same data in the flight ring.
+        mon.log_census()
+        ev = _events("hbm_census")
+        assert ev and ev[-1]["owners"]["index"]["bytes"] == x.nbytes
+
+    def test_watermark_events_are_edge_triggered(self):
+        state = {"use": 10}
+        mon = devmon.DeviceMonitor(
+            watermarks=(0.8, 0.95),
+            stats_fn=lambda d: {"bytes_in_use": state["use"],
+                                "bytes_limit": 100})
+        mon.sample()
+        assert _events("hbm_watermark") == []
+        state["use"] = 85
+        mon.sample()
+        mon.sample()   # still above: no repeat
+        warns = _events("hbm_watermark")
+        assert len(warns) == 1 and warns[0]["level"] == "warning"
+        assert warns[0]["watermark"] == 0.8
+        state["use"] = 99
+        mon.sample()
+        errs = _events("hbm_watermark")
+        assert len(errs) == 2 and errs[-1]["level"] == "error"
+        value, reason = mon.health_signal()
+        assert value == pytest.approx(0.99)
+        assert "watermark" in reason
+        state["use"] = 10
+        mon.sample()
+        assert _events("hbm_watermark_clear")
+        assert mon.health_signal() == (pytest.approx(0.1), None)
+
+    def test_background_thread_samples(self):
+        mon = devmon.DeviceMonitor(period_s=0.02)
+        mon.start()
+        try:
+            deadline = time.monotonic() + 2.0
+            while mon._samples == 0 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert mon._samples > 0
+        finally:
+            mon.stop()
+
+    def test_configure_respects_env(self, monkeypatch):
+        monkeypatch.delenv("TFIDF_TPU_DEVMON", raising=False)
+        assert devmon.configure() is None
+        monkeypatch.setenv("TFIDF_TPU_DEVMON", "1")
+        monkeypatch.setenv("TFIDF_TPU_DEVMON_PERIOD_MS", "50")
+        mon = devmon.configure()
+        try:
+            assert mon is not None and mon.period_s == 0.05
+            assert devmon.configure() is mon  # idempotent
+        finally:
+            mon.stop()
+            devmon.set_monitor(None)
+
+
+class TestMemoryPressureShed:
+    def test_pressure_signal_degrades_health_monitor(self):
+        state = {"use": 10}
+        mon = devmon.DeviceMonitor(
+            stats_fn=lambda d: {"bytes_in_use": state["use"],
+                                "bytes_limit": 100})
+        hm = HealthMonitor()
+        hm.add_signal("memory_pressure", mon.health_signal)
+        mon.sample()
+        assert hm.evaluate().state == OK
+        state["use"] = 90
+        mon.sample()
+        status = hm.evaluate()
+        assert status.state == DEGRADED
+        assert status.checks["memory_pressure"] == pytest.approx(0.9)
+        assert any("memory pressure" in r for r in status.reasons)
+        assert hm.admission_bound(100) == 50
+        state["use"] = 10
+        mon.sample()
+        assert hm.evaluate().state == OK
+
+    def test_forced_low_watermark_sheds_and_recovers(self, retriever):
+        """THE acceptance pin: fault-injected HBM pressure -> health
+        degraded -> admission bound visibly shrinks -> submit sheds ->
+        pressure released -> ok again."""
+        state = {"use": 10}
+        mon = devmon.DeviceMonitor(
+            stats_fn=lambda d: {"bytes_in_use": state["use"],
+                                "bytes_limit": 100})
+        srv = TfidfServer(retriever, quick_cfg(queue_depth=4))
+        try:
+            srv.attach_device_monitor(mon)
+            mon.sample()
+            assert srv.healthz()["status"] == OK
+            state["use"] = 90          # forced low watermark
+            mon.sample()
+            hz = srv.healthz()
+            assert hz["status"] == DEGRADED
+            assert any("memory pressure" in r for r in hz["reasons"])
+            assert hz["admission_bound"] == 2   # 4 -> 2 while degraded
+            with pytest.raises(Overloaded, match="admission bound 2"):
+                srv.submit(QUERIES[:3], k=2)
+            state["use"] = 10          # pressure released
+            mon.sample()
+            # two evaluations: the first still sees the shed we just
+            # provoked inside its rate window (test_health pins that
+            # decay); the second is clean.
+            srv.healthz()
+            hz = srv.healthz()
+            assert hz["status"] == OK
+            assert hz["admission_bound"] == 4
+            # and the index shows up as a census owner
+            c = mon.census()
+            assert c["owners"]["resident_index"]["bytes"] > 0
+        finally:
+            srv.close(drain=True)
+
+
+# ---------------------------------------------------------------------
+class TestCompileWatch:
+    def test_backend_compile_listener_counts(self):
+        reg = MetricsRegistry()
+        watch = devmon.CompileWatch(registry=reg)
+        devmon.set_watch(watch)
+        size = int(time.time() * 1e3) % 977 + 31  # fresh jit shape
+        jax.jit(lambda v: v * 3 + 1)(
+            jnp.zeros((size,), jnp.float32)).block_until_ready()
+        assert watch.compiles >= 1
+        assert watch.compile_seconds > 0
+        assert reg.snapshot()["xla_compiles_total"] >= 1
+
+    def test_note_before_warm_is_breadcrumb_after_is_recompile(self):
+        watch = devmon.CompileWatch(recent_s=0.08)
+        devmon.set_watch(watch)
+        devmon.note_compile("search_bcoo", queries=4, k=8)
+        assert watch.recompile_count == 0
+        assert _events("xla_recompile") == []
+        watch.mark_warm()
+        devmon.note_compile("search_bcoo", queries=16, k=8)
+        assert watch.recompile_count == 1
+        evs = _events("xla_recompile")
+        assert evs and evs[0]["program"] == "search_bcoo"
+        assert evs[0]["queries"] == 16
+        n, reason = watch.health_signal()
+        assert n == 1 and "recompile" in reason
+        time.sleep(0.1)   # the degraded window DECAYS
+        assert watch.health_signal() == (1, None)
+
+    def test_note_compile_without_watch_is_noop(self):
+        devmon.note_compile("anything", k=1)   # must not raise
+
+    def test_search_path_fingerprints_fresh_program(self):
+        # A corpus shape nothing else in the suite compiles, so the
+        # first bucket-2 search provably misses the global jit cache.
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=389,
+                             max_doc_len=24, doc_chunk=24)
+        corpus = Corpus(names=[f"d{i}" for i in range(9)],
+                        docs=[b"alpha beta gamma delta"] * 9)
+        r = TfidfRetriever(cfg).index(corpus)
+        watch = devmon.CompileWatch()
+        devmon.set_watch(watch)
+        watch.mark_warm()
+        r.search(["alpha beta", "gamma"], k=3)   # bucket 2: fresh
+        assert watch.recompile_count >= 1
+        fp = watch.recompiles_after_warm()[0]
+        assert fp["program"] == "search_bcoo"
+        assert fp["queries"] == 2 and fp["k"] == 3
+        # warmed shape again: no new note
+        before = watch.recompile_count
+        r.search(["alpha", "beta"], k=3)
+        assert watch.recompile_count == before
+
+    def test_server_installs_watch_and_uninstalls_on_close(
+            self, retriever):
+        srv = TfidfServer(retriever, quick_cfg())
+        assert devmon.get_watch() is srv.compile_watch
+        srv.close(drain=True)
+        assert devmon.get_watch() is None
+
+    def test_recompile_reason_degrades_server_health(self, retriever):
+        srv = TfidfServer(retriever, quick_cfg())
+        try:
+            srv.mark_warm()
+            srv.compile_watch.note("search_bcoo", queries=32, k=9)
+            hz = srv.healthz()
+            assert hz["status"] == DEGRADED
+            assert any("recompile" in r for r in hz["reasons"])
+            assert hz["checks"]["xla_recompiles_after_warm"] == 1
+        finally:
+            srv.close(drain=True)
+
+    def test_batcher_stamps_recompile_instant(self, tmp_path):
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=401,
+                             max_doc_len=24, doc_chunk=24)
+        corpus = Corpus(names=[f"d{i}" for i in range(11)],
+                        docs=[b"red green blue cyan"] * 11)
+        r = TfidfRetriever(cfg).index(corpus)
+        obs.set_tracer(obs.Tracer(), str(tmp_path / "t.json"))
+        srv = TfidfServer(r, quick_cfg(cache_entries=0))
+        try:
+            r.search(["red"], k=2)        # warm bucket 1 only
+            srv.mark_warm()
+            srv.search(["red", "green", "blue"], k=2)  # bucket 4: fresh
+        finally:
+            srv.close(drain=True)
+        assert srv.compile_watch.recompile_count >= 1
+        instants = [e for e in obs.get_tracer().chrome_events()
+                    if e.get("ph") == "i"
+                    and e["name"] == "recompile_in_batch"]
+        assert instants, "recompile not pinned to its serve batch"
+
+
+# ---------------------------------------------------------------------
+def _load_tool(name):
+    tools = os.path.join(REPO, "tools")
+    if tools not in sys.path:
+        sys.path.append(tools)
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(tools, f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestDoctor:
+    def test_phase_attribution_reconciles_with_phase_timer(
+            self, tmp_path, toy_corpus_dir):
+        """THE acceptance pin: doctor's per-phase totals, read from
+        the trace, reconcile with the PhaseTimer-style phases dict the
+        ingest returns — within 5% (plus a 5 ms cushion for phases at
+        the CPU timer's noise floor)."""
+        from tfidf_tpu.ingest import run_overlapped
+        obs.set_tracer(obs.Tracer())
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, topk=4,
+                             vocab_size=1 << 12)
+        r = run_overlapped(toy_corpus_dir, cfg, doc_len=16,
+                           chunk_docs=2)
+        trace = str(tmp_path / "t.json")
+        obs.export(trace)
+        doctor = _load_tool("doctor")
+        report = doctor.diagnose(trace, None,
+                                 str(tmp_path / "no_ledger.jsonl"))
+        phases = report["phases"]
+        ph = r.phases
+
+        def close(a, b):
+            return abs(a - b) <= max(0.05 * max(a, b), 0.005)
+
+        # Pairs recorded over the SAME interval by construction
+        # (the phase timer and the span wrap one block of code).
+        assert close(ph["pack"], phases["pack_wait"]["total_s"])
+        assert close(ph["put"], phases["dispatch"]["total_s"])
+        assert close(ph["pack_host"], phases["pack"]["total_s"])
+        assert close(ph["fetch_host"], phases["drain"]["total_s"])
+        assert close(ph["fetch"],
+                     phases.get("fetch_wait", {}).get("total_s", 0.0)
+                     + phases.get("fetch", {}).get("total_s", 0.0))
+        assert report["ok"] and report["violations"] == []
+        assert 0.0 <= report["overlap_efficiency"] <= 1.0
+        # byte-stamped phases carry their MB
+        assert phases["dispatch"]["bytes"] > 0
+
+    def _fixture_trace(self, tmp_path):
+        t = obs.Tracer()
+        obs.set_tracer(t, None)
+        with obs.span("dispatch", chunk=0, bytes=1024):
+            time.sleep(0.001)
+        trace = str(tmp_path / "fixture.json")
+        t.export(trace)
+        return trace
+
+    def test_exits_nonzero_on_injected_recompile(self, tmp_path):
+        trace = self._fixture_trace(tmp_path)
+        log = obs.get_log()
+        log.warning("xla_recompile", program="search_bcoo", queries=8,
+                    k=5)
+        flight = str(tmp_path / "fixture.flight.jsonl")
+        log.dump(flight)
+        out = subprocess.run(
+            [sys.executable, DOCTOR, trace, "--flight", flight],
+            capture_output=True, text=True)
+        assert out.returncode == 1, out.stdout + out.stderr
+        assert "recompile" in out.stdout.lower()
+        # the same evidence passes with the budget raised
+        out = subprocess.run(
+            [sys.executable, DOCTOR, trace, "--flight", flight,
+             "--allow-recompiles", "1"],
+            capture_output=True, text=True)
+        assert out.returncode == 0, out.stdout + out.stderr
+
+    def test_exits_nonzero_on_watermark_breach(self, tmp_path):
+        trace = self._fixture_trace(tmp_path)
+        log = obs.get_log()
+        log.error("hbm_watermark", pressure=0.97, watermark=0.95)
+        flight = str(tmp_path / "fixture.flight.jsonl")
+        log.dump(flight)
+        out = subprocess.run(
+            [sys.executable, DOCTOR, trace, "--flight", flight],
+            capture_output=True, text=True)
+        assert out.returncode == 1, out.stdout + out.stderr
+        assert "watermark" in out.stdout.lower()
+
+    def test_phase_budget_violation(self, tmp_path):
+        trace = self._fixture_trace(tmp_path)
+        out = subprocess.run(
+            [sys.executable, DOCTOR, trace, "--budget",
+             "dispatch=0.0000001", "--json"],
+            capture_output=True, text=True)
+        assert out.returncode == 1
+        report = json.loads(out.stdout)
+        assert any("budget" in v for v in report["violations"])
+
+    def test_unreadable_input_exits_2(self, tmp_path):
+        out = subprocess.run(
+            [sys.executable, DOCTOR, str(tmp_path / "missing.json")],
+            capture_output=True, text=True)
+        assert out.returncode == 2
+
+    @pytest.mark.slow
+    def test_serve_trace_flight_doctor_end_to_end(self, tmp_path,
+                                                  retriever):
+        """serve -> trace -> flight -> doctor on CPU: the clean-run
+        smoke. Zero recompiles after warm-up, doctor healthy."""
+        trace = str(tmp_path / "serve.json")
+        obs.set_tracer(obs.Tracer(), trace)
+        srv = TfidfServer(retriever, quick_cfg())
+        try:
+            for b in (1, 2, 4, 8):
+                retriever.search([QUERIES[0]] * b, k=3)
+            srv.mark_warm()
+            for i in range(12):
+                srv.search([QUERIES[i % 4]], k=3)
+            srv.search(QUERIES[:2], k=3)
+            srv.search(QUERIES[:4], k=3)
+        finally:
+            srv.close(drain=True)
+        obs.export(trace)
+        flight = str(tmp_path / "serve.json.flight.jsonl")
+        obs.get_log().dump(flight)
+        out = subprocess.run(
+            [sys.executable, DOCTOR, trace, "--flight", flight,
+             "--json"],
+            capture_output=True, text=True)
+        assert out.returncode == 0, out.stdout + out.stderr
+        report = json.loads(out.stdout)
+        assert report["ok"]
+        assert report["recompile_instants"] == 0
+        assert report["flight"]["recompiles"] == []
+        assert report["serve"]["requests"] == 14
+        # trace_check accepts the same cost-annotated serve trace
+        tc = _load_tool("trace_check")
+        errors, notes = tc.check_trace(trace, mode="serve",
+                                       min_threads=2)
+        assert errors == [], (errors, notes)
+
+
+class TestTraceCheckCostContract:
+    def _trace_with(self, tmp_path, args):
+        doc = {"traceEvents": [
+            {"ph": "M", "pid": 1, "tid": 0, "name": "thread_name",
+             "args": {"name": "main"}},
+            {"ph": "M", "pid": 1, "tid": 1, "name": "thread_name",
+             "args": {"name": "packer"}},
+            {"ph": "X", "pid": 1, "tid": 1, "name": "pack", "ts": 0.0,
+             "dur": 5.0, "args": {"chunk": 0}},
+            {"ph": "X", "pid": 1, "tid": 0, "name": "dispatch",
+             "ts": 1.0, "dur": 5.0, "args": args},
+        ]}
+        path = str(tmp_path / "t.json")
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        return path
+
+    def test_dispatch_without_bytes_fails_ingest_mode(self, tmp_path):
+        tc = _load_tool("trace_check")
+        path = self._trace_with(tmp_path, {"chunk": 0})
+        errors, _ = tc.check_trace(path, mode="ingest", min_threads=1)
+        assert any("bytes stamp" in e for e in errors)
+        path = self._trace_with(tmp_path, {"chunk": 0, "bytes": 4096})
+        errors, _ = tc.check_trace(path, mode="ingest", min_threads=1)
+        assert not any("bytes stamp" in e for e in errors)
+
+    def test_negative_bytes_or_bad_gbps_fails_schema(self, tmp_path):
+        tc = _load_tool("trace_check")
+        path = self._trace_with(tmp_path, {"bytes": -5})
+        errors, _ = tc.check_trace(path, mode="schema", min_threads=1)
+        assert any("bytes" in e for e in errors)
+        path = self._trace_with(tmp_path, {"bytes": 5, "gb_s": -1.0})
+        errors, _ = tc.check_trace(path, mode="schema", min_threads=1)
+        assert any("gb_s" in e for e in errors)
+
+
+# ---------------------------------------------------------------------
+class TestLedgerDeviceTruth:
+    def test_multichip_artifacts_normalize_and_gate(self, tmp_path):
+        perf_ledger = _load_tool("perf_ledger")
+        perf_gate = _load_tool("perf_gate")
+        rec, reason = perf_ledger.normalize(
+            os.path.join(REPO, "MULTICHIP_r05.json"))
+        assert reason is None
+        assert rec["kind"] == "multichip"
+        assert rec["metrics"]["ok"] == 1        # bool -> gated 0/1
+        assert rec["context"]["n_devices"] == 8
+        ledger_path = str(tmp_path / "L.jsonl")
+        appended, _ = perf_ledger.append(
+            perf_ledger.backfill_paths(), ledger_path, quiet=True)
+        records = perf_ledger.load_ledger(ledger_path)
+        multichip = [r for r in records if r["kind"] == "multichip"]
+        assert len(multichip) == 5              # r01-r05 backfilled
+        # unchanged artifact passes; a broken mesh run fails
+        verdict = perf_gate.gate(rec, records)
+        assert verdict["ok"]
+        bad = json.loads(json.dumps(rec))
+        bad["metrics"]["ok"] = 0
+        verdict = perf_gate.gate(bad, records)
+        assert not verdict["ok"]
+        # and the backfill stays idempotent with multichip in the mix
+        appended2, _ = perf_ledger.append(
+            perf_ledger.backfill_paths(), ledger_path, quiet=True)
+        assert appended2 == 0
+
+    def test_memory_and_compile_metrics_gate_directionally(
+            self, tmp_path):
+        perf_ledger = _load_tool("perf_ledger")
+        perf_gate = _load_tool("perf_gate")
+        base = {"metric": "serve_bench", "backend": "cpu", "docs": 64,
+                "k": 5, "max_batch": 8, "requests": 10,
+                "throughput_qps": 100.0, "peak_hbm_bytes": 1_000_000,
+                "xla_compiles": 12}
+        ledger_path = str(tmp_path / "L.jsonl")
+        for i in range(3):
+            p = str(tmp_path / f"a{i}.json")
+            with open(p, "w") as f:
+                json.dump(base, f)
+            perf_ledger.append([p], ledger_path, quiet=True)
+        ledger = perf_ledger.load_ledger(ledger_path)
+        # doubled peak HBM regresses past the 10% tolerance
+        worse = dict(base, peak_hbm_bytes=2_000_000)
+        p = str(tmp_path / "worse.json")
+        with open(p, "w") as f:
+            json.dump(worse, f)
+        cand, _ = perf_ledger.normalize(p)
+        verdict = perf_gate.gate(cand, ledger)
+        checks = {c["metric"]: c for c in verdict["checks"]}
+        assert checks["peak_hbm_bytes"]["verdict"] == "REGRESSED"
+        assert not verdict["ok"]
+        # compile-count explosion regresses too; equality passes
+        worse = dict(base, xla_compiles=30)
+        with open(p, "w") as f:
+            json.dump(worse, f)
+        cand, _ = perf_ledger.normalize(p)
+        checks = {c["metric"]: c
+                  for c in perf_gate.gate(cand, ledger)["checks"]}
+        assert checks["xla_compiles"]["verdict"] == "REGRESSED"
+        with open(p, "w") as f:
+            json.dump(base, f)
+        cand, _ = perf_ledger.normalize(p)
+        assert perf_gate.gate(cand, ledger)["ok"]
+
+
+class TestServeBenchArtifact:
+    @pytest.mark.slow
+    def test_serve_bench_embeds_device_truth(self, tmp_path):
+        """serve_bench on CPU: xla_compiles present; the HBM keys are
+        honestly ABSENT (memory_stats() is None here), not zero."""
+        out_path = str(tmp_path / "SERVE_t.json")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("TFIDF_TPU_TRACE", None)
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "serve_bench.py"),
+             "--requests", "24", "--docs", "48", "--doc-len", "16",
+             "--concurrency", "2", "--out", out_path],
+            capture_output=True, text=True, timeout=600, env=env,
+            cwd=REPO)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        with open(out_path) as f:
+            artifact = json.load(f)
+        assert artifact["xla_compiles"] >= 1
+        assert artifact["recompiles_after_warmup"] == 0
+        assert "peak_hbm_bytes" not in artifact

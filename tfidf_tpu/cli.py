@@ -64,6 +64,10 @@ protocol (one JSON object per line):
   {"op": "canary"}             -> {"canary": {"parity": 1.0}}  (one
       parity probe vs the swap-time oracle; "skipped": true when shed
       under load or raced by a swap)
+  {"op": "devmon"}             -> {"devmon": {"devices": [...],
+      "memory_pressure": 0.12, "census": {...}}}  (one device-monitor
+      sample + live-buffer census by owner; device entries carry HBM
+      stats only on backends that report them)
   {"op": "swap_index", "input": DIR}
       -> {"swapped": true, "epoch": N}  (hot re-index, no downtime;
       the canary oracle re-captures inside the swap)
@@ -288,6 +292,22 @@ def _build_parser() -> argparse.ArgumentParser:
                          "admission bound). 0 disables the background "
                          "thread (default 250; env "
                          "TFIDF_TPU_HEALTH_PERIOD_MS)")
+    sv.add_argument("--devmon-period-ms", type=float, default=1000.0,
+                    help="device-monitor cadence: every period the "
+                         "server samples per-device memory_stats() "
+                         "into gauges, checks the HBM watermarks "
+                         "(TFIDF_TPU_HBM_WATERMARKS) and refreshes "
+                         "the memory_pressure health signal, so "
+                         "admission sheds before OOM. 0 disables the "
+                         "thread (default 1000; env "
+                         "TFIDF_TPU_DEVMON_PERIOD_MS). Backends "
+                         "without memory stats (CPU) run the same "
+                         "path with gauges absent")
+    sv.add_argument("--no-warm", action="store_true",
+                    help="skip the power-of-two query-bucket warm-up "
+                         "(and its mark_warm() line): the compile "
+                         "watchdog then never flags steady-state "
+                         "recompiles")
     sv.add_argument("--canary-period-ms", type=float, default=5000.0,
                     help="canary parity-probe cadence: replay pinned "
                          "golden queries through the batched path and "
@@ -388,6 +408,12 @@ def _run_tpu(args) -> int:
     # library entry points re-apply it idempotently.
     from tfidf_tpu.config import apply_compile_cache
     apply_compile_cache(cfg.compile_cache)
+    # Device-truth sampling (TFIDF_TPU_DEVMON): when armed, a global
+    # DeviceMonitor samples HBM stats in the background and the run's
+    # epilog takes a final sample + live-buffer census into the
+    # flight-recorder ring (tools/doctor.py reads it from the dump).
+    from tfidf_tpu.obs import devmon as obs_devmon
+    obs_devmon.configure()
     from tfidf_tpu.utils.timing import PhaseTimer, Throughput, phase_or_null
     timer = PhaseTimer() if args.timing else None
     throughput = Throughput()
@@ -567,6 +593,10 @@ def _run_tpu(args) -> int:
                 f.write(b"".join(l + b"\n" for l in lines))
         else:
             _write_topk(args.output, result)
+    mon = obs_devmon.get_monitor()
+    if mon is not None:
+        mon.sample()
+        mon.log_census()
     if timer is not None:
         sys.stderr.write(timer.report() + "\n"
                          f"{'docs/sec':>12}: {throughput.docs_per_sec:9.1f}\n")
@@ -763,6 +793,16 @@ def _serve_handle_line(server, line, write, default_k, build_retriever,
     if op == "readyz":
         write({"id": req.get("id"), "readyz": server.readyz()})
         return True
+    if op == "devmon":
+        if server.devmon is None:
+            write({"id": req.get("id"),
+                   "error": "device monitor disabled "
+                            "(--devmon-period-ms 0)"})
+        else:
+            snap = server.devmon.sample()
+            snap["census"] = server.devmon.census()
+            write({"id": req.get("id"), "devmon": snap})
+        return True
     if op == "canary":
         if canary is None:
             write({"id": req.get("id"),
@@ -846,8 +886,21 @@ def _run_serve(args) -> int:
         max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
         queue_depth=args.queue_depth, cache_entries=args.cache_entries,
         default_deadline_ms=args.deadline_ms,
-        health_period_ms=args.health_period_ms)
-    server = TfidfServer(build_retriever(args.input), serve_cfg)
+        health_period_ms=args.health_period_ms,
+        devmon_period_ms=args.devmon_period_ms)
+    retriever = build_retriever(args.input)
+    server = TfidfServer(retriever, serve_cfg)
+    if not args.no_warm:
+        # Touch every power-of-two query bucket steady state can see
+        # (empty queries compile the same Q-shaped programs), then
+        # draw the warm line: from here the compile watchdog flags
+        # any fresh search program as a steady-state recompile —
+        # flight event + windowed degraded health reason.
+        b = 1
+        while b <= serve_cfg.max_batch:
+            retriever.search([""] * b, k=args.k)
+            b *= 2
+        server.mark_warm()
     # The serve process's monitor is THE process monitor: reindex
     # pack/drain workers (swap_index) heartbeat into the same health
     # view as the batcher (obs/health.py module hook).

@@ -237,6 +237,16 @@ class ServeConfig:
         admission bound shrinks to ``queue_depth * factor`` (floor 1)
         — backpressure that drains the backlog instead of compounding
         it. Env ``TFIDF_TPU_DEGRADED_FACTOR``.
+      devmon_period_ms: background device-monitor cadence — every
+        period the server's :class:`~tfidf_tpu.obs.devmon.
+        DeviceMonitor` samples per-device ``memory_stats()`` into
+        registry gauges, checks the HBM watermarks
+        (``TFIDF_TPU_HBM_WATERMARKS``) and refreshes the
+        ``memory_pressure`` health signal, so admission sheds BEFORE
+        the allocator OOMs. None = no monitor thread (the library
+        default; backends with no memory stats — CPU — run the same
+        path with gauges absent). CLI ``--devmon-period-ms`` (0
+        disables) / env ``TFIDF_TPU_DEVMON_PERIOD_MS``.
     """
 
     max_batch: int = 64
@@ -247,6 +257,7 @@ class ServeConfig:
     health_period_ms: Optional[float] = None
     stall_after_ms: float = 1000.0
     degraded_admission_factor: float = 0.5
+    devmon_period_ms: Optional[float] = None
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -264,6 +275,10 @@ class ServeConfig:
                 and self.health_period_ms <= 0):
             raise ValueError("health_period_ms must be positive "
                              "(None disables the watchdog thread)")
+        if (self.devmon_period_ms is not None
+                and self.devmon_period_ms <= 0):
+            raise ValueError("devmon_period_ms must be positive "
+                             "(None disables the device monitor)")
         if self.stall_after_ms <= 0:
             raise ValueError("stall_after_ms must be positive")
         if not 0 < self.degraded_admission_factor <= 1:
@@ -294,13 +309,18 @@ class ServeConfig:
                 kw[key] = val
         if overrides.get("default_deadline_ms") is not None:
             kw["default_deadline_ms"] = overrides["default_deadline_ms"]
-        # health_period_ms: an explicit 0 means "watchdog off" (None).
-        hp = overrides.get("health_period_ms")
-        if hp is None:
-            raw = os.environ.get("TFIDF_TPU_HEALTH_PERIOD_MS")
-            hp = float(raw) if raw else None
-        if hp is not None:
-            kw["health_period_ms"] = hp if hp > 0 else None
+        # health/devmon periods: an explicit 0 means "thread off"
+        # (None), distinct from "not set" (fall through to the env).
+        for key, env in (("health_period_ms",
+                          "TFIDF_TPU_HEALTH_PERIOD_MS"),
+                         ("devmon_period_ms",
+                          "TFIDF_TPU_DEVMON_PERIOD_MS")):
+            val = overrides.get(key)
+            if val is None:
+                raw = os.environ.get(env)
+                val = float(raw) if raw else None
+            if val is not None:
+                kw[key] = val if val > 0 else None
         return ServeConfig(**kw)
 
 

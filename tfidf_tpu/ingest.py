@@ -630,12 +630,13 @@ class _DrainAhead:
         # np.asarray then mostly finds them already on host.
         words.copy_to_host_async()
         _trace("drain_submit", idx)
+        nbytes = int(words.nbytes)
 
         def job(words=words, idx=idx):
             obs.name_thread("drainer")
             _health_beat("drainer")  # no-op unless a monitor is armed
             t0 = time.perf_counter()
-            with obs.span("drain", chunk=idx):
+            with obs.span("drain", chunk=idx, bytes=nbytes):
                 out = self._unpack(np.asarray(words))
             self._host_s += time.perf_counter() - t0
             _trace("drain_done", idx)
@@ -1714,7 +1715,11 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
                 bytes_wire += wire_arr.nbytes + lengths.nbytes
                 bytes_padded += padded_chunk_bytes + lengths.nbytes
                 t0 = time.perf_counter()
-                with obs.span("dispatch", chunk=ci):
+                # bytes stamp (round 12): the trace export turns it
+                # into achieved GB/s on the span (obs/costmodel.py).
+                with obs.span("dispatch", chunk=ci,
+                              bytes=int(wire_arr.nbytes
+                                        + lengths.nbytes)):
                     lens = jax.device_put(lengths)
                     # Sort + DF-fold this chunk NOW (async dispatch):
                     # the transfer+sort runs behind the host's packing
@@ -1812,7 +1817,7 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
         # DF stays on device (jax.Array acts array-like; np.asarray
         # fetches it on first real read — no hot-path consumer does).
         _trace("fetch_start")
-        with obs.span("fetch"):
+        with obs.span("fetch", bytes=int(wire.nbytes)):
             buf = np.asarray(jax.device_get(wire))
         _trace("fetch_done")
         ph["fetch"] = time.perf_counter() - t0
@@ -1905,7 +1910,8 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
             bytes_wire += wire_arr.nbytes + lengths.nbytes
             bytes_padded += padded_chunk_bytes + lengths.nbytes
             _trace("upload", ci)
-            with obs.span("dispatch", chunk=ci):
+            with obs.span("dispatch", chunk=ci,
+                          bytes=int(wire_arr.nbytes + lengths.nbytes)):
                 if cache_bytes + chunk_cache_bytes <= cache_budget:
                     # Sort once, keep the triples: pass B scores these
                     # directly (_phase_b_cached) — no host cache, no
@@ -2047,7 +2053,10 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
         ph["pass_b"] = time.perf_counter() - t_pass
         t0 = time.perf_counter()
         _trace("fetch_start")
-        with obs.span("fetch"):
+        with obs.span("fetch",
+                      bytes=int(df_acc.nbytes
+                                + sum(v.nbytes for v in vals_parts)
+                                + sum(t.nbytes for t in ids_parts))):
             df_host, vals, tids = jax.device_get(
                 (df_acc, jnp.concatenate(vals_parts),
                  jnp.concatenate(ids_parts)))

@@ -37,6 +37,7 @@ from jax.sharding import PartitionSpec as P
 
 from tfidf_tpu.config import PipelineConfig, VocabMode
 from tfidf_tpu.io.corpus import Corpus, discover_corpus, pack_corpus
+from tfidf_tpu.obs import devmon
 from tfidf_tpu.ops.hashing import words_to_ids
 from tfidf_tpu.ops.scoring import idf_from_df
 from tfidf_tpu.ops.sparse import (sorted_term_counts, sparse_df,
@@ -294,8 +295,23 @@ class TfidfRetriever:
         else:
             data = jnp.where(self._head, self._weights, 0.0)
             cols = jnp.where(self._head, self._ids, 0)[..., None]
-            vals, idx = _search_bcoo(data, cols, qmat,
-                                     k=min(k, self._ids.shape[0]))
+            kk = min(k, self._ids.shape[0])
+            # Compile fingerprinting (round 12): with a CompileWatch
+            # armed, a cache-size delta across this call means a fresh
+            # search program — note it with the shape identity the
+            # watch's flight event needs. Disabled cost: one global
+            # load + None test (the hot-path discipline of obs).
+            watch = devmon.get_watch()
+            before = (_search_bcoo._cache_size()
+                      if watch is not None
+                      and hasattr(_search_bcoo, "_cache_size") else None)
+            vals, idx = _search_bcoo(data, cols, qmat, k=kk)
+            if (before is not None
+                    and _search_bcoo._cache_size() > before):
+                devmon.note_compile(
+                    "search_bcoo", queries=int(qmat.shape[1]), k=kk,
+                    docs=int(self._ids.shape[0]),
+                    dtype=str(qmat.dtype))
         # Both paths produce >= min(k, num_docs) sorted columns (the
         # sharded one up to min(k, local_k * n_shards)); trim to the
         # path-independent width so callers see the same shape. Rows

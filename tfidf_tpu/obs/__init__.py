@@ -21,6 +21,14 @@ north star requires — one timeline from submit to drain:
 * :mod:`~tfidf_tpu.obs.health` — the consumption layer: a watchdog
   deriving ``ok | degraded | unhealthy`` from worker heartbeats and
   windowed SLO rates, feeding back into serve admission control.
+* :mod:`~tfidf_tpu.obs.devmon` — the device-truth layer: per-device
+  HBM accounting (gauges, live-buffer census, watermark events, a
+  memory-pressure health signal) and the XLA compile watchdog that
+  flags any recompile after warm-up.
+* :mod:`~tfidf_tpu.obs.costmodel` — the analytic bytes/bandwidth
+  model (stdlib-only): byte-stamped spans export achieved GB/s, and
+  ``tools/doctor.py`` quotes roofline fractions from the same
+  arithmetic.
 
 The tracer API is re-exported here (``from tfidf_tpu import obs;
 obs.span(...)``) because product code calls it on hot paths, and the
@@ -51,9 +59,11 @@ __all__ = [
     "load_chrome_trace", "spans_by_thread", "device_op_table",
     "EventLog", "get_log", "set_log", "log_event", "record_digest",
     "configure_flight", "flight_path", "dump_flight",
-    # lazy (tfidf_tpu.obs.registry / tfidf_tpu.obs.health):
+    # lazy (tfidf_tpu.obs.registry / tfidf_tpu.obs.health /
+    # tfidf_tpu.obs.devmon):
     "MetricsRegistry", "Counter", "Gauge", "Histogram",
     "HealthMonitor", "HealthThresholds", "HealthStatus",
+    "DeviceMonitor", "CompileWatch",
 ]
 
 
@@ -65,4 +75,7 @@ def __getattr__(name):  # PEP 562: heavier members load on demand
     if name in ("HealthMonitor", "HealthThresholds", "HealthStatus"):
         from tfidf_tpu.obs import health
         return getattr(health, name)
+    if name in ("DeviceMonitor", "CompileWatch"):
+        from tfidf_tpu.obs import devmon
+        return getattr(devmon, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

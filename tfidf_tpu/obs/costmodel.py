@@ -1,0 +1,120 @@
+"""Analytic bytes/bandwidth model: the roofline, next to the trace.
+
+Until round 12 the bytes model lived as a private block inside
+``tools/roofline.py`` — a one-shot table a human ran by hand, while
+the tracer (``obs/tracer.py``) recorded *time* with no notion of how
+many bytes each span should have moved. This module is the shared
+home: the per-stage HBM traffic model of the resident device program,
+the per-chip HBM peak table, and the achieved-GB/s arithmetic that
+turns a byte-stamped span into a roofline fraction. Consumers:
+
+* ``obs/tracer.py`` — spans stamped with a ``bytes`` arg get an
+  ``gb_s`` computed at export time, so the Perfetto timeline shows
+  achieved bandwidth per span directly;
+* ``tools/roofline.py`` / ``tools/dispatch_probe.py`` /
+  ``tools/df_probe.py`` — the probes report model-vs-measured through
+  ONE copy of the model instead of three private ones;
+* ``tools/doctor.py`` — the one-shot diagnosis quotes roofline
+  fractions per phase from the same arithmetic.
+
+Stdlib-only by design (like the tracer): the doctor and trace_check
+must run in a bare CI interpreter with no jax or numpy at all.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional
+
+__all__ = [
+    "HBM_PEAK_GBS_DEFAULT", "hbm_peak_gbs", "stage_bytes",
+    "bytes_model", "achieved_gbps", "span_gbps",
+]
+
+# Public per-chip HBM peak bandwidth (GB/s). Keyed by substrings of
+# ``jax.Device.device_kind``; first match wins, order matters (the
+# more specific names first). The default is the v5e the bench
+# hardware exposes — tools that know better pass their own peak.
+HBM_PEAK_GBS_DEFAULT = 819.0  # v5e: 819 GB/s HBM2 per chip
+_HBM_PEAK_TABLE = (
+    ("v5p", 2765.0),
+    ("v5 lite", 819.0), ("v5e", 819.0),
+    ("v4", 1228.0),
+    ("v3", 900.0),
+    ("v2", 700.0),
+)
+
+
+def hbm_peak_gbs(device_kind: Optional[str]) -> Optional[float]:
+    """HBM peak (GB/s) for a ``device_kind`` string, or None when the
+    chip is unknown (CPU backends have no meaningful HBM roofline —
+    callers print "n/a" rather than a made-up fraction)."""
+    if not device_kind:
+        return None
+    kind = device_kind.lower()
+    for key, peak in _HBM_PEAK_TABLE:
+        if key in kind:
+            return peak
+    if "tpu" in kind:
+        return HBM_PEAK_GBS_DEFAULT
+    return None
+
+
+def stage_bytes(docs: int, length: int, topk: int = 16,
+                itemsize: int = 4) -> Dict[str, int]:
+    """HBM traffic per stage of the resident phase-B program, in bytes.
+
+    The model the round-4 roofline derived and the engine bench
+    validated (docs/ENGINES.md): a bitonic row sort reads+writes the
+    [D, L] block once per compare-exchange layer (lg·(lg+1)/2 layers),
+    the RLE term-count pass makes ~6 full passes (prev/head/cummin/
+    counts), the global DF sort is the same bitonic model over the
+    flattened D·L slots, and score+topk is ~4 passes plus the [D, K]
+    result. All in ``itemsize``-byte elements (int32/float32 = 4).
+    """
+    n = docs * length
+    lg = max(1, math.ceil(math.log2(max(length, 2))))
+    lgn = max(1, math.ceil(math.log2(max(n, 2))))
+    return {
+        "row_sort": n * itemsize * 2 * (lg * (lg + 1) // 2),
+        "rle": n * itemsize * 6,
+        "df_global_sort": n * itemsize * 2 * (lgn * (lgn + 1) // 2),
+        "score_topk": n * itemsize * 4 + docs * topk * 2 * itemsize,
+    }
+
+
+def bytes_model(docs: int, length: int, topk: int = 16,
+                hbm_gbs: Optional[float] = HBM_PEAK_GBS_DEFAULT
+                ) -> Dict[str, float]:
+    """The roofline table: per-stage GB, total, and the HBM-bound
+    floor in seconds at ``hbm_gbs`` (omitted when the peak is None —
+    no roofline without a chip)."""
+    stages = stage_bytes(docs, length, topk)
+    model = {f"{name}_gb": b / 1e9 for name, b in stages.items()}
+    total_gb = sum(model.values())
+    model["total_gb"] = total_gb
+    if hbm_gbs:
+        model["hbm_bound_s"] = total_gb / hbm_gbs
+    return model
+
+
+def achieved_gbps(nbytes: float, seconds: float) -> Optional[float]:
+    """Realized bandwidth, or None when the interval is degenerate
+    (zero/negative duration must not export an Infinity that breaks a
+    JSON reader)."""
+    if not seconds or seconds <= 0 or nbytes < 0:
+        return None
+    return nbytes / seconds / 1e9
+
+
+def span_gbps(event: dict) -> Optional[float]:
+    """Achieved GB/s of one Chrome trace-event dict: a complete span
+    whose ``args.bytes`` says what it moved (``ts``/``dur`` are in
+    microseconds). None when the span carries no byte stamp."""
+    args = event.get("args") or {}
+    b = args.get("bytes")
+    dur_us = event.get("dur")
+    if not isinstance(b, (int, float)) \
+            or not isinstance(dur_us, (int, float)):
+        return None
+    return achieved_gbps(float(b), dur_us / 1e6)

@@ -1,0 +1,543 @@
+"""Device-truth telemetry: HBM accounting + XLA compile watchdog.
+
+Rounds 10–11 made every host thread observable; the chip itself stayed
+a black box — nothing reported live/peak HBM, and the only compile
+signal was serve_bench's one-shot ``_cache_size()`` pin. This module
+is the device-side half of ``tfidf_tpu/obs``:
+
+* :class:`DeviceMonitor` — samples per-device ``memory_stats()``
+  (bytes-in-use, peak, limit) into registry gauges, takes a live-
+  buffer census over ``jax.live_arrays()`` attributed by shape/dtype
+  to named OWNERS (resident index, wire buffers, serve cache — any
+  component that registers one), emits flight-recorder watermark
+  events when HBM pressure crosses configurable thresholds, and
+  exposes :meth:`health_signal` so a
+  :class:`~tfidf_tpu.obs.health.HealthMonitor` degrades — and
+  admission control sheds — *before* the allocator OOMs, the same way
+  it already sheds on queue saturation.
+* :class:`CompileWatch` — counts and fingerprints every XLA
+  compilation: a process-global ``jax.monitoring`` listener counts
+  real backend compiles (count + wall), and product call sites
+  fingerprint the programs they know (:func:`note_compile` with
+  shapes/dtype/k — ``TfidfRetriever.search`` stamps its bucketed
+  search programs). After :meth:`mark_warm`, any further compile is a
+  flight event + a windowed ``degraded`` health reason — the live
+  generalization of round 9's post-hoc recompile pin.
+
+Graceful degradation is a hard contract (tier-1 runs on
+``JAX_PLATFORMS=cpu``): CPU devices return ``memory_stats() = None``
+— the monitor still runs its FULL path (census, watermarks vacuous,
+pressure 0.0, health signal clean) with the per-device gauges simply
+absent, and partial stats dicts publish only the keys they carry.
+jax imports lazily, at sample time — constructing a monitor costs no
+backend init.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from tfidf_tpu.obs import log as obs_log
+
+__all__ = [
+    "DeviceMonitor", "CompileWatch", "configure", "get_monitor",
+    "set_monitor", "get_watch", "set_watch", "note_compile",
+    "DEFAULT_WATERMARKS",
+]
+
+# HBM pressure fractions (in-use / limit) at which the monitor emits
+# flight watermark events and reports a degraded health reason. Two
+# rungs: the first is the shed-early line (health degrades, admission
+# shrinks — drain while there is still headroom), the second the
+# near-OOM alarm. Env override: TFIDF_TPU_HBM_WATERMARKS="0.8,0.95".
+DEFAULT_WATERMARKS = (0.80, 0.95)
+
+
+def _env_watermarks() -> Tuple[float, ...]:
+    raw = os.environ.get("TFIDF_TPU_HBM_WATERMARKS")
+    if not raw:
+        return DEFAULT_WATERMARKS
+    marks = tuple(sorted(float(p) for p in raw.split(",") if p.strip()))
+    for m in marks:
+        if not 0 < m <= 1:
+            raise ValueError(
+                f"TFIDF_TPU_HBM_WATERMARKS fractions must be in (0, 1], "
+                f"got {m}")
+    return marks or DEFAULT_WATERMARKS
+
+
+class DeviceMonitor:
+    """Samples device memory truth into gauges, events and a signal.
+
+    Args:
+      registry: optional :class:`~tfidf_tpu.obs.registry.
+        MetricsRegistry`; per-device gauges (``hbm_bytes_in_use_d0``,
+        ``hbm_peak_bytes_d0``, ``hbm_bytes_limit_d0``) are created
+        lazily, only for stats keys the backend actually reports —
+        on CPU no gauge ever appears.
+      period_s: background sampling cadence for :meth:`start`; the
+        monitor also works purely on-demand (:meth:`sample`).
+      watermarks: ascending HBM pressure fractions; crossing one
+        upward emits a ``hbm_watermark`` flight event (level
+        ``warning`` for the first rung, ``error`` past it) and arms
+        the degraded health reason until pressure drops back below.
+      stats_fn: test seam — ``stats_fn(device) -> Optional[dict]``
+        replaces ``device.memory_stats()`` (fault injection: a forced
+        low watermark must shed, tests/test_devmon.py).
+    """
+
+    def __init__(self, registry=None, period_s: Optional[float] = None,
+                 watermarks: Optional[Tuple[float, ...]] = None,
+                 stats_fn: Optional[Callable] = None) -> None:
+        if period_s is not None and period_s <= 0:
+            raise ValueError("period_s must be positive (None = manual)")
+        self._registry = registry
+        self.period_s = period_s
+        self.watermarks = tuple(sorted(watermarks if watermarks is not None
+                                       else _env_watermarks()))
+        self._stats_fn = stats_fn
+        self._owners: Dict[str, Callable] = {}
+        self._lock = threading.Lock()
+        self._gauges: Dict[str, object] = {}
+        self._pressure = 0.0            # last sampled max fraction
+        self._peak_bytes = 0            # max peak_bytes_in_use seen
+        self._armed_mark: Optional[float] = None  # highest rung crossed
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._samples = 0
+
+    # --- owners -------------------------------------------------------
+    def register_owner(self, name: str, arrays_fn: Callable) -> None:
+        """Attribute device buffers to a named owner. ``arrays_fn()``
+        returns the owner's live arrays (anything with ``.nbytes``;
+        None entries are skipped). Re-registering a name replaces its
+        callable — the index owner survives a hot swap that way."""
+        with self._lock:
+            self._owners[name] = arrays_fn
+
+    def unregister_owner(self, name: str) -> None:
+        with self._lock:
+            self._owners.pop(name, None)
+
+    # --- sampling -----------------------------------------------------
+    def _device_stats(self, dev):
+        if self._stats_fn is not None:
+            return self._stats_fn(dev)
+        try:
+            return dev.memory_stats()
+        except Exception:   # backends without the API at all
+            return None
+
+    def sample(self) -> dict:
+        """One monitor pass: read every device's memory stats, publish
+        gauges for the keys present, update pressure + watermark state.
+        Returns the snapshot dict (the ``devmon`` op payload). Never
+        raises on missing/partial stats — that IS the CPU path."""
+        import jax
+        devices = []
+        pressure = 0.0
+        for i, dev in enumerate(jax.devices()):
+            stats = self._device_stats(dev) or {}
+            in_use = stats.get("bytes_in_use")
+            peak = stats.get("peak_bytes_in_use")
+            limit = stats.get("bytes_limit")
+            rec = {"device": i, "kind": dev.device_kind,
+                   "platform": dev.platform}
+            if in_use is not None:
+                rec["bytes_in_use"] = int(in_use)
+                self._gauge(f"hbm_bytes_in_use_d{i}",
+                            "live HBM bytes in use").set(int(in_use))
+            if peak is not None:
+                rec["peak_bytes_in_use"] = int(peak)
+                self._peak_bytes = max(self._peak_bytes, int(peak))
+                self._gauge(f"hbm_peak_bytes_d{i}",
+                            "allocator peak HBM bytes").set(int(peak))
+            if limit is not None:
+                rec["bytes_limit"] = int(limit)
+                self._gauge(f"hbm_bytes_limit_d{i}",
+                            "HBM capacity the allocator sees"
+                            ).set(int(limit))
+            if in_use is not None and limit:
+                frac = in_use / limit
+                rec["pressure"] = round(frac, 4)
+                pressure = max(pressure, frac)
+            devices.append(rec)
+        self._pressure = pressure
+        self._samples += 1
+        self._watermark_check(pressure)
+        snap = {"devices": devices,
+                "memory_pressure": round(pressure, 4),
+                "peak_bytes": self._peak_bytes,
+                "samples": self._samples}
+        return snap
+
+    def _gauge(self, name: str, help: str):
+        g = self._gauges.get(name)
+        if g is None:
+            if self._registry is None:
+                class _Null:
+                    def set(self, v):
+                        pass
+                g = _Null()
+            else:
+                g = self._registry.gauge(name, help)
+            self._gauges[name] = g
+        return g
+
+    def _watermark_check(self, pressure: float) -> None:
+        """Edge-triggered watermark events: crossing a rung upward
+        logs once (warning at the first rung, error past it) and
+        remembers the rung; dropping below the lowest crossed rung
+        logs the recovery and disarms."""
+        crossed = [m for m in self.watermarks if pressure >= m]
+        highest = crossed[-1] if crossed else None
+        if highest is not None and highest != self._armed_mark:
+            level = ("warning" if highest == self.watermarks[0]
+                     else "error")
+            obs_log.log_event(
+                level, "hbm_watermark",
+                msg=f"HBM pressure {pressure:.2f} crossed watermark "
+                    f"{highest:.2f}",
+                pressure=round(pressure, 4), watermark=highest)
+            self._armed_mark = highest
+        elif highest is None and self._armed_mark is not None:
+            obs_log.log_event(
+                "info", "hbm_watermark_clear",
+                msg=f"HBM pressure {pressure:.2f} back below "
+                    f"{self.watermarks[0]:.2f}",
+                pressure=round(pressure, 4))
+            self._armed_mark = None
+
+    # --- census -------------------------------------------------------
+    def census(self, top_shapes: int = 8) -> dict:
+        """Live-buffer census: every ``jax.live_arrays()`` buffer
+        grouped by (shape, dtype), with registered owners' bytes
+        attributed by buffer identity and the remainder reported as
+        ``other``. The "where did the HBM go" answer the doctor
+        prints. Owner callables that raise are skipped (a swapped-out
+        retriever must not break the monitor)."""
+        import jax
+        live = jax.live_arrays()
+        total = 0
+        by_shape: Dict[Tuple, int] = {}
+        ids = {}
+        for arr in live:
+            try:
+                nb = int(arr.nbytes)
+                key = (str(arr.dtype), tuple(arr.shape))
+            except Exception:
+                continue
+            total += nb
+            by_shape[key] = by_shape.get(key, 0) + nb
+            ids[id(arr)] = nb
+        with self._lock:
+            owners_fns = list(self._owners.items())
+        owners = {}
+        claimed = 0
+        for name, fn in owners_fns:
+            bytes_ = n = 0
+            try:
+                arrays = fn() or ()
+            except Exception:
+                continue
+            for arr in arrays:
+                if arr is None:
+                    continue
+                try:
+                    nb = int(arr.nbytes)
+                except Exception:
+                    continue
+                n += 1
+                bytes_ += nb
+                if id(arr) in ids:
+                    claimed += ids.pop(id(arr))
+            owners[name] = {"bytes": bytes_, "arrays": n}
+        owners["other"] = {"bytes": max(0, total - claimed),
+                           "arrays": len(ids)}
+        shapes = sorted(by_shape.items(), key=lambda kv: -kv[1])
+        return {
+            "total_bytes": total,
+            "buffers": len(live),
+            "owners": owners,
+            "top_shapes": [
+                {"dtype": d, "shape": list(s), "bytes": b}
+                for (d, s), b in shapes[:top_shapes]],
+        }
+
+    def log_census(self) -> dict:
+        """Take a census and record it as an ``hbm_census`` flight
+        event — how a census reaches the doctor through a dump."""
+        c = self.census()
+        obs_log.log_event(
+            "info", "hbm_census",
+            msg=f"hbm census: {c['total_bytes'] / 1e6:.1f} MB across "
+                f"{c['buffers']} buffers",
+            total_bytes=c["total_bytes"], buffers=c["buffers"],
+            owners=c["owners"], top_shapes=c["top_shapes"])
+        return c
+
+    # --- signals ------------------------------------------------------
+    @property
+    def memory_pressure(self) -> float:
+        """Last sampled max in-use/limit fraction across devices
+        (0.0 when the backend reports no memory stats)."""
+        return self._pressure
+
+    @property
+    def peak_bytes(self) -> int:
+        """Highest allocator peak seen across all samples/devices."""
+        return self._peak_bytes
+
+    def health_signal(self) -> Tuple[float, Optional[str]]:
+        """The :meth:`HealthMonitor.add_signal` hook: (pressure,
+        degraded-reason-or-None). Reason arms past the FIRST watermark
+        — shedding early is the point — and clears as soon as a sample
+        sees pressure back below it."""
+        p = self._pressure
+        if self.watermarks and p >= self.watermarks[0]:
+            return p, (f"memory pressure {p:.2f} >= watermark "
+                       f"{self.watermarks[0]:.2f}")
+        return p, None
+
+    # --- background sampling ------------------------------------------
+    def start(self) -> "DeviceMonitor":
+        """Start the sampling thread (idempotent; needs ``period_s``)."""
+        if self.period_s is None:
+            raise ValueError("DeviceMonitor(period_s=...) required "
+                             "for background sampling")
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+
+        def run():
+            while not self._stop.wait(self.period_s):
+                try:
+                    self.sample()
+                except Exception as e:  # monitor must never kill serve
+                    obs_log.log_event("warning", "devmon_error",
+                                      msg=f"devmon sample failed: {e!r}")
+
+        self._thread = threading.Thread(
+            target=run, daemon=True, name="tfidf-devmon")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+            self._thread = None
+
+
+class CompileWatch:
+    """Counts + fingerprints XLA compilations; flags recompiles after
+    warm-up.
+
+    Two feeds:
+
+    * the process-global ``jax.monitoring`` listener (installed once,
+      lazily, by :func:`set_watch`) reports every real backend compile
+      — count and wall seconds, no identity;
+    * :func:`note_compile` calls from product call sites that KNOW the
+      program identity (``TfidfRetriever.search`` stamps
+      ``program="search_bcoo"`` with the query bucket, k and docs) —
+      the fingerprints an operator needs to see *which* shape leaked
+      into steady state.
+
+    :meth:`mark_warm` draws the line: fingerprinted compiles after it
+    are recorded as recompiles (flight event ``xla_recompile``,
+    counter ``xla_recompiles_after_warm``) and :meth:`health_signal`
+    reports a degraded reason for ``recent_s`` after the last one —
+    windowed, so health recovers once the storm passes.
+    """
+
+    def __init__(self, registry=None, recent_s: float = 30.0) -> None:
+        self.recent_s = recent_s
+        self._lock = threading.Lock()
+        self._compiles = 0
+        self._compile_s = 0.0
+        self._warm = False
+        self._recompiles: List[dict] = []
+        self._last_recompile: Optional[float] = None
+        self._c_total = self._c_seconds = self._c_recompiles = None
+        if registry is not None:
+            self._c_total = registry.counter(
+                "xla_compiles_total", "XLA backend compilations")
+            self._c_seconds = registry.counter(
+                "xla_compile_seconds_total",
+                "wall seconds spent in XLA backend compilation")
+            self._c_recompiles = registry.counter(
+                "xla_recompiles_after_warm",
+                "fingerprinted compilations after mark_warm()")
+
+    # --- feeds ---
+    def on_backend_compile(self, seconds: float) -> None:
+        """The jax.monitoring feed: one real backend compile."""
+        with self._lock:
+            self._compiles += 1
+            self._compile_s += seconds
+        if self._c_total is not None:
+            self._c_total.inc()
+            self._c_seconds.inc(seconds)
+
+    def note(self, program: str, **fingerprint) -> None:
+        """A product call site reports a program it just compiled
+        (shapes/dtype/k/wire/finish — whatever identifies it). Before
+        warm-up this is a debug breadcrumb; after, it is a recompile:
+        flight warning + counter + the degraded-reason window."""
+        fp = {"program": program, **fingerprint}
+        with self._lock:
+            warm = self._warm
+            if warm:
+                self._recompiles.append(fp)
+                self._last_recompile = time.monotonic()
+        if warm:
+            if self._c_recompiles is not None:
+                self._c_recompiles.inc()
+            obs_log.log_event(
+                "warning", "xla_recompile",
+                msg=f"XLA recompile after warm-up: {fp}", **fp)
+        else:
+            obs_log.log_event("debug", "xla_compile", **fp)
+
+    # --- state ---
+    def mark_warm(self) -> None:
+        """Declare warm-up complete: every fingerprinted compile from
+        here on is a steady-state recompile — the thing the serve loop
+        promised would never happen."""
+        with self._lock:
+            self._warm = True
+        obs_log.log_event("info", "compile_warm",
+                          msg=f"compile warm-up complete "
+                              f"({self._compiles} compiles, "
+                              f"{self._compile_s:.2f}s)",
+                          compiles=self._compiles,
+                          compile_s=round(self._compile_s, 3))
+
+    @property
+    def warm(self) -> bool:
+        return self._warm
+
+    @property
+    def compiles(self) -> int:
+        return self._compiles
+
+    @property
+    def recompile_count(self) -> int:
+        """Recompiles noted since :meth:`mark_warm` (len is atomic
+        under the GIL — cheap enough for the serve loop's per-batch
+        delta check)."""
+        return len(self._recompiles)
+
+    @property
+    def compile_seconds(self) -> float:
+        return self._compile_s
+
+    def recompiles_after_warm(self) -> List[dict]:
+        with self._lock:
+            return list(self._recompiles)
+
+    def health_signal(self) -> Tuple[int, Optional[str]]:
+        """(recompile count after warm, degraded-reason-or-None). The
+        reason stays armed for ``recent_s`` after the newest recompile,
+        then decays — a single stray shape degrades the server briefly
+        instead of forever."""
+        with self._lock:
+            n = len(self._recompiles)
+            last = self._last_recompile
+        if last is not None and time.monotonic() - last < self.recent_s:
+            return n, (f"{n} XLA recompile(s) after warm-up "
+                       f"(last {time.monotonic() - last:.1f}s ago)")
+        return n, None
+
+
+# --- module-level seams ----------------------------------------------
+#
+# One global monitor + one global compile watch, tracer-style: product
+# call sites (retrieval.search, the serve batcher) report through
+# these so the disabled path is a global load + None test, and the
+# jax.monitoring listener — which can never be unregistered piecemeal
+# — is installed once and dispatches to whatever watch is current.
+
+_monitor: Optional[DeviceMonitor] = None
+_watch: Optional[CompileWatch] = None
+_listener_installed = False
+_install_lock = threading.Lock()
+
+
+def _ensure_listener() -> None:
+    global _listener_installed
+    with _install_lock:
+        if _listener_installed:
+            return
+        try:
+            import jax.monitoring as jm
+
+            def _on_duration(key: str, seconds: float, **kw) -> None:
+                w = _watch
+                if w is not None and key.endswith(
+                        "backend_compile_duration"):
+                    w.on_backend_compile(seconds)
+
+            jm.register_event_duration_secs_listener(_on_duration)
+            _listener_installed = True
+        except Exception:   # ancient jax: counts stay note()-only
+            _listener_installed = True
+
+
+def set_watch(watch: Optional[CompileWatch]) -> None:
+    """Install (or with None disarm) the process compile watch. The
+    jax.monitoring listener is registered on first install and stays
+    registered (jax offers no piecemeal removal); it forwards to the
+    CURRENT watch only."""
+    global _watch
+    if watch is not None:
+        _ensure_listener()
+    _watch = watch
+
+
+def get_watch() -> Optional[CompileWatch]:
+    return _watch
+
+
+def note_compile(program: str, **fingerprint) -> None:
+    """Product call-site hook: no-op unless a watch is installed."""
+    w = _watch
+    if w is not None:
+        w.note(program, **fingerprint)
+
+
+def set_monitor(monitor: Optional[DeviceMonitor]) -> None:
+    global _monitor
+    _monitor = monitor
+
+
+def get_monitor() -> Optional[DeviceMonitor]:
+    return _monitor
+
+
+def configure(period_ms: Optional[float] = None,
+              registry=None) -> Optional[DeviceMonitor]:
+    """Arm the global device monitor the way ``tracer.configure`` arms
+    tracing: explicit ``period_ms`` wins, else ``TFIDF_TPU_DEVMON``
+    (any non-empty value, with the cadence from
+    ``TFIDF_TPU_DEVMON_PERIOD_MS``, default 500 ms); unset leaves
+    device monitoring OFF and returns None. Idempotent — an armed
+    monitor is kept."""
+    global _monitor
+    if _monitor is not None:
+        return _monitor
+    if period_ms is None:
+        if not os.environ.get("TFIDF_TPU_DEVMON"):
+            return None
+        period_ms = float(os.environ.get("TFIDF_TPU_DEVMON_PERIOD_MS",
+                                         "500"))
+    if period_ms <= 0:
+        return None
+    _monitor = DeviceMonitor(registry=registry,
+                             period_s=period_ms / 1e3).start()
+    return _monitor

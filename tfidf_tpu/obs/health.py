@@ -126,6 +126,7 @@ class HealthMonitor:
         self._snapshot_fn = snapshot_fn
         self._queue_bound = queue_bound
         self._workers: Dict[str, _Worker] = {}
+        self._signals: Dict[str, Callable] = {}
         self._lock = threading.Lock()
         self._eval_lock = threading.Lock()  # healthz op vs watchdog
         self._status = HealthStatus(OK, [], {})
@@ -168,6 +169,23 @@ class HealthMonitor:
             w = self._workers[name]
         w.last_beat = time.monotonic()
         w.beats += 1
+
+    # --- pluggable signals ---
+    def add_signal(self, name: str,
+                   fn: Callable[[], tuple]) -> None:
+        """Register an external degradation signal: ``fn()`` returns
+        ``(value, reason_or_None)``; the value lands in the status
+        checks under ``name`` and a non-None reason marks the server
+        ``degraded`` (never ``unhealthy`` — only a stalled worker is
+        a wedge). How the device monitor's memory pressure and the
+        compile watchdog's recompile window reach admission control
+        without the health core knowing either exists."""
+        with self._lock:
+            self._signals[name] = fn
+
+    def remove_signal(self, name: str) -> None:
+        with self._lock:
+            self._signals.pop(name, None)
 
     # --- evaluation ---
     def evaluate(self, now: Optional[float] = None) -> HealthStatus:
@@ -240,6 +258,19 @@ class HealthMonitor:
                     f"deadline miss rate "
                     f"{self._rates['deadline_miss_rate']:.3f} >= "
                     f"{thr.deadline_miss_rate_degraded}")
+
+        with self._lock:
+            signals = list(self._signals.items())
+        for name, fn in signals:
+            try:
+                value, reason = fn()
+            except Exception:   # a broken signal must not wedge health
+                continue
+            if value is not None:
+                checks[name] = (round(value, 4)
+                                if isinstance(value, float) else value)
+            if reason:
+                reasons.append(reason)
 
         state = UNHEALTHY if stalled else (DEGRADED if reasons else OK)
         status = HealthStatus(state, reasons, checks)

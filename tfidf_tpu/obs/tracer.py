@@ -260,6 +260,17 @@ class Tracer:
             else:
                 ev["s"] = "t"  # instant scope: thread
             if args:
+                # Cost-annotated spans (round 12): a span stamped with
+                # the bytes it moved exports its achieved bandwidth —
+                # bytes/ns IS GB/s — so the Perfetto timeline reads
+                # roofline fractions directly. Degenerate durations
+                # export no gb_s (json.dump would emit bare Infinity,
+                # which is not JSON). The ring's args dict is shared
+                # with the recording thread — copy, never mutate.
+                b = args.get("bytes")
+                if isinstance(b, (int, float)) and dur > 0:
+                    args = dict(args)
+                    args["gb_s"] = round(b / dur, 4)
                 ev["args"] = args
             events.append(ev)
         return events

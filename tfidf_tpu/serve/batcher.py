@@ -38,6 +38,7 @@ from collections import deque
 import numpy as np
 
 from tfidf_tpu import obs
+from tfidf_tpu.obs import devmon as obs_devmon
 
 
 class ServeError(RuntimeError):
@@ -217,6 +218,14 @@ class MicroBatcher:
             obs.end(p.obs, outcome="batched", batch=bid)
             queries.extend(p.queries)
             offsets.append(len(queries))
+        # Recompile attribution (round 12): with a warm CompileWatch
+        # armed, a recompile-count delta across THIS batch's device
+        # call pins the offending batch on the trace timeline — the
+        # flight event (obs/devmon.py) says which program, the
+        # instant says when in the serve loop it struck.
+        watch = obs_devmon.get_watch()
+        pre_rc = (watch.recompile_count
+                  if watch is not None and watch.warm else None)
         with obs.span("batched", batch=bid, queries=len(queries),
                       requests=len(live)):
             try:
@@ -230,6 +239,10 @@ class MicroBatcher:
                 for p in live:
                     p.future.set_exception(e)
                 return
+            if (pre_rc is not None
+                    and watch.recompile_count > pre_rc):
+                obs.instant("recompile_in_batch", batch=bid,
+                            queries=len(queries))
             if self._metrics is not None:
                 self._metrics.observe_batch(len(queries),
                                             _pow2(len(queries)))

@@ -43,6 +43,7 @@ import numpy as np
 from tfidf_tpu import obs
 from tfidf_tpu.config import ServeConfig
 from tfidf_tpu.models.retrieval import TfidfRetriever
+from tfidf_tpu.obs import devmon as obs_devmon
 from tfidf_tpu.obs import log as obs_log
 from tfidf_tpu.obs.health import HealthMonitor, HealthThresholds
 from tfidf_tpu.serve.batcher import (DeadlineExceeded, MicroBatcher,
@@ -96,6 +97,28 @@ class TfidfServer:
             period_s=(self.config.health_period_ms / 1e3
                       if self.config.health_period_ms else 0.25),
             registry=self.metrics.registry)
+        # Device truth (round 12): the compile watchdog ALWAYS watches
+        # — steady-state serving promised zero recompiles after warmup
+        # (round 9's pin), so any recompile past mark_warm() is a
+        # flight event and a windowed degraded reason. The device
+        # monitor runs when configured; its memory-pressure signal
+        # sheds at the admission gate BEFORE the allocator OOMs, the
+        # same feedback loop queue saturation already drives. The
+        # watch is installed as THE process watch (latest server wins
+        # — one serving process runs one server) and uninstalled on
+        # close.
+        self.compile_watch = obs_devmon.CompileWatch(
+            registry=self.metrics.registry)
+        obs_devmon.set_watch(self.compile_watch)
+        self.health.add_signal("xla_recompiles_after_warm",
+                               self.compile_watch.health_signal)
+        self.devmon: Optional[obs_devmon.DeviceMonitor] = None
+        if self.config.devmon_period_ms is not None:
+            self.devmon = obs_devmon.DeviceMonitor(
+                registry=self.metrics.registry,
+                period_s=self.config.devmon_period_ms / 1e3)
+            self.attach_device_monitor(self.devmon)
+            self.devmon.start()
         self._batcher = MicroBatcher(
             self._run_batch, max_batch=self.config.max_batch,
             max_wait_ms=self.config.max_wait_ms, metrics=self.metrics,
@@ -269,6 +292,28 @@ class TfidfServer:
             listener(epoch, retriever)
         return epoch
 
+    def attach_device_monitor(self, monitor) -> None:
+        """Wire a :class:`~tfidf_tpu.obs.devmon.DeviceMonitor` into
+        this server: the resident index registers as a census owner
+        (the registration reads ``self._retriever`` live, so a hot
+        swap re-attributes automatically) and the monitor's memory
+        pressure becomes a degraded health signal — high HBM shrinks
+        the admission bound exactly like queue saturation does."""
+        monitor.register_owner("resident_index", self._index_arrays)
+        self.health.add_signal("memory_pressure", monitor.health_signal)
+
+    def mark_warm(self) -> None:
+        """Declare serve warm-up complete: the compile watchdog flags
+        every later fingerprinted compile as a steady-state recompile
+        (flight event + windowed degraded reason). The serve CLI and
+        tools/serve_bench.py call this after touching every
+        power-of-two query bucket."""
+        self.compile_watch.mark_warm()
+
+    def _index_arrays(self):
+        r = self._retriever
+        return [r._ids, r._weights, r._head, r._idf]
+
     def add_swap_listener(self, fn: Callable) -> None:
         """Register ``fn(epoch, retriever)`` to run synchronously after
         every :meth:`swap_index` — how the canary prober re-captures
@@ -362,6 +407,10 @@ class TfidfServer:
             self._closed = True
         self._batcher.close(drain=drain)
         self.health.stop()
+        if self.devmon is not None:
+            self.devmon.stop()
+        if obs_devmon.get_watch() is self.compile_watch:
+            obs_devmon.set_watch(None)
         obs_log.dump_flight()  # no-op unless a dump path is armed
 
     @property

@@ -24,6 +24,8 @@ import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 from jax import lax  # noqa: E402
 
+from tfidf_tpu.obs.costmodel import (achieved_gbps,  # noqa: E402
+                                     stage_bytes)
 from tfidf_tpu.ops.sparse import sorted_term_counts  # noqa: E402
 
 VOCAB = 1 << 16
@@ -106,8 +108,15 @@ def main() -> None:
             jax.device_get(last.sum())
             best = min(best, time.perf_counter() - t0)
         marginal = max((best - one) / 7, 1e-9)
+        # Model bytes for the DF lowering from the SHARED analytic
+        # model (obs/costmodel.py): the marginal GB/s says how close
+        # each variant runs to the chip's sort roofline.
+        model_b = stage_bytes(d, length)["df_global_sort"]
+        gbps = achieved_gbps(model_b, marginal) or 0.0
         print(f"{name:13s} one-shot {one * 1e3:7.1f} ms  "
-              f"marginal {marginal * 1e3:7.1f} ms", flush=True)
+              f"marginal {marginal * 1e3:7.1f} ms  "
+              f"({gbps:6.1f} GB/s of {model_b / 1e9:.2f} GB model)",
+              flush=True)
 
 
 if __name__ == "__main__":

@@ -34,6 +34,8 @@ import numpy as np  # noqa: E402
 
 from tfidf_tpu.ingest import (_phase_b_cached_packed,  # noqa: E402
                               _phase_b_scan_packed)
+from tfidf_tpu.obs.costmodel import (achieved_gbps,  # noqa: E402
+                                     stage_bytes)
 from tfidf_tpu.ops.scoring import idf_from_df  # noqa: E402
 from tfidf_tpu.ops.sparse import sorted_term_counts  # noqa: E402
 
@@ -101,6 +103,11 @@ def main() -> None:
 
     chunked_s = best_of(chunked_once, None)
     scan_s = best_of(scan_once, fresh_trips)
+    # Model bytes for the timed work — n chunks of score+top-k — from
+    # the SHARED analytic model (obs/costmodel.py): the achieved GB/s
+    # say how far each finish structure sits from the roofline, not
+    # just which one wins.
+    model_bytes = n * stage_bytes(d, length, topk=k)["score_topk"]
     print(json.dumps({
         "backend": jax.default_backend(),
         "chunks": n, "docs_per_chunk": d, "len": length, "topk": k,
@@ -108,6 +115,11 @@ def main() -> None:
         "scan_s": round(scan_s, 4),
         "dispatch_tax_s": round(chunked_s - scan_s, 4),
         "per_dispatch_s": round((chunked_s - scan_s) / max(n - 1, 1), 5),
+        "score_topk_model_gb": round(model_bytes / 1e9, 4),
+        "chunked_gbps": round(achieved_gbps(model_bytes, chunked_s)
+                              or 0.0, 2),
+        "scan_gbps": round(achieved_gbps(model_bytes, scan_s)
+                           or 0.0, 2),
     }))
 
 

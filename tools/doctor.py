@@ -1,0 +1,331 @@
+"""One-shot diagnosis: where every millisecond and megabyte went.
+
+Reads the evidence one run leaves behind — the span trace
+(``--trace`` / ``TFIDF_TPU_TRACE``), the flight-recorder dump
+(``--flight`` / ``<trace>.flight.jsonl``) and the perf ledger
+(``BENCH_LEDGER.jsonl``) — and prints one report:
+
+* **phase attribution** — total seconds per span name (pack vs
+  dispatch vs compute vs fetch vs drain), the wall-clock extent, the
+  serialized sum and the overlap efficiency (how much of the phase
+  wall the double-buffered pipeline hid). Span totals reconcile with
+  ``PhaseTimer`` because the instrumentation records ONE interval for
+  both (tests/test_devmon.py pins the 5% bound);
+* **bandwidth** — per-phase MB moved and achieved GB/s from the
+  byte-stamped spans (``obs/costmodel.py`` arithmetic — the same
+  numbers the Perfetto timeline shows on each span);
+* **HBM** — top owners from the newest ``hbm_census`` flight event
+  and every ``hbm_watermark`` breach;
+* **recompiles** — every ``xla_recompile`` flight event (program
+  fingerprint included) plus ``recompile_in_batch`` trace instants;
+* **ledger** — the trailing BENCH_LEDGER.jsonl records for context.
+
+Budgets make it a CI gate: the doctor exits non-zero when the run
+recompiled after warm-up (``--allow-recompiles``, default 0), crossed
+an HBM watermark (``--allow-watermarks``, default 0) or blew an
+explicit per-phase time budget (``--budget pack=0.5``, repeatable).
+
+Pure stdlib — runnable under ``JAX_PLATFORMS=cpu`` or no jax at all.
+Exit 0 = healthy, 1 = a budget violation, 2 = unreadable input.
+
+Usage::
+
+    python tools/doctor.py TRACE.json [--flight DUMP.jsonl]
+        [--ledger BENCH_LEDGER.jsonl] [--allow-recompiles 0]
+        [--allow-watermarks 0] [--budget PHASE=SECONDS ...] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+import _common  # noqa: E402,F401  repo-root sys.path bootstrap
+
+# Standalone tracer + costmodel loads (no package import -> no jax),
+# the trace_check.py pattern.
+import importlib.util as _ilu  # noqa: E402
+
+
+def _load(mod: str):
+    spec = _ilu.spec_from_file_location(
+        f"_obs_{mod}", os.path.join(_common.REPO, "tfidf_tpu", "obs",
+                                    f"{mod}.py"))
+    m = _ilu.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    return m
+
+
+_tracer = _load("tracer")
+_costmodel = _load("costmodel")
+
+# The ingest pipeline's span vocabulary, grouped by what the time IS:
+# main-lane stalls + dispatches + device waits, worker-lane busy time.
+_MAIN_SPANS = ("pack_wait", "dispatch", "phase_b", "fetch_wait", "fetch")
+_WORKER_SPANS = ("pack", "drain")
+_INGEST_SPANS = _MAIN_SPANS + _WORKER_SPANS
+
+
+def load_flight(path: str) -> Tuple[dict, List[dict], List[dict]]:
+    """Flight dump -> (header, events, digests). Raises ValueError on
+    a malformed file (trace_check validates; the doctor just reads)."""
+    with open(path) as f:
+        lines = [l for l in (ln.strip() for ln in f) if l]
+    if not lines:
+        raise ValueError("flight dump is empty")
+    header = json.loads(lines[0])
+    events, digests = [], []
+    for line in lines[1:]:
+        rec = json.loads(line)
+        (events if rec.get("kind") == "event" else digests).append(rec)
+    return header, events, digests
+
+
+def analyze_trace(path: str) -> dict:
+    """Span totals, wall extent, byte/bandwidth attribution, serve
+    outcome mix — everything the trace alone can say."""
+    events = _tracer.load_chrome_trace(path)
+    xs = [e for e in events if e.get("ph") == "X"]
+    if not xs:
+        raise ValueError("trace contains no complete (ph=X) spans")
+    lanes = _tracer.spans_by_thread(events)
+
+    phases: Dict[str, dict] = {}
+    t_lo = float("inf")
+    t_hi = 0.0
+    for e in xs:
+        name = e["name"]
+        dur_s = e.get("dur", 0.0) / 1e6
+        t_lo = min(t_lo, e["ts"])
+        t_hi = max(t_hi, e["ts"] + e.get("dur", 0.0))
+        rec = phases.setdefault(
+            name, {"spans": 0, "total_s": 0.0, "bytes": 0})
+        rec["spans"] += 1
+        rec["total_s"] += dur_s
+        b = (e.get("args") or {}).get("bytes")
+        if isinstance(b, (int, float)):
+            rec["bytes"] += int(b)
+    for rec in phases.values():
+        gbps = _costmodel.achieved_gbps(rec["bytes"], rec["total_s"])
+        if rec["bytes"] and gbps is not None:
+            rec["gb_s"] = round(gbps, 3)
+        rec["total_s"] = round(rec["total_s"], 6)
+
+    wall_s = max(0.0, (t_hi - t_lo) / 1e6)
+    out: dict = {"phases": phases, "wall_s": round(wall_s, 6),
+                 "lanes": sorted(lanes)}
+
+    ingest_sum = sum(phases[n]["total_s"] for n in _INGEST_SPANS
+                     if n in phases)
+    if ingest_sum > 0:
+        out["serialized_sum_s"] = round(ingest_sum, 6)
+        # The bench's overlap formula: how much of the summed phase
+        # wall the pipelining hid. A fully serial run scores ~0.
+        out["overlap_efficiency"] = round(
+            max(0.0, 1.0 - wall_s / ingest_sum), 3)
+
+    requests = phases.get("request")
+    if requests:
+        from collections import Counter
+        outcomes = Counter(
+            (e.get("args") or {}).get("outcome")
+            for e in xs if e["name"] == "request")
+        out["serve"] = {
+            "requests": requests["spans"],
+            "outcomes": dict(outcomes),
+            "batches": phases.get("batched", {}).get("spans", 0),
+        }
+    out["recompile_instants"] = sum(
+        1 for e in events
+        if e.get("ph") == "i" and e.get("name") == "recompile_in_batch")
+    return out
+
+
+def analyze_flight(path: str) -> dict:
+    header, events, digests = load_flight(path)
+    recompiles = [e for e in events if e.get("event") == "xla_recompile"]
+    watermarks = [e for e in events if e.get("event") == "hbm_watermark"]
+    censuses = [e for e in events if e.get("event") == "hbm_census"]
+    out = {
+        "events": len(events),
+        "digests": len(digests),
+        "suppressed": header.get("suppressed", {}),
+        "recompiles": [
+            {k: v for k, v in e.items()
+             if k not in ("t", "kind", "level", "msg")}
+            for e in recompiles],
+        "watermarks": [
+            {"level": e.get("level"), "pressure": e.get("pressure"),
+             "watermark": e.get("watermark")} for e in watermarks],
+    }
+    if censuses:
+        latest = censuses[-1]
+        owners = latest.get("owners") or {}
+        out["hbm_owners"] = dict(sorted(
+            owners.items(),
+            key=lambda kv: -(kv[1] or {}).get("bytes", 0)))
+        out["hbm_total_bytes"] = latest.get("total_bytes")
+    if digests:
+        from collections import Counter
+        out["digest_outcomes"] = dict(Counter(
+            d.get("outcome") for d in digests))
+    return out
+
+
+def tail_ledger(path: str, n: int = 5) -> List[dict]:
+    if not os.path.exists(path):
+        return []
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    return records[-n:]
+
+
+def diagnose(trace: str, flight: Optional[str], ledger: str,
+             allow_recompiles: int = 0, allow_watermarks: int = 0,
+             budgets: Optional[Dict[str, float]] = None) -> dict:
+    report: dict = {"trace": trace}
+    report.update(analyze_trace(trace))
+    recompile_count = report["recompile_instants"]
+    watermark_count = 0
+    if flight and os.path.exists(flight):
+        report["flight"] = analyze_flight(flight)
+        recompile_count = max(recompile_count,
+                              len(report["flight"]["recompiles"]))
+        watermark_count = len(report["flight"]["watermarks"])
+    report["ledger_tail"] = tail_ledger(ledger)
+
+    violations: List[str] = []
+    if recompile_count > allow_recompiles:
+        violations.append(
+            f"{recompile_count} XLA recompile(s) after warm-up "
+            f"(allowed {allow_recompiles})")
+    if watermark_count > allow_watermarks:
+        violations.append(
+            f"{watermark_count} HBM watermark breach(es) "
+            f"(allowed {allow_watermarks})")
+    for name, budget in (budgets or {}).items():
+        got = report["phases"].get(name, {}).get("total_s", 0.0)
+        if got > budget:
+            violations.append(
+                f"phase {name!r} spent {got:.3f}s > budget {budget}s")
+    report["violations"] = violations
+    report["ok"] = not violations
+    return report
+
+
+def render(report: dict) -> str:
+    lines = [f"doctor: {report['trace']}"]
+    lines.append(f"  lanes: {report['lanes']}   wall "
+                 f"{report['wall_s'] * 1e3:.1f} ms")
+    lines.append(f"  {'phase':<12}{'spans':>6}{'total ms':>10}"
+                 f"{'% wall':>8}{'MB':>10}{'GB/s':>8}")
+    wall = report["wall_s"] or 1e-12
+    for name, rec in sorted(report["phases"].items(),
+                            key=lambda kv: -kv[1]["total_s"]):
+        mb = rec["bytes"] / 1e6 if rec["bytes"] else None
+        lines.append(
+            f"  {name:<12}{rec['spans']:>6}"
+            f"{rec['total_s'] * 1e3:>10.1f}"
+            f"{rec['total_s'] / wall * 100:>7.0f}%"
+            + (f"{mb:>10.2f}" if mb is not None else f"{'-':>10}")
+            + (f"{rec['gb_s']:>8.2f}" if "gb_s" in rec else f"{'-':>8}"))
+    if "serialized_sum_s" in report:
+        lines.append(
+            f"  serialized sum {report['serialized_sum_s'] * 1e3:.1f} ms"
+            f" -> overlap efficiency {report['overlap_efficiency']:.1%}")
+    if "serve" in report:
+        sv = report["serve"]
+        lines.append(f"  serve: {sv['requests']} requests in "
+                     f"{sv['batches']} batches, outcomes "
+                     f"{sv['outcomes']}")
+    fl = report.get("flight")
+    if fl:
+        lines.append(f"  flight: {fl['events']} events, "
+                     f"{fl['digests']} digests"
+                     + (f", suppressed {fl['suppressed']}"
+                        if fl["suppressed"] else ""))
+        if "hbm_owners" in fl:
+            owners = ", ".join(
+                f"{name} {info.get('bytes', 0) / 1e6:.1f} MB"
+                for name, info in list(fl["hbm_owners"].items())[:5])
+            lines.append(f"  hbm owners: {owners}")
+        for w in fl["watermarks"]:
+            lines.append(f"  HBM WATERMARK [{w['level']}]: pressure "
+                         f"{w['pressure']} >= {w['watermark']}")
+        for r in fl["recompiles"]:
+            lines.append(f"  RECOMPILE after warm-up: {r}")
+    if report["ledger_tail"]:
+        last = report["ledger_tail"][-1]
+        lines.append(f"  ledger: {len(report['ledger_tail'])} trailing "
+                     f"record(s); newest {last.get('source')} "
+                     f"[{last.get('kind')}]")
+    for v in report["violations"]:
+        lines.append(f"FAIL: {v}")
+    lines.append("healthy" if report["ok"]
+                 else "unhealthy: budget violation(s) above")
+    return "\n".join(lines)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.split("\n")[0],
+        epilog="exit 0 = healthy, 1 = budget violation, 2 = unreadable")
+    ap.add_argument("trace", help="Chrome trace-event JSON "
+                                  "(--trace / TFIDF_TPU_TRACE output)")
+    ap.add_argument("--flight", metavar="DUMP.jsonl", default=None,
+                    help="flight-recorder dump (default: "
+                         "<trace>.flight.jsonl when it exists)")
+    ap.add_argument("--ledger",
+                    default=os.path.join(_common.REPO,
+                                         "BENCH_LEDGER.jsonl"))
+    ap.add_argument("--allow-recompiles", type=int, default=0,
+                    help="XLA recompiles after warm-up tolerated "
+                         "before exit 1 (default 0)")
+    ap.add_argument("--allow-watermarks", type=int, default=0,
+                    help="HBM watermark breaches tolerated (default 0)")
+    ap.add_argument("--budget", action="append", default=[],
+                    metavar="PHASE=SECONDS",
+                    help="per-phase wall budget, repeatable "
+                         "(e.g. --budget pack=0.5)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the machine-readable report")
+    args = ap.parse_args()
+
+    budgets = {}
+    for spec in args.budget:
+        name, _, val = spec.partition("=")
+        try:
+            budgets[name] = float(val)
+        except ValueError:
+            print(f"doctor: bad --budget {spec!r} (want PHASE=SECONDS)",
+                  file=sys.stderr)
+            return 2
+    flight = args.flight
+    if flight is None:
+        candidate = f"{args.trace}.flight.jsonl"
+        flight = candidate if os.path.exists(candidate) else None
+
+    try:
+        report = diagnose(args.trace, flight, args.ledger,
+                          allow_recompiles=args.allow_recompiles,
+                          allow_watermarks=args.allow_watermarks,
+                          budgets=budgets)
+    except (OSError, ValueError, KeyError) as e:
+        print(f"doctor: cannot read inputs: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(report, sort_keys=True))
+    else:
+        print(render(report))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

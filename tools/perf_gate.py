@@ -60,6 +60,13 @@ _GATES = {
         "pack_s": ("lower", 0.40),
         "link_tax_s": ("lower", 0.40),
         "recall_at_k": ("higher", 0.02),
+        # Round 12: memory/compile regressions gate like latency ones.
+        # Peak HBM at a fixed corpus shape is allocator-deterministic
+        # to within fragmentation noise (~10%); compile counts should
+        # be exactly reproducible, but the persistent cache can elide
+        # a few, so allow a small band rather than absolute zero.
+        "peak_hbm_bytes": ("lower", 0.10),
+        "xla_compiles": ("lower", 0.15),
     },
     "serve_bench": {
         "throughput_qps": ("higher", 0.30),
@@ -68,11 +75,20 @@ _GATES = {
         "p99_ms": ("lower", 0.60),
         "cache_hit_rate": ("higher", 0.10),
         "recompiles_after_warmup": ("lower", 0.0),
+        "peak_hbm_bytes": ("lower", 0.10),
+        "xla_compiles": ("lower", 0.15),
+    },
+    # The mesh dryrun verdict: ok must STAY 1 (zero-tolerance, the
+    # absolute zero-baseline rule below never fires because ok is the
+    # higher-is-better direction with a nonzero baseline).
+    "multichip": {
+        "ok": ("higher", 0.0),
     },
 }
 # Context keys that must MATCH for two records to be comparable.
 _MATCH_KEYS = {"bench": ("backend", "n_docs"),
-               "serve_bench": ("backend", "docs", "k", "max_batch")}
+               "serve_bench": ("backend", "docs", "k", "max_batch"),
+               "multichip": ("n_devices",)}
 
 
 def comparable(rec: dict, cand: dict) -> bool:

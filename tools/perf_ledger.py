@@ -16,8 +16,11 @@ Record shape (``schema: 1``)::
      "context": {"backend": ..., "n_docs": ..., ...},
      "metrics": {"docs_per_sec": ..., "vs_baseline": ..., ...}}
 
-``kind`` is detected from the artifact itself; wrapped driver
-artifacts (``{"n", "cmd", "rc", "tail", "parsed"}``) unwrap to their
+``kind`` is detected from the artifact itself (``bench``,
+``serve_bench``, or ``multichip`` for the MULTICHIP_r0X dryrun
+verdicts — ``ok`` gated as a 0/1 metric, ``n_devices`` as
+comparability context); wrapped driver artifacts
+(``{"n", "cmd", "rc", "tail", "parsed"}``) unwrap to their
 ``parsed`` payload, so both the raw ``bench.py`` stdout JSON and the
 archived round files append identically. Artifacts that carry no
 parsed metrics (a failed run, e.g. ``BENCH_r01.json``'s rc=1 crash)
@@ -58,6 +61,8 @@ _BENCH_METRICS = {
     "tpu_s": "tpu_s",
     "cpu_s": "cpu_s",
     "recall_at_k": "recall_at_k",
+    "peak_hbm_bytes": "peak_hbm_bytes",
+    "xla_compiles": "xla_compiles",
 }
 _SERVE_METRICS = {
     "throughput_qps": "throughput_qps",
@@ -69,7 +74,14 @@ _SERVE_METRICS = {
     "cache_hit_rate": "cache.hit_rate",
     "shed_rate": "shed.rate",
     "recompiles_after_warmup": "recompiles_after_warmup",
+    "peak_hbm_bytes": "peak_hbm_bytes",
+    "xla_compiles": "xla_compiles",
 }
+# Multi-chip dryrun artifacts (MULTICHIP_r0X.json): a driver wrapper
+# with no parsed payload — just the mesh smoke's verdict. "ok" is the
+# gated metric (1 must stay 1); n_devices is comparability context.
+_MULTICHIP_METRICS = {"ok": "ok", "n_devices": "n_devices"}
+_MULTICHIP_CONTEXT = {"n_devices": "n_devices"}
 _BENCH_CONTEXT = {"backend": "backend", "n_docs": "n_docs",
                   "engine": "engine", "ingest_path": "ingest_path",
                   "repeats": "repeats"}
@@ -103,6 +115,8 @@ def classify(payload: dict) -> Optional[str]:
         return "serve_bench"
     if payload.get("unit") == "docs/sec" or "vs_baseline" in payload:
         return "bench"
+    if "n_devices" in payload and "ok" in payload:
+        return "multichip"
     return None
 
 
@@ -120,11 +134,14 @@ def normalize(path: str) -> Tuple[Optional[dict], Optional[str]]:
     kind = classify(payload)
     if kind is None:
         return None, "unrecognized artifact shape (not bench/serve)"
-    metric_paths = (_SERVE_METRICS if kind == "serve_bench"
-                    else _BENCH_METRICS)
-    ctx_paths = (_SERVE_CONTEXT if kind == "serve_bench"
-                 else _BENCH_CONTEXT)
-    metrics = {name: v for name, p in metric_paths.items()
+    metric_paths = {"serve_bench": _SERVE_METRICS,
+                    "bench": _BENCH_METRICS,
+                    "multichip": _MULTICHIP_METRICS}[kind]
+    ctx_paths = {"serve_bench": _SERVE_CONTEXT,
+                 "bench": _BENCH_CONTEXT,
+                 "multichip": _MULTICHIP_CONTEXT}[kind]
+    metrics = {name: (int(v) if isinstance(v, bool) else v)
+               for name, p in metric_paths.items()
                if (v := _dig(payload, p)) is not None}
     if not metrics:
         return None, "artifact carries none of the known metrics"
@@ -206,6 +223,8 @@ def append(paths: List[str], ledger_path: str,
 def backfill_paths() -> List[str]:
     """The repo's archived round artifacts, oldest first."""
     return (sorted(glob.glob(os.path.join(_common.REPO, "BENCH_r*.json")))
+            + sorted(glob.glob(os.path.join(_common.REPO,
+                                            "MULTICHIP_r*.json")))
             + sorted(glob.glob(os.path.join(_common.REPO,
                                             "SERVE_r*.json"))))
 

@@ -42,12 +42,15 @@ import numpy as np  # noqa: E402
 from tfidf_tpu.config import PipelineConfig, VocabMode  # noqa: E402
 from tfidf_tpu.ingest import (_chunk_step, _finish_wire,  # noqa: E402
                               _resident_df_mode, flatten_aligned)
+# The analytic bytes model lives in obs/costmodel.py since round 12 —
+# the tracer and tools/doctor.py quote the same arithmetic.
+from tfidf_tpu.obs.costmodel import (HBM_PEAK_GBS_DEFAULT,  # noqa: E402
+                                     bytes_model, hbm_peak_gbs)
 from tfidf_tpu.ops.sparse import (sorted_term_counts, sparse_df,  # noqa: E402
                                   sparse_forward)
 
 VOCAB = 1 << 16
 TOPK = 16
-HBM_PEAK_GBS = 819.0  # v5e: 819 GB/s HBM2 per chip (public spec)
 
 
 def fence(x):
@@ -265,23 +268,10 @@ def main() -> None:
         res["forward_marginal_s"] = max(
             (best - res["forward_s"]) / (n_pipe - 1), 1e-9)
 
-    # -- analytic bytes model ---------------------------------------------
-    n = d * length
-    lg = int(np.ceil(np.log2(length)))
-    lgn = int(np.ceil(np.log2(n)))
-    bytes_row_sort = n * 4 * 2 * (lg * (lg + 1) // 2)
-    bytes_rle = n * 4 * 6          # prev/head/cummin/counts passes
-    bytes_df_sort = n * 4 * 2 * (lgn * (lgn + 1) // 2)
-    bytes_score_topk = n * 4 * 4 + d * TOPK * 8
-    model = {
-        "row_sort_gb": bytes_row_sort / 1e9,
-        "rle_gb": bytes_rle / 1e9,
-        "df_global_sort_gb": bytes_df_sort / 1e9,
-        "score_topk_gb": bytes_score_topk / 1e9,
-    }
-    total_gb = sum(model.values())
-    model["total_gb"] = total_gb
-    model["hbm_bound_s"] = total_gb / HBM_PEAK_GBS
+    # -- analytic bytes model (obs/costmodel.py, shared) -------------------
+    hbm_gbs = (hbm_peak_gbs(jax.devices()[0].device_kind)
+               or HBM_PEAK_GBS_DEFAULT)
+    model = bytes_model(d, length, topk=TOPK, hbm_gbs=hbm_gbs)
     res["bytes_model"] = {k2: round(v, 4) for k2, v in model.items()}
 
     # -- report ------------------------------------------------------------
@@ -315,9 +305,12 @@ def main() -> None:
     if "prod_c4_with_fetch_s" in res:
         row("prod x4 + wire fetch", res["prod_c4_with_fetch_s"])
     print(f"\nbytes model: {json.dumps(res['bytes_model'])}")
-    print(f"HBM-bound floor at {HBM_PEAK_GBS:.0f} GB/s: "
-          f"{res['bytes_model']['hbm_bound_s'] * 1e3:.1f} ms "
-          f"({tokens / res['bytes_model']['hbm_bound_s'] / 1e6:.0f} Mtok/s)")
+    # the UNROUNDED floor: the artifact value rounds to 4 dp, which a
+    # toy shape's microsecond-scale floor rounds to zero
+    bound_s = model["hbm_bound_s"]
+    print(f"HBM-bound floor at {hbm_gbs:.0f} GB/s: "
+          f"{bound_s * 1e3:.1f} ms "
+          f"({tokens / bound_s / 1e6:.0f} Mtok/s)")
     print(json.dumps({k2: (round(v, 5) if isinstance(v, float) else v)
                       for k2, v in res.items()}), file=sys.stderr)
 

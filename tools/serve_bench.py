@@ -137,6 +137,15 @@ def main() -> int:
         for nb in sorted(buckets):
             retriever.search([draw() for _ in range(nb)], k=args.k)
         compiles_warm = _search_bcoo._cache_size()
+        # Round 12: the LIVE recompile signal draws the same warm line
+        # — any fingerprinted compile past here is a flight event and
+        # a degraded health reason, not just a post-hoc count.
+        server.mark_warm()
+        # Device-truth receipts for the artifact: peak HBM from the
+        # monitor (absent on CPU — memory_stats() is None there) and
+        # total XLA compiles from the watch.
+        devmon = obs.DeviceMonitor(registry=server.metrics.registry)
+        devmon.sample()
 
         shed = [0]
         lock = threading.Lock()
@@ -178,6 +187,8 @@ def main() -> int:
             for th in workers:
                 th.join()
         wall = time.perf_counter() - t0
+        devmon.sample()
+        watch = server.compile_watch
         server.close(drain=True)
         recompiles = _search_bcoo._cache_size() - compiles_warm
 
@@ -207,7 +218,11 @@ def main() -> int:
             "queue_peak": snap["queue"]["peak"],
             "index_s": round(index_s, 3),
             "recompiles_after_warmup": recompiles,
+            "xla_compiles": watch.compiles,
         }
+        if devmon.peak_bytes:   # backends without memory stats omit
+            artifact["peak_hbm_bytes"] = devmon.peak_bytes
+            artifact["memory_pressure"] = devmon.memory_pressure
         trace_path = obs.export()
         if trace_path:
             artifact["trace_path"] = trace_path

@@ -11,7 +11,9 @@ schema (always):
   * every lane used by a span carries ``thread_name`` metadata;
   * at least ``--min-threads`` distinct lanes recorded spans (the
     overlap machinery IS threads — a single-lane trace means the
-    instrumentation or the workers are broken).
+    instrumentation or the workers are broken);
+  * cost-annotated spans (round 12) are sane: a ``bytes`` stamp is a
+    non-negative number and the exported ``gb_s`` is finite.
 
 ingest traces (auto-detected by ``pack`` spans):
   * pack spans live on a non-main lane, dispatch/phase_b on main;
@@ -25,6 +27,8 @@ ingest traces (auto-detected by ``pack`` spans):
     chunk's ``phase_b`` — the async drain actually hid behind
     scoring. (The scanned finish emits ONE drain; the check is then
     vacuous and says so.)
+  * every ``dispatch`` / ``fetch`` / ``drain`` span carries its
+    ``bytes`` stamp — the cost attribution tools/doctor.py reads.
 
 serve traces (auto-detected by ``request`` spans):
   * every ``request`` span carries an ``outcome`` in the known set —
@@ -100,6 +104,22 @@ def check_trace(path: str, mode: str = "auto",
                 or e["dur"] < 0:
             errors.append(f"span with bad ts/dur: {e!r}")
             break
+    # Cost-annotated spans (round 12): any span carrying a byte stamp
+    # must carry a sane one, and the exported gb_s — computed by the
+    # tracer from bytes/dur — must be a finite number (a bare
+    # Infinity would not even be JSON; a negative byte count is an
+    # instrumentation bug).
+    for e in xs:
+        a = e.get("args") or {}
+        if "bytes" in a and (not isinstance(a["bytes"], (int, float))
+                             or a["bytes"] < 0):
+            errors.append(f"span with bad bytes stamp: {e!r}")
+            break
+        if "gb_s" in a and (not isinstance(a["gb_s"], (int, float))
+                            or a["gb_s"] != a["gb_s"]
+                            or a["gb_s"] < 0):
+            errors.append(f"span with non-finite gb_s: {e!r}")
+            break
     lanes = spans_by_thread(events)
     named = {(e.get("pid"), e.get("tid"))
              for e in events
@@ -147,6 +167,18 @@ def _check_ingest(lanes, by_name, notes) -> List[str]:
                       "(worker thread not labeled / pack on main?)")
     if not main_disp:
         errors.append("no dispatch/phase_b spans on the 'main' lane")
+    # Round 12 cost contract: the wire-moving spans carry their byte
+    # stamps (obs/costmodel.py turns them into per-span GB/s at
+    # export) — a dispatch/fetch/drain span without one regressed the
+    # instrumentation.
+    for name in ("dispatch", "fetch", "drain"):
+        for e in by_name.get(name, []):
+            if not isinstance((e.get("args") or {}).get("bytes"),
+                              (int, float)):
+                errors.append(
+                    f"{name} span without a bytes stamp (cost "
+                    f"attribution regressed): {e.get('args')!r}")
+                break
 
     # Overlap checks arm only when some span carries chunk >= 1: a
     # trace may hold SEVERAL sequential single-chunk runs (bench
