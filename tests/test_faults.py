@@ -1,0 +1,737 @@
+"""Serving that survives (ISSUE 8): fault-injection seams, supervised
+retry/restart, circuit breaking, poison-batch quarantine.
+
+The acceptance pins: a deterministic fault plan armed at the named
+seams makes the REAL recovery paths run — transient dispatch faults
+are absorbed by retry (responses stay bit-identical), a poison query
+is isolated by bisection (its future fails typed, innocent co-batched
+queries are served, resubmission 4xxes at the gate), the breaker
+trips into degraded admission and recovers, crashed workers restart
+inside their budget, and a swap racing close either completes or
+raises the typed ServerClosed — never deadlocks. The ad-hoc
+injections earlier rounds scattered across tests (monkeypatched
+search fns, fake never-beating workers) have a single registry-driven
+mechanism here that exercises the production seams themselves.
+"""
+
+import importlib.util
+import os
+import random
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tfidf_tpu import faults, obs
+from tfidf_tpu.config import PipelineConfig, ServeConfig, VocabMode
+from tfidf_tpu.io.corpus import Corpus
+from tfidf_tpu.models import TfidfRetriever
+from tfidf_tpu.obs.health import DEGRADED, OK, UNHEALTHY, set_monitor
+from tfidf_tpu.obs.log import EventLog
+from tfidf_tpu.serve import (CircuitBreaker, PoisonQuery, QuarantineList,
+                             RetryPolicy, ServeError, ServerClosed,
+                             SupervisedDispatch, TfidfServer)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CFG = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=512,
+                     max_doc_len=16, doc_chunk=16)
+CORPUS = Corpus(
+    names=["doc1", "doc2", "doc3", "doc4", "doc5"],
+    docs=[b"apple banana apple cherry",
+          b"banana banana date",
+          b"cherry date elder fig",
+          b"apple fig fig fig",
+          b"grape grape grape grape"])
+QUERIES = ["apple cherry", "banana date", "grape", "fig elder"]
+
+
+@pytest.fixture(scope="module")
+def retriever():
+    return TfidfRetriever(CFG).index(CORPUS)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults_and_obs():
+    """Every test runs with a private event log, no armed plan and no
+    global health monitor — and leaks none of them."""
+    obs.set_log(EventLog(echo="off"))
+    faults.disarm()
+    set_monitor(None)
+    yield
+    faults.disarm()
+    set_monitor(None)
+    obs.set_log(None)
+
+
+def quick_cfg(**kw):
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("max_wait_ms", 5)
+    kw.setdefault("queue_depth", 64)
+    kw.setdefault("cache_entries", 64)
+    kw.setdefault("retry_backoff_ms", 1.0)
+    return ServeConfig(**kw)
+
+
+# ---------------------------------------------------------------------
+class TestFaultPlan:
+    def test_parse_grammar(self):
+        plan = faults.FaultPlan.parse(
+            "device_dispatch:transient:n=3;"
+            "pack_worker:fatal:at=2;"
+            "batcher_loop:sleep:s=0.25;"
+            "swap:transient:p=0.5;"
+            "device_dispatch:fatal:match=zzz", seed=7)
+        assert len(plan.rules) == 5
+        r = plan.rules_for("device_dispatch")
+        assert r[0].kind == "transient" and r[0].n == 3
+        assert r[1].match == "zzz" and r[1].n == -1  # poison: unlimited
+        assert plan.rules_for("pack_worker")[0].at == 2
+        assert plan.rules_for("batcher_loop")[0].sleep_s == 0.25
+        assert plan.rules_for("swap")[0].p == 0.5
+
+    def test_parse_rejects_garbage(self):
+        for bad in ("nota_seam:transient", "swap:nota_kind",
+                    "swap", "swap:fatal:bogus=1", "swap:fatal:n",
+                    "", "swap:transient:p=2.0"):
+            with pytest.raises(ValueError):
+                faults.FaultPlan.parse(bad)
+
+    def test_probabilistic_rules_replay_with_seed(self):
+        def fires(seed):
+            plan = faults.FaultPlan.parse("swap:transient:p=0.5:n=-1",
+                                          seed=seed)
+            reg = faults.FaultRegistry().arm(plan)
+            out = []
+            for _ in range(64):
+                try:
+                    reg.fire("swap")
+                    out.append(0)
+                except faults.TransientFault:
+                    out.append(1)
+            return out
+
+        assert fires(3) == fires(3)          # replayable
+        assert fires(3) != fires(4)          # and seed-sensitive
+        assert 0 < sum(fires(3)) < 64        # actually probabilistic
+
+
+class TestFaultRegistry:
+    def test_disarmed_fire_is_noop(self):
+        faults.fire("device_dispatch", text="anything")
+        assert not faults.get_registry().armed
+
+    def test_typed_faults_and_counts(self):
+        faults.arm(faults.FaultPlan.parse(
+            "device_dispatch:transient:n=2;swap:fatal:n=1"))
+        with pytest.raises(faults.TransientFault) as ei:
+            faults.fire("device_dispatch")
+        assert ei.value.seam == "device_dispatch"
+        with pytest.raises(faults.TransientFault):
+            faults.fire("device_dispatch")
+        faults.fire("device_dispatch")       # budget n=2 spent
+        with pytest.raises(faults.FatalFault):
+            faults.fire("swap")
+        snap = faults.get_registry().snapshot()
+        assert snap["device_dispatch:transient:n=2"]["fired"] == 2
+        assert snap["swap:fatal:n=1"]["fired"] == 1
+
+    def test_match_rule_selects_poison_text(self):
+        faults.arm(faults.FaultPlan.parse(
+            "device_dispatch:fatal:match=zzpoison"))
+        faults.fire("device_dispatch", text="clean queries only")
+        with pytest.raises(faults.FatalFault):
+            faults.fire("device_dispatch", text="a zzpoison b")
+        # poison stays poison (unlimited fires)
+        with pytest.raises(faults.FatalFault):
+            faults.fire("device_dispatch", text="zzpoison again")
+
+    def test_at_delays_first_fire(self):
+        faults.arm(faults.FaultPlan.parse("drain:transient:at=3"))
+        faults.fire("drain")
+        faults.fire("drain")
+        with pytest.raises(faults.TransientFault):
+            faults.fire("drain")
+
+    def test_firing_logs_flight_event(self):
+        log = EventLog(echo="off")
+        obs.set_log(log)
+        faults.arm(faults.FaultPlan.parse("swap:transient:n=1"))
+        with pytest.raises(faults.TransientFault):
+            faults.fire("swap")
+        evs = [e for e in log.events() if e["event"] == "fault_injected"]
+        assert evs and evs[0]["seam"] == "swap"
+
+    def test_configure_reads_env(self, monkeypatch):
+        monkeypatch.setenv("TFIDF_TPU_FAULTS", "swap:fatal:n=1")
+        monkeypatch.setenv("TFIDF_TPU_FAULT_SEED", "9")
+        plan = faults.configure()
+        assert plan is not None and plan.seed == 9
+        assert faults.get_registry().armed
+
+
+# ---------------------------------------------------------------------
+class TestCircuitBreaker:
+    def test_trips_after_threshold_and_recovers(self):
+        from tfidf_tpu.obs.registry import MetricsRegistry
+        reg = MetricsRegistry()
+        b = CircuitBreaker(threshold=3, cooldown_s=0.05, registry=reg)
+        assert b.state == "closed"
+        b.record_failure()
+        b.record_failure()
+        assert b.state == "closed"
+        assert b.record_failure()            # the tripping failure
+        assert b.state == "open"
+        assert reg.snapshot()["serve_breaker_trips_total"] == 1
+        assert reg.snapshot()["serve_breaker_open"]["value"] == 1
+        value, reason = b.health_signal()
+        assert value == "open" and "breaker" in reason
+        time.sleep(0.06)
+        assert b.state == "half_open"        # cooldown elapsed: trial
+        b.record_success()
+        assert b.state == "closed"
+        assert b.health_signal()[1] is None
+        assert reg.snapshot()["serve_breaker_open"]["value"] == 0
+
+    def test_halfopen_failure_reopens(self):
+        b = CircuitBreaker(threshold=1, cooldown_s=0.05)
+        b.record_failure()
+        time.sleep(0.06)
+        assert b.state == "half_open"
+        b.record_failure()                   # trial failed
+        assert b.state == "open"
+        assert b.cooldown_remaining() > 0
+
+
+class TestQuarantineList:
+    def test_add_contains_cap(self):
+        from tfidf_tpu.obs.registry import MetricsRegistry
+        reg = MetricsRegistry()
+        q = QuarantineList(cap=2, registry=reg)
+        assert q.add("a") and not q.add("a")   # dedup
+        q.add("b")
+        q.add("c")                             # evicts oldest (a)
+        assert len(q) == 2
+        assert not q.contains("a") and q.contains("c")
+        assert reg.snapshot()["serve_quarantined_total"] == 3
+        assert reg.snapshot()["serve_quarantine_size"]["value"] == 2
+        q.clear()
+        assert len(q) == 0
+
+
+# ---------------------------------------------------------------------
+def _fake_rows(q):
+    """Deterministic per-query result row for the fake dispatcher."""
+    h = sum(q.encode()) % 251
+    return (np.array([h, h + 1], np.float32),
+            np.array([h % 5, (h + 1) % 5], np.int64))
+
+
+def _fake_dispatch(poison):
+    calls = []
+
+    def fn(queries, k, group):
+        calls.append(list(queries))
+        if any(q in poison for q in queries):
+            raise RuntimeError("kernel rejected poison")
+        vals = np.stack([_fake_rows(q)[0] for q in queries])
+        ids = np.stack([_fake_rows(q)[1] for q in queries])
+        return vals, ids
+
+    fn.calls = calls
+    return fn
+
+
+class TestSupervisedDispatch:
+    def test_transient_absorbed_within_budget(self):
+        fn = _fake_dispatch(set())
+        d = SupervisedDispatch(fn, RetryPolicy(max_attempts=3,
+                                               backoff_ms=1))
+        faults.arm(faults.FaultPlan.parse(
+            "device_dispatch:transient:n=2"))
+        vals, ids, poison = d.run_batch(["a", "b"], 2, None)
+        assert poison == []
+        np.testing.assert_array_equal(vals[0], _fake_rows("a")[0])
+        assert len(fn.calls) == 1            # faults fired pre-dispatch
+
+    def test_transient_past_budget_fails_batch_not_poison(self):
+        fn = _fake_dispatch(set())
+        d = SupervisedDispatch(fn, RetryPolicy(max_attempts=2,
+                                               backoff_ms=1))
+        faults.arm(faults.FaultPlan.parse(
+            "device_dispatch:transient:n=10"))
+        with pytest.raises(faults.TransientFault):
+            d.run_batch(["a", "b"], 2, None)
+
+    def test_bisection_isolates_exactly_the_poison(self):
+        """Property: for random batches and random poison subsets, the
+        bisection isolates EXACTLY the poison queries and returns the
+        bit-identical rows a clean dispatch would give the rest."""
+        rng = random.Random(1234)
+        for trial in range(40):
+            n = rng.randint(1, 12)
+            queries = [f"q{trial}_{i}" for i in range(n)]
+            n_poison = rng.randint(1, n)
+            poison_set = set(rng.sample(queries, n_poison))
+            d = SupervisedDispatch(_fake_dispatch(poison_set),
+                                   RetryPolicy(max_attempts=1))
+            vals, ids, poison = d.run_batch(queries, 2, None)
+            want = sorted(i for i, q in enumerate(queries)
+                          if q in poison_set)
+            assert poison == want, (trial, queries, poison_set)
+            if len(want) == n:
+                assert vals is None and ids is None
+            else:
+                for i, q in enumerate(queries):
+                    if i not in poison:
+                        np.testing.assert_array_equal(
+                            vals[i], _fake_rows(q)[0], err_msg=q)
+                        np.testing.assert_array_equal(
+                            ids[i], _fake_rows(q)[1], err_msg=q)
+
+    def test_non_separable_failure_raises(self):
+        # Fails only when >= 2 queries batch together: no subset of
+        # size 1 fails, so bisection finds no poison and the final
+        # full retry surfaces the batch error.
+        def fn(queries, k, group):
+            if len(queries) >= 2:
+                raise RuntimeError("batch-shape dependent")
+            vals = np.stack([_fake_rows(q)[0] for q in queries])
+            ids = np.stack([_fake_rows(q)[1] for q in queries])
+            return vals, ids
+
+        d = SupervisedDispatch(fn, RetryPolicy(max_attempts=1))
+        with pytest.raises(RuntimeError, match="batch-shape"):
+            d.run_batch(["a", "b", "c"], 2, None)
+
+    def test_breaker_records_attempts(self):
+        b = CircuitBreaker(threshold=2, cooldown_s=10.0)
+        d = SupervisedDispatch(_fake_dispatch({"bad"}),
+                               RetryPolicy(max_attempts=1), breaker=b)
+        with pytest.raises(RuntimeError):
+            d.run(["bad"], 2, None)
+        with pytest.raises(RuntimeError):
+            d.run(["bad"], 2, None)
+        assert b.state == "open"
+        # run_batch on a clean batch closes it again (cooldown is long
+        # but half-open is reached by the explicit wait in run()).
+        b._open_since -= 11                  # fast-forward the clock
+        vals, ids, poison = d.run_batch(["ok"], 2, None)
+        assert poison == [] and b.state == "closed"
+
+
+# ---------------------------------------------------------------------
+class TestServerSurvives:
+    """The serve-layer integration: the same injections the old tests
+    did with monkeypatches, driven through the registry seams."""
+
+    def test_transient_faults_keep_responses_bit_identical(self,
+                                                           retriever):
+        faults.arm(faults.FaultPlan.parse(
+            "device_dispatch:transient:n=2"))
+        with TfidfServer(retriever, quick_cfg()) as srv:
+            got = srv.submit(QUERIES[:2], k=3,
+                             use_cache=False).result(timeout=30)
+            snap = srv.metrics.registry.snapshot()
+        want = retriever.search(QUERIES[:2], k=3)
+        np.testing.assert_array_equal(got[0], want[0])
+        np.testing.assert_array_equal(got[1], want[1])
+        assert snap["serve_dispatch_retries_total"] >= 1
+
+    def test_poison_query_quarantined_innocents_served(self, retriever):
+        log = EventLog(echo="off")
+        obs.set_log(log)
+        faults.arm(faults.FaultPlan.parse(
+            "device_dispatch:fatal:match=zzpoison"))
+        srv = TfidfServer(retriever, quick_cfg(max_wait_ms=40,
+                                               cache_entries=0))
+        try:
+            futs = {q: srv.submit([q], k=3) for q in
+                    [QUERIES[0], "zzpoison attack", QUERIES[1]]}
+            with pytest.raises(PoisonQuery) as ei:
+                futs["zzpoison attack"].result(timeout=30)
+            assert ei.value.queries == ["zzpoison attack"]
+            for q in (QUERIES[0], QUERIES[1]):
+                got = futs[q].result(timeout=30)
+                want = retriever.search([q], k=3)
+                np.testing.assert_array_equal(got[0], want[0])
+                np.testing.assert_array_equal(got[1], want[1])
+            # 4xx thereafter: the gate fails fast, no device work.
+            with pytest.raises(PoisonQuery):
+                srv.submit(["zzpoison attack"], k=3)
+            snap = srv.metrics.registry.snapshot()
+            assert snap["serve_quarantined_total"] == 1
+            assert snap["serve_poisoned_total"] == 2
+        finally:
+            srv.close()
+        events = {e["event"] for e in log.events()}
+        assert "poison_isolated" in events
+        assert "query_quarantined" in events
+        outcomes = [d["outcome"] for d in log.digests()]
+        assert outcomes.count("poisoned") == 2
+
+    def test_breaker_trips_into_degraded_admission(self, retriever):
+        faults.arm(faults.FaultPlan.parse(
+            "device_dispatch:transient:n=40"))
+        srv = TfidfServer(retriever, quick_cfg(
+            queue_depth=8, dispatch_retries=0, breaker_threshold=3,
+            breaker_cooldown_ms=50, cache_entries=0))
+        try:
+            for _ in range(3):
+                with pytest.raises(faults.TransientFault):
+                    srv.submit([QUERIES[0]], k=3,
+                               use_cache=False).result(timeout=30)
+            assert srv.breaker.state in ("open", "half_open")
+            hz = srv.healthz()
+            assert hz["status"] == DEGRADED
+            assert any("breaker" in r for r in hz["reasons"])
+            assert hz["admission_bound"] == 4      # 8 -> 4 degraded
+            faults.disarm()
+            time.sleep(0.06)                       # past the cooldown
+            srv.submit([QUERIES[0]], k=3,
+                       use_cache=False).result(timeout=30)
+            assert srv.breaker.state == "closed"
+            srv.healthz()
+            assert srv.healthz()["status"] == OK   # second eval clean
+        finally:
+            srv.close()
+
+    def test_batcher_loop_restarts_and_serves(self, retriever):
+        log = EventLog(echo="off")
+        obs.set_log(log)
+        faults.arm(faults.FaultPlan.parse("batcher_loop:fatal:n=1"))
+        with TfidfServer(retriever, quick_cfg(restart_budget=2)) as srv:
+            got = srv.submit(QUERIES[:1], k=3,
+                             use_cache=False).result(timeout=30)
+            assert srv._batcher.restarts == 1
+        want = retriever.search(QUERIES[:1], k=3)
+        np.testing.assert_array_equal(got[0], want[0])
+        evs = [e for e in log.events() if e["event"] == "worker_restart"]
+        assert evs and evs[0]["worker"] == "batcher"
+
+    def test_restart_budget_exhaustion_kills_batcher_typed(self,
+                                                           retriever):
+        faults.arm(faults.FaultPlan.parse("batcher_loop:fatal:n=99"))
+        srv = TfidfServer(retriever, quick_cfg(restart_budget=1))
+        try:
+            f = srv.submit(QUERIES[:1], k=3, use_cache=False)
+            with pytest.raises((ServeError, faults.FatalFault)):
+                f.result(timeout=30)
+            deadline = time.monotonic() + 10
+            while not srv._batcher._dead and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert srv._batcher._dead
+            faults.disarm()     # a dead batcher stays dead
+            with pytest.raises(ServeError, match="dead"):
+                srv._batcher.submit(["x"], k=1)
+        finally:
+            srv.close()
+
+    def test_sleep_fault_stalls_batcher_to_unhealthy(self, retriever):
+        """The registry-driven version of the old fake-worker stall
+        injection: a real batcher, really stalled, flips readyz."""
+        faults.arm(faults.FaultPlan.parse(
+            "batcher_loop:sleep:s=0.8:at=2"))
+        srv = TfidfServer(retriever, quick_cfg(
+            stall_after_ms=100, cache_entries=0, max_wait_ms=1))
+        try:
+            srv.submit(QUERIES[:1], k=3).result(timeout=30)
+            # The loop's next wake hits the sleep rule; work queued
+            # behind it makes the batcher busy-but-silent.
+            f = srv.submit(QUERIES[1:2], k=3)
+            deadline = time.monotonic() + 5
+            state = None
+            while time.monotonic() < deadline:
+                state = srv.health.evaluate().state
+                if state == UNHEALTHY:
+                    break
+                time.sleep(0.02)
+            assert state == UNHEALTHY
+            assert not srv.readyz()["ready"]
+            f.result(timeout=30)               # stall ends, work flows
+            deadline = time.monotonic() + 5
+            while (srv.health.evaluate().state != OK
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            assert srv.readyz()["ready"]       # recovered
+        finally:
+            srv.close()
+
+    def test_server_arms_and_disarms_config_plan(self, retriever):
+        srv = TfidfServer(retriever, quick_cfg(
+            faults="device_dispatch:transient:n=1"))
+        assert faults.get_registry().armed
+        srv.close()
+        assert not faults.get_registry().armed
+
+
+# ---------------------------------------------------------------------
+class TestSwapCloseRace:
+    def test_swap_mid_drain_completes_or_raises_serverclosed(
+            self, retriever):
+        """A swap landing while close(drain=True) drains must either
+        complete or raise the typed ServerClosed — and the whole dance
+        must finish (no deadlock)."""
+        twin = TfidfRetriever(CFG).index(CORPUS)
+        all_results = []
+        for _ in range(5):
+            srv = TfidfServer(retriever, quick_cfg(
+                max_wait_ms=20, cache_entries=0))
+            for q in QUERIES:
+                srv.submit([q], k=3)           # backlog to drain
+            results = []
+            go = threading.Event()
+
+            def swapper():
+                go.wait()                      # race close() for real
+                for _ in range(8):
+                    try:
+                        results.append(("ok", srv.swap_index(twin)))
+                    except ServerClosed:
+                        results.append(("closed", None))
+                    except ServeError as e:    # pragma: no cover
+                        results.append(("other", repr(e)))
+
+            t = threading.Thread(target=swapper)
+            t.start()
+            go.set()
+            srv.close(drain=True)
+            t.join(timeout=30)
+            assert not t.is_alive(), "swap vs close deadlocked"
+            assert results and all(kind in ("ok", "closed")
+                                   for kind, _ in results)
+            all_results += results
+        # The typed refusal itself shows up deterministically once the
+        # server IS closed (pinned below); across five staged races
+        # at least the terminal swaps after close land as 'closed'.
+        assert any(kind == "closed" for kind, _ in all_results)
+
+    def test_submit_after_close_raises_serverclosed(self, retriever):
+        srv = TfidfServer(retriever, quick_cfg())
+        srv.close()
+        with pytest.raises(ServerClosed):
+            srv.submit(QUERIES[:1], k=2)
+        with pytest.raises(ServerClosed):
+            srv.swap_index(retriever)
+
+
+# ---------------------------------------------------------------------
+class TestIngestWorkerRestart:
+    def test_pack_and_drain_transients_restart_identically(
+            self, toy_corpus_dir):
+        from tfidf_tpu.ingest import run_overlapped
+        log = EventLog(echo="off")
+        obs.set_log(log)
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, topk=4,
+                             vocab_size=1 << 12)
+        clean = run_overlapped(toy_corpus_dir, cfg, doc_len=16,
+                               chunk_docs=2)
+        faults.arm(faults.FaultPlan.parse(
+            "pack_worker:transient:n=1;drain:transient:n=1"))
+        faulted = run_overlapped(toy_corpus_dir, cfg, doc_len=16,
+                                 chunk_docs=2)
+        np.testing.assert_array_equal(np.asarray(clean.df),
+                                      np.asarray(faulted.df))
+        restarts = [e for e in log.events()
+                    if e["event"] == "worker_restart"]
+        workers = {e["worker"] for e in restarts}
+        assert {"packer", "drainer"} <= workers
+
+    def test_fatal_fault_propagates(self, toy_corpus_dir):
+        from tfidf_tpu.ingest import run_overlapped
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, topk=4,
+                             vocab_size=1 << 12)
+        faults.arm(faults.FaultPlan.parse("pack_worker:fatal:n=1"))
+        with pytest.raises(faults.FatalFault):
+            run_overlapped(toy_corpus_dir, cfg, doc_len=16,
+                           chunk_docs=2)
+
+    def test_restart_budget_env_bounds_retries(self, toy_corpus_dir,
+                                               monkeypatch):
+        from tfidf_tpu.ingest import run_overlapped
+        monkeypatch.setenv("TFIDF_TPU_RESTART_BUDGET", "1")
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, topk=4,
+                             vocab_size=1 << 12)
+        faults.arm(faults.FaultPlan.parse("pack_worker:transient:n=5"))
+        with pytest.raises(faults.TransientFault):
+            run_overlapped(toy_corpus_dir, cfg, doc_len=16,
+                           chunk_docs=2)
+
+
+# ---------------------------------------------------------------------
+def _load_tool(name):
+    tools = os.path.join(REPO, "tools")
+    if tools not in sys.path:
+        sys.path.append(tools)
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(tools, f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestRecoveryObservability:
+    def test_retry_spans_nest_and_poisoned_outcome_validates(
+            self, retriever, tmp_path):
+        """trace_check: dispatch_retry spans nest inside their batched
+        span; a quarantined request's span ends outcome=poisoned."""
+        path = str(tmp_path / "chaos_trace.json")
+        obs.set_tracer(obs.Tracer(), path)
+        try:
+            faults.arm(faults.FaultPlan.parse(
+                "device_dispatch:transient:n=1;"
+                "device_dispatch:fatal:match=zzpoison"))
+            with TfidfServer(retriever, quick_cfg(
+                    cache_entries=0)) as srv:
+                srv.submit(QUERIES[:2], k=3,
+                           use_cache=False).result(timeout=30)
+                with pytest.raises(PoisonQuery):
+                    srv.submit(["zzpoison x"], k=3).result(timeout=30)
+            out = obs.export()
+        finally:
+            obs.set_tracer(None)
+        assert out == path
+        tc = _load_tool("trace_check")
+        errors, notes = tc.check_trace(path, mode="serve",
+                                       min_threads=2)
+        assert errors == [], (errors, notes)
+        events = tc.load_chrome_trace(path)
+        outcomes = {(e.get("args") or {}).get("outcome")
+                    for e in events if e.get("ph") == "X"
+                    and e.get("name") == "request"}
+        assert "poisoned" in outcomes
+        retries = [e for e in events if e.get("ph") == "X"
+                   and e.get("name") == "dispatch_retry"]
+        assert retries, "retry left no span"
+
+    def test_mangled_retry_span_fails_trace_check(self, tmp_path):
+        """A dispatch_retry span floating OUTSIDE any batched span on
+        its lane is an instrumentation regression."""
+        import json
+        events = [
+            {"ph": "M", "name": "thread_name", "pid": 1, "tid": 1,
+             "args": {"name": "batcher"}},
+            {"ph": "X", "name": "request", "pid": 1, "tid": 1,
+             "ts": 0.0, "dur": 100.0, "args": {"outcome": "drained"}},
+            {"ph": "X", "name": "batched", "pid": 1, "tid": 1,
+             "ts": 10.0, "dur": 20.0, "args": {"batch": 0}},
+            {"ph": "X", "name": "dispatch_retry", "pid": 1, "tid": 1,
+             "ts": 50.0, "dur": 10.0, "args": {"batch": 0}},
+        ]
+        path = tmp_path / "bad.json"
+        path.write_text(json.dumps({"traceEvents": events}))
+        tc = _load_tool("trace_check")
+        errors, _ = tc.check_trace(str(path), mode="serve",
+                                   min_threads=1)
+        assert any("dispatch_retry" in e for e in errors)
+
+    def test_quarantine_cross_check_trace_vs_flight(self, tmp_path):
+        import json
+        tc = _load_tool("trace_check")
+        log = EventLog(echo="off")
+        log.log("error", "query_quarantined", size=1)
+        flight = str(tmp_path / "f.jsonl")
+        log.dump(flight)
+
+        def trace_with(outcome):
+            path = tmp_path / f"t_{outcome}.json"
+            path.write_text(json.dumps({"traceEvents": [
+                {"ph": "M", "name": "thread_name", "pid": 1, "tid": 1,
+                 "args": {"name": "main"}},
+                {"ph": "X", "name": "request", "pid": 1, "tid": 1,
+                 "ts": 0.0, "dur": 5.0, "args": {"outcome": outcome}},
+            ]}))
+            return str(path)
+
+        notes = []
+        # quarantine in flight + poisoned terminal in trace: clean
+        assert tc._cross_check_quarantine(
+            trace_with("poisoned"), flight, notes) == []
+        assert notes
+        # quarantine in flight but NO poisoned request span: flagged
+        errs = tc._cross_check_quarantine(
+            trace_with("drained"), flight, [])
+        assert errs and "poisoned" in errs[0]
+
+    def test_doctor_reports_faults_and_gates_breaker_open(
+            self, tmp_path):
+        import json
+        log = EventLog(echo="off")
+        log.log("warning", "dispatch_retry", attempt=1, batch=0)
+        log.log("warning", "worker_restart", worker="packer", chunk=0)
+        log.log("error", "breaker_trip", consecutive=5)
+        log.log("error", "query_quarantined", size=1)
+        log.digest(outcome="poisoned", queries=1, k=3, ms=1.0)
+        flight = str(tmp_path / "f.jsonl")
+        log.dump(flight)
+        trace = tmp_path / "t.json"
+        trace.write_text(json.dumps({"traceEvents": [
+            {"ph": "M", "name": "thread_name", "pid": 1, "tid": 1,
+             "args": {"name": "main"}},
+            {"ph": "X", "name": "request", "pid": 1, "tid": 1,
+             "ts": 0.0, "dur": 10.0, "args": {"outcome": "poisoned"}},
+        ]}))
+        doctor = _load_tool("doctor")
+        report = doctor.diagnose(str(trace), flight,
+                                 str(tmp_path / "no_ledger.jsonl"))
+        fa = report["flight"]["faults"]
+        assert fa["dispatch_retry"] == 1
+        assert fa["worker_restart"] == 1
+        assert fa["breaker_trip"] == 1
+        assert fa["query_quarantined"] == 1
+        assert fa["breaker_open_at_exit"] is True
+        assert fa["restarts_by_worker"] == {"packer": 1}
+        assert any("breaker OPEN at exit" in v
+                   for v in report["violations"])
+        assert not report["ok"]
+        # allow flag tolerates; a later breaker_close clears entirely
+        report = doctor.diagnose(str(trace), flight,
+                                 str(tmp_path / "no_ledger.jsonl"),
+                                 allow_breaker_open=True)
+        assert report["ok"]
+        log.log("info", "breaker_close")
+        log.dump(flight)
+        report = doctor.diagnose(str(trace), flight,
+                                 str(tmp_path / "no_ledger.jsonl"))
+        assert report["flight"]["faults"]["breaker_open_at_exit"] \
+            is False
+        assert report["ok"]
+        rendered = doctor.render(report)
+        assert "faults:" in rendered
+
+    def test_chaos_artifact_normalizes_and_gates(self, tmp_path):
+        import json
+        ledger = _load_tool("perf_ledger")
+        gate = _load_tool("perf_gate")
+        artifact = {
+            "metric": "serve_bench", "backend": "cpu", "docs": 128,
+            "k": 10, "requests": 64, "mode": "closed",
+            "concurrency": 4, "max_batch": 64,
+            "throughput_qps": 1500.0, "throughput_rps": 400.0,
+            "latency_ms": {"p50": 1.0, "p99": 4.0},
+            "chaos": {"plan": "device_dispatch:transient:n=2",
+                      "seed": 0, "retries": 2, "worker_restarts": 0,
+                      "breaker_trips": 0, "breaker_open_at_exit": 0,
+                      "quarantined": 1, "poisoned_requests": 1,
+                      "shed_requests": 0, "parity_checked": 60,
+                      "parity_mismatches": 0, "parity_ok": 1},
+        }
+        path = tmp_path / "CHAOS_t.json"
+        path.write_text(json.dumps(artifact))
+        rec, reason = ledger.normalize(str(path))
+        assert reason is None and rec["kind"] == "chaos"
+        assert rec["metrics"]["parity_ok"] == 1
+        assert rec["context"]["plan"] == "device_dispatch:transient:n=2"
+        verdict = gate.gate(rec, [rec])
+        assert verdict["ok"]
+        # Parity break or a breaker left open fails zero-tolerance.
+        for key, val in (("parity_ok", 0), ("breaker_open_at_exit", 1)):
+            bad = json.loads(json.dumps(artifact))
+            bad["chaos"][key] = val
+            bpath = tmp_path / f"CHAOS_bad_{key}.json"
+            bpath.write_text(json.dumps(bad))
+            brec, _ = ledger.normalize(str(bpath))
+            bverdict = gate.gate(brec, [rec])
+            assert not bverdict["ok"], key

@@ -20,18 +20,36 @@ a lose-everything window; the seq+pointer layer closes it.)
 Payload backend: Orbax's PyTreeCheckpointer (handles sharded arrays)
 when importable; otherwise a plain ``.npz``. Both produce/consume the
 same logical state dict.
+
+Since round 13 the SAME seq+LATEST protocol also persists the built
+retriever index (:func:`save_index` / :func:`restore_index`): CSR
+arrays + IDF + doc names + caller metadata (epoch, config
+fingerprint), each array sha256-checksummed so silent disk corruption
+raises the typed :class:`SnapshotMismatch` instead of serving wrong
+bytes. This is what lets a SIGKILLed ``tfidf serve --snapshot-dir``
+process resume serving in seconds instead of re-ingesting the corpus
+(tests/test_snapshot.py pins the crash windows).
 """
 
 from __future__ import annotations
 
 import contextlib
 import fcntl
+import hashlib
+import json
 import os
 import shutil
 import tempfile
-from typing import Dict, Iterator
+from typing import Callable, Dict, Iterator, Tuple
 
 import numpy as np
+
+
+class SnapshotMismatch(ValueError):
+    """A committed snapshot cannot serve this process: a checksum
+    failed (corruption) or the config fingerprint differs from the
+    running config (restoring it would silently serve wrong results).
+    Callers fall back to a rebuild."""
 
 try:  # orbax is in the image; guard anyway so the npz path self-heals
     import orbax.checkpoint as _ocp
@@ -121,6 +139,35 @@ def _committed_payload(path: str):
     return payload, seq
 
 
+def _commit_payload(path: str, write_payload: Callable[[str], None]
+                    ) -> None:
+    """The shared crash-safety protocol: write a fresh ``ckpt-<seq>``
+    payload via ``write_payload(payload_dir)``, then atomically
+    repoint ``LATEST``, then drop the superseded payload. A crash at
+    any instant leaves the old committed checkpoint or the new one —
+    never neither. Single-writer per root (flock-enforced)."""
+    os.makedirs(path, exist_ok=True)
+    with _writer_lock(path):
+        old_payload, seq = _committed_payload(path)
+        _reclaim_debris(path,
+                        os.path.basename(old_payload) if old_payload else None)
+        name = f"ckpt-{seq + 1}"
+        payload = os.path.join(path, name)
+        write_payload(payload)
+        _fsync_dir(path)  # make the new payload's dirent durable pre-commit
+
+        # Commit: atomically repoint LATEST, then drop superseded payload.
+        fd, tmp = tempfile.mkstemp(dir=path, suffix=".latest.tmp")
+        with os.fdopen(fd, "w") as f:
+            f.write(name)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(path, _LATEST))
+        _fsync_dir(path)  # rename must hit disk before old payload goes
+        if old_payload and os.path.isdir(old_payload):
+            shutil.rmtree(old_payload, ignore_errors=True)
+
+
 def save_state(path: str, state: Dict[str, np.ndarray],
                force_npz: bool = False) -> str:
     """Persist a streaming state dict under the checkpoint root ``path``.
@@ -134,37 +181,22 @@ def save_state(path: str, state: Dict[str, np.ndarray],
     ``restore_state`` only follows the committed ``LATEST`` pointer.
     """
     state = {k: np.asarray(v) for k, v in state.items()}
-    os.makedirs(path, exist_ok=True)
-    with _writer_lock(path):
-        old_payload, seq = _committed_payload(path)
-        _reclaim_debris(path,
-                        os.path.basename(old_payload) if old_payload else None)
-        name = f"ckpt-{seq + 1}"
-        payload = os.path.join(path, name)
+    backend = []
 
+    def write_payload(payload: str) -> None:
         if _HAVE_ORBAX and not force_npz:
             _ocp.PyTreeCheckpointer().save(os.path.abspath(payload), state)
-            backend = "orbax"
+            backend.append("orbax")
         else:
             os.makedirs(payload)
             with open(os.path.join(payload, _NPZ_NAME), "wb") as f:
                 np.savez(f, **state)
                 f.flush()
                 os.fsync(f.fileno())
-            backend = "npz"
-        _fsync_dir(path)  # make the new payload's dirent durable pre-commit
+            backend.append("npz")
 
-        # Commit: atomically repoint LATEST, then drop superseded payload.
-        fd, tmp = tempfile.mkstemp(dir=path, suffix=".latest.tmp")
-        with os.fdopen(fd, "w") as f:
-            f.write(name)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, os.path.join(path, _LATEST))
-        _fsync_dir(path)  # rename must hit disk before old payload goes
-        if old_payload and os.path.isdir(old_payload):
-            shutil.rmtree(old_payload, ignore_errors=True)
-    return backend
+    _commit_payload(path, write_payload)
+    return backend[0]
 
 
 def restore_state(path: str) -> Dict[str, np.ndarray]:
@@ -186,3 +218,91 @@ def restore_state(path: str) -> Dict[str, np.ndarray]:
 def exists(path: str) -> bool:
     """True when ``path`` holds a committed, restorable checkpoint."""
     return _committed_payload(path)[0] is not None
+
+
+# --- index snapshots (round 13) --------------------------------------
+
+_INDEX_NPZ = "index.npz"
+_INDEX_META = "meta.json"
+INDEX_SCHEMA = "tfidf-index/1"
+
+
+def _array_sha(arr: np.ndarray) -> str:
+    h = hashlib.sha256()
+    h.update(str(arr.dtype).encode())
+    h.update(str(arr.shape).encode())
+    h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()
+
+
+def save_index(path: str, arrays: Dict[str, np.ndarray],
+               meta: Dict) -> str:
+    """Persist a built retriever index under the checkpoint root
+    ``path`` with the same seq+LATEST protocol as :func:`save_state`.
+
+    The payload is one plain ``index.npz`` (portable — restoring
+    needs numpy, not orbax) plus ``meta.json`` carrying the caller's
+    metadata (epoch, config fingerprint, doc count) and a sha256
+    checksum per array; :func:`restore_index` re-verifies them, so a
+    torn or bit-rotted snapshot raises :class:`SnapshotMismatch`
+    instead of silently serving wrong results. Returns ``path``."""
+    arrays = {k: np.asarray(v) for k, v in arrays.items()}
+    doc = {
+        "schema": INDEX_SCHEMA,
+        "meta": dict(meta),
+        "checksums": {k: _array_sha(v) for k, v in arrays.items()},
+        "arrays": {k: {"dtype": str(v.dtype), "shape": list(v.shape)}
+                   for k, v in arrays.items()},
+    }
+
+    def write_payload(payload: str) -> None:
+        os.makedirs(payload)
+        with open(os.path.join(payload, _INDEX_NPZ), "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        with open(os.path.join(payload, _INDEX_META), "w") as f:
+            json.dump(doc, f, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+
+    _commit_payload(path, write_payload)
+    return path
+
+
+def restore_index(path: str) -> Tuple[Dict[str, np.ndarray], Dict]:
+    """Load the committed index snapshot: ``(arrays, meta)``.
+
+    Raises ``FileNotFoundError`` when no committed snapshot exists and
+    :class:`SnapshotMismatch` when the payload fails its schema or
+    checksum validation (the caller falls back to a rebuild)."""
+    payload, _ = _committed_payload(path)
+    if payload is None:
+        raise FileNotFoundError(f"no committed index snapshot at {path}")
+    meta_path = os.path.join(payload, _INDEX_META)
+    npz_path = os.path.join(payload, _INDEX_NPZ)
+    if not os.path.exists(meta_path) or not os.path.exists(npz_path):
+        raise SnapshotMismatch(
+            f"committed payload {payload} is not an index snapshot "
+            f"(state checkpoint? missing meta/npz)")
+    with open(meta_path) as f:
+        doc = json.load(f)
+    if doc.get("schema") != INDEX_SCHEMA:
+        raise SnapshotMismatch(
+            f"index snapshot schema {doc.get('schema')!r} != "
+            f"{INDEX_SCHEMA!r}")
+    with np.load(npz_path) as data:
+        arrays = {k: data[k] for k in data.files}
+    checksums = doc.get("checksums", {})
+    if set(checksums) != set(arrays):
+        raise SnapshotMismatch(
+            f"index snapshot arrays {sorted(arrays)} != checksummed "
+            f"set {sorted(checksums)}")
+    for name, arr in arrays.items():
+        got = _array_sha(arr)
+        if got != checksums[name]:
+            raise SnapshotMismatch(
+                f"index snapshot array {name!r} fails its checksum "
+                f"({got[:12]}... != {checksums[name][:12]}...) — "
+                f"corrupt payload")
+    return arrays, dict(doc.get("meta", {}))

@@ -13,6 +13,7 @@ import argparse
 import os
 import subprocess
 import sys
+import time
 from typing import List, Optional
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -70,9 +71,15 @@ protocol (one JSON object per line):
       stats only on backends that report them)
   {"op": "swap_index", "input": DIR}
       -> {"swapped": true, "epoch": N}  (hot re-index, no downtime;
-      the canary oracle re-captures inside the swap)
+      the canary oracle re-captures inside the swap; with
+      --snapshot-dir the NEW epoch is snapshotted before the flip)
+  {"op": "snapshot"}           -> {"snapshot": DIR, "epoch": N}
+      (persist the resident index now; needs --snapshot-dir)
   {"op": "shutdown"}           -> drains in-flight work and exits
 overload responses carry {"error": "overloaded"}; back off and retry.
+quarantined queries answer {"error": "poison_query"} — the request
+named a query isolated as poison by dispatch bisection (4xx: do not
+retry it).
 """
 
 
@@ -318,6 +325,27 @@ def _build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--canary-queries", type=int, default=8,
                     help="pinned golden queries drawn from the corpus "
                          "(first tokens of the first N docs)")
+    sv.add_argument("--snapshot-dir", metavar="DIR", default=None,
+                    help="crash-fast index snapshot root (also env "
+                         "TFIDF_TPU_SNAPSHOT_DIR): on start, a "
+                         "committed snapshot with a matching config "
+                         "fingerprint restores in seconds instead of "
+                         "re-ingesting --input; after a fresh build "
+                         "(and before every swap_index flip) the "
+                         "index is snapshotted there atomically "
+                         "(checkpoint.py seq+LATEST protocol). "
+                         "JSONL op {\"op\": \"snapshot\"} snapshots "
+                         "on demand")
+    sv.add_argument("--faults", metavar="PLAN", default=None,
+                    help="arm a deterministic fault-injection plan "
+                         "(chaos testing; also env TFIDF_TPU_FAULTS; "
+                         "grammar in tfidf_tpu/faults.py), e.g. "
+                         "'device_dispatch:transient:n=2;"
+                         "device_dispatch:fatal:match=zz'")
+    sv.add_argument("--fault-seed", type=int, default=None,
+                    help="seed for the fault plan's probabilistic "
+                         "rules + retry jitter (replayable chaos; "
+                         "env TFIDF_TPU_FAULT_SEED)")
     sv.add_argument("--flight", metavar="OUT.jsonl", default=None,
                     help="flight-recorder dump path: the structured "
                          "event ring + last-N request digests write "
@@ -765,7 +793,8 @@ def _serve_handle_line(server, line, write, default_k, build_retriever,
     when the line asked for shutdown."""
     import json
 
-    from tfidf_tpu.serve import DeadlineExceeded, Overloaded, ServeError
+    from tfidf_tpu.serve import (DeadlineExceeded, Overloaded,
+                                 PoisonQuery, ServeError)
 
     line = line.strip()
     if not line:
@@ -821,6 +850,14 @@ def _serve_handle_line(server, line, write, default_k, build_retriever,
         except (KeyError, ValueError, OSError) as e:
             write({"id": req.get("id"), "error": f"swap failed: {e}"})
         return True
+    if op == "snapshot":
+        try:
+            path = server.snapshot()
+            write({"id": req.get("id"), "snapshot": path,
+                   "epoch": server.epoch})
+        except (ValueError, OSError, RuntimeError) as e:
+            write({"id": req.get("id"), "error": f"snapshot failed: {e}"})
+        return True
     if op is not None:
         write({"id": req.get("id"), "error": f"unknown op {op!r}"})
         return True
@@ -841,6 +878,9 @@ def _serve_handle_line(server, line, write, default_k, build_retriever,
             write({"id": rid, "error": "overloaded"})
         elif isinstance(err, DeadlineExceeded):
             write({"id": rid, "error": "deadline_exceeded"})
+        elif isinstance(err, PoisonQuery):
+            write({"id": rid, "error": "poison_query",
+                   "detail": str(err)})
         elif err is not None:
             write({"id": rid, "error": str(err)})
         else:
@@ -854,6 +894,8 @@ def _serve_handle_line(server, line, write, default_k, build_retriever,
         server.submit(queries, k,
                       deadline_ms=req.get("deadline_ms")
                       ).add_done_callback(on_done)
+    except PoisonQuery as e:     # quarantined: the protocol's 4xx
+        write({"id": rid, "error": "poison_query", "detail": str(e)})
     except (Overloaded, ServeError) as e:
         write({"id": rid,
                "error": "overloaded" if isinstance(e, Overloaded)
@@ -887,9 +929,49 @@ def _run_serve(args) -> int:
         queue_depth=args.queue_depth, cache_entries=args.cache_entries,
         default_deadline_ms=args.deadline_ms,
         health_period_ms=args.health_period_ms,
-        devmon_period_ms=args.devmon_period_ms)
-    retriever = build_retriever(args.input)
-    server = TfidfServer(retriever, serve_cfg)
+        devmon_period_ms=args.devmon_period_ms,
+        snapshot_dir=args.snapshot_dir, faults=args.faults,
+        fault_seed=args.fault_seed)
+
+    # Crash-fast start: a committed snapshot with a matching config
+    # fingerprint restores the resident index from disk — seconds, no
+    # corpus read at all (the restart acceptance pin deletes the
+    # corpus to prove it). A mismatched/corrupt snapshot falls back
+    # to the normal build, loudly.
+    from tfidf_tpu import checkpoint as ckpt
+    from tfidf_tpu.obs import log as obs_log
+    retriever = None
+    restored_meta = None
+    if serve_cfg.snapshot_dir and ckpt.exists(serve_cfg.snapshot_dir):
+        t0 = time.monotonic()
+        try:
+            retriever, restored_meta = TfidfRetriever.restore(
+                serve_cfg.snapshot_dir, cfg)
+        except ckpt.SnapshotMismatch as e:
+            sys.stderr.write(f"snapshot at {serve_cfg.snapshot_dir} "
+                             f"unusable ({e}); rebuilding from "
+                             f"--input\n")
+        else:
+            obs_log.log_event(
+                "info", "index_restored",
+                msg=f"index restored from {serve_cfg.snapshot_dir} "
+                    f"(epoch {restored_meta.get('epoch', 0)}, "
+                    f"{retriever._num_docs} docs) in "
+                    f"{time.monotonic() - t0:.3f}s — corpus not "
+                    f"re-ingested",
+                epoch=restored_meta.get("epoch", 0),
+                docs=retriever._num_docs,
+                restore_s=round(time.monotonic() - t0, 4))
+    if retriever is None:
+        retriever = build_retriever(args.input)
+    server = TfidfServer(
+        retriever, serve_cfg,
+        initial_epoch=(int(restored_meta.get("epoch", 0))
+                       if restored_meta else 0))
+    if serve_cfg.snapshot_dir and restored_meta is None:
+        # First boot on this snapshot root: persist the fresh build
+        # so the NEXT start (or a crash one second from now) restores.
+        server.snapshot()
     if not args.no_warm:
         # Touch every power-of-two query bucket steady state can see
         # (empty queries compile the same Q-shaped programs), then
@@ -909,20 +991,30 @@ def _run_serve(args) -> int:
     canary = None
     if args.canary_period_ms and args.canary_period_ms > 0:
         from tfidf_tpu.serve import CanaryProber, pinned_queries_from_dir
-        pinned = pinned_queries_from_dir(args.input,
-                                         n=args.canary_queries,
-                                         strict=not args.no_strict)
+        try:
+            pinned = pinned_queries_from_dir(args.input,
+                                             n=args.canary_queries,
+                                             strict=not args.no_strict)
+        except (OSError, ValueError):
+            # Snapshot-restored server without the corpus on disk:
+            # no pinned queries to derive — serve without the canary.
+            pinned = []
         if pinned:
             canary = CanaryProber(
                 server, pinned, k=args.k,
                 period_s=args.canary_period_ms / 1e3).start()
+    snap_state = ("restored" if restored_meta
+                  else "on" if serve_cfg.snapshot_dir else "off")
     sys.stderr.write(f"serving {server.num_docs} docs "
                      f"(max_batch={serve_cfg.max_batch}, "
                      f"max_wait_ms={serve_cfg.max_wait_ms}, "
                      f"queue_depth={serve_cfg.queue_depth}, "
                      f"cache_entries={serve_cfg.cache_entries}, "
                      f"health_period_ms={serve_cfg.health_period_ms}, "
-                     f"canary={'on' if canary else 'off'})\n")
+                     f"canary={'on' if canary else 'off'}, "
+                     f"snapshot={snap_state}, "
+                     f"faults={'armed' if serve_cfg.faults else 'off'}"
+                     f")\n")
 
     prev_term = _install_sigterm_dump()
     try:

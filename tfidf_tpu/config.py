@@ -247,6 +247,35 @@ class ServeConfig:
         default; backends with no memory stats — CPU — run the same
         path with gauges absent). CLI ``--devmon-period-ms`` (0
         disables) / env ``TFIDF_TPU_DEVMON_PERIOD_MS``.
+      dispatch_retries: transient dispatch failures retried per batch
+        before bisection/failure (total attempts = 1 + retries).
+        Env ``TFIDF_TPU_DISPATCH_RETRIES``.
+      retry_backoff_ms: base of the jittered exponential backoff
+        between dispatch retries (x2 per attempt, capped at 1 s).
+        Env ``TFIDF_TPU_RETRY_BACKOFF_MS``.
+      breaker_threshold: consecutive dispatch failures that trip the
+        circuit breaker OPEN — a degraded health reason shrinking the
+        admission bound until a dispatch succeeds after the cooldown.
+        Env ``TFIDF_TPU_BREAKER_THRESHOLD``.
+      breaker_cooldown_ms: how long an open breaker pauses dispatch
+        attempts before the half-open recovery probe.
+        Env ``TFIDF_TPU_BREAKER_COOLDOWN_MS``.
+      restart_budget: crashed-worker restarts tolerated (batcher
+        loop; the ingest pack/drain workers honor the same env) —
+        past it the batcher declares itself dead and the server
+        refuses work instead of serving as a zombie.
+        Env ``TFIDF_TPU_RESTART_BUDGET``.
+      snapshot_dir: checkpoint root for the resident-index snapshot
+        (``TfidfServer.snapshot`` / restore-on-start; ``swap_index``
+        snapshots the incoming epoch before flipping). None disables.
+        CLI ``--snapshot-dir`` / env ``TFIDF_TPU_SNAPSHOT_DIR``.
+      faults: fault-injection plan spec armed by the server on
+        construction and disarmed on close (chaos testing —
+        ``tfidf_tpu/faults.py`` has the grammar). None = no
+        injection. Env ``TFIDF_TPU_FAULTS``.
+      fault_seed: seed for the plan's probabilistic rules and the
+        retry jitter, so chaos runs replay deterministically.
+        Env ``TFIDF_TPU_FAULT_SEED``.
     """
 
     max_batch: int = 64
@@ -258,6 +287,14 @@ class ServeConfig:
     stall_after_ms: float = 1000.0
     degraded_admission_factor: float = 0.5
     devmon_period_ms: Optional[float] = None
+    dispatch_retries: int = 2
+    retry_backoff_ms: float = 10.0
+    breaker_threshold: int = 5
+    breaker_cooldown_ms: float = 1000.0
+    restart_budget: int = 3
+    snapshot_dir: Optional[str] = None
+    faults: Optional[str] = None
+    fault_seed: int = 0
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -284,6 +321,16 @@ class ServeConfig:
         if not 0 < self.degraded_admission_factor <= 1:
             raise ValueError(
                 "degraded_admission_factor must be in (0, 1]")
+        if self.dispatch_retries < 0:
+            raise ValueError("dispatch_retries must be >= 0")
+        if self.retry_backoff_ms < 0:
+            raise ValueError("retry_backoff_ms must be >= 0")
+        if self.breaker_threshold < 1:
+            raise ValueError("breaker_threshold must be >= 1")
+        if self.breaker_cooldown_ms <= 0:
+            raise ValueError("breaker_cooldown_ms must be positive")
+        if self.restart_budget < 0:
+            raise ValueError("restart_budget must be >= 0")
 
     @staticmethod
     def from_env(**overrides) -> "ServeConfig":
@@ -303,7 +350,18 @@ class ServeConfig:
                 ("cache_entries", "TFIDF_TPU_CACHE_ENTRIES", int),
                 ("stall_after_ms", "TFIDF_TPU_STALL_AFTER_MS", float),
                 ("degraded_admission_factor",
-                 "TFIDF_TPU_DEGRADED_FACTOR", float)):
+                 "TFIDF_TPU_DEGRADED_FACTOR", float),
+                ("dispatch_retries", "TFIDF_TPU_DISPATCH_RETRIES", int),
+                ("retry_backoff_ms", "TFIDF_TPU_RETRY_BACKOFF_MS",
+                 float),
+                ("breaker_threshold", "TFIDF_TPU_BREAKER_THRESHOLD",
+                 int),
+                ("breaker_cooldown_ms",
+                 "TFIDF_TPU_BREAKER_COOLDOWN_MS", float),
+                ("restart_budget", "TFIDF_TPU_RESTART_BUDGET", int),
+                ("snapshot_dir", "TFIDF_TPU_SNAPSHOT_DIR", str),
+                ("faults", "TFIDF_TPU_FAULTS", str),
+                ("fault_seed", "TFIDF_TPU_FAULT_SEED", int)):
             val = pick(key, env, cast)
             if val is not None:
                 kw[key] = val

@@ -52,7 +52,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from tfidf_tpu import obs
+from tfidf_tpu import faults, obs
 from tfidf_tpu.config import (PipelineConfig, TokenizerKind, VocabMode,
                               apply_compile_cache)
 from tfidf_tpu.io import fast_tokenizer
@@ -503,6 +503,49 @@ def _trace(event: str, idx: int = -1) -> None:
         _overlap_trace((event, idx))
 
 
+def _restart_budget() -> int:
+    """Worker-job restarts tolerated before an ingest worker's crash
+    surfaces to the dispatch loop (``TFIDF_TPU_RESTART_BUDGET``; the
+    serve batcher honors the same knob through ``ServeConfig``)."""
+    return max(0, int(os.environ.get("TFIDF_TPU_RESTART_BUDGET", "3")))
+
+
+def _supervised_job(worker: str, idx: int, body):
+    """Run one worker job under restart supervision: a crash —
+    including an injected ``pack_worker``/``drain`` transient fault —
+    retries the (pure, per-chunk) job with jittered backoff inside
+    the restart budget, logging a ``worker_restart`` flight event per
+    retry; a :class:`~tfidf_tpu.faults.FatalFault` or an exhausted
+    budget propagates to the dispatch loop (whose checkpoint/resume
+    story is the next recovery layer). Pack/drain jobs are pure
+    functions of their chunk (the exact-path intern table is
+    append-only), so re-running one is safe."""
+    from tfidf_tpu.obs import log as obs_log
+    budget = _restart_budget()
+    attempt = 0
+    while True:
+        try:
+            faults.fire("pack_worker" if worker == "packer"
+                        else "drain", chunk=idx)
+            return body()
+        except faults.FatalFault:
+            raise
+        except Exception as e:  # noqa: BLE001 — supervised restart
+            attempt += 1
+            if attempt > budget:
+                raise
+            obs_log.log_event(
+                "warning", "worker_restart",
+                msg=f"{worker} job for chunk {idx} crashed "
+                    f"({type(e).__name__}: {e}); restart "
+                    f"{attempt}/{budget}",
+                worker=worker, chunk=idx, restart=attempt,
+                error=type(e).__name__)
+            obs.instant("worker_restart", worker=worker, chunk=idx,
+                        restart=attempt)
+            time.sleep(faults.backoff_s(attempt, 20.0))
+
+
 class _PackAhead:
     """Double-buffered host packing: ONE worker thread runs the chunk
     packer ahead of the dispatch loop, so chunk i+1's tokenize+hash
@@ -555,11 +598,15 @@ class _PackAhead:
         def job(item=self._items[i], i=i):
             obs.name_thread("packer")
             _health_beat("packer")  # no-op unless a monitor is armed
-            t0 = time.perf_counter()
-            with obs.span("pack", chunk=i):
-                out = self._fn(item)
-            self._host_s += time.perf_counter() - t0
-            return out
+
+            def body():
+                t0 = time.perf_counter()
+                with obs.span("pack", chunk=i):
+                    out = self._fn(item)
+                self._host_s += time.perf_counter() - t0
+                return out
+
+            return _supervised_job("packer", i, body)
 
         self._futs[i] = self._ex.submit(job)
         self._next += 1
@@ -635,10 +682,15 @@ class _DrainAhead:
         def job(words=words, idx=idx):
             obs.name_thread("drainer")
             _health_beat("drainer")  # no-op unless a monitor is armed
-            t0 = time.perf_counter()
-            with obs.span("drain", chunk=idx, bytes=nbytes):
-                out = self._unpack(np.asarray(words))
-            self._host_s += time.perf_counter() - t0
+
+            def body():
+                t0 = time.perf_counter()
+                with obs.span("drain", chunk=idx, bytes=nbytes):
+                    out = self._unpack(np.asarray(words))
+                self._host_s += time.perf_counter() - t0
+                return out
+
+            out = _supervised_job("drainer", idx, body)
             _trace("drain_done", idx)
             return out
 

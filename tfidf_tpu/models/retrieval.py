@@ -25,6 +25,8 @@ columns are both L2-normalized at build time.
 from __future__ import annotations
 
 import functools
+import hashlib
+import json
 import os
 from typing import List, Optional, Sequence, Tuple, Union
 
@@ -132,6 +134,31 @@ def _make_search_sharded(plan: MeshPlan, k: int):
         check_vma=False))
 
 
+def config_fingerprint(cfg: PipelineConfig) -> str:
+    """Stable hash over the config fields that determine index BYTES
+    and query packing — the compatibility contract between a snapshot
+    and the process restoring it. Fields that only choose an
+    execution path promised bit-identical (wire/finish/result_wire),
+    or that don't touch the retriever arrays (topk, trace,
+    compile_cache, mesh placement), are deliberately excluded: a
+    snapshot taken under one of those settings restores under
+    another."""
+    ident = {
+        "vocab_mode": cfg.vocab_mode.value,
+        "vocab_size": cfg.vocab_size,
+        "hash_seed": cfg.hash_seed,
+        "tokenizer": cfg.tokenizer.value,
+        "ngram_range": list(cfg.ngram_range),
+        "chargram_on_device": cfg.chargram_on_device,
+        "truncate_tokens_at": cfg.truncate_tokens_at,
+        "max_doc_len": cfg.max_doc_len,
+        "doc_chunk": cfg.doc_chunk,
+        "score_dtype": cfg.score_dtype,
+    }
+    return hashlib.sha256(
+        json.dumps(ident, sort_keys=True).encode()).hexdigest()[:16]
+
+
 class TfidfRetriever:
     """Index a corpus once, answer ranked cosine queries from device.
 
@@ -230,6 +257,79 @@ class TfidfRetriever:
     @property
     def indexed(self) -> bool:
         return self._num_docs > 0
+
+    # --- snapshot / restore (round 13) ---
+    def snapshot(self, path: str, epoch: int = 0,
+                 extra_meta: Optional[dict] = None) -> str:
+        """Persist the built index (CSR triples + IDF + names) under
+        the checkpoint root ``path`` via ``checkpoint.save_index`` —
+        the crash-fast restart path: :meth:`restore` rebuilds this
+        exact retriever from disk without touching the corpus.
+        Single-device indexes only (a mesh-sharded index restores
+        per-shard once ROADMAP item 1 lands)."""
+        from tfidf_tpu import checkpoint as ckpt
+        if not self.indexed:
+            raise RuntimeError("index() a corpus before snapshot()")
+        if self.plan is not None:
+            raise ValueError("snapshot() supports single-device "
+                             "indexes only")
+        # Doc names ride as one NUL-joined uint8 blob: filenames
+        # cannot contain NUL, and npz round-trips raw bytes exactly.
+        blob = np.frombuffer(
+            "\x00".join(self.names).encode("utf-8"), dtype=np.uint8)
+        arrays = {
+            "ids": np.asarray(self._ids),
+            "weights": np.asarray(self._weights),
+            "head": np.asarray(self._head),
+            "idf": np.asarray(self._idf),
+            "names_blob": blob,
+        }
+        meta = {
+            "num_docs": int(self._num_docs),
+            "epoch": int(epoch),
+            "config_sha": config_fingerprint(self.config),
+            "vocab_size": int(self.config.vocab_size),
+        }
+        if extra_meta:
+            meta.update(extra_meta)
+        return ckpt.save_index(path, arrays, meta)
+
+    @classmethod
+    def restore(cls, path: str,
+                config: Optional[PipelineConfig] = None
+                ) -> Tuple["TfidfRetriever", dict]:
+        """Rebuild a retriever from a committed snapshot: ``(retriever,
+        meta)``. The snapshot's config fingerprint must match
+        ``config`` (default HASHED at the snapshot's vocab size) —
+        a mismatch raises ``checkpoint.SnapshotMismatch`` rather than
+        silently serving results a live rebuild would not produce."""
+        from tfidf_tpu import checkpoint as ckpt
+        arrays, meta = ckpt.restore_index(path)
+        if config is None:
+            config = PipelineConfig(
+                vocab_mode=VocabMode.HASHED,
+                vocab_size=int(meta.get("vocab_size", 1 << 16)))
+        want = config_fingerprint(config)
+        got = meta.get("config_sha")
+        if got != want:
+            raise ckpt.SnapshotMismatch(
+                f"snapshot config fingerprint {got!r} != running "
+                f"config {want!r} — rebuild instead of serving a "
+                f"mismatched index")
+        r = cls(config)
+        r._ids = jnp.asarray(arrays["ids"])
+        r._weights = jnp.asarray(arrays["weights"])
+        r._head = jnp.asarray(arrays["head"])
+        r._idf = jnp.asarray(arrays["idf"])
+        blob = arrays["names_blob"]
+        r.names = (bytes(blob.tobytes()).decode("utf-8").split("\x00")
+                   if blob.size else [])
+        r._num_docs = int(meta["num_docs"])
+        if len(r.names) != r._num_docs:
+            raise ckpt.SnapshotMismatch(
+                f"snapshot names ({len(r.names)}) != num_docs "
+                f"({r._num_docs})")
+        return r, meta
 
     # --- querying ---
     def _query_matrix(self, queries: Sequence[Union[str, bytes]],

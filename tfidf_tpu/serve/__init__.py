@@ -15,7 +15,12 @@ the ROADMAP's serve-heavy-traffic leg. Four parts:
   occupancy, queue depth, shed/cache counters;
 * :mod:`~tfidf_tpu.serve.canary` — background parity probes replaying
   pinned golden queries against the swap-time oracle, the live
-  index-corruption detector (``serve_canary_parity`` gauge).
+  index-corruption detector (``serve_canary_parity`` gauge);
+* :mod:`~tfidf_tpu.serve.supervisor` — the recovery half: bounded
+  retry with backoff for transient dispatch faults, a circuit breaker
+  tripping into degraded admission, poison-query bisection +
+  quarantine (typed :class:`PoisonQuery`), all rehearsable through
+  the deterministic fault seams of :mod:`tfidf_tpu.faults`.
 
 The server also watches itself: every :class:`TfidfServer` carries a
 :class:`~tfidf_tpu.obs.health.HealthMonitor` deriving
@@ -30,11 +35,14 @@ docs/OBSERVABILITY.md the health/canary/flight-recorder story.
 """
 
 from tfidf_tpu.serve.batcher import (DeadlineExceeded, MicroBatcher,
-                                     Overloaded, ServeError)
+                                     Overloaded, PoisonQuery,
+                                     ServeError, ServerClosed)
 from tfidf_tpu.serve.cache import ResultCache, normalize_query
 from tfidf_tpu.serve.canary import CanaryProber, pinned_queries_from_dir
 from tfidf_tpu.serve.metrics import ServeMetrics
 from tfidf_tpu.serve.server import TfidfServer
+from tfidf_tpu.serve.supervisor import (CircuitBreaker, QuarantineList,
+                                        RetryPolicy, SupervisedDispatch)
 
 __all__ = [
     "TfidfServer",
@@ -45,6 +53,12 @@ __all__ = [
     "ServeError",
     "Overloaded",
     "DeadlineExceeded",
+    "ServerClosed",
+    "PoisonQuery",
+    "RetryPolicy",
+    "CircuitBreaker",
+    "QuarantineList",
+    "SupervisedDispatch",
     "normalize_query",
     "pinned_queries_from_dir",
 ]

@@ -24,6 +24,18 @@ Requests stay atomic: one request's queries always score in one batch
 (a request larger than ``max_batch`` overflows its batch alone —
 ``search`` blocks internally), and per-query results are independent,
 so slicing a coalesced batch back per request is exact.
+
+Survival (round 13): the worker thread is SUPERVISED — an exception
+escaping the loop (a bug, or an injected ``batcher_loop`` fault)
+restarts it with backoff inside a restart budget instead of leaving a
+zombie server whose health page can only narrate the wedge; past the
+budget the batcher declares itself dead, fails everything queued, and
+``submit`` raises. With a
+:class:`~tfidf_tpu.serve.supervisor.SupervisedDispatch` attached, the
+device call itself gets bounded retry and poison-query bisection: a
+batch that fails persistently is split until the poison queries are
+isolated (their requests fail with the typed :class:`PoisonQuery`),
+and every innocent co-batched request still resolves bit-identically.
 """
 
 from __future__ import annotations
@@ -37,8 +49,9 @@ from collections import deque
 
 import numpy as np
 
-from tfidf_tpu import obs
+from tfidf_tpu import faults, obs
 from tfidf_tpu.obs import devmon as obs_devmon
+from tfidf_tpu.obs import log as obs_log
 
 
 class ServeError(RuntimeError):
@@ -53,6 +66,24 @@ class Overloaded(ServeError):
 class DeadlineExceeded(ServeError):
     """The request's deadline expired while it was still queued; it was
     shed without touching the device."""
+
+
+class ServerClosed(ServeError):
+    """The server (or batcher) is closed: the operation raced a
+    shutdown and was refused, not lost — retry against a live
+    replica. ``swap_index``/``submit`` raise this instead of
+    deadlocking against a draining close."""
+
+
+class PoisonQuery(ServeError):
+    """The request contained a query isolated as poison (its dispatch
+    fails deterministically) or already quarantined. The rest of its
+    batch was unaffected; resubmitting the same query fails fast
+    (the 4xx of this protocol)."""
+
+    def __init__(self, msg: str, queries: Sequence = ()):
+        super().__init__(msg)
+        self.queries = list(queries)
 
 
 def _pow2(n: int) -> int:
@@ -92,21 +123,40 @@ class MicroBatcher:
         invokes every loop wake and around every batch — the
         :class:`~tfidf_tpu.obs.health.HealthMonitor` stall signal (a
         busy batcher that stops beating is a wedged pipeline).
+      supervisor: optional :class:`~tfidf_tpu.serve.supervisor.
+        SupervisedDispatch` — the device call then gets bounded retry
+        and poison bisection; None keeps the bare round-9 dispatch
+        (one failure fails the whole batch).
+      restart_budget: worker-loop crash restarts tolerated before the
+        batcher declares itself dead (fails queued work, refuses
+        submits). 0 disables supervision (a loop crash is fatal
+        immediately).
+      restart_backoff_ms: base of the jittered exponential backoff
+        between loop restarts.
     """
 
     def __init__(self, search_fn: Callable, *, max_batch: int = 64,
                  max_wait_ms: float = 2.0, metrics=None,
                  heartbeat: Optional[Callable[[], None]] = None,
+                 supervisor=None, restart_budget: int = 3,
+                 restart_backoff_ms: float = 50.0,
                  thread_name: str = "tfidf-serve-batcher") -> None:
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         if max_wait_ms < 0:
             raise ValueError("max_wait_ms must be >= 0")
+        if restart_budget < 0:
+            raise ValueError("restart_budget must be >= 0")
         self._search_fn = search_fn
         self.max_batch = max_batch
         self.max_wait = max_wait_ms / 1e3
         self._metrics = metrics
         self._heartbeat = heartbeat
+        self._supervisor = supervisor
+        self._restart_budget = restart_budget
+        self._restart_backoff_ms = restart_backoff_ms
+        self.restarts = 0
+        self._dead = False
         self._batch_seq = 0   # trace batch-id; worker thread only
         self._queue: Deque[_Pending] = deque()
         self._cond = threading.Condition()
@@ -127,7 +177,11 @@ class MicroBatcher:
         p = _Pending(list(queries), int(k), group, deadline)
         with self._cond:
             if self._closed:
-                raise ServeError("batcher is closed")
+                raise ServerClosed("batcher is closed")
+            if self._dead:
+                raise ServeError(
+                    f"batcher worker is dead (restart budget "
+                    f"{self._restart_budget} exhausted)")
             self._queue.append(p)
             self._cond.notify_all()
         return p.future
@@ -181,9 +235,58 @@ class MicroBatcher:
         return batch
 
     def _run(self) -> None:
+        """Supervision wrapper: restart the loop on a crash (with
+        backoff, inside the restart budget) so an exception escaping
+        the batching machinery — a bug, or an injected
+        ``batcher_loop`` fault — never leaves a zombie server whose
+        queue silently grows forever. Queued requests survive a
+        restart untouched (the deque is shared state, not loop
+        state); past the budget everything queued fails with a typed
+        error and the batcher refuses new work."""
+        while True:
+            try:
+                self._loop()
+                return                  # clean exit: close() observed
+            except BaseException as e:  # noqa: BLE001 — supervised
+                self.restarts += 1
+                if self._metrics is not None:
+                    self._metrics.count("worker_restarts")
+                over = self.restarts > self._restart_budget
+                obs_log.log_event(
+                    "error" if over else "warning",
+                    "worker_restart",
+                    msg=f"batcher loop crashed "
+                        f"({type(e).__name__}: {e}); "
+                        + ("restart budget exhausted — batcher is "
+                           "dead" if over else
+                           f"restart {self.restarts}/"
+                           f"{self._restart_budget}"),
+                    worker="batcher", restart=self.restarts,
+                    error=type(e).__name__)
+                obs.instant("worker_restart", worker="batcher",
+                            restart=self.restarts)
+                if over or self._closed:
+                    self._die(e)
+                    return
+                time.sleep(faults.backoff_s(
+                    self.restarts, self._restart_backoff_ms))
+
+    def _die(self, err: BaseException) -> None:
+        with self._cond:
+            self._dead = True
+            pending = list(self._queue)
+            self._queue.clear()
+            self._cond.notify_all()
+        for p in pending:
+            obs.end(p.obs, outcome="error")
+            p.future.set_exception(ServeError(
+                f"batcher worker died: {type(err).__name__}: {err}"))
+
+    def _loop(self) -> None:
         while True:
             if self._heartbeat is not None:
                 self._heartbeat()
+            faults.fire("batcher_loop")
             batch = self._take_batch()
             if batch is None:
                 return
@@ -228,13 +331,21 @@ class MicroBatcher:
                   if watch is not None and watch.warm else None)
         with obs.span("batched", batch=bid, queries=len(queries),
                       requests=len(live)):
+            poison: List[int] = []
             try:
                 # TraceAnnotation-wrapped: the device lanes of a
                 # profiler capture carry the same batch id.
                 with obs.device_span("device", batch=bid,
                                      queries=len(queries)):
-                    vals, ids = self._search_fn(queries, live[0].k,
-                                                live[0].group)
+                    if self._supervisor is not None:
+                        vals, ids, poison = self._supervisor.run_batch(
+                            queries, live[0].k, live[0].group,
+                            batch_id=bid)
+                    else:
+                        faults.fire("device_dispatch",
+                                    queries=len(queries), batch=bid)
+                        vals, ids = self._search_fn(queries, live[0].k,
+                                                    live[0].group)
             except BaseException as e:  # noqa: BLE001 — deliver
                 for p in live:
                     p.future.set_exception(e)
@@ -246,9 +357,26 @@ class MicroBatcher:
             if self._metrics is not None:
                 self._metrics.observe_batch(len(queries),
                                             _pow2(len(queries)))
-            vals, ids = np.asarray(vals), np.asarray(ids)
+            if not poison:
+                vals, ids = np.asarray(vals), np.asarray(ids)
+                for p, lo, hi in zip(live, offsets, offsets[1:]):
+                    p.future.set_result((vals[lo:hi], ids[lo:hi]))
+                return
+            # Poison isolation: requests carrying a poison query fail
+            # with the typed error (naming THEIR poison queries);
+            # every innocent request resolves from the bisection's
+            # per-query rows — bit-identical to a clean dispatch.
+            pset = set(poison)
             for p, lo, hi in zip(live, offsets, offsets[1:]):
-                p.future.set_result((vals[lo:hi], ids[lo:hi]))
+                bad = [j - lo for j in range(lo, hi) if j in pset]
+                if bad:
+                    p.future.set_exception(PoisonQuery(
+                        f"{len(bad)} of {hi - lo} queries in this "
+                        f"request poisoned batch {bid} and were "
+                        f"quarantined",
+                        queries=[p.queries[b] for b in bad]))
+                else:
+                    p.future.set_result((vals[lo:hi], ids[lo:hi]))
 
     # --- shutdown ---
     def close(self, drain: bool = True) -> None:

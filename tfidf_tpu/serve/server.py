@@ -26,6 +26,16 @@ Guarantees:
   downtime and zero mixed-epoch batches.
 * **Graceful shutdown** — :meth:`close` drains in-flight work by
   default; ``drain=False`` fails queued requests fast.
+* **Survival** (round 13) — device dispatch runs under a
+  :class:`~tfidf_tpu.serve.supervisor.SupervisedDispatch` (bounded
+  retry with jittered backoff for transient faults; poison-query
+  bisection + quarantine — resubmitted poison fails fast with the
+  typed :class:`PoisonQuery`); a :class:`~tfidf_tpu.serve.supervisor.
+  CircuitBreaker` trips into degraded admission after N consecutive
+  dispatch failures; the batcher loop restarts itself inside a
+  budget; and :meth:`snapshot` / restore-on-start persist the
+  resident index through ``checkpoint.py``'s crash-safe protocol so
+  a killed server resumes serving without re-ingesting.
 """
 
 from __future__ import annotations
@@ -40,18 +50,22 @@ from typing import Callable, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
-from tfidf_tpu import obs
+from tfidf_tpu import faults, obs
 from tfidf_tpu.config import ServeConfig
 from tfidf_tpu.models.retrieval import TfidfRetriever
 from tfidf_tpu.obs import devmon as obs_devmon
 from tfidf_tpu.obs import log as obs_log
 from tfidf_tpu.obs.health import HealthMonitor, HealthThresholds
 from tfidf_tpu.serve.batcher import (DeadlineExceeded, MicroBatcher,
-                                     Overloaded, ServeError)
+                                     Overloaded, PoisonQuery,
+                                     ServeError, ServerClosed)
 from tfidf_tpu.serve.cache import ResultCache, normalize_query
 from tfidf_tpu.serve.metrics import ServeMetrics
+from tfidf_tpu.serve.supervisor import (CircuitBreaker, QuarantineList,
+                                        RetryPolicy, SupervisedDispatch)
 
-__all__ = ["TfidfServer", "ServeError", "Overloaded", "DeadlineExceeded"]
+__all__ = ["TfidfServer", "ServeError", "Overloaded", "DeadlineExceeded",
+           "ServerClosed", "PoisonQuery"]
 
 
 class TfidfServer:
@@ -67,20 +81,32 @@ class TfidfServer:
 
     def __init__(self, retriever: TfidfRetriever,
                  config: Optional[ServeConfig] = None,
-                 metrics: Optional[ServeMetrics] = None) -> None:
+                 metrics: Optional[ServeMetrics] = None,
+                 initial_epoch: int = 0) -> None:
         if not retriever.indexed:
             raise ValueError("TfidfServer needs an indexed retriever; "
                              "call index()/index_dir() first")
         self.config = config or ServeConfig.from_env()
         self.metrics = metrics or ServeMetrics()
         self._retriever = retriever
-        self._epoch = 0
+        # initial_epoch: a snapshot-restored server resumes at the
+        # epoch it snapshotted (cache keys and canary oracles stay
+        # epoch-consistent across the restart).
+        self._epoch = initial_epoch
         self._lock = threading.Lock()   # epoch/retriever swap + admission
         self._inflight = 0              # admitted, unresolved queries
         self._closed = False
         self._t0 = time.monotonic()     # uptime_s anchor
         self._swap_listeners: List[Callable] = []
         self._cache = ResultCache(self.config.cache_entries)
+        # Fault plan (round 13): arming is the server's job when the
+        # config names one (the chaos path — serve_bench --chaos /
+        # TFIDF_TPU_FAULTS); disarmed again on close so an embedded
+        # test server never leaks faults into the host process.
+        self._armed_faults = None
+        if self.config.faults:
+            self._armed_faults = faults.arm(faults.FaultPlan.parse(
+                self.config.faults, seed=self.config.fault_seed))
         # The health watchdog: batcher liveness + queue saturation +
         # windowed shed rates -> ok|degraded|unhealthy, with degraded
         # feeding back into admission (docstring of obs/health.py).
@@ -119,10 +145,30 @@ class TfidfServer:
                 period_s=self.config.devmon_period_ms / 1e3)
             self.attach_device_monitor(self.devmon)
             self.devmon.start()
+        # Supervised execution (round 13): retry/backoff + breaker +
+        # poison bisection around the device call, and a supervised
+        # (restartable) batcher loop. The breaker feeds health the
+        # same way memory pressure does — open breaker -> degraded ->
+        # admission bound shrinks at the gate.
+        self.breaker = CircuitBreaker(
+            threshold=self.config.breaker_threshold,
+            cooldown_s=self.config.breaker_cooldown_ms / 1e3,
+            registry=self.metrics.registry)
+        self.health.add_signal("circuit_breaker",
+                               self.breaker.health_signal)
+        self.quarantine = QuarantineList(registry=self.metrics.registry)
+        self._dispatcher = SupervisedDispatch(
+            self._run_batch,
+            RetryPolicy(max_attempts=1 + self.config.dispatch_retries,
+                        backoff_ms=self.config.retry_backoff_ms,
+                        seed=self.config.fault_seed),
+            breaker=self.breaker, metrics=self.metrics)
         self._batcher = MicroBatcher(
             self._run_batch, max_batch=self.config.max_batch,
             max_wait_ms=self.config.max_wait_ms, metrics=self.metrics,
-            heartbeat=lambda: self.health.heartbeat("batcher"))
+            heartbeat=lambda: self.health.heartbeat("batcher"),
+            supervisor=self._dispatcher,
+            restart_budget=self.config.restart_budget)
         self.health.register(
             "batcher", busy_fn=lambda: self._batcher.queued_queries() > 0)
         if self.config.health_period_ms is not None:
@@ -172,11 +218,25 @@ class TfidfServer:
         # while healthy, shrunk while the watchdog says degraded /
         # unhealthy — shedding earlier at the gate is how a degraded
         # server drains its backlog instead of compounding it.
+        # Quarantine gate: a query isolated as poison by an earlier
+        # batch's bisection fails fast here — the typed 4xx — instead
+        # of re-poisoning a batch. Zero cost while the list is empty.
+        if len(self.quarantine):
+            qcfg = self._retriever.config
+            bad = [q for q in queries
+                   if self.quarantine.contains(normalize_query(q, qcfg))]
+            if bad:
+                self.metrics.count("poisoned")
+                obs.end(req, outcome="poisoned")
+                self._digest(t0, n, k, "poisoned")
+                raise PoisonQuery(
+                    f"{len(bad)} of {n} queries are quarantined as "
+                    f"poison", queries=bad)
         bound = self.health.admission_bound(self.config.queue_depth)
         with self._lock:
             if self._closed:
                 obs.end(req, outcome="rejected")
-                raise ServeError("server is closed")
+                raise ServerClosed("server is closed")
             if self._inflight + n > bound:
                 self.metrics.count("shed_overload")
                 obs.end(req, outcome="shed_overload")
@@ -232,10 +292,23 @@ class TfidfServer:
             err = f.exception()
             if err is not None:
                 self._finish(n)
-                outcome = (
-                    "shed_deadline" if isinstance(err, DeadlineExceeded)
-                    else "shed_overload" if isinstance(err, Overloaded)
-                    else "error")
+                if isinstance(err, PoisonQuery):
+                    # Bisection isolated poison queries in this
+                    # request: quarantine them (resubmissions fail
+                    # fast at the gate) and fail the future typed.
+                    for q in err.queries:
+                        self.quarantine.add(
+                            normalize_query(q, cfg),
+                            query_repr=f"len={len(q)}")
+                    self.metrics.count("poisoned")
+                    outcome = "poisoned"
+                else:
+                    outcome = (
+                        "shed_deadline"
+                        if isinstance(err, DeadlineExceeded)
+                        else "shed_overload"
+                        if isinstance(err, Overloaded)
+                        else "error")
                 obs.end(req, outcome=outcome)
                 self._digest(t0, n, k, outcome, epoch=epoch,
                              error=(None if outcome != "error"
@@ -274,12 +347,29 @@ class TfidfServer:
         invalidated (epoch bump + clear). Swap listeners (the canary
         prober's oracle re-capture) run synchronously BEFORE the epoch
         returns, so the swap is observable the instant it is live.
-        Returns the new epoch."""
+        Returns the new epoch.
+
+        A swap racing :meth:`close` either completes or raises the
+        typed :class:`ServerClosed` — never deadlocks (close never
+        holds the admission lock while draining, and the snapshot /
+        listeners here run outside it). With ``snapshot_dir``
+        configured, the NEW epoch is snapshotted BEFORE the flip:
+        a crash at any instant after the swap returns restores the
+        index that was serving — the swap-then-crash hole is closed.
+        """
         if not retriever.indexed:
             raise ValueError("swap_index needs an indexed retriever")
+        faults.fire("swap", epoch=self._epoch + 1)
+        if self.config.snapshot_dir:
+            # Persist the incoming epoch first: if we crash between
+            # here and the flip, the snapshot is merely ahead by one
+            # swap that never went live — restoring it serves the
+            # index the swap was installing, never a torn state.
+            retriever.snapshot(self.config.snapshot_dir,
+                               epoch=self._epoch + 1)
         with self._lock:
             if self._closed:
-                raise ServeError("server is closed")
+                raise ServerClosed("server is closed")
             self._retriever = retriever
             self._epoch += 1
             epoch = self._epoch
@@ -291,6 +381,31 @@ class TfidfServer:
         for listener in list(self._swap_listeners):
             listener(epoch, retriever)
         return epoch
+
+    def snapshot(self, snapshot_dir: Optional[str] = None) -> str:
+        """Persist the CURRENT resident index (CSR arrays + IDF +
+        names + epoch + config fingerprint, checksummed) under
+        ``snapshot_dir`` (default ``config.snapshot_dir``) through
+        ``checkpoint.py``'s seq+LATEST atomic protocol. A process
+        killed at any instant leaves the previous committed snapshot
+        restorable; the serve CLI's ``--snapshot-dir`` restores it on
+        start so a restarted server serves in seconds instead of
+        re-ingesting. Returns the snapshot directory."""
+        d = snapshot_dir or self.config.snapshot_dir
+        if not d:
+            raise ValueError("no snapshot dir (pass one or set "
+                             "ServeConfig.snapshot_dir)")
+        with self._lock:
+            epoch, retriever = self._epoch, self._retriever
+        t0 = time.monotonic()
+        retriever.snapshot(d, epoch=epoch)
+        obs_log.log_event(
+            "info", "index_snapshot",
+            msg=f"index snapshot (epoch {epoch}, "
+                f"{retriever._num_docs} docs) -> {d} "
+                f"in {time.monotonic() - t0:.3f}s",
+            epoch=epoch, docs=retriever._num_docs, dir=d)
+        return d
 
     def attach_device_monitor(self, monitor) -> None:
         """Wire a :class:`~tfidf_tpu.obs.devmon.DeviceMonitor` into
@@ -411,6 +526,8 @@ class TfidfServer:
             self.devmon.stop()
         if obs_devmon.get_watch() is self.compile_watch:
             obs_devmon.set_watch(None)
+        if self._armed_faults is not None:
+            faults.disarm()
         obs_log.dump_flight()  # no-op unless a dump path is armed
 
     @property

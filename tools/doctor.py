@@ -18,12 +18,18 @@ Reads the evidence one run leaves behind — the span trace
   and every ``hbm_watermark`` breach;
 * **recompiles** — every ``xla_recompile`` flight event (program
   fingerprint included) plus ``recompile_in_batch`` trace instants;
+* **faults** — the recovery story's receipts (round 13): dispatch
+  retries, worker restarts (by worker), breaker trips/closes,
+  quarantines and injected faults from the flight events, plus
+  whether the run ENDED with the breaker open;
 * **ledger** — the trailing BENCH_LEDGER.jsonl records for context.
 
 Budgets make it a CI gate: the doctor exits non-zero when the run
 recompiled after warm-up (``--allow-recompiles``, default 0), crossed
-an HBM watermark (``--allow-watermarks``, default 0) or blew an
-explicit per-phase time budget (``--budget pack=0.5``, repeatable).
+an HBM watermark (``--allow-watermarks``, default 0), ended with the
+dispatch circuit breaker open (``--allow-breaker-open`` to tolerate)
+or blew an explicit per-phase time budget (``--budget pack=0.5``,
+repeatable).
 
 Pure stdlib — runnable under ``JAX_PLATFORMS=cpu`` or no jax at all.
 Exit 0 = healthy, 1 = a budget violation, 2 = unreadable input.
@@ -149,10 +155,35 @@ def analyze_flight(path: str) -> dict:
     recompiles = [e for e in events if e.get("event") == "xla_recompile"]
     watermarks = [e for e in events if e.get("event") == "hbm_watermark"]
     censuses = [e for e in events if e.get("event") == "hbm_census"]
+    # The recovery story's receipts (round 13): every retry, worker
+    # restart, breaker transition, quarantine and injected fault is a
+    # flight event — the doctor folds them into one "faults" section
+    # and flags a run that ENDED with the breaker open (the last
+    # breaker event is a trip with no close after it: the server
+    # never recovered before exit).
+    from collections import Counter as _Counter
+    _FAULT_EVENTS = ("dispatch_retry", "worker_restart", "breaker_trip",
+                     "breaker_close", "query_quarantined",
+                     "poison_isolated", "fault_injected")
+    fault_counts = _Counter(e["event"] for e in events
+                            if e.get("event") in _FAULT_EVENTS)
+    breaker_tail = [e["event"] for e in events
+                    if e.get("event") in ("breaker_trip",
+                                          "breaker_close")]
+    faults_out = {name: fault_counts.get(name, 0)
+                  for name in _FAULT_EVENTS}
+    faults_out["breaker_open_at_exit"] = bool(
+        breaker_tail and breaker_tail[-1] == "breaker_trip")
+    restarts_by_worker = _Counter(
+        e.get("worker", "?") for e in events
+        if e.get("event") == "worker_restart")
+    if restarts_by_worker:
+        faults_out["restarts_by_worker"] = dict(restarts_by_worker)
     out = {
         "events": len(events),
         "digests": len(digests),
         "suppressed": header.get("suppressed", {}),
+        "faults": faults_out,
         "recompiles": [
             {k: v for k, v in e.items()
              if k not in ("t", "kind", "level", "msg")}
@@ -189,16 +220,20 @@ def tail_ledger(path: str, n: int = 5) -> List[dict]:
 
 def diagnose(trace: str, flight: Optional[str], ledger: str,
              allow_recompiles: int = 0, allow_watermarks: int = 0,
+             allow_breaker_open: bool = False,
              budgets: Optional[Dict[str, float]] = None) -> dict:
     report: dict = {"trace": trace}
     report.update(analyze_trace(trace))
     recompile_count = report["recompile_instants"]
     watermark_count = 0
+    breaker_open = False
     if flight and os.path.exists(flight):
         report["flight"] = analyze_flight(flight)
         recompile_count = max(recompile_count,
                               len(report["flight"]["recompiles"]))
         watermark_count = len(report["flight"]["watermarks"])
+        breaker_open = report["flight"]["faults"][
+            "breaker_open_at_exit"]
     report["ledger_tail"] = tail_ledger(ledger)
 
     violations: List[str] = []
@@ -210,6 +245,11 @@ def diagnose(trace: str, flight: Optional[str], ledger: str,
         violations.append(
             f"{watermark_count} HBM watermark breach(es) "
             f"(allowed {allow_watermarks})")
+    if breaker_open and not allow_breaker_open:
+        violations.append(
+            "circuit breaker OPEN at exit (last breaker event is a "
+            "trip with no close after it — the server never "
+            "recovered; --allow-breaker-open to tolerate)")
     for name, budget in (budgets or {}).items():
         got = report["phases"].get(name, {}).get("total_s", 0.0)
         if got > budget:
@@ -251,6 +291,19 @@ def render(report: dict) -> str:
                      f"{fl['digests']} digests"
                      + (f", suppressed {fl['suppressed']}"
                         if fl["suppressed"] else ""))
+        fa = fl.get("faults", {})
+        if any(v for k, v in fa.items()
+               if k not in ("breaker_open_at_exit",
+                            "restarts_by_worker")):
+            by_worker = fa.get("restarts_by_worker")
+            lines.append(
+                f"  faults: {fa['dispatch_retry']} retries, "
+                f"{fa['worker_restart']} worker restarts"
+                + (f" {by_worker}" if by_worker else "")
+                + f", {fa['breaker_trip']} breaker trips "
+                f"({'OPEN' if fa['breaker_open_at_exit'] else 'closed'}"
+                f" at exit), {fa['query_quarantined']} quarantined, "
+                f"{fa['fault_injected']} injected")
         if "hbm_owners" in fl:
             owners = ", ".join(
                 f"{name} {info.get('bytes', 0) / 1e6:.1f} MB"
@@ -290,6 +343,10 @@ def main() -> int:
                          "before exit 1 (default 0)")
     ap.add_argument("--allow-watermarks", type=int, default=0,
                     help="HBM watermark breaches tolerated (default 0)")
+    ap.add_argument("--allow-breaker-open", action="store_true",
+                    help="tolerate a run whose flight dump ends with "
+                         "the dispatch circuit breaker open (default: "
+                         "exit 1 — the server never recovered)")
     ap.add_argument("--budget", action="append", default=[],
                     metavar="PHASE=SECONDS",
                     help="per-phase wall budget, repeatable "
@@ -316,6 +373,7 @@ def main() -> int:
         report = diagnose(args.trace, flight, args.ledger,
                           allow_recompiles=args.allow_recompiles,
                           allow_watermarks=args.allow_watermarks,
+                          allow_breaker_open=args.allow_breaker_open,
                           budgets=budgets)
     except (OSError, ValueError, KeyError) as e:
         print(f"doctor: cannot read inputs: {e}", file=sys.stderr)

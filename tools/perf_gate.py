@@ -84,10 +84,24 @@ _GATES = {
     "multichip": {
         "ok": ("higher", 0.0),
     },
+    # Chaos runs (serve_bench --chaos): parity under faults is the
+    # whole point — zero-tolerance both ways. parity_ok must stay 1
+    # (any served-vs-direct byte divergence fails), and
+    # breaker_open_at_exit must stay 0 (a run that ends with the
+    # breaker open did not recover — the absolute zero-baseline rule
+    # fires on any nonzero candidate). Fault counts are context, not
+    # gates: they move with the plan, which _MATCH_KEYS pins anyway.
+    "chaos": {
+        "parity_ok": ("higher", 0.0),
+        "breaker_open_at_exit": ("lower", 0.0),
+        "throughput_qps": ("higher", 0.50),
+    },
 }
 # Context keys that must MATCH for two records to be comparable.
 _MATCH_KEYS = {"bench": ("backend", "n_docs"),
                "serve_bench": ("backend", "docs", "k", "max_batch"),
+               "chaos": ("backend", "docs", "k", "max_batch", "plan",
+                         "seed"),
                "multichip": ("n_devices",)}
 
 

@@ -77,6 +77,26 @@ _SERVE_METRICS = {
     "peak_hbm_bytes": "peak_hbm_bytes",
     "xla_compiles": "xla_compiles",
 }
+# Chaos artifacts (serve_bench --chaos): the fault-plan receipts. The
+# gated metric is parity_ok — every non-shed non-poisoned response
+# bit-identical to direct search DESPITE the injected faults (1 must
+# stay 1; perf_gate zero-tolerates it) — with breaker_open_at_exit
+# its zero-must-stay-zero twin. The counts are recorded for trend
+# reading, not gated: a different plan legitimately moves them.
+_CHAOS_METRICS = {
+    "parity_ok": "chaos.parity_ok",
+    "breaker_open_at_exit": "chaos.breaker_open_at_exit",
+    "retries": "chaos.retries",
+    "worker_restarts": "chaos.worker_restarts",
+    "breaker_trips": "chaos.breaker_trips",
+    "quarantined": "chaos.quarantined",
+    "poisoned_requests": "chaos.poisoned_requests",
+    "shed_requests": "chaos.shed_requests",
+    "throughput_qps": "throughput_qps",
+}
+_CHAOS_CONTEXT = {"backend": "backend", "docs": "docs", "k": "k",
+                  "requests": "requests", "max_batch": "max_batch",
+                  "plan": "chaos.plan", "seed": "chaos.seed"}
 # Multi-chip dryrun artifacts (MULTICHIP_r0X.json): a driver wrapper
 # with no parsed payload — just the mesh smoke's verdict. "ok" is the
 # gated metric (1 must stay 1); n_devices is comparability context.
@@ -112,7 +132,10 @@ def unwrap(doc: dict) -> Optional[dict]:
 
 def classify(payload: dict) -> Optional[str]:
     if payload.get("metric") == "serve_bench":
-        return "serve_bench"
+        # A serve_bench run under an armed fault plan is its own kind:
+        # chaos runs are only comparable to chaos runs with the SAME
+        # plan (context below), never to clean serving baselines.
+        return "chaos" if "chaos" in payload else "serve_bench"
     if payload.get("unit") == "docs/sec" or "vs_baseline" in payload:
         return "bench"
     if "n_devices" in payload and "ok" in payload:
@@ -136,9 +159,11 @@ def normalize(path: str) -> Tuple[Optional[dict], Optional[str]]:
         return None, "unrecognized artifact shape (not bench/serve)"
     metric_paths = {"serve_bench": _SERVE_METRICS,
                     "bench": _BENCH_METRICS,
+                    "chaos": _CHAOS_METRICS,
                     "multichip": _MULTICHIP_METRICS}[kind]
     ctx_paths = {"serve_bench": _SERVE_CONTEXT,
                  "bench": _BENCH_CONTEXT,
+                 "chaos": _CHAOS_CONTEXT,
                  "multichip": _MULTICHIP_CONTEXT}[kind]
     metrics = {name: (int(v) if isinstance(v, bool) else v)
                for name, p in metric_paths.items()

@@ -72,6 +72,20 @@ def main() -> int:
     ap.add_argument("--cache-entries", type=int, default=4096)
     ap.add_argument("--deadline-ms", type=float, default=None)
     ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--chaos", metavar="PLAN", default=None,
+                    help="arm this fault-injection plan for the whole "
+                         "load (grammar in tfidf_tpu/faults.py, e.g. "
+                         "'device_dispatch:transient:n=4;"
+                         "device_dispatch:fatal:match=__poison__'); "
+                         "the artifact gains a 'chaos' object with "
+                         "retry/restart/quarantine/shed counts and a "
+                         "parity_ok verdict (every non-shed "
+                         "non-poisoned response re-checked "
+                         "bit-identical against direct search). "
+                         "match= rules on device_dispatch make the "
+                         "bench inject matching poison requests")
+    ap.add_argument("--chaos-seed", type=int, default=0,
+                    help="fault-plan + jitter seed (replayable chaos)")
     ap.add_argument("--out", default="SERVE_r01.json")
     ap.add_argument("--trace", metavar="OUT.json", default=None,
                     help="record the host span timeline (request "
@@ -91,7 +105,8 @@ def main() -> int:
     from tfidf_tpu.config import PipelineConfig, ServeConfig, VocabMode
     from tfidf_tpu.models import TfidfRetriever
     from tfidf_tpu.models.retrieval import _search_bcoo
-    from tfidf_tpu.serve import Overloaded, ServeError, TfidfServer
+    from tfidf_tpu.serve import (Overloaded, PoisonQuery, ServeError,
+                                 TfidfServer)
 
     # Structured diagnostics: the stderr echo preserves the old print
     # behavior; the events also land in the flight-recorder ring.
@@ -120,11 +135,25 @@ def main() -> int:
         server = TfidfServer(retriever, ServeConfig(
             max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
             queue_depth=args.queue_depth, cache_entries=args.cache_entries,
-            default_deadline_ms=args.deadline_ms))
+            default_deadline_ms=args.deadline_ms,
+            faults=args.chaos, fault_seed=args.chaos_seed))
 
         rng = np.random.default_rng(args.seed)
         draw = make_queries(rng, args.pool, benchmod.N_WORDS, qlen=4)
         sizes = [int(s) for s in args.queries_per_request.split(",")]
+
+        # Chaos mode: requests matching a device_dispatch match= rule
+        # are the plan's poison — inject a few deliberately so the
+        # poison path (bisect -> PoisonQuery -> quarantine) actually
+        # runs, and remember which requests to expect 4xx from.
+        poison_tokens = []
+        if args.chaos:
+            from tfidf_tpu import faults as faults_mod
+            plan = faults_mod.FaultPlan.parse(args.chaos,
+                                              seed=args.chaos_seed)
+            poison_tokens = [r.match for r in
+                             plan.rules_for("device_dispatch")
+                             if r.match is not None]
 
         # Warmup: touch every power-of-two query bucket this load can
         # produce (plus max_batch itself — full coalesced batches), so
@@ -148,15 +177,35 @@ def main() -> int:
         devmon.sample()
 
         shed = [0]
+        poisoned = [0]
+        failed = [0]
+        completed = []   # (queries, vals, ids) for the parity pass
         lock = threading.Lock()
 
         def one_request(i):
             qs = [draw() for _ in range(sizes[i % len(sizes)])]
+            if poison_tokens and i % 16 == 3:
+                # Every 16th request carries the plan's poison token:
+                # its batch must bisect, ITS future must fail typed,
+                # and its co-batched neighbors must still be served.
+                qs = list(qs) + [f"{poison_tokens[i % len(poison_tokens)]}"
+                                 f" q{i}"]
             try:
-                server.search(qs, k=args.k)
+                vals, ids = server.search(qs, k=args.k)
+                if args.chaos:
+                    with lock:
+                        completed.append((qs, vals, ids))
+            except PoisonQuery:
+                with lock:
+                    poisoned[0] += 1
             except (Overloaded, ServeError):
                 with lock:
                     shed[0] += 1
+            except Exception:  # noqa: BLE001 — e.g. a transient fault
+                # past the retry budget: a real client would back off
+                # and retry; the bench counts it and keeps loading.
+                with lock:
+                    failed[0] += 1
 
         t0 = time.perf_counter()
         if args.rate > 0:  # open loop: fire-and-forget at fixed arrivals
@@ -189,6 +238,43 @@ def main() -> int:
         wall = time.perf_counter() - t0
         devmon.sample()
         watch = server.compile_watch
+        chaos = None
+        if args.chaos:
+            # Final health: two evaluations so the shed-rate window
+            # the chaos itself provoked has decayed (the health tests
+            # pin that recovery shape); the breaker must have closed.
+            server.health.evaluate()
+            final = server.health.evaluate()
+            reg = server.metrics.registry.snapshot()
+            # Parity: every non-shed non-poisoned response must be
+            # bit-identical to a direct (unfaulted, unbatched) search
+            # — retries, bisection and restarts may cost time, never
+            # bytes.
+            mismatches = 0
+            for qs, vals, ids in completed:
+                dvals, dids = retriever.search(qs, k=args.k)
+                if not (np.array_equal(vals, dvals)
+                        and np.array_equal(ids, dids)):
+                    mismatches += 1
+            chaos = {
+                "plan": args.chaos,
+                "seed": args.chaos_seed,
+                "retries": reg.get("serve_dispatch_retries_total", 0),
+                "worker_restarts": reg.get(
+                    "serve_worker_restarts_total", 0),
+                "breaker_trips": reg.get("serve_breaker_trips_total",
+                                         0),
+                "breaker_open_at_exit": int(
+                    server.breaker.state != "closed"),
+                "quarantined": reg.get("serve_quarantined_total", 0),
+                "poisoned_requests": poisoned[0],
+                "shed_requests": shed[0],
+                "failed_requests": failed[0],
+                "final_health": final.state,
+                "parity_checked": len(completed),
+                "parity_mismatches": mismatches,
+                "parity_ok": int(mismatches == 0 and len(completed) > 0),
+            }
         server.close(drain=True)
         recompiles = _search_bcoo._cache_size() - compiles_warm
 
@@ -220,6 +306,8 @@ def main() -> int:
             "recompiles_after_warmup": recompiles,
             "xla_compiles": watch.compiles,
         }
+        if chaos is not None:
+            artifact["chaos"] = chaos
         if devmon.peak_bytes:   # backends without memory stats omit
             artifact["peak_hbm_bytes"] = devmon.peak_bytes
             artifact["memory_pressure"] = devmon.memory_pressure
@@ -237,6 +325,14 @@ def main() -> int:
                         msg=f"warning: {recompiles} recompiles after "
                             f"warmup (expected 0)",
                         recompiles=recompiles)
+            return 1
+        if chaos is not None and not chaos["parity_ok"]:
+            log.error("serve_bench_chaos_parity",
+                      msg=f"chaos parity FAILED: "
+                          f"{chaos['parity_mismatches']}/"
+                          f"{chaos['parity_checked']} responses "
+                          f"diverged from direct search",
+                      mismatches=chaos["parity_mismatches"])
             return 1
         return 0
     finally:

@@ -33,8 +33,14 @@ ingest traces (auto-detected by ``pack`` spans):
 serve traces (auto-detected by ``request`` spans):
   * every ``request`` span carries an ``outcome`` in the known set —
     the span-chain parity the serving layer promises (each submitted
-    request appears exactly once as drained / cache_hit / shed / ...);
-  * every ``queued`` span that reached a batch carries its batch id.
+    request appears exactly once as drained / cache_hit / shed /
+    poisoned / ...; ``poisoned`` is the quarantined-query terminal
+    state — a quarantined request must END that way, never hang);
+  * every ``queued`` span that reached a batch carries its batch id;
+  * recovery spans nest (round 13): every ``dispatch_retry`` span
+    lies inside a ``batched`` span on the same lane (retries happen
+    INSIDE the batch serving the requests, so the timeline attributes
+    the added latency to the right batch).
 
 flight recorder (``--flight DUMP.jsonl``, round 11):
   * line 1 is a ``tfidf-flight/1`` schema header whose ``events`` /
@@ -76,7 +82,7 @@ load_chrome_trace = _tracer.load_chrome_trace
 spans_by_thread = _tracer.spans_by_thread
 
 _OUTCOMES = {"drained", "cache_hit", "shed_overload", "shed_deadline",
-             "rejected", "error", "empty"}
+             "rejected", "error", "empty", "poisoned"}
 
 
 def _overlaps(a: dict, b: dict) -> bool:
@@ -221,6 +227,13 @@ def _check_ingest(lanes, by_name, notes) -> List[str]:
     return errors
 
 
+def _contained(inner: dict, outer: dict, slack: float = 1.0) -> bool:
+    """inner's [ts, ts+dur] within outer's, to ``slack`` us."""
+    return (inner["ts"] >= outer["ts"] - slack
+            and inner["ts"] + inner.get("dur", 0.0)
+            <= outer["ts"] + outer.get("dur", 0.0) + slack)
+
+
 def _check_serve(by_name, notes) -> List[str]:
     errors: List[str] = []
     requests = by_name.get("request", [])
@@ -244,6 +257,24 @@ def _check_serve(by_name, notes) -> List[str]:
     if batches:
         bids = {(e.get("args") or {}).get("batch") for e in batches}
         notes.append(f"batches: {len(batches)} ({len(bids)} ids)")
+    # Round 13 recovery nesting: a dispatch retry happens INSIDE the
+    # batch it is retrying — its span must be contained in a batched
+    # span on the same lane (same pid/tid), so the timeline charges
+    # the backoff to the right batch and never floats free.
+    retries = by_name.get("dispatch_retry", [])
+    for r in retries:
+        lane_batches = [b for b in batches
+                        if (b.get("pid"), b.get("tid"))
+                        == (r.get("pid"), r.get("tid"))]
+        if not any(_contained(r, b) for b in lane_batches):
+            errors.append(
+                f"dispatch_retry span (batch "
+                f"{(r.get('args') or {}).get('batch')!r}) not nested "
+                f"inside any batched span on its lane")
+            break
+    if retries:
+        notes.append(f"dispatch retries: {len(retries)} "
+                     f"(all nested in batches)")
     return errors
 
 
@@ -306,6 +337,37 @@ def check_flight(path: str) -> Tuple[List[str], List[str]]:
     return errors, notes
 
 
+def _cross_check_quarantine(trace_path: str, flight_path: str,
+                            notes: List[str]) -> List[str]:
+    """Trace + flight are one incident's evidence: when the flight
+    dump records quarantines, the trace's request spans must show the
+    ``poisoned`` terminal outcome — a quarantined request that never
+    ENDS poisoned either hung or was misreported."""
+    import json
+    with open(flight_path) as f:
+        lines = [l for l in (ln.strip() for ln in f) if l]
+    quarantines = sum(
+        1 for line in lines[1:]
+        if json.loads(line).get("event") == "query_quarantined")
+    if not quarantines:
+        return []
+    events = load_chrome_trace(trace_path)
+    requests = [e for e in events if e.get("ph") == "X"
+                and e.get("name") == "request"]
+    if not requests:
+        return []    # not a serve trace: nothing to cross-check
+    poisoned = sum(1 for e in requests
+                   if (e.get("args") or {}).get("outcome")
+                   == "poisoned")
+    if poisoned == 0:
+        return [f"flight records {quarantines} quarantine(s) but no "
+                f"request span ends with outcome 'poisoned' — "
+                f"quarantined requests must terminate typed"]
+    notes.append(f"quarantine cross-check: {quarantines} event(s), "
+                 f"{poisoned} poisoned request span(s)")
+    return []
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(
         description=__doc__.split("\n")[0],
@@ -339,6 +401,9 @@ def main() -> int:
             return 2
         errors += ferrors
         notes += fnotes
+        if not ferrors:
+            errors += _cross_check_quarantine(args.trace, args.flight,
+                                              notes)
     for n in notes:
         print(f"  {n}")
     if errors:
