@@ -156,27 +156,40 @@ def native_once(input_dir: str, out: str) -> float:
 
 def bench_tpu(input_dir: str):
     from tfidf_tpu.config import PipelineConfig, VocabMode
-    from tfidf_tpu.ingest import make_flat_packer, run_overlapped
+    from tfidf_tpu.ingest import (make_bytes_packer, make_flat_packer,
+                                  run_overlapped, use_bytes_wire)
     from tfidf_tpu.io.corpus import discover_names
 
     # Overlapped chunked ingest on the row-sparse engine: the native
     # parallel loader packs chunk i+1 while the device runs chunk i
     # (async dispatch), DF folds into one device accumulator, and pass B
     # rescoreds each chunk against the corpus-wide IDF. Device memory is
-    # O(chunk x L) — flat in corpus size.
+    # O(chunk x L) — flat in corpus size. BENCH_WIRE selects the chunk
+    # wire (ragged default; "bytes" ships raw UTF-8 and tokenizes on
+    # device — round 14).
     cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=VOCAB,
                          max_doc_len=DOC_LEN, doc_chunk=DOC_LEN, topk=TOPK,
-                         engine="sparse")
+                         engine="sparse",
+                         wire=os.environ.get("BENCH_WIRE", "ragged"))
     # ~4 chunks won the round-3 structure sweep (tools/ab probes): each
     # chunk pays ~8 ms of tunnel dispatch, and 4 chunks still pipeline
     # transfer+sort behind host packing.
     chunk = max(2048, N_DOCS // 4)
 
-    # Host pack cost alone (one pass over the corpus with the exact
-    # packer run_overlapped uses — native loader or Python fallback) so
-    # the breakdown shows where the wall-clock goes.
+    # SERIALIZED host pack cost alone — one fenced pass over the corpus
+    # with the exact packer run_overlapped uses (native loader or
+    # Python fallback), nothing overlapped. This is the artifact's
+    # `pack_serial_s` (and the perf_gate's `pack_s` metric); the
+    # overlapped run's `phases.pack` is a DIFFERENT span — the stall
+    # waiting on the double-buffered packer thread (round 14 named
+    # them apart; docs/SCALING.md round 14).
     names = discover_names(input_dir, strict=True)
-    packer = make_flat_packer(input_dir, cfg, chunk, DOC_LEN)
+    pack_split = {}
+    if use_bytes_wire(cfg, chunk, DOC_LEN):
+        packer = make_bytes_packer(input_dir, cfg, chunk, DOC_LEN,
+                                   stats=pack_split)
+    else:
+        packer = make_flat_packer(input_dir, cfg, chunk, DOC_LEN)
     t0 = time.perf_counter()
     for s in range(0, len(names), chunk):
         packer(names[s:s + chunk])
@@ -195,7 +208,12 @@ def bench_tpu(input_dir: str):
         assert r.topk_vals.shape == (N_DOCS, TOPK)
         return dt, r
 
-    return tpu_once, pack_s, result, cfg, chunk
+    return tpu_once, pack_s, pack_split, result, cfg, chunk
+
+
+def _resolved_pack_threads(cfg) -> int:
+    from tfidf_tpu.io.fast_tokenizer import resolve_pack_threads
+    return resolve_pack_threads(getattr(cfg, "pack_threads", None))
 
 
 def profile_phases(input_dir: str, cfg, chunk: int, result):
@@ -405,7 +423,8 @@ def main() -> None:
         obs_devmon.set_watch(compile_watch)
         hbm_mon = obs_devmon.DeviceMonitor()
         log("warming TPU path (compile)...")
-        tpu_once, pack_s, result, cfg_tpu, chunk = bench_tpu(input_dir)
+        tpu_once, pack_s, pack_split, result, cfg_tpu, chunk = \
+            bench_tpu(input_dir)
         cpu_times, tpu_times, ratios = [], [], []
         for i in range(REPEATS):
             c = native_once(input_dir, oracle_out)
@@ -475,6 +494,12 @@ def main() -> None:
             record["bytes_on_wire_padded"] = int(result.bytes_on_wire_padded)
             record["wire_ratio"] = round(
                 result.bytes_on_wire / result.bytes_on_wire_padded, 3)
+        # Bytes-wire pack split (round 14): the serialized pack measure
+        # above decomposes into file reads (load_s) and slab assembly
+        # (slab_s) — there is no tokenize/hash on the host at all.
+        if pack_split:
+            record["pack_split"] = {
+                f"{k}_s": round(v, 3) for k, v in pack_split.items()}
         # Downlink accounting (round 7): actual device->host result
         # payload vs what the same selection costs as (int32 id,
         # float32 score) pairs. result_wire_ratio <= 0.55 means the
@@ -583,7 +608,17 @@ def main() -> None:
             cpu_docs_per_sec=round(cpu_dps, 1),
             tpu_s=round(tpu_s, 3),
             cpu_s=round(cpu_s, 3),
-            pack_s=round(pack_s, 3),
+            # pack_serial_s: the fenced one-pass host pack measure the
+            # perf_gate tracks as `pack_s` (renamed round 14 — the old
+            # top-level `pack_s` collided with `phases.pack`, which is
+            # the overlapped run's packer-thread STALL, a different
+            # span; BENCH_r05 showed 0.248 vs 0.369 for that reason,
+            # not drift). perf_ledger reads pack_serial_s with a
+            # pack_s fallback for pre-round-14 artifacts.
+            pack_serial_s=round(pack_s, 3),
+            # Resolved host packer thread count (the reference's
+            # OpenMP knob, --pack-threads / TFIDF_TPU_PACK_THREADS).
+            pack_threads=_resolved_pack_threads(cfg_tpu),
             recall_at_k=round(recall, 4),
             recall_exact_rerank=round(recall_exact, 4),
             exact_docs_per_sec=round(N_DOCS / exact_s, 1),

@@ -224,6 +224,102 @@ int64_t loader_fill_flat_u16_v2(void* handle, uint64_t seed,
   return total;
 }
 
+// Threaded flat fill (round 14 — the reference's "extra" variant is
+// five OpenMP pragmas over exactly this per-doc loop,
+// TFIDF_extra.c:69-302; done properly here on the shared ParallelFor
+// pool): a parallel capped token-count prepass fixes every doc's
+// aligned offset, then the tokenize+hash fill runs per-doc
+// work-stolen across threads, each doc writing (and zero-padding) its
+// own disjoint slice. Bit-identical output to the serial v2 fill —
+// offsets depend only on the capped counts, which the prepass
+// computes exactly (pinned by tests/test_native.py). The serial fills
+// above remain for single-core hosts and stale-.so fallback.
+int64_t loader_fill_flat_u16_v3(void* handle, uint64_t seed,
+                                int64_t vocab_size, int64_t truncate_at,
+                                int64_t max_per_doc, uint16_t* out,
+                                int64_t cap, int32_t* out_lengths,
+                                int64_t align, int n_threads) {
+  Loader* L = static_cast<Loader*>(handle);
+  int64_t n_docs = (int64_t)L->docs.size();
+  std::vector<int64_t> offs(n_docs + 1, 0);
+  ParallelFor(n_docs, n_threads, [&](int64_t d) {
+    // Capped count: exactly the tokens TokenizeHashInto will write.
+    int64_t n = tfidf::ForEachToken(
+        reinterpret_cast<const uint8_t*>(L->docs[d].data()),
+        (int64_t)L->docs[d].size(), /*truncate_at=*/0, max_per_doc,
+        [](const uint8_t*, int64_t) {});
+    offs[d + 1] = n;  // counts first; prefixed below
+  });
+  for (int64_t d = 0; d < n_docs; ++d) {
+    int64_t n = offs[d + 1];
+    int64_t padded = align > 1 ? (n + align - 1) / align * align : n;
+    offs[d + 1] = offs[d] + padded;
+  }
+  int64_t total = offs[n_docs];
+  ParallelFor(n_docs, n_threads, [&](int64_t d) {
+    int64_t n = tfidf::TokenizeHashInto(
+        reinterpret_cast<const uint8_t*>(L->docs[d].data()),
+        (int64_t)L->docs[d].size(), seed, vocab_size, truncate_at,
+        out + offs[d], max_per_doc);
+    out_lengths[d] = (int32_t)n;
+    int64_t pad = offs[d + 1] - offs[d] - n;
+    if (pad > 0)
+      std::memset(out + offs[d] + n, 0, (size_t)pad * sizeof(uint16_t));
+  });
+  if (total < cap)
+    std::memset(out + total, 0,
+                (size_t)(cap - total) * sizeof(uint16_t));
+  return total;
+}
+
+// --- bytes wire (round 14): raw byte slab, zero host tokenize -------
+//
+// The slab layout contract (ops/device_tokenize.py docstring): doc d's
+// raw bytes start at sum of ceil((blen_e + 1) / align) * align over
+// e < d — at least one fill byte between docs — and every
+// non-document byte is 0x20 (space), so the device tokenizer sees
+// whitespace separators and can never merge adjacent documents or
+// manufacture phantom tokens from fill.
+
+// Total aligned slab bytes of the loaded docs — sizes the staging
+// buffer (callers round up to the byte bucket for the compile cache).
+int64_t loader_slab_bytes(void* handle, int64_t align) {
+  Loader* L = static_cast<Loader*>(handle);
+  int64_t a = align > 1 ? align : 1;
+  int64_t total = 0;
+  for (const std::string& s : L->docs)
+    total += ((int64_t)s.size() + a) / a * a;
+  return total;
+}
+
+// Byte-slab fill: one space memset over the whole capacity, then a
+// parallel memcpy of each doc's raw bytes at its aligned offset. This
+// IS the bytes wire's entire host pack — no tokenize, no hash, no id
+// store; the per-token loop the reference parallelizes is gone from
+// the host entirely. Returns total aligned bytes (<= cap).
+int64_t loader_fill_slab(void* handle, uint8_t* out, int64_t cap,
+                         int32_t* out_blens, int64_t align,
+                         int n_threads) {
+  Loader* L = static_cast<Loader*>(handle);
+  int64_t n_docs = (int64_t)L->docs.size();
+  int64_t a = align > 1 ? align : 1;
+  std::vector<int64_t> offs(n_docs, 0);
+  int64_t total = 0;
+  for (int64_t d = 0; d < n_docs; ++d) {
+    offs[d] = total;
+    total += ((int64_t)L->docs[d].size() + a) / a * a;
+  }
+  if (total > cap) return -1;  // caller sized the buffer from
+                               // loader_slab_bytes; cannot happen
+  std::memset(out, 0x20, (size_t)cap);
+  ParallelFor(n_docs, n_threads, [&](int64_t d) {
+    const std::string& s = L->docs[d];
+    if (!s.empty()) std::memcpy(out + offs[d], s.data(), s.size());
+    out_blens[d] = (int32_t)s.size();
+  });
+  return total;
+}
+
 void loader_close(void* handle) { delete static_cast<Loader*>(handle); }
 
 }  // extern "C"

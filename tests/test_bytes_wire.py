@@ -1,0 +1,439 @@
+"""The bytes wire (round 14): raw UTF-8 on the wire, tokenize+hash on
+device. Pins the device tokenizer bit-identical to BOTH host packers
+(the Python semantics oracle and native/fast_tokenizer.so) over random
+byte corpora — multi-byte UTF-8 runs, all-whitespace docs, token byte
+truncation, the max-per-doc token cap, and tokens straddling bucket /
+kernel-block boundaries — plus the Pallas/XLA hash-lowering parity,
+run_overlapped end-to-end parity on every regime, the three-way wire
+selection chain (bytes -> ragged -> padded), and the new slab /
+device_tokenize trace spans."""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+from tfidf_tpu import PipelineConfig
+from tfidf_tpu import ingest as ing
+from tfidf_tpu.config import VocabMode
+from tfidf_tpu.io import fast_tokenizer
+from tfidf_tpu.ops.device_tokenize import (aligned_byte_lengths,
+                                           fnv1a_step, fold_mod,
+                                           seed_state,
+                                           tokenize_hash_device,
+                                           tokenize_method)
+from tfidf_tpu.ops.hashing import words_to_ids
+from tfidf_tpu.ops.tokenize import whitespace_tokenize
+
+NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "native")
+
+
+@pytest.fixture(scope="session", autouse=True)
+def build_native():
+    subprocess.run(["make", "-C", NATIVE_DIR], check=True,
+                   capture_output=True)
+
+
+def _cfg(**kw):
+    base = dict(vocab_mode=VocabMode.HASHED, vocab_size=1 << 10,
+                max_doc_len=64, doc_chunk=64, topk=5, engine="sparse",
+                wire="bytes")
+    base.update(kw)
+    return PipelineConfig(**base)
+
+
+def build_slab(docs, align, bucket=1024):
+    """Reference slab builder (the layout contract in
+    ops/device_tokenize.py): doc bytes at aligned offsets, 0x20 fill."""
+    blens = np.array([len(d) for d in docs], np.int32)
+    albl = aligned_byte_lengths(blens, align)
+    total = int(albl.sum())
+    cap = max(total + (-total % bucket), bucket)
+    slab = np.full(cap, 0x20, np.uint8)
+    off = 0
+    for doc, a in zip(docs, albl.tolist()):
+        slab[off:off + len(doc)] = np.frombuffer(doc, np.uint8)
+        off += int(a)
+    return slab, blens
+
+
+def host_ids(docs, length, vocab, seed, trunc):
+    """The Python host packer's [D, L] contract — THE semantics oracle
+    (whitespace_tokenize + words_to_ids, zero-filled padding)."""
+    ids = np.zeros((len(docs), length), np.int32)
+    lens = np.zeros(len(docs), np.int32)
+    for i, doc in enumerate(docs):
+        toks = whitespace_tokenize(doc, trunc)[:length]
+        lens[i] = len(toks)
+        if toks:
+            ids[i, :len(toks)] = words_to_ids(toks, vocab, seed)
+    return ids, lens
+
+
+class TestFnvEmulation:
+    """The paired-uint32-limb FNV-1a64 emulation equals Python's
+    arbitrary-precision arithmetic, byte for byte."""
+
+    def test_step_matches_bigint(self):
+        import jax.numpy as jnp
+        rng = np.random.default_rng(0)
+        P = 1099511628211
+        h = rng.integers(0, 1 << 64, 64, dtype=np.uint64)
+        b = rng.integers(0, 256, 64, dtype=np.uint64)
+        hi, lo = fnv1a_step(
+            jnp.asarray((h >> np.uint64(32)).astype(np.uint32)),
+            jnp.asarray((h & np.uint64(0xFFFFFFFF)).astype(np.uint32)),
+            jnp.asarray(b.astype(np.uint32)))
+        for i in range(64):
+            ref = ((int(h[i]) ^ int(b[i])) * P) % (1 << 64)
+            got = (int(hi[i]) << 32) | int(lo[i])
+            assert got == ref, (i, hex(got), hex(ref))
+
+    def test_fold_mod_matches_bigint(self):
+        import jax.numpy as jnp
+        rng = np.random.default_rng(1)
+        h = rng.integers(0, 1 << 64, 64, dtype=np.uint64)
+        for vocab in (1 << 16, 65535, 1 << 10, 999, 7, 1):
+            ids = fold_mod(
+                jnp.asarray((h >> np.uint64(32)).astype(np.uint32)),
+                jnp.asarray((h & np.uint64(0xFFFFFFFF))
+                            .astype(np.uint32)), vocab)
+            ref = [(int(x) ^ (int(x) >> 32)) % vocab for x in h]
+            np.testing.assert_array_equal(np.asarray(ids), ref)
+
+    def test_fold_mod_rejects_wide_vocab(self):
+        import jax.numpy as jnp
+        one = jnp.zeros((1,), jnp.uint32)
+        with pytest.raises(ValueError, match="2\\^16"):
+            fold_mod(one, one, (1 << 16) + 1)
+
+    def test_seed_state(self):
+        hi, lo = seed_state(0xDEADBEEF12345678)
+        ref = 14695981039346656037 ^ 0xDEADBEEF12345678
+        assert (int(hi) << 32) | int(lo) == ref
+
+
+class TestDeviceTokenizeParity:
+    """Property test: device tokenize+hash is bit-identical to the
+    Python host oracle over random byte corpora — both lowerings."""
+
+    CASES = [
+        # multi-byte UTF-8 runs, empties, whitespace-only docs
+        ([b"hello world", b"", b"   \t\n ",
+          "héllo wörld 中文 éé".encode(),
+          b"a b c d e f g h i j k l m n o p"], None),
+        # the whitespace family, every separator byte
+        ([b"a\tb\nc\x0bd\x0ce\rf g", b"\r\n\t", b"x"], None),
+        # token byte-truncation (the reference's 16-char quirk) and a
+        # token far longer than any static cap could guess
+        ([b"supercalifragilisticexpialidocious tiny",
+          b"w" * 5000 + b" end"], 16),
+        ([b"supercalifragilisticexpialidocious tiny"], 3),
+    ]
+
+    @pytest.mark.parametrize("method", ["xla", "pallas"])
+    @pytest.mark.parametrize("align", [1, 4, 16])
+    def test_fixed_cases(self, method, align):
+        for docs, trunc in self.CASES:
+            slab, blens = build_slab(docs, align)
+            tok, lens = tokenize_hash_device(
+                slab, blens, length=8, vocab_size=1000, seed=7,
+                truncate_at=trunc, align=align, method=method,
+                interpret=True)
+            eids, elens = host_ids(docs, 8, 1000, 7, trunc)
+            np.testing.assert_array_equal(np.asarray(lens), elens)
+            np.testing.assert_array_equal(np.asarray(tok), eids)
+
+    @pytest.mark.parametrize("method", ["xla", "pallas"])
+    def test_random_binary_corpora(self, method):
+        rng = np.random.default_rng(3)
+        for case in range(8):
+            docs = [bytes(rng.integers(1, 256,
+                                       rng.integers(0, 300))
+                          .astype(np.uint8))
+                    for _ in range(int(rng.integers(1, 10)))]
+            trunc = [None, 4, 16][case % 3]
+            length = int(rng.integers(1, 24))
+            slab, blens = build_slab(docs, 16)
+            tok, lens = tokenize_hash_device(
+                slab, blens, length=length, vocab_size=1 << 10,
+                seed=case, truncate_at=trunc, align=16, method=method,
+                interpret=True)
+            eids, elens = host_ids(docs, length, 1 << 10, case, trunc)
+            np.testing.assert_array_equal(np.asarray(lens), elens)
+            np.testing.assert_array_equal(np.asarray(tok), eids)
+
+    def test_token_straddles_bucket_boundary(self):
+        # One doc engineered so a token's bytes cross the 1024-byte
+        # slab bucket (and any power-of-two kernel block) boundary.
+        doc = b"x" * 1019 + b" straddler " + b"y" * 50
+        slab, blens = build_slab([doc], 16, bucket=1024)
+        assert slab.size > 1024  # the straddler crossed the bucket
+        tok, lens = tokenize_hash_device(
+            slab, blens, length=4, vocab_size=1 << 10, seed=0,
+            align=16, method="xla")
+        eids, elens = host_ids([doc], 4, 1 << 10, 0, None)
+        np.testing.assert_array_equal(np.asarray(tok), eids)
+        np.testing.assert_array_equal(np.asarray(lens), elens)
+
+    def test_max_per_doc_cap(self):
+        # More tokens than L: device lengths cap at L and ids carry
+        # the FIRST L tokens, like TokenizeHashInto's max_out.
+        doc = b" ".join(f"t{i}".encode() for i in range(40))
+        slab, blens = build_slab([doc], 16)
+        tok, lens = tokenize_hash_device(
+            slab, blens, length=10, vocab_size=1 << 10, seed=0,
+            align=16, method="xla")
+        assert int(lens[0]) == 10
+        eids, _ = host_ids([doc], 10, 1 << 10, 0, None)
+        np.testing.assert_array_equal(np.asarray(tok), eids)
+
+    @pytest.mark.skipif(not fast_tokenizer.loader_available(),
+                        reason="native loader not built")
+    def test_matches_native_packer(self, tmp_path):
+        rng = np.random.default_rng(9)
+        docs, paths = [], []
+        for i in range(12):
+            words = [f"w{rng.integers(0, 500)}"
+                     for _ in range(int(rng.integers(0, 30)))]
+            doc = " ".join(words).encode()
+            p = tmp_path / f"doc{i + 1}"
+            p.write_bytes(doc)
+            docs.append(doc)
+            paths.append(str(p))
+        native = fast_tokenizer.load_pack_paths(
+            paths, 1 << 12, seed=5, truncate_at=16, fixed_len=16,
+            pad_docs_to=16)
+        assert native is not None
+        slab, blens = build_slab(docs, 16)
+        blens = np.concatenate([blens,
+                                np.zeros(16 - len(docs), np.int32)])
+        tok, lens = tokenize_hash_device(
+            slab, blens, length=16, vocab_size=1 << 12, seed=5,
+            truncate_at=16, align=16, method="xla")
+        np.testing.assert_array_equal(np.asarray(lens), native[1])
+        np.testing.assert_array_equal(np.asarray(tok),
+                                      native[0].astype(np.int32))
+
+
+class TestSlabPackers:
+    """Native and Python slab packers emit the identical wire."""
+
+    def _write(self, tmp_path, docs):
+        names = []
+        for i, d in enumerate(docs):
+            (tmp_path / f"doc{i + 1}").write_bytes(d)
+            names.append(f"doc{i + 1}")
+        return names
+
+    @pytest.mark.skipif(not fast_tokenizer.slab_available(),
+                        reason="native slab loader not built")
+    def test_native_matches_python(self, tmp_path, monkeypatch):
+        docs = [b"alpha beta", b"", b"  x  ", b"q" * 100]
+        names = self._write(tmp_path, docs)
+        cfg = _cfg()
+        native = ing.make_bytes_packer(str(tmp_path), cfg, 8, 64)
+        s_n, b_n, t_n = native(names)
+        monkeypatch.setenv("TFIDF_TPU_NO_NATIVE", "1")
+        python = ing.make_bytes_packer(str(tmp_path), cfg, 8, 64)
+        s_p, b_p, t_p = python(names)
+        assert t_n == t_p
+        np.testing.assert_array_equal(b_n, b_p)
+        np.testing.assert_array_equal(s_n, s_p)
+
+    def test_stats_split(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TFIDF_TPU_NO_NATIVE", "1")
+        names = self._write(tmp_path, [b"a b c", b"d"])
+        stats = {}
+        pack = ing.make_bytes_packer(str(tmp_path), _cfg(), 4, 64,
+                                     stats=stats)
+        pack(names)
+        assert set(stats) == {"load", "slab"}
+        assert all(v >= 0 for v in stats.values())
+
+    def test_slab_guard_names_bound(self):
+        with pytest.raises(ValueError, match="int32"):
+            ing._check_slab_fits_int32(1 << 31)
+        ing._check_slab_fits_int32(1 << 20)  # fits
+
+
+class TestRunOverlappedBytes:
+    """End-to-end: --wire=bytes equals --wire=ragged on every regime —
+    df, top-k ids, lengths bit-identical; scores allclose."""
+
+    @pytest.fixture
+    def corpus_dir(self, tmp_path):
+        rng = np.random.default_rng(7)
+        for i in range(1, 41):
+            words = [f"w{rng.integers(0, 60)}"
+                     for _ in range(int(rng.integers(0, 40)))]
+            (tmp_path / f"doc{i}").write_text(" ".join(words))
+        return str(tmp_path)
+
+    @pytest.mark.parametrize("regime", ["resident", "streaming",
+                                        "streaming-cached"])
+    def test_parity(self, corpus_dir, regime, monkeypatch):
+        if regime.startswith("streaming"):
+            monkeypatch.setenv("TFIDF_TPU_RESIDENT_ELEMS", "0")
+        if regime == "streaming":
+            monkeypatch.setenv("TFIDF_TPU_TRIPLE_CACHE_BYTES", "0")
+        r_b = ing.run_overlapped(corpus_dir, _cfg(), chunk_docs=16,
+                                 doc_len=64)
+        r_r = ing.run_overlapped(corpus_dir, _cfg(wire="ragged"),
+                                 chunk_docs=16, doc_len=64)
+        assert r_b.wire == "bytes" and r_r.wire == "ragged"
+        np.testing.assert_array_equal(r_b.df, r_r.df)
+        np.testing.assert_array_equal(r_b.topk_ids, r_r.topk_ids)
+        np.testing.assert_allclose(r_b.topk_vals, r_r.topk_vals,
+                                   rtol=1e-6)
+        # lengths are DEVICE-derived on the bytes wire — same values.
+        np.testing.assert_array_equal(r_b.lengths, r_r.lengths)
+        assert r_b.bytes_on_wire > 0
+        assert r_b.bytes_on_wire_padded == r_r.bytes_on_wire_padded
+
+    def test_truncate_parity(self, corpus_dir):
+        r_b = ing.run_overlapped(corpus_dir,
+                                 _cfg(truncate_tokens_at=2),
+                                 chunk_docs=16, doc_len=64)
+        r_r = ing.run_overlapped(corpus_dir,
+                                 _cfg(wire="ragged",
+                                      truncate_tokens_at=2),
+                                 chunk_docs=16, doc_len=64)
+        np.testing.assert_array_equal(r_b.topk_ids, r_r.topk_ids)
+        np.testing.assert_array_equal(r_b.df, r_r.df)
+
+    def test_pallas_method_parity(self, corpus_dir, monkeypatch):
+        monkeypatch.setenv("TFIDF_TPU_DEVICE_TOKENIZE", "pallas")
+        r_p = ing.run_overlapped(corpus_dir, _cfg(), chunk_docs=16,
+                                 doc_len=64)
+        monkeypatch.setenv("TFIDF_TPU_DEVICE_TOKENIZE", "xla")
+        r_x = ing.run_overlapped(corpus_dir, _cfg(), chunk_docs=16,
+                                 doc_len=64)
+        np.testing.assert_array_equal(r_p.topk_ids, r_x.topk_ids)
+        np.testing.assert_array_equal(r_p.df, r_x.df)
+        np.testing.assert_array_equal(r_p.lengths, r_x.lengths)
+
+    def test_pair_result_wire(self, corpus_dir):
+        r_b = ing.run_overlapped(corpus_dir,
+                                 _cfg(result_wire="pair"),
+                                 chunk_docs=16, doc_len=64)
+        r_r = ing.run_overlapped(corpus_dir,
+                                 _cfg(wire="ragged",
+                                      result_wire="pair"),
+                                 chunk_docs=16, doc_len=64)
+        assert r_b.result_wire == "pair"
+        np.testing.assert_array_equal(r_b.topk_ids, r_r.topk_ids)
+
+    def test_python_fallback_parity(self, corpus_dir, monkeypatch):
+        monkeypatch.setenv("TFIDF_TPU_NO_NATIVE", "1")
+        r_b = ing.run_overlapped(corpus_dir, _cfg(), chunk_docs=16,
+                                 doc_len=64)
+        monkeypatch.delenv("TFIDF_TPU_NO_NATIVE")
+        r_n = ing.run_overlapped(corpus_dir, _cfg(), chunk_docs=16,
+                                 doc_len=64)
+        np.testing.assert_array_equal(r_b.topk_ids, r_n.topk_ids)
+        np.testing.assert_array_equal(r_b.df, r_n.df)
+
+    def test_profile_resident_bytes(self, corpus_dir):
+        cfg = _cfg()
+        ing.run_overlapped(corpus_dir, cfg, chunk_docs=16, doc_len=64)
+        ph = ing.profile_resident(corpus_dir, cfg, chunk_docs=16,
+                                  doc_len=64)
+        assert ph["compute"] > 0 and ph["bytes_on_wire"] > 0
+
+
+class TestWireSelection:
+    """The bytes -> ragged -> padded degradation chain and the env
+    override."""
+
+    def test_config_accepts_bytes(self):
+        assert _cfg().wire == "bytes"
+
+    def test_config_rejects_unknown(self):
+        with pytest.raises(ValueError, match="wire"):
+            _cfg(wire="utf8")
+
+    def test_bytes_selected(self):
+        assert ing.use_bytes_wire(_cfg(), 16, 64)
+
+    def test_wide_vocab_degrades_to_padded(self):
+        cfg = _cfg(vocab_size=(1 << 16) + 1)
+        assert not ing.use_bytes_wire(cfg, 16, 64)
+        assert not ing.use_ragged_wire(cfg, 16, 64)
+
+    def test_chargram_degrades(self):
+        from tfidf_tpu.config import TokenizerKind
+        cfg = _cfg(tokenizer=TokenizerKind.CHARGRAM)
+        assert not ing.use_bytes_wire(cfg, 16, 64)
+
+    def test_ragged_ask_never_bytes(self):
+        assert not ing.use_bytes_wire(_cfg(wire="ragged"), 16, 64)
+
+    def test_env_override_wins(self, monkeypatch):
+        monkeypatch.setenv("TFIDF_TPU_WIRE", "bytes")
+        assert ing.use_bytes_wire(_cfg(wire="ragged"), 16, 64)
+        monkeypatch.setenv("TFIDF_TPU_WIRE", "padded")
+        assert not ing.use_bytes_wire(_cfg(), 16, 64)
+        assert not ing.use_ragged_wire(_cfg(), 16, 64)
+
+    def test_env_validates(self, monkeypatch):
+        monkeypatch.setenv("TFIDF_TPU_WIRE", "csr")
+        with pytest.raises(ValueError, match="TFIDF_TPU_WIRE"):
+            ing.resolve_wire(_cfg())
+
+    def test_method_env_validates(self, monkeypatch):
+        monkeypatch.setenv("TFIDF_TPU_DEVICE_TOKENIZE", "mosaic")
+        with pytest.raises(ValueError,
+                           match="TFIDF_TPU_DEVICE_TOKENIZE"):
+            tokenize_method()
+
+    def test_pack_threads_validates(self, monkeypatch):
+        monkeypatch.setenv("TFIDF_TPU_PACK_THREADS", "0")
+        with pytest.raises(ValueError, match="TFIDF_TPU_PACK_THREADS"):
+            fast_tokenizer.resolve_pack_threads()
+        assert fast_tokenizer.resolve_pack_threads(3) == 3
+
+
+class TestTraceSpans:
+    """Bytes-wire runs emit byte-stamped slab (packer lane) and
+    device_tokenize (main lane) spans; tools/trace_check.py accepts
+    the trace (satellite: the doctor's cost attribution feeds on
+    exactly these stamps)."""
+
+    def test_spans_and_trace_check(self, tmp_path, monkeypatch):
+        rng = np.random.default_rng(5)
+        cdir = tmp_path / "corpus"
+        cdir.mkdir()
+        for i in range(1, 31):
+            words = [f"w{rng.integers(0, 40)}"
+                     for _ in range(int(rng.integers(1, 30)))]
+            (cdir / f"doc{i}").write_text(" ".join(words))
+        trace = str(tmp_path / "trace.json")
+        from tfidf_tpu import obs
+        prior = obs.get_tracer()
+        try:
+            obs.configure(trace)
+            ing.run_overlapped(str(cdir), _cfg(), chunk_docs=10,
+                               doc_len=64)
+            path = obs.export()
+        finally:
+            obs.set_tracer(prior)
+        assert path
+        import importlib.util as ilu
+        spec = ilu.spec_from_file_location(
+            "_tc", os.path.join(os.path.dirname(NATIVE_DIR), "tools",
+                                "trace_check.py"))
+        tc = ilu.module_from_spec(spec)
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(NATIVE_DIR),
+                                        "tools"))
+        try:
+            spec.loader.exec_module(tc)
+        finally:
+            sys.path.pop(0)
+        errors, notes = tc.check_trace(path, "ingest", min_threads=2)
+        assert not errors, errors
+        assert any("bytes wire" in n for n in notes), notes

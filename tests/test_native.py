@@ -204,6 +204,106 @@ class TestParallelLoader:
         assert (a.token_ids == b.token_ids).all()
 
 
+class TestThreadedFlatPack:
+    """loader_fill_flat_u16_v3 (round 14): the ragged packer's
+    tokenize+hash fill threaded over the shared ParallelFor pool — the
+    reference's OpenMP move (TFIDF_extra.c:69-302) done race-free.
+    Output must be bit-identical to the serial v2 fill and the Python
+    flatten_aligned layout at every thread count."""
+
+    def _corpus(self, tmp_path, n=23, seed=11):
+        rng = np.random.default_rng(seed)
+        paths = []
+        for i in range(1, n + 1):
+            words = [f"w{rng.integers(0, 300)}"
+                     for _ in range(int(rng.integers(0, 40)))]
+            p = tmp_path / f"doc{i}"
+            p.write_text(" ".join(words))
+            paths.append(str(p))
+        return paths
+
+    @pytest.mark.parametrize("threads", [2, 4, 7])
+    def test_threads_match_serial(self, tmp_path, threads):
+        from tfidf_tpu.io import fast_tokenizer as ft
+        if not ft.flat_available():
+            pytest.skip("native flat packer not built")
+        paths = self._corpus(tmp_path)
+        kw = dict(vocab_size=1 << 12, seed=3, truncate_at=16,
+                  max_per_doc=16, pad_docs_to=32, align=16,
+                  cap_ids=4096)
+        serial = ft.load_pack_flat(paths, n_threads=1, **kw)
+        threaded = ft.load_pack_flat(paths, n_threads=threads, **kw)
+        assert serial[2] == threaded[2]
+        np.testing.assert_array_equal(serial[1], threaded[1])
+        # Whole-capacity equality: ids, inter-doc zero pad, AND the
+        # bucket tail — the threaded fill's per-doc memsets must leave
+        # the identical ship-ready buffer.
+        np.testing.assert_array_equal(serial[0], threaded[0])
+
+    def test_threads_match_python_layout(self, tmp_path):
+        from tfidf_tpu import PipelineConfig
+        from tfidf_tpu.config import VocabMode
+        from tfidf_tpu.io import fast_tokenizer as ft
+        from tfidf_tpu.io.corpus import pack_corpus, Corpus
+        from tfidf_tpu.ingest import flatten_aligned
+        if not ft.flat_available():
+            pytest.skip("native flat packer not built")
+        paths = self._corpus(tmp_path, n=9, seed=4)
+        docs = [open(p, "rb").read() for p in paths]
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED,
+                             vocab_size=1 << 12, max_doc_len=16,
+                             doc_chunk=16)
+        batch = pack_corpus(
+            Corpus(names=[os.path.basename(p) for p in paths],
+                   docs=docs), cfg, pad_docs_to=9, want_words=False)
+        ids = batch.token_ids[:, :16]
+        if ids.shape[1] < 16:
+            ids = np.pad(ids, ((0, 0), (0, 16 - ids.shape[1])))
+        flat_py, total_py = flatten_aligned(
+            ids, np.minimum(batch.lengths, 16).astype(np.int32), 16)
+        out = ft.load_pack_flat(paths, 1 << 12, max_per_doc=16,
+                                pad_docs_to=9, align=16,
+                                cap_ids=4096, n_threads=4)
+        assert out[2] == total_py
+        np.testing.assert_array_equal(out[0][:total_py],
+                                      flat_py[:total_py])
+
+    def test_pack_threads_env_resolution(self, monkeypatch):
+        from tfidf_tpu.io import fast_tokenizer as ft
+        monkeypatch.setenv("TFIDF_TPU_PACK_THREADS", "5")
+        assert ft.resolve_pack_threads() == 5
+        assert ft.resolve_pack_threads(2) == 2  # explicit wins
+
+
+class TestBytesSlabLoader:
+    """loader_fill_slab (round 14): the bytes wire's host pack — raw
+    doc bytes at aligned offsets, 0x20 fill everywhere else."""
+
+    def test_layout_contract(self, tmp_path):
+        from tfidf_tpu.io import fast_tokenizer as ft
+        if not ft.slab_available():
+            pytest.skip("native slab loader not built")
+        docs = [b"alpha beta gamma", b"", b"  x ", b"q" * 33]
+        paths = []
+        for i, d in enumerate(docs):
+            p = tmp_path / f"doc{i + 1}"
+            p.write_bytes(d)
+            paths.append(str(p))
+        slab, blens, total = ft.load_slab_paths(
+            paths, pad_docs_to=8, align=16, cap_round=256)
+        assert list(blens[:4]) == [len(d) for d in docs]
+        assert (blens[4:] == 0).all()
+        off = 0
+        for d in docs:
+            a = (len(d) + 16) // 16 * 16  # >= 1 separator byte
+            assert slab[off:off + len(d)].tobytes() == d
+            assert (slab[off + len(d):off + a] == 0x20).all()
+            off += a
+        assert off == total
+        assert (slab[total:] == 0x20).all()
+        assert slab.size % 256 == 0
+
+
 class TestHybridOpenMP:
     """The reference's MPI+OpenMP hybrid (TFIDF_extra.c) rebuilt race-free:
     `make tfidf_ref_omp` adds intra-rank thread fan-out over each rank's

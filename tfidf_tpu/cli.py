@@ -42,6 +42,21 @@ dispatch & compile knobs (round 8):
   TFIDF_TPU_SCORE         xla|pallas — phase-B score+top-k lowering
                           (pallas = the fused Mosaic kernel, A/B
                           probe; ids bit-exact either way)
+
+wire & pack knobs (round 14):
+  --wire bytes            ship RAW document bytes; tokenize+hash ON
+                          DEVICE (ids bit-identical to the host
+                          packers; host pack becomes read+memcpy).
+                          Degrades bytes->ragged->padded when the
+                          device tokenizer cannot carry the run.
+                          Env: TFIDF_TPU_WIRE
+  --pack-threads N        native host packer thread count (default
+                          every core) — threads the ragged fill's
+                          per-doc tokenize+hash loop (the reference's
+                          OpenMP move, race-free, bit-identical).
+                          Env: TFIDF_TPU_PACK_THREADS
+  TFIDF_TPU_DEVICE_TOKENIZE  xla|pallas — bytes-wire hash lowering
+                          (pallas = Mosaic doc-tile kernel, A/B probe)
 """
 
 
@@ -127,17 +142,30 @@ def _build_parser() -> argparse.ArgumentParser:
                           "only): keep packed chunks in host RAM between "
                           "passes, re-read from disk, or pick by byte "
                           "budget (default auto)")
-    run.add_argument("--wire", choices=["ragged", "padded"],
+    run.add_argument("--wire", choices=["ragged", "padded", "bytes"],
                      default="ragged",
                      help="host->device chunk wire format (--doc-len "
                           "runs): 'ragged' ships one flat uint16 token "
                           "stream per chunk (bytes scale with real "
                           "tokens) and rebuilds [D, L] on device; "
+                          "'bytes' ships RAW document bytes and "
+                          "tokenizes+hashes ON DEVICE (the host never "
+                          "hashes at all; ids bit-identical to the "
+                          "host packers — ops/device_tokenize.py), "
+                          "degrading to 'ragged' when the device "
+                          "tokenizer cannot carry the run (vocab past "
+                          "2^16, chargram, mesh, --exact-terms); "
                           "'padded' forces the dense wire — the bit-"
                           "identical parity fallback, also selected "
                           "automatically for vocabs past 2^16 or chunks "
                           "whose flat stream would overflow the int32 "
-                          "bucket bound")
+                          "bucket bound. Env: TFIDF_TPU_WIRE")
+    run.add_argument("--pack-threads", type=int, default=None,
+                     help="host packer thread count for the native "
+                          "tokenize+hash fill (the reference's OpenMP "
+                          "move on the shared ParallelFor pool); "
+                          "default every core (env "
+                          "TFIDF_TPU_PACK_THREADS)")
     run.add_argument("--result-wire", choices=["packed", "pair"],
                      default="packed",
                      help="device->host top-k result wire: 'packed' "
@@ -427,6 +455,7 @@ def _run_tpu(args) -> int:
         use_pallas=args.pallas,
         mesh_shape=mesh_shape,
         wire=getattr(args, "wire", "ragged"),
+        pack_threads=getattr(args, "pack_threads", None),
         result_wire=getattr(args, "result_wire", "packed"),
         finish=getattr(args, "finish", None) or "scan",
         compile_cache=getattr(args, "compile_cache", None),
@@ -514,6 +543,20 @@ def _run_tpu(args) -> int:
                 "falling back to the chunked/fused finish (the pair "
                 "and exact wires' fused finish program is already one "
                 "dispatch)\n")
+    # An EXPLICIT --wire=bytes that cannot run warns once too: the
+    # device tokenizer serves single-device hashed whitespace runs
+    # within the uint16 vocab bound; everything else degrades down the
+    # bytes -> ragged -> padded chain silently only when NOT asked for.
+    if getattr(args, "wire", None) == "bytes":
+        from tfidf_tpu.ingest import use_bytes_wire
+        chunk_guess = args.chunk_docs or 8192
+        if (not overlapped or exact_terms or mesh_shape
+                or not use_bytes_wire(cfg, chunk_guess,
+                                      args.doc_len or cfg.max_doc_len)):
+            sys.stderr.write(
+                "warning: --wire=bytes needs a single-device hashed "
+                "whitespace --doc-len run with vocab <= 2^16; falling "
+                "back to the ragged/padded id wire\n")
     if overlapped and exact_terms and not mesh_shape:
         # Exact-terms with automatic engine choice (rerank.exact_terms):
         # device-exact intern ids when the corpus fits the vocab (no

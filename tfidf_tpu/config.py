@@ -85,11 +85,24 @@ class PipelineConfig:
         "ragged" (default) ships one concatenated uint16 token stream
         per chunk (CSR-style, granule-aligned — bytes scale with real
         tokens, not D×L) and rebuilds the padded batch on device;
-        "padded" forces the dense [D, L] wire — the bit-identical
-        parity fallback. "ragged" silently degrades to the padded wire
-        when it cannot carry the run (vocab > 2^16, or a chunk whose
-        aligned flat stream would overflow the int32/``_FLAT_BUCKET``
-        offset bound — see ``ingest.use_ragged_wire``).
+        "bytes" ships the RAW document bytes (one space-filled slab
+        per chunk — the host never tokenizes, hashes or packs ids at
+        all) and performs whitespace tokenization + FNV-1a64 +
+        fold-to-vocab ON DEVICE (``ops/device_tokenize.py``), emitting
+        ids bit-identical to the host packers; "padded" forces the
+        dense [D, L] wire — the bit-identical parity fallback.
+        "bytes" degrades to "ragged" when the device tokenizer cannot
+        carry the run (vocab > 2^16, non-whitespace tokenizer, or a
+        mesh plan — ``ingest.use_bytes_wire``), and "ragged" in turn
+        degrades to "padded" per ``ingest.use_ragged_wire`` (vocab
+        past 2^16, or a chunk whose aligned flat stream would
+        overflow the int32/``_FLAT_BUCKET`` offset bound). Env
+        override ``TFIDF_TPU_WIRE``.
+      pack_threads: host packer thread count for the native loader's
+        tokenize+hash fill (the reference's OpenMP move done on the
+        shared ``ParallelFor`` pool). None = ``--pack-threads`` /
+        ``TFIDF_TPU_PACK_THREADS`` / every core
+        (``io.fast_tokenizer.resolve_pack_threads``).
       result_wire: device→host result wire for top-k selections.
         "packed" (default) ships one uint32 word per selected slot —
         16-bit score in the high half, uint16 vocab id in the low half
@@ -149,15 +162,18 @@ class PipelineConfig:
     score_dtype: str = "float32"
     topk: Optional[int] = None
     wire: str = "ragged"
+    pack_threads: Optional[int] = None
     result_wire: str = "packed"
     finish: str = "scan"
     compile_cache: Optional[str] = None
     trace: Optional[str] = None
 
     def __post_init__(self):
-        if self.wire not in ("ragged", "padded"):
+        if self.wire not in ("ragged", "padded", "bytes"):
             raise ValueError(f"unknown wire format {self.wire!r} "
-                             f"(choose 'ragged' or 'padded')")
+                             f"(choose 'ragged', 'padded' or 'bytes')")
+        if self.pack_threads is not None and self.pack_threads < 1:
+            raise ValueError("pack_threads must be >= 1")
         if self.result_wire not in ("packed", "pair"):
             raise ValueError(f"unknown result wire {self.result_wire!r} "
                              f"(choose 'packed' or 'pair')")

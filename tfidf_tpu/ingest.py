@@ -58,6 +58,7 @@ from tfidf_tpu.config import (PipelineConfig, TokenizerKind, VocabMode,
 from tfidf_tpu.io import fast_tokenizer
 from tfidf_tpu.io.corpus import discover_names, pack_corpus
 from tfidf_tpu.obs.health import beat as _health_beat
+from tfidf_tpu.ops.device_tokenize import tokenize_method
 from tfidf_tpu.ops.downlink import (pack_result_words, pack_words,
                                     pair_slot_bytes, unpack_result_words,
                                     use_packed_result_wire)
@@ -433,6 +434,42 @@ def _bucket_cap_ids(chunk_docs: int, length: int, align: int) -> int:
 _RAGGED_MAX_IDS = (1 << 31) - _FLAT_BUCKET
 
 
+def resolve_wire(cfg: PipelineConfig) -> str:
+    """The run's ASKED wire format: ``TFIDF_TPU_WIRE`` env override,
+    else ``config.wire``. What actually carries the run is resolved by
+    :func:`use_bytes_wire` / :func:`use_ragged_wire` — the degradation
+    chain is bytes → ragged → padded."""
+    choice = os.environ.get("TFIDF_TPU_WIRE") or getattr(cfg, "wire",
+                                                         "ragged")
+    if choice not in ("ragged", "padded", "bytes"):
+        raise ValueError(
+            f"unknown wire {choice!r} (TFIDF_TPU_WIRE / --wire: choose "
+            f"'ragged', 'padded' or 'bytes')")
+    return choice
+
+
+def use_bytes_wire(cfg: PipelineConfig, chunk_docs: int,
+                   length: int) -> bool:
+    """True when this run ships raw document bytes and tokenizes +
+    hashes ON DEVICE (``--wire=bytes``, round 14 —
+    ``ops/device_tokenize.py``). The bytes wire degrades to the ragged
+    id wire when the device tokenizer cannot carry the run: vocab past
+    2^16 (the 32-bit-limb fold bound — same bound as the uint16 id
+    wire), a non-whitespace tokenizer (chargram ids are already
+    computed on device from bytes, a different wire), or a chunk whose
+    token slots overflow int32. Exact-vocab ingest never asks (the
+    intern table is host-side by construction); mesh ingest ignores
+    the knob (its block-sharded ``device_put`` needs the padded
+    wire)."""
+    if resolve_wire(cfg) != "bytes":
+        return False
+    if cfg.vocab_size > (1 << 16):
+        return False  # fold_mod's 32-bit partial products bound
+    if cfg.tokenizer is not TokenizerKind.WHITESPACE:
+        return False
+    return chunk_docs * length < (1 << 31)
+
+
 def use_ragged_wire(cfg: PipelineConfig, chunk_docs: int,
                     length: int) -> bool:
     """Resolve one run's chunk wire format from ``config.wire``:
@@ -441,8 +478,10 @@ def use_ragged_wire(cfg: PipelineConfig, chunk_docs: int,
     padded parity wire when the uint16 stream cannot carry the run:
     vocab past 2^16, or a chunk whose aligned flat capacity would
     cross the int32/_FLAT_BUCKET offset bound (``_RAGGED_MAX_IDS``).
-    ``"padded"`` forces the legacy bit-identical path everywhere."""
-    if getattr(cfg, "wire", "ragged") == "padded":
+    ``"padded"`` forces the legacy bit-identical path everywhere. A
+    ``"bytes"`` ask that :func:`use_bytes_wire` declined lands here —
+    the middle link of the bytes → ragged → padded chain."""
+    if resolve_wire(cfg) == "padded":
         return False
     if cfg.vocab_size > (1 << 16):
         return False  # the uint16 wire cannot carry the ids
@@ -1318,6 +1357,7 @@ def make_flat_packer(input_dir: str, cfg: PipelineConfig, chunk_docs: int,
             [os.path.join(input_dir, n) for n in chunk_names],
             cfg.vocab_size, cfg.hash_seed, cfg.truncate_tokens_at,
             max_per_doc=length, pad_docs_to=chunk_docs,
+            n_threads=getattr(cfg, "pack_threads", None),
             align=align, cap_ids=cap)
         assert out is not None
         flat, lengths, total = out
@@ -1331,6 +1371,163 @@ def make_flat_packer(input_dir: str, cfg: PipelineConfig, chunk_docs: int,
         return flat, lengths, total
 
     return pack_native if use_native else pack_python
+
+
+# Bytes-wire slab padding granularity — the byte-stream twin of
+# _FLAT_BUCKET (same compile-cache purpose: a handful of slab shapes,
+# not one per chunk). Default = _FLAT_BUCKET bytes (2^17 = 128 KB): at
+# ~3-6 B/token the round-up waste stays in the same few-percent band
+# the id bucket was sized for. Read at import like _FLAT_BUCKET.
+_BYTE_BUCKET = int(os.environ.get("TFIDF_TPU_BYTE_BUCKET",
+                                  str(_FLAT_BUCKET)))
+if _BYTE_BUCKET <= 0 or _BYTE_BUCKET & (_BYTE_BUCKET - 1):
+    raise ValueError(f"TFIDF_TPU_BYTE_BUCKET must be a positive power "
+                     f"of two, got {_BYTE_BUCKET}")
+
+
+def make_bytes_packer(input_dir: str, cfg: PipelineConfig,
+                      chunk_docs: int, length: int,
+                      stats: Optional[Dict[str, float]] = None):
+    """Bytes-wire host packing: names -> (slab, blens, total) — raw
+    document bytes at aligned offsets, 0x20 fill, bucket-padded
+    capacity. The host's ENTIRE per-chunk work is a parallel file read
+    plus a memcpy; tokenize/hash/pack-ids moved to the device
+    (``ops/device_tokenize.py`` has the layout contract). Native slab
+    loader when built, contract-identical Python fallback otherwise.
+
+    ``stats`` (optional dict) accumulates the two host sub-phases the
+    bench splits pack into — ``load`` (file reads) and ``slab`` (slab
+    assembly/copy) — in seconds; the native path measures the same
+    boundary (loader_open2 = load, loader_fill_slab = slab). Each pack
+    also records a ``slab`` span stamped with the chunk's byte payload
+    (tools/trace_check.py validates the stamp)."""
+    align = _wire_align()
+    use_native = (cfg.tokenizer is TokenizerKind.WHITESPACE
+                  and fast_tokenizer.slab_available())
+
+    def add(key: str, secs: float) -> None:
+        if stats is not None:
+            stats[key] = stats.get(key, 0.0) + secs
+
+    def pack_native(chunk_names: List[str]):
+        paths = [os.path.join(input_dir, n) for n in chunk_names]
+        t0 = time.perf_counter()
+        out = fast_tokenizer.load_slab_paths(
+            paths, pad_docs_to=chunk_docs,
+            n_threads=getattr(cfg, "pack_threads", None), align=align,
+            cap_round=_BYTE_BUCKET)
+        assert out is not None  # slab_available() checked above
+        slab, blens, total = out
+        # The native path reads+fills in one call; the whole wall is
+        # the slab phase (its internal read IS the load, but the
+        # boundary is not observable through one ctypes call).
+        dt = time.perf_counter() - t0
+        add("slab", dt)
+        with obs.span("slab", bytes=int(slab.nbytes)):
+            pass  # native work already done; stamp the payload
+        return slab, blens, total
+
+    def pack_python(chunk_names: List[str]):
+        t0 = time.perf_counter()
+        docs = []
+        for n in chunk_names:
+            with open(os.path.join(input_dir, n), "rb") as f:
+                docs.append(f.read())
+        add("load", time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        d_padded = max(chunk_docs, len(docs))
+        blens = np.zeros((d_padded,), np.int32)
+        blens[:len(docs)] = [len(d) for d in docs]
+        from tfidf_tpu.ops.device_tokenize import aligned_byte_lengths
+        albl = aligned_byte_lengths(blens[:len(docs)], align)
+        total = int(albl.sum())
+        cap = max(total + (-total % _BYTE_BUCKET), _BYTE_BUCKET)
+        slab = np.full((cap,), 0x20, np.uint8)
+        off = 0
+        for doc, a in zip(docs, albl.tolist()):
+            slab[off:off + len(doc)] = np.frombuffer(doc, np.uint8)
+            off += int(a)
+        add("slab", time.perf_counter() - t0)
+        with obs.span("slab", bytes=int(slab.nbytes)):
+            pass
+        return slab, blens, total
+
+    return pack_native if use_native else pack_python
+
+
+def _check_slab_fits_int32(total: int) -> None:
+    """Bytes-wire offset guard: the device tokenizer's byte positions
+    and cumulative token counts are int32, so one chunk's slab must
+    stay under 2^31 bytes (an absurd chunk — lower --chunk-docs)."""
+    if total >= (1 << 31):
+        raise ValueError(
+            f"bytes-wire chunk slab of {total} bytes overflows int32 "
+            f"offsets; lower --chunk-docs")
+
+
+# Bytes-wire chunk kernels: the slab arrives as raw uint8 document
+# bytes; tokenize + FNV-1a64 + fold run ON DEVICE
+# (ops/device_tokenize.py — bit-identical to the host packers by
+# contract) before the same sort+fold every other wire feeds. The
+# kernels RETURN the device-derived [D] lengths (the host never
+# tokenizes, so it never knows them): callers keep the device array
+# for the finish programs and ride a copy_to_host_async for the
+# IngestResult.lengths bookkeeping. _chunk_bytes is NOT donated for
+# the same reason as _chunk_ragged — profile_resident re-dispatches
+# the same resident slabs through it (cache-sharing doctrine); the
+# streaming kernels below donate their always-fresh slabs.
+@functools.partial(jax.jit,
+                   static_argnames=("length", "vocab_size", "seed",
+                                    "truncate_at", "align", "fold_df",
+                                    "method"))
+def _chunk_bytes(slab, blens, df_acc, *, length: int, vocab_size: int,
+                 seed: int, truncate_at, align: int,
+                 fold_df: bool = True, method: str = "xla"):
+    from tfidf_tpu.ops.device_tokenize import tokenize_hash_device
+    from tfidf_tpu.ops.pallas_kernels import default_interpret
+    tok, lens = tokenize_hash_device(
+        slab, blens, length=length, vocab_size=vocab_size, seed=seed,
+        truncate_at=truncate_at, align=align, method=method,
+        interpret=default_interpret() if method == "pallas" else False)
+    ids, counts, head = sorted_term_counts(tok, lens)
+    if not fold_df:  # finish program derives DF (see _chunk_step)
+        return ids, counts, head, df_acc, lens
+    return ids, counts, head, \
+        df_acc + sparse_df(ids, head, vocab_size), lens
+
+
+@functools.partial(jax.jit, donate_argnums=(0,),
+                   static_argnames=("length", "vocab_size", "seed",
+                                    "truncate_at", "align", "method"))
+def _phase_a_bytes(slab, blens, df_acc, *, length: int, vocab_size: int,
+                   seed: int, truncate_at, align: int,
+                   method: str = "xla"):
+    from tfidf_tpu.ops.device_tokenize import tokenize_hash_device
+    from tfidf_tpu.ops.pallas_kernels import default_interpret
+    tok, lens = tokenize_hash_device(
+        slab, blens, length=length, vocab_size=vocab_size, seed=seed,
+        truncate_at=truncate_at, align=align, method=method,
+        interpret=default_interpret() if method == "pallas" else False)
+    ids, _, head = sorted_term_counts(tok, lens)
+    return df_acc + sparse_df(ids, head, vocab_size), lens
+
+
+@functools.partial(jax.jit, donate_argnums=(0,),
+                   static_argnames=("length", "vocab_size", "seed",
+                                    "truncate_at", "align", "topk",
+                                    "method", "packed"))
+def _phase_b_bytes(slab, blens, idf, *, length: int, vocab_size: int,
+                   seed: int, truncate_at, align: int, topk: int,
+                   method: str = "xla", packed: bool = True):
+    from tfidf_tpu.ops.device_tokenize import tokenize_hash_device
+    from tfidf_tpu.ops.pallas_kernels import default_interpret
+    tok, lens = tokenize_hash_device(
+        slab, blens, length=length, vocab_size=vocab_size, seed=seed,
+        truncate_at=truncate_at, align=align, method=method,
+        interpret=default_interpret() if method == "pallas" else False)
+    ids, counts, head = sorted_term_counts(tok, lens)
+    out = score_topk(ids, counts, head, lens, idf, topk)
+    return pack_result_words(*out) if packed else out
 
 
 # Final program of the resident path: score the cached triples against
@@ -1547,8 +1744,10 @@ class IngestResult:
     # "put" (upload/dispatch staging), "fetch" (the single unfenced
     # result round trip — transfer/compute drain included).
     # Streaming path: pack_a/pack_b (stalls), pack_host, pass_a/pass_b,
-    # fetch. Values are numeric only (cli --timing feeds them to
-    # PhaseTimer.add verbatim).
+    # fetch. Bytes-wire runs add load_host/slab_host — the packer
+    # thread's file-read and slab-assembly walls (there is no host
+    # tokenize at all). Values are numeric only (cli --timing feeds
+    # them to PhaseTimer.add verbatim).
     phases: Optional[Dict[str, float]] = None
     # Chunk wire format this run resolved to ("ragged" | "padded" —
     # use_ragged_wire; mesh paths are always "padded" by design) and
@@ -1602,7 +1801,8 @@ def make_chunk_packer(input_dir: str, cfg: PipelineConfig, chunk_docs: int,
             [os.path.join(input_dir, n) for n in chunk_names],
             cfg.vocab_size, cfg.hash_seed, cfg.truncate_tokens_at,
             min_len=length, chunk=length, fixed_len=length,
-            pad_docs_to=chunk_docs)
+            pad_docs_to=chunk_docs,
+            n_threads=getattr(cfg, "pack_threads", None))
         assert packed is not None  # loader_available() checked above
         return packed
 
@@ -1740,9 +1940,18 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
                                            length)
         _check_chunk_fits_int32(chunk_docs, length)
         _check_total_slots_fit_int32(len(starts) * chunk_docs, length)
-        ragged = use_ragged_wire(cfg, chunk_docs, length)
-        flat_pack = (make_flat_packer(input_dir, cfg, chunk_docs, length)
-                     if ragged else None)
+        bwire = use_bytes_wire(cfg, chunk_docs, length)
+        ragged = (not bwire) and use_ragged_wire(cfg, chunk_docs, length)
+        pack_stats: Dict[str, float] = {}
+        if bwire:
+            chunk_pack = make_bytes_packer(input_dir, cfg, chunk_docs,
+                                           length, stats=pack_stats)
+            tok_method = tokenize_method()
+        elif ragged:
+            chunk_pack = make_flat_packer(input_dir, cfg, chunk_docs,
+                                          length)
+        else:
+            chunk_pack = pack_chunk
 
         ph = {"pack": 0.0, "put": 0.0}
         padded_chunk_bytes = chunk_docs * length * itemsize
@@ -1750,10 +1959,11 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
         df_acc = jnp.zeros((cfg.vocab_size,), jnp.int32)
         trip_i, trip_c, trip_h, len_parts, all_lengths = [], [], [], [], []
         # Double-buffered upload pipeline: the packer thread runs one
-        # chunk ahead, so chunk i+1's tokenize+hash overlaps chunk i's
-        # device_put staging and dispatch (which themselves overlap the
-        # device's transfer+sort of earlier chunks — see _PackAhead).
-        with _PackAhead(flat_pack if ragged else pack_chunk,
+        # chunk ahead, so chunk i+1's tokenize+hash — or, on the bytes
+        # wire, its read+slab copy — overlaps chunk i's device_put
+        # staging and dispatch (which themselves overlap the device's
+        # transfer+sort of earlier chunks — see _PackAhead).
+        with _PackAhead(chunk_pack,
                         [names[s:s + chunk_docs] for s in starts]) \
                 as packer:
             for ci in range(len(starts)):
@@ -1763,7 +1973,8 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
                     packed = packer.get(ci)  # stall; pack rides ahead
                 ph["pack"] += time.perf_counter() - t0
                 wire_arr, lengths = packed[0], packed[1]
-                all_lengths.append(lengths[:n_chunk])
+                if not bwire:
+                    all_lengths.append(lengths[:n_chunk])
                 bytes_wire += wire_arr.nbytes + lengths.nbytes
                 bytes_padded += padded_chunk_bytes + lengths.nbytes
                 t0 = time.perf_counter()
@@ -1778,10 +1989,29 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
                     # of the next chunk, and the wire buffer is dead
                     # once consumed.
                     _trace("upload", ci)
-                    i_, c_, h_, df_acc = _chunk_step(
-                        jax.device_put(wire_arr), lens, df_acc, cfg,
-                        length, ragged=ragged,
-                        fold_df=not _resident_df_mode()[1])
+                    if bwire:
+                        # lengths here are BYTE lengths; the kernel
+                        # tokenizes on device and returns the token
+                        # lengths the host packers would have computed
+                        # — fetched asynchronously for the result's
+                        # bookkeeping, device-resident for the finish.
+                        with obs.span("device_tokenize", chunk=ci,
+                                      bytes=int(wire_arr.nbytes)):
+                            i_, c_, h_, df_acc, lens = _chunk_bytes(
+                                jax.device_put(wire_arr), lens, df_acc,
+                                length=length,
+                                vocab_size=cfg.vocab_size,
+                                seed=cfg.hash_seed,
+                                truncate_at=cfg.truncate_tokens_at,
+                                align=_wire_align(),
+                                fold_df=not _resident_df_mode()[1],
+                                method=tok_method)
+                        lens.copy_to_host_async()
+                    else:
+                        i_, c_, h_, df_acc = _chunk_step(
+                            jax.device_put(wire_arr), lens, df_acc, cfg,
+                            length, ragged=ragged,
+                            fold_df=not _resident_df_mode()[1])
                     _trace("dispatch", ci)
                 trip_i.append(i_)
                 trip_c.append(c_)
@@ -1789,10 +2019,20 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
                 len_parts.append(lens)
                 ph["put"] += time.perf_counter() - t0
         ph["pack_host"] = packer.host_seconds
+        if bwire:
+            # Token lengths are device truth on the bytes wire; their
+            # async copies were started at dispatch, so these reads
+            # find them landed.
+            all_lengths = [
+                np.asarray(lp)[:len(names[s:s + chunk_docs])]
+                for lp, s in zip(len_parts, starts)]
+            for key, secs in pack_stats.items():
+                ph[f"{key}_host"] = secs
         d_padded = len(starts) * chunk_docs
         common = dict(lengths=np.concatenate(all_lengths), names=names,
                       num_docs=num_docs, path="resident",
-                      wire="ragged" if ragged else "padded",
+                      wire="bytes" if bwire
+                      else ("ragged" if ragged else "padded"),
                       bytes_on_wire=bytes_wire,
                       bytes_on_wire_padded=bytes_padded,
                       bytes_off_wire_pair=(d_padded * k
@@ -1901,8 +2141,16 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
     # as the resident path, and spill="host" then caches the FLAT
     # arrays, so pass B never re-packs at all (round-2 streaming paid a
     # full second pack+pad per chunk even from RAM). use_ragged_wire
-    # degrades to padded for wide vocabs / over-bucket chunks.
-    ragged = use_ragged_wire(cfg, chunk_docs, length)
+    # degrades to padded for wide vocabs / over-bucket chunks; the
+    # bytes wire (round 14) ships raw slabs and tokenizes on device —
+    # spill="host" then caches the SLABS, so pass B re-reads nothing
+    # and re-tokenizes on device only for cache-missed chunks.
+    bwire = use_bytes_wire(cfg, chunk_docs, length)
+    ragged = (not bwire) and use_ragged_wire(cfg, chunk_docs, length)
+    pack_stats: Dict[str, float] = {}
+    bytes_pack = (make_bytes_packer(input_dir, cfg, chunk_docs, length,
+                                    stats=pack_stats) if bwire else None)
+    tok_method = tokenize_method() if bwire else "xla"
     flat_pack = (make_flat_packer(input_dir, cfg, chunk_docs, length)
                  if ragged else None)
     align = _wire_align()
@@ -1927,12 +2175,17 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
     chunk_cache_bytes = chunk_docs * length * 9 + chunk_docs * 4
 
     def pack_any(chunk_names):
+        if bytes_pack is not None:
+            slab, blens, _ = bytes_pack(chunk_names)
+            return slab, blens
         if flat_pack is not None:
             flat, lengths, _ = flat_pack(chunk_names)
             return flat, lengths
         return pack_chunk(chunk_names)
 
     def phase_a_any(wire_arr, lens, df_acc):
+        # bytes wire: handled at the call site (_phase_a_bytes also
+        # returns the device-derived token lengths).
         if flat_pack is not None:
             return _phase_a_ragged(wire_arr, lens, df_acc, length=length,
                                    vocab_size=cfg.vocab_size,
@@ -1940,6 +2193,13 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
         return _phase_a(wire_arr, lens, df_acc, vocab_size=cfg.vocab_size)
 
     def phase_b_any(wire_arr, lens, idf):
+        if bwire:
+            return _phase_b_bytes(wire_arr, lens, idf, length=length,
+                                  vocab_size=cfg.vocab_size,
+                                  seed=cfg.hash_seed,
+                                  truncate_at=cfg.truncate_tokens_at,
+                                  align=align, topk=k,
+                                  method=tok_method, packed=packed_wire)
         if flat_pack is not None:
             fn = _phase_b_ragged_packed if packed_wire else _phase_b_ragged
             return fn(wire_arr, lens, idf, length=length,
@@ -1958,7 +2218,8 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
             with obs.span("pack_wait", chunk=ci):
                 wire_arr, lengths = packer.get(ci)
             ph["pack_a"] += time.perf_counter() - t0  # stall only
-            all_lengths.append(lengths[:len(chunk_names)])
+            if not bwire:
+                all_lengths.append(lengths[:len(chunk_names)])
             bytes_wire += wire_arr.nbytes + lengths.nbytes
             bytes_padded += padded_chunk_bytes + lengths.nbytes
             _trace("upload", ci)
@@ -1969,9 +2230,22 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
                     # directly (_phase_b_cached) — no host cache, no
                     # re-pack, no re-sort for this chunk.
                     lens_dev = jax.device_put(lengths)
-                    i_, c_, h_, df_acc = _chunk_step(
-                        jax.device_put(wire_arr), lens_dev, df_acc, cfg,
-                        length, ragged=ragged)
+                    if bwire:
+                        with obs.span("device_tokenize", chunk=ci,
+                                      bytes=int(wire_arr.nbytes)):
+                            i_, c_, h_, df_acc, lens_dev = _chunk_bytes(
+                                jax.device_put(wire_arr), lens_dev,
+                                df_acc, length=length,
+                                vocab_size=cfg.vocab_size,
+                                seed=cfg.hash_seed,
+                                truncate_at=cfg.truncate_tokens_at,
+                                align=align, method=tok_method)
+                        lens_dev.copy_to_host_async()
+                        all_lengths.append(lens_dev)
+                    else:
+                        i_, c_, h_, df_acc = _chunk_step(
+                            jax.device_put(wire_arr), lens_dev, df_acc,
+                            cfg, length, ragged=ragged)
                     trip_cache[ci] = (i_, c_, h_, lens_dev)
                     cache_bytes += chunk_cache_bytes
                     if spill == "host":
@@ -1979,8 +2253,23 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
                 else:
                     if spill == "host":
                         cached.append((wire_arr, lengths))
-                    df_acc = phase_a_any(jax.device_put(wire_arr),
-                                         jax.device_put(lengths), df_acc)
+                    if bwire:
+                        with obs.span("device_tokenize", chunk=ci,
+                                      bytes=int(wire_arr.nbytes)):
+                            df_acc, lens_dev = _phase_a_bytes(
+                                jax.device_put(wire_arr),
+                                jax.device_put(lengths), df_acc,
+                                length=length,
+                                vocab_size=cfg.vocab_size,
+                                seed=cfg.hash_seed,
+                                truncate_at=cfg.truncate_tokens_at,
+                                align=align, method=tok_method)
+                        lens_dev.copy_to_host_async()
+                        all_lengths.append(lens_dev)
+                    else:
+                        df_acc = phase_a_any(jax.device_put(wire_arr),
+                                             jax.device_put(lengths),
+                                             df_acc)
             _trace("dispatch", ci)
             in_flight.append(df_acc)
             if len(in_flight) > max_ahead:
@@ -2115,13 +2404,21 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
         _trace("fetch_done")
         ph["fetch"] = time.perf_counter() - t0
         bytes_off = vals.nbytes + tids.nbytes
+    if bwire:
+        # Token lengths are device truth on the bytes wire (async
+        # copies started at dispatch); trim each chunk to its live docs.
+        all_lengths = [np.asarray(lp)[:len(names[s:s + chunk_docs])]
+                       for lp, s in zip(all_lengths, starts)]
+        for key, secs in pack_stats.items():
+            ph[f"{key}_host"] = secs
     return IngestResult(df=df_host, topk_vals=vals[:num_docs],
                         topk_ids=tids[:num_docs],
                         lengths=np.concatenate(all_lengths), names=names,
                         num_docs=num_docs,
                         df_occupied=int((df_host > 0).sum()),
                         path="streaming", phases=ph,
-                        wire="ragged" if ragged else "padded",
+                        wire="bytes" if bwire
+                        else ("ragged" if ragged else "padded"),
                         bytes_on_wire=bytes_wire,
                         bytes_on_wire_padded=bytes_padded,
                         result_wire="packed" if packed_wire else "pair",
@@ -2291,14 +2588,24 @@ def profile_resident(input_dir: str, config: Optional[PipelineConfig] = None,
     score_dtype = jax.dtypes.canonicalize_dtype(jnp.dtype(cfg.score_dtype))
     k = min(cfg.topk, length)
     chunk_docs, starts = _resident_chunking(num_docs, chunk_docs)
-    ragged = use_ragged_wire(cfg, chunk_docs, length)
-    pack = (make_flat_packer(input_dir, cfg, chunk_docs, length) if ragged
-            else make_chunk_packer(input_dir, cfg, chunk_docs, length))
+    bwire = use_bytes_wire(cfg, chunk_docs, length)
+    ragged = (not bwire) and use_ragged_wire(cfg, chunk_docs, length)
+    pack_stats: Dict[str, float] = {}
+    if bwire:
+        pack = make_bytes_packer(input_dir, cfg, chunk_docs, length,
+                                 stats=pack_stats)
+        tok_method = tokenize_method()
+    elif ragged:
+        pack = make_flat_packer(input_dir, cfg, chunk_docs, length)
+    else:
+        pack = make_chunk_packer(input_dir, cfg, chunk_docs, length)
 
     ph: Dict[str, float] = {}
     t0 = time.perf_counter()
     packed = [pack(names[s:s + chunk_docs]) for s in starts]
     ph["pack"] = time.perf_counter() - t0
+    for key, secs in pack_stats.items():
+        ph[f"pack_{key}"] = secs  # bytes wire: pack = load + slab
     # Actual wire payload of the serialized profile (same buffers the
     # upload phase stages) and the padded-format equivalent — the
     # bench's bytes_on_wire fields for the fenced protocol.
@@ -2340,13 +2647,31 @@ def profile_resident(input_dir: str, config: Optional[PipelineConfig] = None,
     def compute_once():
         df_acc = jnp.zeros((cfg.vocab_size,), jnp.int32)
         trip_i, trip_c, trip_h = [], [], []
-        for toks, lens in zip(tok_parts, len_parts):
-            i_, c_, h_, df_acc = _chunk_step(
-                toks, lens, df_acc, cfg, length, ragged=ragged,
-                fold_df=not _resident_df_mode()[1])
-            trip_i.append(i_)
-            trip_c.append(c_)
-            trip_h.append(h_)
+        tok_lens = len_parts
+        if bwire:
+            # The bytes wire's finish consumes the DEVICE-derived token
+            # lengths (len_parts staged above are byte lengths).
+            tok_lens = []
+            for slab, blens in zip(tok_parts, len_parts):
+                i_, c_, h_, df_acc, lens = _chunk_bytes(
+                    slab, blens, df_acc, length=length,
+                    vocab_size=cfg.vocab_size, seed=cfg.hash_seed,
+                    truncate_at=cfg.truncate_tokens_at,
+                    align=_wire_align(),
+                    fold_df=not _resident_df_mode()[1],
+                    method=tok_method)
+                trip_i.append(i_)
+                trip_c.append(c_)
+                trip_h.append(h_)
+                tok_lens.append(lens)
+        else:
+            for toks, lens in zip(tok_parts, len_parts):
+                i_, c_, h_, df_acc = _chunk_step(
+                    toks, lens, df_acc, cfg, length, ragged=ragged,
+                    fold_df=not _resident_df_mode()[1])
+                trip_i.append(i_)
+                trip_c.append(c_)
+                trip_h.append(h_)
         if packed_wire:
             df_dev = (_df_from_trips(tuple(trip_i), tuple(trip_h),
                                      vocab_size=cfg.vocab_size)
@@ -2356,11 +2681,11 @@ def profile_resident(input_dir: str, config: Optional[PipelineConfig] = None,
             if scan_finish:
                 return _phase_b_scan_packed(
                     tuple(trip_i), tuple(trip_c), tuple(trip_h),
-                    tuple(len_parts), idf, topk=k)
+                    tuple(tok_lens), idf, topk=k)
             return [_phase_b_cached_packed(i_, c_, h_, lens, idf, topk=k)
                     for i_, c_, h_, lens in zip(trip_i, trip_c, trip_h,
-                                                len_parts)]
-        _, wire = _finish_wire((trip_i, trip_c, trip_h), len_parts,
+                                                tok_lens)]
+        _, wire = _finish_wire((trip_i, trip_c, trip_h), tok_lens,
                                df_acc, num_docs, k, score_dtype, cfg,
                                wire_vals=True)
         return wire

@@ -28,12 +28,14 @@ _has_open2 = False
 _has_rerank = False
 _has_flat = False
 _has_flat_v2 = False
+_has_flat_v3 = False
+_has_slab = False
 _has_intern = False
 
 
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _load_failed, _has_loader, _has_open2, _has_rerank, \
-        _has_flat, _has_flat_v2, _has_intern
+        _has_flat, _has_flat_v2, _has_flat_v3, _has_slab, _has_intern
     # The kill-switch wins even over an already-loaded library, and a
     # missing .so is not sticky (tests build it on demand mid-process).
     if os.environ.get("TFIDF_TPU_NO_NATIVE"):
@@ -108,6 +110,29 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int32), ctypes.c_int64]
         _has_flat_v2 = True
     except AttributeError:  # stale .so predating the capacity fill
+        pass
+    try:
+        lib.loader_fill_flat_u16_v3.restype = ctypes.c_int64
+        lib.loader_fill_flat_u16_v3.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint16), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+            ctypes.c_int]
+        _has_flat_v3 = True
+    except AttributeError:  # stale .so predating the threaded fill
+        pass
+    try:
+        lib.loader_slab_bytes.restype = ctypes.c_int64
+        lib.loader_slab_bytes.argtypes = [ctypes.c_void_p,
+                                          ctypes.c_int64]
+        lib.loader_fill_slab.restype = ctypes.c_int64
+        lib.loader_fill_slab.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64, ctypes.c_int]
+        _has_slab = True
+    except AttributeError:  # stale .so predating the bytes wire
         pass
     try:
         lib.intern_open.restype = ctypes.c_void_p
@@ -243,7 +268,7 @@ def load_pack_paths(paths: List[str], vocab_size: int, seed: int = 0,
     lib = _load()
     if lib is None or not _has_loader:
         return None
-    n_threads = n_threads or min(os.cpu_count() or 1, 16)
+    n_threads = resolve_pack_threads(n_threads)
     blob = b"\0".join(p.encode() for p in paths) + b"\0"
     # fixed_len pins the batch shape, so the per-doc token counts are
     # never read — loader_open2(want_counts=0) skips that whole extra
@@ -287,6 +312,22 @@ def load_pack_paths(paths: List[str], vocab_size: int, seed: int = 0,
 def flat_available() -> bool:
     """True when the native ragged (flat) packer symbol is present."""
     return _load() is not None and _has_flat
+
+
+def resolve_pack_threads(explicit: Optional[int] = None) -> int:
+    """Host packer thread count: explicit arg > ``--pack-threads`` /
+    ``TFIDF_TPU_PACK_THREADS`` env > every core (the paper's OpenMP
+    default). Read at call time so tests can override after import;
+    the bench artifact reports the resolved value."""
+    if explicit is not None:
+        n = int(explicit)
+    else:
+        raw = os.environ.get("TFIDF_TPU_PACK_THREADS")
+        n = int(raw) if raw else (os.cpu_count() or 1)
+    if n < 1:
+        raise ValueError(
+            f"TFIDF_TPU_PACK_THREADS must be >= 1, got {n}")
+    return n
 
 
 def _flat_pack_scaffold(lib, paths: List[str], max_per_doc: int,
@@ -350,8 +391,21 @@ def load_pack_flat(paths: List[str], vocab_size: int, seed: int = 0,
     if lib is None or not _has_flat or not _has_open2 \
             or vocab_size > (1 << 16):
         return None
+    threads = resolve_pack_threads(n_threads)
 
     def fill(handle, flat, lens):
+        # Threaded fill (round 14): the per-doc tokenize+hash loop —
+        # the reference's OpenMP target — runs work-stolen across the
+        # loader's ParallelFor pool. With one thread the serial v2/v1
+        # fills keep their single-pass edge (v3 pays a count prepass).
+        if _has_flat_v3 and threads > 1:
+            return lib.loader_fill_flat_u16_v3(
+                handle, ctypes.c_uint64(seed), vocab_size,
+                truncate_at or 0, max_per_doc,
+                flat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+                ctypes.c_int64(flat.size),
+                lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                ctypes.c_int64(align), threads)
         if _has_flat_v2 and cap_ids:
             return lib.loader_fill_flat_u16_v2(
                 handle, ctypes.c_uint64(seed), vocab_size,
@@ -368,8 +422,49 @@ def load_pack_flat(paths: List[str], vocab_size: int, seed: int = 0,
             ctypes.c_int64(align))
 
     return _flat_pack_scaffold(lib, paths, max_per_doc, pad_docs_to,
-                               n_threads, fill, align=align,
+                               threads, fill, align=align,
                                cap_ids=cap_ids)
+
+
+def slab_available() -> bool:
+    """True when the native byte-slab loader symbols are present."""
+    return _load() is not None and _has_slab and _has_open2
+
+
+def load_slab_paths(paths: List[str], pad_docs_to: Optional[int] = None,
+                    n_threads: Optional[int] = None, align: int = 16,
+                    cap_round: int = 1):
+    """Native bytes-wire pack: parallel file read + byte-slab fill —
+    NO tokenize, NO hash, no id store on the host at all (the bytes
+    wire's whole point; ``ops/device_tokenize.py`` has the layout
+    contract). Returns ``(slab uint8 [cap], blens int32 [D_padded],
+    total)`` where ``cap`` is the aligned total rounded up to a
+    ``cap_round`` multiple and every non-document byte is ``0x20``, or
+    None when the native slab loader is unavailable (the caller's
+    Python fallback is contract-identical)."""
+    lib = _load()
+    if lib is None or not _has_slab or not _has_open2:
+        return None
+    threads = resolve_pack_threads(n_threads)
+    blob = b"\0".join(p.encode() for p in paths) + b"\0"
+    handle = lib.loader_open2(blob, len(paths), threads, 0)
+    try:
+        err = lib.loader_error(handle)
+        if err >= 0:
+            raise FileNotFoundError(paths[err])
+        total = int(lib.loader_slab_bytes(handle, align))
+        cap = max(total + (-total % cap_round), cap_round)
+        d_padded = max(pad_docs_to or len(paths), len(paths))
+        slab = np.empty((cap,), dtype=np.uint8)
+        blens = np.zeros((d_padded,), dtype=np.int32)
+        wrote = lib.loader_fill_slab(
+            handle, slab.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            cap, blens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            align, threads)
+        assert wrote == total, (wrote, total)
+        return slab, blens, total
+    finally:
+        lib.loader_close(handle)
 
 
 def rerank_available() -> bool:

@@ -1,0 +1,276 @@
+"""On-device tokenize+hash: the device half of the bytes wire.
+
+The bytes wire (``--wire=bytes``, round 14) ships each chunk as ONE
+flat slab of raw document bytes — the host never tokenizes, hashes or
+packs ids at all (the reference's "extra" variant parallelizes exactly
+that host loop with five OpenMP pragmas, ``TFIDF_extra.c:69-302``; we
+delete the loop from the host instead) — and this module turns the
+slab back into the SAME padded ``[D, L]`` id batch the host packers
+emit, on device, bit-identical by contract:
+
+* whitespace semantics are the fixed ASCII isspace set
+  (``native/tokenize_common.h IsSpace``, = ``bytes.split()``);
+* the hash is seeded FNV-1a64 → xor-fold → mod-vocab
+  (``ops.hashing.words_to_ids`` / ``tokenize_common.h HashWord``),
+  emulated in paired uint32 limbs because TPU jax runs without 64-bit
+  types enabled;
+* per-token byte truncation (``truncate_tokens_at``) and the
+  ``max_per_doc`` token cap apply exactly as in ``TokenizeHashInto``.
+
+Parity with both host packers is pinned by tests/test_bytes_wire.py
+over random byte corpora (multi-byte UTF-8, all-whitespace docs,
+truncation, bucket-boundary tokens).
+
+Slab layout contract (mirrored by ``ingest.make_bytes_packer`` and
+``native/loader.cc loader_fill_slab``): doc d's raw bytes start at
+``offs[d] = sum_{e<d} ceil((blen[e] + 1) / align) * align`` — the
+``+ 1`` guarantees at least one fill byte between documents — and
+every non-document byte of the slab (inter-doc fill, bucket pad) is
+``0x20`` (space), so the flat stream tokenizes globally with NO
+doc-boundary special case: fill bytes are whitespace, tokens can never
+straddle documents, and a document's token starts fall out of one
+vectorized scan over the whole slab.
+
+Two hash lowerings, selected by ``TFIDF_TPU_DEVICE_TOKENIZE``
+(trace-time static, like ``TFIDF_TPU_REBUILD``): ``"xla"`` — the
+portable default, a masked ``lax.while_loop`` whose trip count is the
+longest live token in the chunk — and ``"pallas"`` (the Mosaic kernel
+``ops.pallas_kernels.tokenize_hash_pallas``, doc-tile grid with the
+slab resident in VMEM — the in-tree A/B probe, same scope doctrine as
+``ragged_rebuild_pallas``). The token-start derivation (scan + offsets
++ scatter) is shared XLA code under both, so the lowerings cannot
+drift on tokenization; only the per-byte hash loop differs.
+
+The fold-to-vocab requires ``vocab_size <= 2^16`` (the 32-limb modular
+reduction's products must fit uint32) — the same bound as the ragged
+uint16 wire, and ``ingest.use_bytes_wire`` degrades wider runs the
+same way.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+__all__ = [
+    "FNV_OFFSET", "FNV_PRIME", "is_space", "fnv1a_step", "fold_mod",
+    "aligned_byte_lengths", "token_starts", "tokenize_hash_device",
+    "tokenize_method",
+]
+
+FNV_OFFSET = 14695981039346656037  # tokenize_common.h kFnvOffset
+FNV_PRIME = 1099511628211          # tokenize_common.h kFnvPrime
+
+_PRIME_HI = np.uint32(FNV_PRIME >> 32)          # 0x100
+_PRIME_LO = np.uint32(FNV_PRIME & 0xFFFFFFFF)   # 0x1B3
+_U16 = np.uint32(0xFFFF)
+_SHIFT16 = np.uint32(16)
+
+
+def tokenize_method(explicit=None) -> str:
+    """Resolve the device tokenize+hash lowering: ``"xla"`` (portable
+    default) or ``"pallas"`` (``ops.pallas_kernels.
+    tokenize_hash_pallas``). Override via ``TFIDF_TPU_DEVICE_TOKENIZE``;
+    resolved at trace time like :func:`ingest.rebuild_method`."""
+    if explicit is not None:
+        return explicit
+    method = os.environ.get("TFIDF_TPU_DEVICE_TOKENIZE") or "xla"
+    if method not in ("xla", "pallas"):
+        raise ValueError(
+            f"unknown TFIDF_TPU_DEVICE_TOKENIZE method {method!r} "
+            f"(choose 'xla' or 'pallas')")
+    return method
+
+
+def is_space(b):
+    """The fixed ASCII whitespace set over int byte values — exactly
+    ``tokenize_common.h IsSpace`` / ``bytes.split()``: space, \\t, \\n,
+    \\v, \\f, \\r. Works on any integer dtype array."""
+    return (b == 32) | ((b >= 9) & (b <= 13))
+
+
+def _mul32(a, b):
+    """uint32 × uint32 → (hi, lo) uint32 — the 64-bit product in two
+    limbs, via 16-bit partials (no 64-bit types on the TPU path)."""
+    a0, a1 = a & _U16, a >> _SHIFT16
+    b0, b1 = b & _U16, b >> _SHIFT16
+    p00 = a0 * b0
+    p01 = a0 * b1
+    p10 = a1 * b0
+    mid = (p00 >> _SHIFT16) + (p01 & _U16) + (p10 & _U16)
+    lo = (p00 & _U16) | ((mid & _U16) << _SHIFT16)
+    hi = a1 * b1 + (p01 >> _SHIFT16) + (p10 >> _SHIFT16) \
+        + (mid >> _SHIFT16)
+    return hi, lo
+
+
+def fnv1a_step(hi, lo, byte_u32):
+    """One FNV-1a64 byte step on (hi, lo) uint32 limb pairs:
+    ``h = (h ^ byte) * FNV_PRIME mod 2^64``. The ``h_hi * P_hi`` term
+    falls off the top (× 2^64), so the 64-bit product reduces to three
+    32-bit multiplies plus one carry."""
+    lo = lo ^ byte_u32
+    carry_hi, new_lo = _mul32(lo, _PRIME_LO)
+    new_hi = hi * _PRIME_LO + lo * _PRIME_HI + carry_hi
+    return new_hi, new_lo
+
+
+def seed_state(seed: int):
+    """Initial (hi, lo) limbs: ``FNV_OFFSET ^ seed`` (the seeded offset
+    basis every host path uses)."""
+    h = FNV_OFFSET ^ (int(seed) & 0xFFFFFFFFFFFFFFFF)
+    return np.uint32(h >> 32), np.uint32(h & 0xFFFFFFFF)
+
+
+def fold_mod(hi, lo, vocab_size: int):
+    """xor-fold + mod-vocab on limb pairs — ``hash_to_vocab`` /
+    ``FoldToVocab`` exactly: ``f = h ^ (h >> 32); f % V``. Requires
+    ``V <= 2^16`` so every partial stays inside uint32:
+    ``f mod V = ((f_hi mod V) * (2^32 mod V) + f_lo mod V) mod V``,
+    and ``(V-1) * (2^32 mod V) < 2^32`` at that bound."""
+    if vocab_size > (1 << 16):
+        raise ValueError(
+            f"device fold-to-vocab carries vocab_size <= 2^16, got "
+            f"{vocab_size} (the bytes wire degrades to ragged there — "
+            f"ingest.use_bytes_wire)")
+    v = np.uint32(vocab_size)
+    m32 = np.uint32((1 << 32) % vocab_size)
+    f_lo = lo ^ hi  # folded low limb; the high limb is hi unchanged
+    return (((hi % v) * m32 + (f_lo % v)) % v).astype(jnp.int32)
+
+
+def aligned_byte_lengths(blens, align: int):
+    """Slab bytes each doc occupies: ``ceil((blen + 1) / align) *
+    align`` — the ``+ 1`` reserves the guaranteed inter-doc fill byte
+    (a space), so adjacent documents can never concatenate into one
+    token. THE layout rule; both packers and the device decode call
+    this (numpy and jnp arrays both work)."""
+    mod = jnp if isinstance(blens, jax.Array) else np
+    return (mod.maximum(blens, 0) + align) // align * align
+
+
+def token_starts(slab, blens, *, length: int, align: int):
+    """Shared tokenization stage of both hash lowerings: one
+    vectorized scan over the slab derives, per document, the byte
+    positions of its first ``length`` tokens.
+
+    Args:
+      slab: uint8/int32 ``[N]`` byte slab (layout contract above).
+      blens: int32 ``[D]`` raw byte length per doc.
+      length: static token cap L (``max_per_doc``).
+      align: the slab granule (``ingest._wire_align``).
+
+    Returns ``(starts, valid, lengths, bytes_i32)``: int32 ``[D, L]``
+    token start positions (invalid slots point at slab pad — a space,
+    so the hash loop consumes nothing there), bool ``[D, L]`` validity,
+    int32 ``[D]`` per-doc token counts capped at L (the host packers'
+    ``lengths`` contract), and the upcast ``[N]`` byte array for the
+    hash stage to gather from.
+    """
+    n = slab.shape[0]
+    d = blens.shape[0]
+    b = slab.astype(jnp.int32)
+    sp = is_space(b)
+    # Token starts: a non-space byte whose predecessor is whitespace
+    # (position 0 is doc 0's first byte — the layout guarantees it).
+    start = (~sp) & jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), sp[:-1]])
+    start_i = start.astype(jnp.int32)
+    albl = aligned_byte_lengths(blens, align)
+    offs_ext = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         jnp.cumsum(albl, dtype=jnp.int32)])          # [D + 1]
+    cum = jnp.cumsum(start_i)                          # inclusive [N]
+    # starts strictly before byte i, extended so index N is legal
+    # (a doc whose offset equals the slab total holds no bytes).
+    cum_ex = jnp.concatenate([cum - start_i, cum[-1:]])  # [N + 1]
+    base = cum_ex[jnp.minimum(offs_ext, n)]            # [D + 1]
+    lengths = jnp.minimum(base[1:] - base[:-1], length)
+    # Per-byte doc id (only consulted at start bytes; fill bytes are
+    # whitespace so pad/tail positions never carry a start). Among
+    # equal offsets — empty docs — searchsorted(right) lands on the
+    # last, which is exactly the doc that owns the bytes there.
+    did = jnp.clip(
+        jnp.searchsorted(offs_ext[:-1],
+                         jnp.arange(n, dtype=jnp.int32),
+                         side="right") - 1, 0, d - 1)
+    k = cum - 1 - base[did]   # 0-based token ordinal within its doc
+    # Scatter the first L start positions into [D, L]; everything else
+    # (non-starts, ordinals past L) collides on the sentinel slot that
+    # the slice below discards. Default n - 1 points at slab pad — a
+    # space — so invalid slots hash nothing even without the mask.
+    tgt = jnp.where(start & (k < length), did * length + k, d * length)
+    flat = jnp.full((d * length + 1,), n - 1, jnp.int32) \
+        .at[tgt].set(jnp.arange(n, dtype=jnp.int32))
+    starts = flat[:d * length].reshape(d, length)
+    valid = jnp.arange(length, dtype=jnp.int32)[None, :] \
+        < lengths[:, None]
+    return starts, valid, lengths, b
+
+
+def hash_tokens_xla(bytes_i32, starts, valid, *, vocab_size: int,
+                    seed: int, truncate_at):
+    """The portable hash stage: a masked ``lax.while_loop`` whose trip
+    count is the longest live token in the chunk (exact for ANY token
+    length — no static byte cap). Each iteration gathers one byte per
+    (doc, slot), folds it into the FNV limbs where the token is still
+    alive, and kills tokens at their first whitespace byte (or at
+    ``truncate_at`` bytes — the host packers hash the truncated
+    prefix, ``TokenizeHashInto``)."""
+    n = bytes_i32.shape[0]
+    hi0, lo0 = seed_state(seed)
+    hi = jnp.full(starts.shape, hi0, jnp.uint32)
+    lo = jnp.full(starts.shape, lo0, jnp.uint32)
+
+    def cond(c):
+        return jnp.any(c[1])
+
+    def body(c):
+        j, alive, hi, lo = c
+        pos = starts + j
+        byte = bytes_i32[jnp.minimum(pos, n - 1)]
+        consume = alive & ~is_space(byte) & (pos < n)
+        if truncate_at:
+            consume &= j < truncate_at
+        nhi, nlo = fnv1a_step(hi, lo, byte.astype(jnp.uint32))
+        return (j + 1, consume, jnp.where(consume, nhi, hi),
+                jnp.where(consume, nlo, lo))
+
+    _, _, hi, lo = lax.while_loop(
+        cond, body, (jnp.int32(0), valid, hi, lo))
+    ids = fold_mod(hi, lo, vocab_size)
+    # Padding slots zero-filled — the host packers' buffer contract
+    # (np.zeros / memset), so whole-batch comparisons are exact.
+    return jnp.where(valid, ids, 0)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("length", "vocab_size", "seed",
+                                    "truncate_at", "align", "method",
+                                    "interpret"))
+def tokenize_hash_device(slab, blens, *, length: int, vocab_size: int,
+                         seed: int = 0, truncate_at=None,
+                         align: int = 16, method: str = "xla",
+                         interpret: bool = False):
+    """Raw byte slab -> the host packer's ``(token_ids [D, L] int32,
+    lengths [D] int32)`` pair, entirely on device. ``method`` selects
+    the hash lowering (:func:`tokenize_method`); tokenization itself
+    (:func:`token_starts`) is shared, so the lowerings agree by
+    construction on everything but the per-byte loop."""
+    starts, valid, lengths, b = token_starts(slab, blens,
+                                             length=length, align=align)
+    if method == "pallas":
+        from tfidf_tpu.ops.pallas_kernels import tokenize_hash_pallas
+        ids = tokenize_hash_pallas(b, starts, lengths,
+                                   vocab_size=vocab_size, seed=seed,
+                                   truncate_at=truncate_at or 0,
+                                   interpret=interpret)
+    else:
+        ids = hash_tokens_xla(b, starts, valid, vocab_size=vocab_size,
+                              seed=seed, truncate_at=truncate_at)
+    return ids, lengths

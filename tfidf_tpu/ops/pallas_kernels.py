@@ -326,6 +326,103 @@ def pack_words_pallas(vals: jax.Array, tids: jax.Array, *,
 # sort-join avoids the gather entirely) stays the measured default.
 
 
+# --- bytes-wire tokenize+hash -----------------------------------------
+#
+# The bytes wire (round 14) ships raw document bytes; the device
+# derives the padded [D, L] id batch itself (ops/device_tokenize.py).
+# The token-start derivation is shared XLA code; this kernel is the
+# Mosaic variant of the HASH stage (TFIDF_TPU_DEVICE_TOKENIZE=pallas):
+# per doc-tile, the per-token FNV-1a64 byte loop runs as a masked
+# lax.while_loop over (TILE_D, L) lanes with the whole byte slab
+# resident in VMEM (a 2^17-byte bucket is 512 KB as int32 — well under
+# the ~16 MB budget), gathering one byte per live token per step —
+# the device twin of the reference's OpenMP-parallel per-token loop
+# (TFIDF_extra.c:69-302), bit-identical ids to the XLA lowering and
+# both host packers (tests/test_bytes_wire.py).
+#
+# MEASURED SCOPE: in-tree A/B probe like ragged_rebuild_pallas — the
+# in-kernel slab gather is the op class the round-5 trace indicted on
+# this backend, so the XLA while_loop stays the portable default; the
+# kernel exists to measure whether VMEM-resident gathers beat it, and
+# needs the whole chunk slab to fit VMEM (multi-bucket slabs fall back
+# to XLA — ops.device_tokenize.tokenize_hash_device's caller scope).
+
+
+def _tokenize_hash_kernel(slab_ref, starts_ref, len_ref, ids_ref, *,
+                          vocab_size, seed, truncate_at, n):
+    from tfidf_tpu.ops.device_tokenize import (fnv1a_step, fold_mod,
+                                               is_space, seed_state)
+
+    starts = starts_ref[...]                     # [TILE_D, L] int32
+    lens = len_ref[...]                          # [TILE_D, 1] int32
+    length = starts.shape[1]
+    valid = jax.lax.broadcasted_iota(
+        jnp.int32, starts.shape, 1) < lens       # first lens[d] slots
+    hi0, lo0 = seed_state(seed)
+    hi = jnp.full(starts.shape, hi0, jnp.uint32)
+    lo = jnp.full(starts.shape, lo0, jnp.uint32)
+    del length
+
+    def cond(c):
+        return jnp.any(c[1])
+
+    def body(c):
+        j, alive, hi, lo = c
+        pos = starts + j
+        byte = jnp.take(slab_ref[0, :], jnp.minimum(pos, n - 1))
+        consume = alive & ~is_space(byte) & (pos < n)
+        if truncate_at:
+            consume &= j < truncate_at
+        nhi, nlo = fnv1a_step(hi, lo, byte.astype(jnp.uint32))
+        return (j + 1, consume, jnp.where(consume, nhi, hi),
+                jnp.where(consume, nlo, lo))
+
+    _, _, hi, lo = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), valid, hi, lo))
+    ids_ref[...] = jnp.where(valid, fold_mod(hi, lo, vocab_size), 0)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("vocab_size", "seed", "truncate_at",
+                                    "interpret"))
+def tokenize_hash_pallas(bytes_i32: jax.Array, starts: jax.Array,
+                         lengths: jax.Array, *, vocab_size: int,
+                         seed: int = 0, truncate_at: int = 0,
+                         interpret: bool = False) -> jax.Array:
+    """Pallas twin of ``ops.device_tokenize.hash_tokens_xla``
+    (bit-identical ids, pinned by tests/test_bytes_wire.py).
+
+    Args:
+      bytes_i32: int32 [N] upcast slab bytes (``token_starts`` output).
+      starts: int32 [D, L] token start positions (invalid slots point
+        at slab pad — whitespace — and additionally mask via lengths).
+      lengths: int32 [D] per-doc token counts capped at L.
+      vocab_size / seed / truncate_at: the hash contract statics
+        (truncate_at 0 = no truncation).
+
+    Returns int32 [D, L] vocab ids, padding slots zero-filled.
+    """
+    d, k = starts.shape
+    n = bytes_i32.shape[0]
+    dp = _pad_to(d, TILE_D)
+    # Padding rows: zero tokens -> the while mask starts dead there.
+    starts_p = jnp.full((dp, k), n - 1, jnp.int32).at[:d].set(starts)
+    lens_p = jnp.zeros((dp, 1), jnp.int32).at[:d, 0].set(lengths)
+    slab2 = bytes_i32.reshape(1, -1)
+    out = pl.pallas_call(
+        functools.partial(_tokenize_hash_kernel, vocab_size=vocab_size,
+                          seed=seed, truncate_at=truncate_at, n=n),
+        grid=(dp // TILE_D,),
+        in_specs=[pl.BlockSpec((1, n), lambda i: (0, 0)),
+                  pl.BlockSpec((TILE_D, k), lambda i: (i, 0)),
+                  pl.BlockSpec((TILE_D, 1), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((TILE_D, k), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((dp, k), jnp.int32),
+        interpret=interpret,
+    )(slab2, starts_p, lens_p)
+    return out[:d]
+
+
 def _fused_score_topk_kernel(ids_ref, cnt_ref, head_ref, len_ref,
                              idf_ref, vals_ref, tids_ref, *, k, length):
     dtype = idf_ref.dtype

@@ -70,8 +70,13 @@ _costmodel = _load("costmodel")
 
 # The ingest pipeline's span vocabulary, grouped by what the time IS:
 # main-lane stalls + dispatches + device waits, worker-lane busy time.
-_MAIN_SPANS = ("pack_wait", "dispatch", "phase_b", "fetch_wait", "fetch")
-_WORKER_SPANS = ("pack", "drain")
+# device_tokenize (round 14, bytes wire) nests inside dispatch on the
+# main lane; slab nests inside pack on the packer lane — both carry
+# byte stamps, so the generic per-name attribution below prices the
+# moved host pack the same way it prices the wire transfers.
+_MAIN_SPANS = ("pack_wait", "dispatch", "device_tokenize", "phase_b",
+               "fetch_wait", "fetch")
+_WORKER_SPANS = ("pack", "slab", "drain")
 _INGEST_SPANS = _MAIN_SPANS + _WORKER_SPANS
 
 

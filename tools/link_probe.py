@@ -2,17 +2,25 @@
 
 Measures device_put/device_get wall time for the bench's wire shapes at
 several chunkings, so upload/fetch optimization targets measured tunnel
-behavior instead of guesses. Run standalone on the real chip:
+behavior instead of guesses; plus (round 14) a three-way A/B of the
+chunk wire FORMATS — padded [D, L] ids, ragged flat uint16 ids, and the
+raw-byte slab — on a bench-shaped Zipf corpus: bytes on the wire, pack
+wall (the host cost of producing each format), and staged upload time.
+Run standalone on the real chip:
     python tools/link_probe.py
 """
 
+import os
 import sys
+import tempfile
 import time
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+import _common  # noqa: E402,F401  repo-root sys.path bootstrap
 
 
 def timed(fn, n=3):
@@ -22,6 +30,59 @@ def timed(fn, n=3):
         fn()
         best = min(best, time.perf_counter() - t0)
     return best
+
+
+def wire_format_ab(n_docs: int = 8192, doc_len: int = 256) -> None:
+    """Three-way ragged/padded/bytes wire A/B on one bench-shaped
+    chunk: what each format costs the HOST to produce (pack wall —
+    tokenize+hash for the id wires, read+memcpy for the byte slab),
+    what it puts ON the wire, and the staged upload wall. The byte
+    receipt is corpus-dependent: the slab carries mean-token-bytes+1
+    per token where the ragged wire carries a flat 2 — raw UTF-8 only
+    wins the byte count below ~2 B/token (docs/SCALING.md round 14
+    has the honest arithmetic)."""
+    from tfidf_tpu.config import PipelineConfig, VocabMode
+    from tfidf_tpu.ingest import (make_bytes_packer, make_chunk_packer,
+                                  make_flat_packer)
+
+    rng = np.random.default_rng(0)
+    words = np.array([f"w{i}".encode() for i in range(8192)],
+                     dtype=object)
+    tmp = tempfile.mkdtemp(prefix="wire_ab_")
+    lens = np.maximum(
+        doc_len // np.clip(rng.zipf(1.3, n_docs), 1, doc_len), 1)
+    for i in range(1, n_docs + 1):
+        n = int(lens[i - 1])
+        doc = b" ".join(words[np.clip(rng.zipf(1.3, n), 1, 8192) - 1])
+        with open(os.path.join(tmp, f"doc{i}"), "wb") as f:
+            f.write(doc)
+    names = [f"doc{i}" for i in range(1, n_docs + 1)]
+    n_tokens = int(lens.sum())
+    cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, topk=16,
+                         max_doc_len=doc_len, doc_chunk=doc_len,
+                         engine="sparse")
+    packers = {
+        "padded": make_chunk_packer(tmp, cfg, n_docs, doc_len),
+        "ragged": make_flat_packer(tmp, cfg, n_docs, doc_len),
+        "bytes": make_bytes_packer(tmp, cfg, n_docs, doc_len),
+    }
+    print(f"\nwire-format A/B ({n_docs} docs x {doc_len} cap, "
+          f"{n_tokens} live tokens):")
+    for name, pack in packers.items():
+        pack_wall = timed(lambda pack=pack: pack(names))
+        out = pack(names)
+        wire, plens = out[0], out[1]
+        nbytes = wire.nbytes + plens.nbytes
+
+        def put(wire=wire, plens=plens):
+            jax.block_until_ready([jax.device_put(wire),
+                                   jax.device_put(plens)])
+
+        up = timed(put)
+        print(f"  {name:>6}: {nbytes / 1e6:7.2f} MB "
+              f"({nbytes / max(n_tokens, 1):5.2f} B/token)  "
+              f"pack {pack_wall * 1e3:7.1f} ms  "
+              f"put {up * 1e3:7.1f} ms")
 
 
 def main():
@@ -67,6 +128,8 @@ def main():
     one = np.zeros((8,), np.int32)
     s = timed(lambda: np.asarray(jax.device_put(one)))
     print(f"roundtrip 32B: {s * 1000:.1f} ms")
+
+    wire_format_ab()
 
 
 if __name__ == "__main__":

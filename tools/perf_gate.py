@@ -57,7 +57,17 @@ _GATES = {
         "docs_per_sec": ("higher", 0.25),
         "vs_baseline": ("higher", 0.25),
         "device_docs_per_sec": ("higher", 0.30),
+        # Serialized one-pass host pack (artifact pack_serial_s, with
+        # a pack_s fallback for pre-round-14 artifacts — the ledger
+        # keeps ONE pack trajectory under this name; perf_ledger.py
+        # has the rename story). A pack that re-serializes or loses
+        # its threading regresses here and fails the gate.
         "pack_s": ("lower", 0.40),
+        # Upload byte receipt (bytes_on_wire / padded denominator):
+        # byte counts are deterministic at a fixed corpus shape, so
+        # the band is tight — a packer change that silently re-fattens
+        # the wire cannot hide inside run-to-run noise.
+        "wire_ratio": ("lower", 0.05),
         "link_tax_s": ("lower", 0.40),
         "recall_at_k": ("higher", 0.02),
         # Round 12: memory/compile regressions gate like latency ones.
@@ -98,18 +108,25 @@ _GATES = {
     },
 }
 # Context keys that must MATCH for two records to be comparable.
-_MATCH_KEYS = {"bench": ("backend", "n_docs"),
+_MATCH_KEYS = {"bench": ("backend", "n_docs", "wire"),
                "serve_bench": ("backend", "docs", "k", "max_batch"),
                "chaos": ("backend", "docs", "k", "max_batch", "plan",
                          "seed"),
                "multichip": ("n_devices",)}
+# Defaults applied to BOTH sides of a match when the key is absent —
+# how records that predate a context key stay comparable to their
+# successors (pre-round-14 bench records carry no "wire"; they were
+# all ragged-wire runs by construction).
+_MATCH_DEFAULTS = {"wire": "ragged"}
 
 
 def comparable(rec: dict, cand: dict) -> bool:
     if rec["kind"] != cand["kind"]:
         return False
     for key in _MATCH_KEYS[cand["kind"]]:
-        if rec["context"].get(key) != cand["context"].get(key):
+        default = _MATCH_DEFAULTS.get(key)
+        if (rec["context"].get(key) or default) \
+                != (cand["context"].get(key) or default):
             return False
     return True
 

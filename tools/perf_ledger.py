@@ -51,12 +51,24 @@ import _common  # noqa: E402,F401  repo-root sys.path bootstrap
 SCHEMA = 1
 DEFAULT_LEDGER = os.path.join(_common.REPO, "BENCH_LEDGER.jsonl")
 
-# metric name -> path into the artifact (dots descend into objects)
+# metric name -> path into the artifact (dots descend into objects; a
+# tuple = fallback chain, first present wins — how renamed artifact
+# fields keep one trajectory under one metric name).
 _BENCH_METRICS = {
     "docs_per_sec": "value",
     "vs_baseline": "vs_baseline",
     "device_docs_per_sec": "device_docs_per_sec",
-    "pack_s": "pack_s",
+    # THE serialized one-pass host pack measure. Round 14 renamed the
+    # artifact field pack_serial_s (the old top-level pack_s collided
+    # in name with phases.pack — the overlapped run's packer-thread
+    # stall, a different span); the METRIC name stays pack_s so the
+    # pre-rename ledger records remain one comparable trajectory.
+    # This, not phases.pack, is what tools/perf_gate.py gates.
+    "pack_s": ("pack_serial_s", "pack_s"),
+    # Upload byte receipt (lower = leaner wire): actual bytes shipped
+    # over the padded-format denominator. Gated so a packer regression
+    # that silently re-fattens the wire fails the gate (round 14).
+    "wire_ratio": "wire_ratio",
     "link_tax_s": "link_tax_s",
     "tpu_s": "tpu_s",
     "cpu_s": "cpu_s",
@@ -104,7 +116,12 @@ _MULTICHIP_METRICS = {"ok": "ok", "n_devices": "n_devices"}
 _MULTICHIP_CONTEXT = {"n_devices": "n_devices"}
 _BENCH_CONTEXT = {"backend": "backend", "n_docs": "n_docs",
                   "engine": "engine", "ingest_path": "ingest_path",
-                  "repeats": "repeats"}
+                  "repeats": "repeats",
+                  # Chunk wire format (round 14): a bytes-wire bench
+                  # and a ragged-wire bench are different protocols —
+                  # comparability-matched by perf_gate with "ragged"
+                  # defaulted for pre-wire records (_MATCH_DEFAULTS).
+                  "wire": "wire"}
 _SERVE_CONTEXT = {"backend": "backend", "docs": "docs", "k": "k",
                   "requests": "requests", "mode": "mode",
                   "concurrency": "concurrency",
@@ -112,7 +129,13 @@ _SERVE_CONTEXT = {"backend": "backend", "docs": "docs", "k": "k",
                   "fingerprint": "fingerprint.config_sha"}
 
 
-def _dig(doc: dict, path: str):
+def _dig(doc: dict, path):
+    if isinstance(path, tuple):  # fallback chain: first present wins
+        for p in path:
+            v = _dig(doc, p)
+            if v is not None:
+                return v
+        return None
     cur = doc
     for part in path.split("."):
         if not isinstance(cur, dict) or part not in cur:

@@ -28,7 +28,12 @@ ingest traces (auto-detected by ``pack`` spans):
     scoring. (The scanned finish emits ONE drain; the check is then
     vacuous and says so.)
   * every ``dispatch`` / ``fetch`` / ``drain`` span carries its
-    ``bytes`` stamp — the cost attribution tools/doctor.py reads.
+    ``bytes`` stamp — the cost attribution tools/doctor.py reads;
+  * bytes-wire runs (round 14): ``slab`` spans (host slab assembly)
+    and ``device_tokenize`` spans (on-device tokenize+hash dispatch)
+    carry byte stamps too, and slab assembly rides the packer lane —
+    so the "host pack became a copy that overlaps dispatch" claim is
+    checkable the same way the id-wire pack overlap is.
 
 serve traces (auto-detected by ``request`` spans):
   * every ``request`` span carries an ``outcome`` in the known set —
@@ -176,8 +181,13 @@ def _check_ingest(lanes, by_name, notes) -> List[str]:
     # Round 12 cost contract: the wire-moving spans carry their byte
     # stamps (obs/costmodel.py turns them into per-span GB/s at
     # export) — a dispatch/fetch/drain span without one regressed the
-    # instrumentation.
-    for name in ("dispatch", "fetch", "drain"):
+    # instrumentation. Round 14 adds the bytes-wire spans: every
+    # `slab` (host slab assembly, packer lane) and `device_tokenize`
+    # (on-device tokenize+hash dispatch, main lane) span must carry
+    # the chunk's byte payload too — the doctor attributes the moved
+    # host pack through exactly these stamps.
+    for name in ("dispatch", "fetch", "drain", "slab",
+                 "device_tokenize"):
         for e in by_name.get(name, []):
             if not isinstance((e.get("args") or {}).get("bytes"),
                               (int, float)):
@@ -185,6 +195,21 @@ def _check_ingest(lanes, by_name, notes) -> List[str]:
                     f"{name} span without a bytes stamp (cost "
                     f"attribution regressed): {e.get('args')!r}")
                 break
+    if by_name.get("slab"):
+        notes.append(f"bytes wire: {len(by_name['slab'])} slab "
+                     f"span(s), "
+                     f"{len(by_name.get('device_tokenize', []))} "
+                     f"device_tokenize span(s), byte stamps present")
+        # The bytes wire's slab copy must ride the packer lane — the
+        # overlap claim (_PackAhead hides slab assembly behind
+        # dispatch) is only meaningful off the main thread.
+        slab_main = [e for e in lanes.get("main", [])
+                     if e["name"] == "slab"]
+        if slab_main and not [e for e in lanes.get("packer", [])
+                              if e["name"] == "slab"]:
+            errors.append("slab spans exist but none on the 'packer' "
+                          "lane (slab assembly on main — _PackAhead "
+                          "not engaged?)")
 
     # Overlap checks arm only when some span carries chunk >= 1: a
     # trace may hold SEVERAL sequential single-chunk runs (bench
