@@ -1,0 +1,507 @@
+"""The static-analysis suite (tools/analyze) — checker units on
+planted fixtures, baseline mechanics, and the tier-1 gate that the
+real repo analyzes clean against the committed baseline.
+
+The fixture tests build throwaway mini-repos (a ``tfidf_tpu/`` dir
+with one planted hazard each) and assert the checker both FIRES on
+the planted violation and stays quiet on the adjacent correct idiom —
+every lint here is only as good as its negative cases. The drift
+demonstrations copy the real repo, delete one docs/CONFIG.md row /
+rename one span label, and watch the gate fail — the acceptance
+contract of docs/ANALYSIS.md.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.analyze import contracts, jax_lints, run, threads  # noqa: E402
+from tools.analyze.core import Baseline, Finding, Tree  # noqa: E402
+
+
+def mini_tree(tmp_path, files):
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    return Tree(str(tmp_path))
+
+
+def codes(findings):
+    return sorted({f.code for f in findings})
+
+
+# --- J001: use-after-donate ------------------------------------------
+
+_DONOR = """
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def update(buf, x):
+        return buf + x
+"""
+
+
+class TestUseAfterDonate:
+    def test_planted_use_fires(self, tmp_path):
+        tree = mini_tree(tmp_path, {"tfidf_tpu/m.py": _DONOR + """
+    def step(buf, x):
+        out = update(buf, x)
+        return buf.sum() + out      # buf's memory was donated
+"""})
+        finds = jax_lints.check(tree)
+        assert [f.code for f in finds] == ["J001"]
+        assert finds[0].symbol == "step:buf"
+
+    def test_rebind_and_return_are_clean(self, tmp_path):
+        tree = mini_tree(tmp_path, {"tfidf_tpu/m.py": _DONOR + """
+    def ok_rebind(buf, x):
+        buf = update(buf, x)        # result rebinds the name
+        return buf
+
+    def ok_return(buf, x):
+        return update(buf, x)       # control leaves the scope
+
+    def ok_branches(buf, x, flag):
+        if flag:
+            return update(buf, x)
+        return buf * 2              # other branch: never donated
+"""})
+        assert jax_lints.check(tree) == []
+
+    def test_closure_params_do_not_leak_scope(self, tmp_path):
+        tree = mini_tree(tmp_path, {"tfidf_tpu/m.py": _DONOR + """
+    def outer(buf, x):
+        def inner(buf):
+            return update(buf, x)
+        return inner(buf) + inner(buf)   # outer buf never donated
+"""})
+        assert jax_lints.check(tree) == []
+
+    def test_donate_argnames_kwarg(self, tmp_path):
+        tree = mini_tree(tmp_path, {"tfidf_tpu/m.py": """
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, donate_argnames=("buf",))
+    def update(x, buf=None):
+        return buf + x
+
+    def step(buf, x):
+        out = update(x, buf=buf)
+        print(buf)
+        return out
+"""})
+        finds = jax_lints.check(tree)
+        assert [f.code for f in finds] == ["J001"]
+
+
+# --- J002: host sync inside a device-hot span ------------------------
+
+class TestHostSyncInSpan:
+    def test_asarray_in_device_span_fires(self, tmp_path):
+        tree = mini_tree(tmp_path, {"tfidf_tpu/m.py": """
+    import numpy as np
+    from tfidf_tpu import obs
+
+    def go(x):
+        with obs.device_span("phase_b", chunk=0):
+            y = np.asarray(x)        # forces a host sync mid-span
+        return y
+"""})
+        finds = jax_lints.check(tree)
+        assert [f.code for f in finds] == ["J002"]
+        assert "np.asarray" in finds[0].symbol
+
+    def test_item_and_float_fire(self, tmp_path):
+        tree = mini_tree(tmp_path, {"tfidf_tpu/m.py": """
+    from tfidf_tpu import obs
+
+    def go(x):
+        with obs.span("dispatch", chunk=0):
+            a = x.item()
+            b = float(x)
+        return a + b
+"""})
+        assert len(jax_lints.check(tree)) == 2
+
+    def test_host_side_spans_are_exempt(self, tmp_path):
+        tree = mini_tree(tmp_path, {"tfidf_tpu/m.py": """
+    import numpy as np
+    from tfidf_tpu import obs
+
+    def go(x):
+        with obs.span("fetch", bytes=8):
+            y = np.asarray(x)        # fetch IS the sync — by design
+        with obs.span("drain", chunk=0):
+            z = np.asarray(x)
+        return y, z
+"""})
+        assert jax_lints.check(tree) == []
+
+
+# --- J003: traced control flow ---------------------------------------
+
+class TestTracedControlFlow:
+    def test_branch_on_traced_param_fires(self, tmp_path):
+        tree = mini_tree(tmp_path, {"tfidf_tpu/m.py": """
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def f(x, k):
+        if x > 0:
+            return x * k
+        return -x
+"""})
+        finds = jax_lints.check(tree)
+        assert [f.code for f in finds] == ["J003"]
+        assert finds[0].symbol == "f:x"
+
+    def test_static_shape_and_none_tests_are_clean(self, tmp_path):
+        tree = mini_tree(tmp_path, {"tfidf_tpu/m.py": """
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, static_argnames=("k", "topk"))
+    def f(x, k, topk=None):
+        if k > 2:                    # static: branch is fine
+            x = x * 2
+        if topk is None:             # identity test: fine
+            return x
+        if x.shape[0] > 4:           # shape metadata: fine
+            return x[:4]
+        while len(x.shape) < 3:
+            x = x[None]
+        return x
+"""})
+        assert jax_lints.check(tree) == []
+
+
+# --- T001: unlocked cross-thread writes ------------------------------
+
+_THREADED = """
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+            self._t = threading.Thread(target=self._run, daemon=True)
+
+        def _run(self):
+            while True:
+                {worker_write}
+
+        def bump(self):
+            {main_write}
+"""
+
+
+class TestThreadDiscipline:
+    def test_unlocked_two_domain_write_fires(self, tmp_path):
+        tree = mini_tree(tmp_path, {"tfidf_tpu/m.py": _THREADED.format(
+            worker_write="self.count += 1",
+            main_write="self.count += 1")})
+        finds = threads.check(tree)
+        assert [f.code for f in finds] == ["T001"]
+        assert finds[0].symbol == "Worker.count"
+
+    def test_locked_writes_are_clean(self, tmp_path):
+        tree = mini_tree(tmp_path, {"tfidf_tpu/m.py": _THREADED.format(
+            worker_write="self._bump()",
+            main_write="self._bump()") + """
+        def _bump(self):
+            with self._lock:
+                self.count += 1
+"""})
+        assert threads.check(tree) == []
+
+    def test_callsite_lock_inference(self, tmp_path):
+        # the _pop_batch idiom: the helper holds no lock itself, but
+        # every call site does
+        tree = mini_tree(tmp_path, {"tfidf_tpu/m.py": _THREADED.format(
+            worker_write="self._locked_bump()",
+            main_write="self._locked_bump()") + """
+        def _locked_bump(self):
+            with self._lock:
+                self._bump()
+
+        def _bump(self):
+            self.count += 1
+"""})
+        assert threads.check(tree) == []
+
+    def test_single_domain_write_is_clean(self, tmp_path):
+        tree = mini_tree(tmp_path, {"tfidf_tpu/m.py": _THREADED.format(
+            worker_write="self.count += 1",
+            main_write="pass")})
+        assert threads.check(tree) == []
+
+    def test_executor_submit_opens_a_domain(self, tmp_path):
+        tree = mini_tree(tmp_path, {"tfidf_tpu/m.py": """
+    import concurrent.futures as cf
+
+    class Pool:
+        def __init__(self):
+            self._ex = cf.ThreadPoolExecutor(max_workers=1)
+            self.done = 0
+
+        def kick(self):
+            def job():
+                self.done += 1       # worker domain
+            self._ex.submit(job)
+
+        def reset(self):
+            self.done = 0            # main domain
+"""})
+        finds = threads.check(tree)
+        assert [f.code for f in finds] == ["T001"]
+        assert finds[0].symbol == "Pool.done"
+
+    def test_no_thread_no_findings(self, tmp_path):
+        tree = mini_tree(tmp_path, {"tfidf_tpu/m.py": """
+    class Plain:
+        def __init__(self):
+            self.n = 0
+
+        def a(self):
+            self.n += 1
+
+        def b(self):
+            self.n -= 1
+"""})
+        assert threads.check(tree) == []
+
+
+# --- contract gates on planted drift ---------------------------------
+
+_CONFIG_MD = """
+    # knobs
+    | Variable | Default | Bounds | Touch it when |
+    |---|---|---|---|
+    | `TFIDF_TPU_DOCUMENTED` | `1` | a knob | never |
+"""
+
+
+class TestContractGates:
+    def test_undocumented_knob_fires(self, tmp_path):
+        tree = mini_tree(tmp_path, {
+            "docs/CONFIG.md": _CONFIG_MD,
+            "tfidf_tpu/m.py": """
+    import os
+    A = os.environ.get("TFIDF_TPU_DOCUMENTED")
+    B = os.environ.get("TFIDF_TPU_PHANTOM_KNOB")
+"""})
+        finds = contracts.check_knobs(tree)
+        assert [(f.code, f.symbol) for f in finds] == [
+            ("C001", "TFIDF_TPU_PHANTOM_KNOB")]
+
+    def test_stale_doc_row_fires(self, tmp_path):
+        tree = mini_tree(tmp_path, {
+            "docs/CONFIG.md": _CONFIG_MD,
+            "tfidf_tpu/m.py": "X = 1\n"})
+        finds = contracts.check_knobs(tree)
+        assert [(f.code, f.symbol) for f in finds] == [
+            ("C002", "TFIDF_TPU_DOCUMENTED")]
+
+    def test_undeclared_span_fires(self, tmp_path):
+        tree = mini_tree(tmp_path, {"tfidf_tpu/m.py": """
+    from tfidf_tpu import obs
+
+    def go():
+        with obs.span("zorp"):
+            pass
+"""})
+        finds = contracts.check_spans(tree)
+        assert [(f.code, f.symbol) for f in finds] == [("C005", "zorp")]
+
+    def test_declared_span_is_clean(self, tmp_path):
+        tree = mini_tree(tmp_path, {"tfidf_tpu/m.py": """
+    from tfidf_tpu import obs
+
+    def go():
+        with obs.span("dispatch", chunk=0):
+            pass
+"""})
+        assert contracts.check_spans(tree) == []
+
+    def test_unconsulted_seam_fires(self, tmp_path):
+        tree = mini_tree(tmp_path, {
+            "tfidf_tpu/faults.py": 'SEAMS = ("swap", "drain")\n',
+            "tfidf_tpu/m.py": """
+    from tfidf_tpu import faults
+
+    def go(worker):
+        faults.fire("swap" if worker else "drain")
+"""})
+        assert contracts.check_seams(tree) == []
+        tree2 = mini_tree(tmp_path / "b", {
+            "tfidf_tpu/faults.py": 'SEAMS = ("swap", "ghost_seam")\n',
+            "tfidf_tpu/m.py": """
+    from tfidf_tpu import faults
+
+    def go():
+        faults.fire("swap")
+"""})
+        finds = contracts.check_seams(tree2)
+        assert [(f.code, f.symbol) for f in finds] == [
+            ("C009", "ghost_seam")]
+
+    def test_undeclared_seam_at_fire_site_fires(self, tmp_path):
+        tree = mini_tree(tmp_path, {
+            "tfidf_tpu/faults.py": 'SEAMS = ("swap",)\n',
+            "tfidf_tpu/m.py": """
+    from tfidf_tpu import faults
+
+    def go():
+        faults.fire("not_a_seam")
+"""})
+        assert ("C010", "not_a_seam") in [
+            (f.code, f.symbol) for f in contracts.check_seams(tree)]
+
+    def test_undeclared_flight_event_fires(self, tmp_path):
+        tree = mini_tree(tmp_path, {"tfidf_tpu/m.py": """
+    from tfidf_tpu.obs import log as obs_log
+
+    def go():
+        obs_log.log_event("info", "zorp_event", msg="hi")
+"""})
+        finds = contracts.check_flight_events(tree)
+        assert [(f.code, f.symbol) for f in finds] == [
+            ("C012", "zorp_event")]
+
+
+# --- baseline mechanics ----------------------------------------------
+
+class TestBaseline:
+    def test_roundtrip_and_split(self, tmp_path):
+        f1 = Finding("J001", "a.py", 3, "f:x", "msg")
+        f2 = Finding("C001", "b.py", 9, "TFIDF_TPU_Z", "msg")
+        b = Baseline({f1.key: "known issue"})
+        new, suppressed, stale = b.split([f1, f2])
+        assert [f.key for f in new] == [f2.key]
+        assert [f.key for f in suppressed] == [f1.key]
+        assert stale == []
+        path = str(tmp_path / "baseline.json")
+        b.entries["ghost:key"] = "gone"
+        b.save(path)
+        b2 = Baseline.load(path)
+        assert b2.entries == b.entries
+        _, _, stale = b2.split([f1])
+        assert stale == ["ghost:key"]
+
+    def test_baseline_requires_justification(self, tmp_path):
+        path = tmp_path / "baseline.json"
+        path.write_text(json.dumps(
+            {"version": 1, "entries": [{"key": "a:b:c"}]}))
+        with pytest.raises(ValueError, match="justification"):
+            Baseline.load(str(path))
+
+    def test_run_suppresses_via_baseline(self, tmp_path):
+        mini_tree(tmp_path, {"tfidf_tpu/m.py": """
+    from tfidf_tpu import obs
+
+    def go():
+        with obs.span("zorp"):
+            pass
+"""})
+        report = run(root=str(tmp_path), checkers=["contracts"])
+        assert not report["ok"]
+        keys = [f["key"] for f in report["findings"]]
+        assert any(":zorp" in k for k in keys)
+        bl = tmp_path / "bl.json"
+        bl.write_text(json.dumps({"version": 1, "entries": [
+            {"key": k, "justification": "test"} for k in keys]}))
+        report = run(root=str(tmp_path), checkers=["contracts"],
+                     baseline_path=str(bl))
+        assert report["ok"]
+        assert len(report["suppressed"]) == len(keys)
+
+
+# --- the real repo ---------------------------------------------------
+
+class TestRepoGate:
+    def test_repo_analyzes_clean_against_committed_baseline(self):
+        report = run(root=REPO)
+        assert report["ok"], (
+            "new static-analysis findings:\n" + "\n".join(
+                f"  {f['code']} {f['path']}:{f['line']} {f['message']}"
+                for f in report["findings"]))
+        assert report["stale_baseline"] == [], (
+            "baseline entries that no longer fire — delete them: "
+            f"{report['stale_baseline']}")
+
+    def test_runner_exit_codes(self, tmp_path):
+        # clean repo -> 0; a planted violation -> 1 (the CI contract)
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.analyze"],
+            capture_output=True, cwd=REPO)
+        assert proc.returncode == 0, proc.stdout.decode()[-2000:]
+        mini_tree(tmp_path, {
+            "docs/CONFIG.md": _CONFIG_MD,
+            "tfidf_tpu/m.py": """
+    import os
+    from tfidf_tpu import obs
+
+    B = os.environ.get("TFIDF_TPU_PHANTOM_KNOB")
+
+    def go():
+        with obs.span("zorp"):
+            pass
+"""})
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.analyze", "--root",
+             str(tmp_path), "--json"],
+            capture_output=True, cwd=REPO)
+        assert proc.returncode == 1
+        report = json.loads(proc.stdout.decode())
+        got = {f["code"] for f in report["findings"]}
+        assert {"C001", "C005"} <= got
+
+
+def _copy_repo(tmp_path):
+    """The contract-gate surface of the real repo (no tests/native/
+    artifacts), cheap enough to copy per drift demonstration."""
+    dst = tmp_path / "repo"
+    dst.mkdir()
+    for d in ("tfidf_tpu", "tools", "docs"):
+        shutil.copytree(
+            os.path.join(REPO, d), dst / d,
+            ignore=shutil.ignore_patterns("__pycache__", "*.so"))
+    shutil.copy(os.path.join(REPO, "bench.py"), dst / "bench.py")
+    return dst
+
+
+class TestDriftDemonstrations:
+    def test_deleting_a_config_row_fails_the_gate(self, tmp_path):
+        dst = _copy_repo(tmp_path)
+        cfg = dst / "docs" / "CONFIG.md"
+        lines = [ln for ln in cfg.read_text().splitlines()
+                 if not ln.startswith("| `TFIDF_TPU_FETCH_AHEAD`")]
+        cfg.write_text("\n".join(lines) + "\n")
+        report = run(root=str(dst), checkers=["contracts"])
+        assert not report["ok"]
+        assert ("C001", "TFIDF_TPU_FETCH_AHEAD") in [
+            (f["code"], f["symbol"]) for f in report["findings"]]
+
+    def test_renaming_a_span_label_fails_the_gate(self, tmp_path):
+        dst = _copy_repo(tmp_path)
+        ing = dst / "tfidf_tpu" / "ingest.py"
+        ing.write_text(ing.read_text().replace('"dispatch"',
+                                               '"dispatchx"'))
+        report = run(root=str(dst), checkers=["contracts"])
+        assert not report["ok"]
+        pairs = [(f["code"], f["symbol"]) for f in report["findings"]]
+        assert ("C005", "dispatchx") in pairs      # undeclared label
+        assert ("C006", "dispatch") in pairs       # doctor went dark

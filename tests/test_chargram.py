@@ -154,8 +154,6 @@ class TestDeviceChargram:
         __import__("jax").device_count() < 8, reason="needs 8 devices")
     def test_sharded_sparse_chargram_matches_single(self):
         import jax
-
-        from tfidf_tpu.parallel.mesh import MeshPlan
         cfg = PipelineConfig(tokenizer=TokenizerKind.CHARGRAM,
                              vocab_mode=VocabMode.HASHED,
                              vocab_size=1 << 14, ngram_range=(2, 3),

@@ -1,7 +1,5 @@
 """CLI driver tests: both backends end-to-end through main()."""
 
-import os
-
 import pytest
 
 from tfidf_tpu.cli import main

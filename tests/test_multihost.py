@@ -10,8 +10,6 @@ import os
 import subprocess
 import sys
 
-import pytest
-
 from tfidf_tpu.parallel.multihost import HostTopology, initialize
 
 

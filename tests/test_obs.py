@@ -15,7 +15,6 @@ import os
 import threading
 import time
 
-import numpy as np
 import pytest
 
 from tfidf_tpu import obs

@@ -7,7 +7,7 @@ import numpy as np
 import pytest
 
 from tfidf_tpu.ops.hashing import (device_ngram_ids, fnv1a_hash_words,
-                                   hash_to_vocab, words_to_ids)
+                                   words_to_ids)
 from tfidf_tpu.ops.histogram import df_from_counts, tf_counts, tf_counts_chunked
 from tfidf_tpu.ops.scoring import idf_from_df, tfidf_dense
 from tfidf_tpu.ops.tokenize import char_ngrams, whitespace_tokenize

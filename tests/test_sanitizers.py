@@ -1,0 +1,191 @@
+"""ASan/UBSan drives of the native hot loops.
+
+The TSan target (tests/test_tsan.py) proves the thread-comm reference
+backend race-free; these tests do the same for the ctypes library's
+memory story: the slab fill (bytes wire), the threaded ragged fill
+(``loader_fill_flat_u16_v3`` — the round-14 OpenMP move), the padded
+loader fills and the tokenizer itself run under AddressSanitizer and
+UndefinedBehaviorSanitizer builds (``make -C native sanitizers``)
+against an adversarial corpus (multi-byte UTF-8, NUL bytes, 0x80–0xFF
+binary runs, over-long tokens, empty/whitespace-only docs), and their
+output must be byte-identical to the plain build's.
+
+Mechanics: the sanitizer .so loads through the real ctypes bindings
+via ``TFIDF_TPU_NATIVE_LIB`` in a subprocess (ASan's runtime must be
+preloaded into the uninstrumented python host — ``LD_PRELOAD``), the
+module itself loaded standalone so no jax ever rides under the
+sanitizer. A clean run exits 0 with no report; any heap overflow /
+UB aborts with the sanitizer's exit code and fails the assert with
+the report text.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE_DIR = os.path.join(REPO, "native")
+
+# Runs standalone (no jax, no package import): loads the ctypes module
+# by path, drives every loader entry point over the corpus, prints one
+# JSON digest line. Exit 3 = native library unavailable (skip).
+_DRIVER = r"""
+import glob, hashlib, importlib.util, json, os, sys
+
+import numpy as np
+
+repo, corpus = sys.argv[1], sys.argv[2]
+spec = importlib.util.spec_from_file_location(
+    "_ft", os.path.join(repo, "tfidf_tpu", "io", "fast_tokenizer.py"))
+ft = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(ft)
+if not (ft.available() and ft.loader_available()
+        and ft.flat_available() and ft.slab_available()):
+    print("SKIP: native loader unavailable")
+    sys.exit(3)
+
+paths = sorted(glob.glob(os.path.join(corpus, "*.txt")))
+docs = [open(p, "rb").read() for p in paths]
+
+
+def digest(*arrays):
+    m = hashlib.sha256()
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        m.update(str(a.dtype).encode())
+        m.update(str(a.shape).encode())
+        m.update(a.tobytes())
+    return m.hexdigest()[:32]
+
+
+out = {}
+# tokenizer parity path (incl. the reference's 16-byte truncation)
+out["tok"] = digest(*[ft.tokenize_hash_ids(d, 1 << 16, seed=7)
+                      for d in docs])
+out["tok_trunc"] = digest(
+    *[ft.tokenize_hash_ids(d, 1 << 16, seed=7, truncate_at=16)
+      for d in docs])
+# threaded ragged fill: 1 thread = serial v1/v2 fill, >1 = v3
+# work-stolen fill — every width must land the identical stream
+for n in (1, 2, 4, 8):
+    r = ft.load_pack_flat(paths, 1 << 16, seed=7, max_per_doc=64,
+                          n_threads=n, align=16)
+    if r is None:
+        print("SKIP: flat packer unavailable")
+        sys.exit(3)
+    flat, lens, total = r
+    # digest the real stream only: without cap_ids the serial v1
+    # fill leaves the scaffold tail past `total` uninitialized by
+    # contract (the wire ships cap_ids-rounded buffers, where the
+    # v2/v3 fills zero the tail in C++)
+    out["flat_t%d" % n] = digest(flat[:total], lens) + ":%d" % total
+# bytes-wire slab fill
+for n in (1, 4):
+    r = ft.load_slab_paths(paths, n_threads=n, align=16,
+                           cap_round=4096)
+    if r is None:
+        print("SKIP: slab loader unavailable")
+        sys.exit(3)
+    slab, blens, total = r
+    out["slab_t%d" % n] = digest(slab, blens) + ":%d" % total
+# padded loader, both element widths
+ids, lens = ft.load_pack_paths(paths, 1 << 16, seed=7, n_threads=4)
+out["pad_u16"] = digest(ids, lens)
+ids, lens = ft.load_pack_paths(paths, (1 << 16) + 7, seed=7,
+                               n_threads=4)
+out["pad_i32"] = digest(ids, lens)
+print(json.dumps(out, sort_keys=True))
+"""
+
+
+@pytest.fixture(scope="module")
+def hazard_corpus(tmp_path_factory):
+    """Docs chosen to stress every boundary the fills index over."""
+    d = tmp_path_factory.mktemp("san_corpus")
+    docs = {
+        "plain": b"the quick brown fox jumps over the lazy dog " * 40,
+        "utf8": ("中文 tokens mixed with café naïve "
+                 "über " * 30).encode(),
+        "empty": b"",
+        "spaces": b" \t\n  \r  " * 16,
+        "longtok": b"x" * 300 + b" y " + b"z" * 4096,
+        "nul": b"alpha\x00beta gamma \x00 delta",
+        "binary": bytes(range(0x80, 0x100)) * 8,
+        "overflow": (b"w " * 500),          # > max_per_doc tokens
+        "big": (b"lorem ipsum dolor sit amet consectetur " * 1500),
+    }
+    for i in range(16):                      # give the pool real work
+        docs[f"doc{i:02d}"] = (f"doc {i} body words " * (i * 7 + 3)
+                               ).encode()
+    for name, body in docs.items():
+        (d / f"{name}.txt").write_bytes(body)
+    return str(d)
+
+
+def _build(target):
+    if shutil.which("g++") is None or shutil.which("make") is None:
+        pytest.skip("needs g++ and make")
+    r = subprocess.run(["make", "-C", NATIVE_DIR, target],
+                       capture_output=True)
+    if r.returncode != 0:
+        pytest.skip(f"{target} build unavailable: "
+                    f"{r.stderr.decode()[-200:]}")
+    return os.path.join(NATIVE_DIR, target)
+
+
+def _run_driver(corpus, extra_env):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("TFIDF_TPU_NO_NATIVE", "TFIDF_TPU_NATIVE_LIB",
+                        "TFIDF_TPU_PACK_THREADS", "LD_PRELOAD")}
+    env.update(extra_env)
+    return subprocess.run(
+        [sys.executable, "-c", _DRIVER, REPO, corpus],
+        capture_output=True, env=env, timeout=300)
+
+
+@pytest.fixture(scope="module")
+def reference_digests(hazard_corpus):
+    """The plain build's answer — what the sanitized runs must match."""
+    _build("fast_tokenizer.so")
+    proc = _run_driver(hazard_corpus, {})
+    if proc.returncode == 3:
+        pytest.skip(proc.stdout.decode().strip())
+    assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+    return json.loads(proc.stdout.decode().strip().splitlines()[-1])
+
+
+def _sanitizer_env(kind):
+    if kind == "asan":
+        runtime = subprocess.run(
+            ["gcc", "-print-file-name=libasan.so"],
+            capture_output=True, text=True).stdout.strip()
+        if not os.path.isabs(runtime):
+            pytest.skip("libasan.so runtime not found")
+        # detect_leaks=0: the python *host* leaks by design; the .so's
+        # own heap errors still abort with exitcode=66.
+        return {"LD_PRELOAD": runtime,
+                "ASAN_OPTIONS": "detect_leaks=0:exitcode=66"}
+    return {"UBSAN_OPTIONS": "halt_on_error=1:print_stacktrace=1"}
+
+
+@pytest.mark.parametrize("kind", ["asan", "ubsan"])
+def test_sanitized_native_paths_clean_and_identical(
+        kind, hazard_corpus, reference_digests):
+    lib = _build(f"fast_tokenizer_{kind}.so")
+    proc = _run_driver(hazard_corpus, dict(
+        _sanitizer_env(kind), TFIDF_TPU_NATIVE_LIB=lib))
+    stderr = proc.stderr.decode()
+    assert proc.returncode != 66, f"AddressSanitizer report:\n{stderr[-4000:]}"
+    assert proc.returncode == 0, f"{kind} run failed:\n{stderr[-4000:]}"
+    for marker in ("AddressSanitizer", "runtime error",
+                   "UndefinedBehaviorSanitizer"):
+        assert marker not in stderr, f"{kind} report:\n{stderr[-4000:]}"
+    got = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    assert got == reference_digests, (
+        f"{kind} build diverged from the plain build")

@@ -9,7 +9,7 @@ from tfidf_tpu import PipelineConfig, TfidfPipeline, discover_corpus
 from tfidf_tpu.config import VocabMode
 from tfidf_tpu.golden import golden_output
 from tfidf_tpu.ops.sparse import (sorted_term_counts, sparse_df,
-                                  sparse_topk, to_bcoo)
+                                  to_bcoo)
 from tfidf_tpu.parallel import MeshPlan, ShardedPipeline
 
 

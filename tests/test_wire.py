@@ -3,12 +3,10 @@ pipeline (ingest.py round 6): ragged<->padded round-trip equality,
 device-rebuild vs host-pad parity on both engines, the overlap-loop
 ordering contract, and the --wire knob's fallback selection."""
 
-import os
-
 import numpy as np
 import pytest
 
-from tfidf_tpu import PipelineConfig, discover_corpus
+from tfidf_tpu import PipelineConfig
 from tfidf_tpu import ingest as ing
 from tfidf_tpu.config import VocabMode
 from tfidf_tpu.io.corpus import (Corpus, pack_corpus, pack_ragged,

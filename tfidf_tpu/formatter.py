@@ -12,7 +12,7 @@ the reference (SURVEY §7 "hard parts": bit-identical output).
 from __future__ import annotations
 
 import math
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Sequence
 
 import numpy as np
 

@@ -25,7 +25,7 @@ token buffer overflow — those are bugs, not semantics.
 from __future__ import annotations
 
 import math
-from typing import Dict, List, Sequence
+from typing import Dict, List
 
 from tfidf_tpu.io.corpus import Corpus
 from tfidf_tpu.ops.tokenize import whitespace_tokenize

@@ -64,7 +64,7 @@ from tfidf_tpu.ops.downlink import (pack_result_words, pack_words,
                                     use_packed_result_wire)
 from tfidf_tpu.ops.scoring import idf_from_df
 from tfidf_tpu.ops.sparse import (score_topk, sorted_term_counts,
-                                  sparse_df, sparse_forward, sparse_scores,
+                                  sparse_df, sparse_scores,
                                   sparse_topk)
 
 if TYPE_CHECKING:  # parallel imports stay lazy for single-device runs

@@ -42,10 +42,15 @@ def _load() -> Optional[ctypes.CDLL]:
         return None
     if _lib is not None or _load_failed:
         return _lib
-    if not os.path.exists(_LIB_PATH):
+    # TFIDF_TPU_NATIVE_LIB points at an alternate build of the same
+    # library — how the sanitizer tests drive the ASan/UBSan .so
+    # through the real ctypes bindings. Read at first load; the
+    # resolved library then sticks for the process.
+    lib_path = os.environ.get("TFIDF_TPU_NATIVE_LIB") or _LIB_PATH
+    if not os.path.exists(lib_path):
         return None
     try:
-        lib = ctypes.CDLL(_LIB_PATH)
+        lib = ctypes.CDLL(lib_path)
     except OSError:
         _load_failed = True
         return None

@@ -135,43 +135,49 @@ class DeviceMonitor:
         """One monitor pass: read every device's memory stats, publish
         gauges for the keys present, update pressure + watermark state.
         Returns the snapshot dict (the ``devmon`` op payload). Never
-        raises on missing/partial stats — that IS the CPU path."""
+        raises on missing/partial stats — that IS the CPU path.
+
+        Serialized under ``self._lock``: the ``devmon`` op calls this
+        from a protocol thread while the background monitor samples on
+        its own cadence, and the peak/gauge/watermark updates are
+        read-modify-writes."""
         import jax
-        devices = []
-        pressure = 0.0
-        for i, dev in enumerate(jax.devices()):
-            stats = self._device_stats(dev) or {}
-            in_use = stats.get("bytes_in_use")
-            peak = stats.get("peak_bytes_in_use")
-            limit = stats.get("bytes_limit")
-            rec = {"device": i, "kind": dev.device_kind,
-                   "platform": dev.platform}
-            if in_use is not None:
-                rec["bytes_in_use"] = int(in_use)
-                self._gauge(f"hbm_bytes_in_use_d{i}",
-                            "live HBM bytes in use").set(int(in_use))
-            if peak is not None:
-                rec["peak_bytes_in_use"] = int(peak)
-                self._peak_bytes = max(self._peak_bytes, int(peak))
-                self._gauge(f"hbm_peak_bytes_d{i}",
-                            "allocator peak HBM bytes").set(int(peak))
-            if limit is not None:
-                rec["bytes_limit"] = int(limit)
-                self._gauge(f"hbm_bytes_limit_d{i}",
-                            "HBM capacity the allocator sees"
-                            ).set(int(limit))
-            if in_use is not None and limit:
-                frac = in_use / limit
-                rec["pressure"] = round(frac, 4)
-                pressure = max(pressure, frac)
-            devices.append(rec)
-        self._pressure = pressure
-        self._samples += 1
-        self._watermark_check(pressure)
-        snap = {"devices": devices,
-                "memory_pressure": round(pressure, 4),
-                "peak_bytes": self._peak_bytes,
-                "samples": self._samples}
+        with self._lock:
+            devices = []
+            pressure = 0.0
+            for i, dev in enumerate(jax.devices()):
+                stats = self._device_stats(dev) or {}
+                in_use = stats.get("bytes_in_use")
+                peak = stats.get("peak_bytes_in_use")
+                limit = stats.get("bytes_limit")
+                rec = {"device": i, "kind": dev.device_kind,
+                       "platform": dev.platform}
+                if in_use is not None:
+                    rec["bytes_in_use"] = int(in_use)
+                    self._gauge(f"hbm_bytes_in_use_d{i}",
+                                "live HBM bytes in use").set(int(in_use))
+                if peak is not None:
+                    rec["peak_bytes_in_use"] = int(peak)
+                    self._peak_bytes = max(self._peak_bytes, int(peak))
+                    self._gauge(f"hbm_peak_bytes_d{i}",
+                                "allocator peak HBM bytes").set(int(peak))
+                if limit is not None:
+                    rec["bytes_limit"] = int(limit)
+                    self._gauge(f"hbm_bytes_limit_d{i}",
+                                "HBM capacity the allocator sees"
+                                ).set(int(limit))
+                if in_use is not None and limit:
+                    frac = in_use / limit
+                    rec["pressure"] = round(frac, 4)
+                    pressure = max(pressure, frac)
+                devices.append(rec)
+            self._pressure = pressure
+            self._samples += 1
+            self._watermark_check(pressure)
+            snap = {"devices": devices,
+                    "memory_pressure": round(pressure, 4),
+                    "peak_bytes": self._peak_bytes,
+                    "samples": self._samples}
         return snap
 
     def _gauge(self, name: str, help: str):

@@ -28,7 +28,7 @@ its high-water mark between snapshots (``snapshot(reset_peaks=True)``).
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 from tfidf_tpu.utils.timing import LatencyHistogram
 

@@ -18,7 +18,7 @@ Two hash paths:
 
 from __future__ import annotations
 
-from typing import List, Sequence
+from typing import Sequence
 
 import jax.numpy as jnp
 import numpy as np

@@ -13,7 +13,6 @@ from __future__ import annotations
 from typing import Tuple
 
 import jax
-import jax.numpy as jnp
 from jax import lax
 
 # lax.top_k over a flattened [D*V] stream returns int32 indices, and the

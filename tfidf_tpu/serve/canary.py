@@ -166,7 +166,10 @@ class CanaryProber:
                if not (np.array_equal(vals[i], ovals[i])
                        and np.array_equal(ids[i], oids[i]))]
         parity = 1.0 - len(bad) / len(self._queries)
-        self._parity = parity
+        with self._lock:
+            # the `canary` op probes from a protocol thread while the
+            # background prober runs its own cadence
+            self._parity = parity
         self._c_probes.inc()
         self._g_parity.set(int(round(parity * 1000)))
         if bad:

@@ -1,0 +1,3 @@
+# Namespace package marker so `python -m tools.analyze` resolves from
+# the repo root. The standalone scripts in this directory still run as
+# scripts (`python tools/doctor.py`) via their `import _common` bootstrap.

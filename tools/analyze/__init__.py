@@ -1,0 +1,135 @@
+"""Repo-invariant static analysis: ``python -m tools.analyze``.
+
+One runner, three checker families (docs/ANALYSIS.md):
+
+* ``jax`` — donated-buffer use-after-donate, host syncs inside
+  device-hot spans, Python control flow on traced values (J-family);
+* ``threads`` — unlocked ``self.*`` writes reachable from two or more
+  thread entry domains (T-family);
+* ``contracts`` — knob/doc/CLI drift, span and flight-event
+  vocabulary drift, unconsulted fault seams, undocumented metrics
+  (C-family).
+
+Exit 0 when every finding is baselined (``baseline.json``), 1 when a
+new finding fires, 2 on unreadable input. ``--json`` for machines,
+``--write-baseline`` to grandfather the current findings (each new
+entry gets a TODO justification a human must replace).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+from . import contracts, jax_lints, threads
+from .core import Baseline, Finding, Tree
+
+CHECKERS = {
+    "jax": jax_lints.check,
+    "threads": threads.check,
+    "contracts": contracts.check,
+}
+
+_TODO = "TODO: justify or fix (added by --write-baseline)"
+
+
+def default_baseline_path(root: Optional[str] = None) -> str:
+    tree = Tree(root)
+    return os.path.join(tree.root, "tools", "analyze", "baseline.json")
+
+
+def run(root: Optional[str] = None,
+        checkers: Optional[List[str]] = None,
+        baseline_path: Optional[str] = None) -> Dict:
+    """Run the selected checkers; returns the report dict the CLI
+    prints (``ok`` is the gate verdict)."""
+    tree = Tree(root)
+    names = checkers or sorted(CHECKERS)
+    findings: List[Finding] = []
+    for name in names:
+        findings += CHECKERS[name](tree)
+    findings.sort(key=lambda f: (f.code, f.path, f.line))
+    if baseline_path is None:
+        baseline_path = os.path.join(tree.root, "tools", "analyze",
+                                     "baseline.json")
+    baseline = Baseline.load(baseline_path)
+    new, suppressed, stale = baseline.split(findings)
+    return {
+        "root": tree.root,
+        "checkers": names,
+        "findings": [f.to_json() for f in new],
+        "suppressed": [dict(f.to_json(),
+                            justification=baseline.entries[f.key])
+                       for f in suppressed],
+        "stale_baseline": stale,
+        "ok": not new,
+    }
+
+
+def _render(report: Dict) -> str:
+    lines: List[str] = []
+    for f in report["findings"]:
+        lines.append(f"{f['code']} {f['path']}:{f['line']} "
+                     f"[{f['symbol']}] {f['message']}")
+    if report["suppressed"]:
+        lines.append(f"  {len(report['suppressed'])} baselined "
+                     f"finding(s) suppressed:")
+        for f in report["suppressed"]:
+            lines.append(f"    {f['key']} — {f['justification']}")
+    for key in report["stale_baseline"]:
+        lines.append(f"  STALE baseline entry (no longer fires — "
+                     f"delete it): {key}")
+    n = len(report["findings"])
+    lines.append(
+        f"analyze: {n} new finding(s), "
+        f"{len(report['suppressed'])} baselined, "
+        f"checkers: {', '.join(report['checkers'])}"
+        + (" — FAIL" if n else " — OK"))
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analyze",
+        description=__doc__.split("\n")[0],
+        epilog="exit 0 = clean vs baseline, 1 = new findings, "
+               "2 = unreadable input")
+    ap.add_argument("--root", default=None,
+                    help="repo root to analyze (default: this repo)")
+    ap.add_argument("--checker", action="append", dest="checkers",
+                    choices=sorted(CHECKERS), default=None,
+                    help="run only this family (repeatable)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: "
+                         "tools/analyze/baseline.json under --root)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="grandfather current findings into the "
+                         "baseline (new entries get a TODO "
+                         "justification)")
+    args = ap.parse_args(argv)
+    try:
+        report = run(args.root, args.checkers, args.baseline)
+    except (OSError, SyntaxError, ValueError) as e:
+        print(f"analyze: cannot analyze: {e}", file=sys.stderr)
+        return 2
+    if args.write_baseline:
+        path = args.baseline or default_baseline_path(args.root)
+        baseline = Baseline.load(path)
+        for k in report["stale_baseline"]:
+            baseline.entries.pop(k, None)
+        for f in report["findings"]:
+            baseline.entries.setdefault(f["key"], _TODO)
+        baseline.save(path)
+        print(f"analyze: baseline written to {path} "
+              f"({len(baseline.entries)} entries)")
+        return 0
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(_render(report))
+    return 0 if report["ok"] else 1

@@ -1,0 +1,421 @@
+"""Contract-drift gates (C-family): cross-artifact consistency.
+
+The repo's conventions live in four places at once — code, docs, CLI,
+and the stdlib tools that read the artifacts the code writes. Each
+gate below holds one n-way correspondence together:
+
+* **C001/C002 knobs** — every ``TFIDF_TPU_*`` env var referenced in
+  code has a ``docs/CONFIG.md`` table row, and every row names a var
+  the code still reads (the stale-doc direction).
+* **C003 ServeConfig.from_env** — every ``(field, env)`` pair in the
+  resolver names a real ``ServeConfig`` dataclass field.
+* **C004 CLI mirrors** — every env knob declared CLI-mirrored in
+  ``vocab.ENV_CLI_FLAGS`` has its flag as an ``add_argument`` literal
+  in ``tfidf_tpu/cli.py``.
+* **C005/C006/C007 spans** — every literal span label emitted through
+  ``obs.span``/``device_span``/``begin``/``instant`` is declared in
+  ``vocab.SPANS``/``INSTANTS``; every span name the trace tools
+  consume (``tools/doctor.py`` ``_MAIN_SPANS``/``_WORKER_SPANS``) is
+  actually emitted somewhere; a *dynamic* span label is flagged so it
+  is either justified in the baseline or made literal.
+* **C008 outcomes** — every literal ``outcome=`` label ends up in
+  ``tools/trace_check.py``'s ``_OUTCOMES`` vocabulary (or the
+  queued-span extras).
+* **C009/C010 fault seams** — every seam declared in
+  ``tfidf_tpu/faults.py`` ``SEAMS`` is consulted by a real
+  ``faults.fire(...)`` call site, and no call site names an
+  undeclared seam.
+* **C011 metrics** — every literal registry metric name is mentioned
+  in ``docs/OBSERVABILITY.md`` (dynamic families match by declared
+  prefix).
+* **C012/C013 flight events** — every literal ``log_event`` kind is
+  declared in ``vocab.FLIGHT_EVENTS``, and every kind the doctor /
+  trace_check consume is emitted by some call site.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set, Tuple
+
+from . import vocab
+from .core import (Finding, Tree, call_name, const_str, kwarg,
+                   str_consts_in)
+
+_ENV_RE = re.compile(r"TFIDF_TPU_[A-Z0-9_]+")
+_DOC_ROW_RE = re.compile(r"^\|\s*`(TFIDF_TPU_[A-Z0-9_]+)`\s*\|",
+                         re.MULTILINE)
+
+# implementation modules whose internal plumbing would self-match
+_SPAN_IMPL = ("tfidf_tpu/obs/tracer.py", "tfidf_tpu/obs/__init__.py")
+_LOG_IMPL = ("tfidf_tpu/obs/log.py",)
+_METRIC_IMPL = ("tfidf_tpu/obs/registry.py", "tfidf_tpu/obs/__init__.py")
+
+
+def _norm(rel: str) -> str:
+    return rel.replace("\\", "/")
+
+
+# --- knobs -----------------------------------------------------------
+
+def _code_env_refs(tree: Tree) -> Dict[str, Tuple[str, int]]:
+    """env var -> (first file, line) across the contract scope."""
+    refs: Dict[str, Tuple[str, int]] = {}
+    for rel in tree.contract_files():
+        for i, line in enumerate(tree.text(rel).splitlines(), 1):
+            for m in _ENV_RE.finditer(line):
+                refs.setdefault(m.group(0), (rel, i))
+    return refs
+
+
+def check_knobs(tree: Tree) -> List[Finding]:
+    findings: List[Finding] = []
+    if not tree.exists("docs/CONFIG.md"):
+        return [Finding("C001", "docs/CONFIG.md", 1, "CONFIG.md",
+                        "docs/CONFIG.md is missing — the knob table "
+                        "is the contract surface")]
+    doc_text = tree.text("docs/CONFIG.md")
+    rows = {m.group(1) for m in _DOC_ROW_RE.finditer(doc_text)}
+    refs = _code_env_refs(tree)
+    for var, (rel, line) in sorted(refs.items()):
+        if var not in rows:
+            findings.append(Finding(
+                "C001", rel, line, var,
+                f"env knob {var} is read in code but has no "
+                f"docs/CONFIG.md table row"))
+    row_lines = {m.group(1): doc_text[:m.start()].count("\n") + 1
+                 for m in _DOC_ROW_RE.finditer(doc_text)}
+    for var in sorted(rows - set(refs)):
+        findings.append(Finding(
+            "C002", "docs/CONFIG.md", row_lines[var], var,
+            f"docs/CONFIG.md documents {var} but no code reads it "
+            f"(stale row, or the reader was renamed)"))
+    return findings
+
+
+def check_serve_config(tree: Tree) -> List[Finding]:
+    rel = "tfidf_tpu/config.py"
+    if not tree.exists(rel):
+        return []
+    mod = tree.tree(rel)
+    findings: List[Finding] = []
+    for cls in ast.walk(mod):
+        if not (isinstance(cls, ast.ClassDef)
+                and cls.name == "ServeConfig"):
+            continue
+        fields = {s.target.id for s in cls.body
+                  if isinstance(s, ast.AnnAssign)
+                  and isinstance(s.target, ast.Name)}
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Tuple) \
+                    or len(node.elts) not in (2, 3):
+                continue
+            field = const_str(node.elts[0])
+            env = const_str(node.elts[1])
+            if field is None or env is None \
+                    or not env.startswith("TFIDF_TPU_"):
+                continue
+            if field not in fields:
+                findings.append(Finding(
+                    "C003", rel, node.lineno, field,
+                    f"ServeConfig.from_env maps {env} onto "
+                    f"'{field}', which is not a ServeConfig field"))
+    return findings
+
+
+def check_cli_flags(tree: Tree) -> List[Finding]:
+    rel = "tfidf_tpu/cli.py"
+    if not tree.exists(rel):
+        return []
+    flags: Set[str] = set()
+    for node in ast.walk(tree.tree(rel)):
+        if isinstance(node, ast.Call) \
+                and call_name(node).endswith("add_argument"):
+            for a in node.args:
+                s = const_str(a)
+                if s and s.startswith("--"):
+                    flags.add(s)
+    findings: List[Finding] = []
+    for env, flag in sorted(vocab.ENV_CLI_FLAGS.items()):
+        if flag not in flags:
+            findings.append(Finding(
+                "C004", rel, 1, env,
+                f"{env} is declared CLI-mirrored as '{flag}' "
+                f"(vocab.ENV_CLI_FLAGS) but cli.py defines no such "
+                f"flag"))
+    return findings
+
+
+# --- spans -----------------------------------------------------------
+
+def _emitted_spans(tree: Tree) -> Tuple[Dict[str, Tuple[str, int]],
+                                        Dict[str, Tuple[str, int]],
+                                        List[Finding]]:
+    """-> (span name -> first site, instant name -> first site,
+    dynamic-label findings)."""
+    spans: Dict[str, Tuple[str, int]] = {}
+    instants: Dict[str, Tuple[str, int]] = {}
+    dynamic: List[Finding] = []
+    for rel in tree.product_files():
+        if _norm(rel) in _SPAN_IMPL:
+            continue
+        for node in ast.walk(tree.tree(rel)):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            last = name.rsplit(".", 1)[-1]
+            if last in ("span", "device_span", "begin"):
+                if not name.startswith("obs") and "." in name:
+                    # foo.span()/x.begin() on a non-obs object (e.g.
+                    # a Future/Condition API) is not the tracer
+                    if not name.startswith(("obs.", "self.obs")):
+                        continue
+                if not node.args:
+                    continue
+                label = const_str(node.args[0])
+                if label is None:
+                    dynamic.append(Finding(
+                        "C007", rel, node.lineno,
+                        f"dynamic:{name}",
+                        f"span label passed to {name}() is not a "
+                        f"string literal — the trace tools cannot "
+                        f"know this name"))
+                else:
+                    spans.setdefault(label, (rel, node.lineno))
+            elif last == "instant" and node.args \
+                    and name.startswith("obs"):
+                label = const_str(node.args[0])
+                if label is not None:
+                    instants.setdefault(label, (rel, node.lineno))
+    return spans, instants, dynamic
+
+
+def _doctor_consumed_spans(tree: Tree) -> Set[str]:
+    rel = "tools/doctor.py"
+    if not tree.exists(rel):
+        return set()
+    out: Set[str] = set()
+    for node in ast.walk(tree.tree(rel)):
+        if isinstance(node, ast.Assign) \
+                and any(isinstance(t, ast.Name)
+                        and t.id in ("_MAIN_SPANS", "_WORKER_SPANS")
+                        for t in node.targets):
+            out.update(s for s in str_consts_in(node.value))
+    return out
+
+
+def check_spans(tree: Tree) -> List[Finding]:
+    spans, instants, findings = _emitted_spans(tree)
+    for label, (rel, line) in sorted(spans.items()):
+        if label not in vocab.SPANS:
+            findings.append(Finding(
+                "C005", rel, line, label,
+                f"span '{label}' is emitted but not declared in "
+                f"tools/analyze/vocab.py SPANS — the trace tools "
+                f"don't know it"))
+    for label, (rel, line) in sorted(instants.items()):
+        if label not in vocab.INSTANTS:
+            findings.append(Finding(
+                "C005", rel, line, label,
+                f"trace instant '{label}' is emitted but not declared "
+                f"in tools/analyze/vocab.py INSTANTS"))
+    for label in sorted(_doctor_consumed_spans(tree)):
+        if label not in spans:
+            findings.append(Finding(
+                "C006", "tools/doctor.py", 1, label,
+                f"tools/doctor.py attributes the span '{label}' but "
+                f"no code emits it (renamed emission site?)"))
+    return findings
+
+
+# --- outcomes --------------------------------------------------------
+
+def _trace_check_outcomes(tree: Tree) -> Set[str]:
+    rel = "tools/trace_check.py"
+    if not tree.exists(rel):
+        return set()
+    for node in ast.walk(tree.tree(rel)):
+        if isinstance(node, ast.Assign) \
+                and any(isinstance(t, ast.Name) and t.id == "_OUTCOMES"
+                        for t in node.targets):
+            return set(str_consts_in(node.value))
+    return set()
+
+
+def check_outcomes(tree: Tree) -> List[Finding]:
+    known = _trace_check_outcomes(tree) | vocab.QUEUED_OUTCOMES
+    if not known:
+        return [Finding("C008", "tools/trace_check.py", 1, "_OUTCOMES",
+                        "tools/trace_check.py no longer declares the "
+                        "_OUTCOMES vocabulary")]
+    findings: List[Finding] = []
+    for rel in tree.product_files():
+        if _norm(rel) in _SPAN_IMPL:
+            continue
+        for node in ast.walk(tree.tree(rel)):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name.rsplit(".", 1)[-1] not in ("end", "span", "begin"):
+                continue
+            if not name.startswith("obs"):
+                continue
+            val = kwarg(node, "outcome")
+            label = const_str(val) if val is not None else None
+            if label is not None and label not in known:
+                findings.append(Finding(
+                    "C008", rel, node.lineno, label,
+                    f"span outcome '{label}' is emitted but "
+                    f"tools/trace_check.py's _OUTCOMES vocabulary "
+                    f"does not know it"))
+    return findings
+
+
+# --- fault seams -----------------------------------------------------
+
+def _declared_seams(tree: Tree) -> Set[str]:
+    rel = "tfidf_tpu/faults.py"
+    if not tree.exists(rel):
+        return set()
+    for node in ast.walk(tree.tree(rel)):
+        if isinstance(node, ast.Assign) \
+                and any(isinstance(t, ast.Name) and t.id == "SEAMS"
+                        for t in node.targets):
+            return set(str_consts_in(node.value))
+    return set()
+
+
+def _seam_literals(node: ast.expr) -> List[str]:
+    """Seam names a ``fire()`` first argument can evaluate to: a
+    literal, or either branch of a conditional expression. A string
+    inside an IfExp's *test* is never the seam itself."""
+    s = const_str(node)
+    if s is not None:
+        return [s]
+    if isinstance(node, ast.IfExp):
+        return _seam_literals(node.body) + _seam_literals(node.orelse)
+    return []
+
+
+def check_seams(tree: Tree) -> List[Finding]:
+    declared = _declared_seams(tree)
+    consulted: Dict[str, Tuple[str, int]] = {}
+    findings: List[Finding] = []
+    for rel in tree.product_files():
+        if _norm(rel) == "tfidf_tpu/faults.py":
+            continue
+        for node in ast.walk(tree.tree(rel)):
+            if not (isinstance(node, ast.Call)
+                    and call_name(node) == "faults.fire"
+                    and node.args):
+                continue
+            names = _seam_literals(node.args[0])
+            if not names:
+                findings.append(Finding(
+                    "C010", rel, node.lineno, f"dynamic:{rel}",
+                    "faults.fire() with a fully dynamic seam name — "
+                    "the seam gate cannot prove it is declared"))
+            for seam in names:
+                consulted.setdefault(seam, (rel, node.lineno))
+                if seam not in declared:
+                    findings.append(Finding(
+                        "C010", rel, node.lineno, seam,
+                        f"faults.fire('{seam}') names a seam not "
+                        f"declared in faults.SEAMS"))
+    for seam in sorted(declared - set(consulted)):
+        findings.append(Finding(
+            "C009", "tfidf_tpu/faults.py", 1, seam,
+            f"fault seam '{seam}' is declared in faults.SEAMS but no "
+            f"hot path consults it — chaos plans naming it silently "
+            f"never fire"))
+    return findings
+
+
+# --- metrics ---------------------------------------------------------
+
+def check_metrics(tree: Tree) -> List[Finding]:
+    if not tree.exists("docs/OBSERVABILITY.md"):
+        return []
+    doc = tree.text("docs/OBSERVABILITY.md")
+    findings: List[Finding] = []
+    for rel in tree.product_files():
+        if _norm(rel) in _METRIC_IMPL:
+            continue
+        for node in ast.walk(tree.tree(rel)):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name.rsplit(".", 1)[-1] not in ("counter", "gauge",
+                                               "histogram"):
+                continue
+            if not node.args:
+                continue
+            metric = const_str(node.args[0])
+            if metric is None:
+                continue
+            documented = metric in doc or any(
+                metric.startswith(p) and p in doc
+                for p in vocab.METRIC_DYNAMIC_PREFIXES)
+            if not documented:
+                findings.append(Finding(
+                    "C011", rel, node.lineno, metric,
+                    f"registry metric '{metric}' is not mentioned in "
+                    f"docs/OBSERVABILITY.md"))
+    return findings
+
+
+# --- flight events ---------------------------------------------------
+
+def check_flight_events(tree: Tree) -> List[Finding]:
+    emitted: Dict[str, Tuple[str, int]] = {}
+    findings: List[Finding] = []
+    # contract scope, not just product scope: bench.py and the tools
+    # ride the same flight ring as the library
+    for rel in tree.contract_files():
+        if _norm(rel) in _LOG_IMPL:
+            continue
+        for node in ast.walk(tree.tree(rel)):
+            if not (isinstance(node, ast.Call)
+                    and call_name(node).rsplit(".", 1)[-1]
+                    == "log_event"
+                    and len(node.args) >= 2):
+                continue
+            kind = const_str(node.args[1])
+            if kind is None:
+                continue
+            emitted.setdefault(kind, (rel, node.lineno))
+            if kind not in vocab.FLIGHT_EVENTS:
+                findings.append(Finding(
+                    "C012", rel, node.lineno, kind,
+                    f"flight event '{kind}' is emitted but not "
+                    f"declared in tools/analyze/vocab.py "
+                    f"FLIGHT_EVENTS"))
+    consumed: Set[str] = set()
+    for rel in ("tools/doctor.py", "tools/trace_check.py"):
+        if not tree.exists(rel):
+            continue
+        for node in ast.walk(tree.tree(rel)):
+            if isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str) \
+                    and node.value in vocab.FLIGHT_EVENTS:
+                consumed.add(node.value)
+    for kind in sorted(consumed - set(emitted)):
+        findings.append(Finding(
+            "C013", "tools/doctor.py", 1, kind,
+            f"the flight event '{kind}' is consumed by the trace "
+            f"tools but no code emits it (renamed emission site?)"))
+    return findings
+
+
+def check(tree: Tree) -> List[Finding]:
+    findings: List[Finding] = []
+    findings += check_knobs(tree)
+    findings += check_serve_config(tree)
+    findings += check_cli_flags(tree)
+    findings += check_spans(tree)
+    findings += check_outcomes(tree)
+    findings += check_seams(tree)
+    findings += check_metrics(tree)
+    findings += check_flight_events(tree)
+    return findings

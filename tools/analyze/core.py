@@ -1,0 +1,209 @@
+"""Shared machinery of the static-analysis suite: findings, the
+baseline protocol, and the repo file walk.
+
+Every checker returns :class:`Finding` records keyed on *stable*
+identity (checker code + file + symbol — never a line number), so a
+baseline entry survives unrelated edits to the file above it. The
+baseline file (``tools/analyze/baseline.json``) grandfathers findings
+for incremental adoption: a finding whose key appears there is
+reported as suppressed and does not fail the run; every entry must
+carry a one-line justification (the review surface for "why is this
+allowed to stay").
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+#: Directories (relative to the repo root) whose Python files the
+#: code-facing checkers walk. Tests are deliberately out of scope:
+#: they monkeypatch, fake workers and plant hazards on purpose.
+PRODUCT_DIRS = ("tfidf_tpu",)
+#: Additional scope for the contract gates (knob references, tool
+#: vocabularies). tools/analyze itself is excluded — its vocabulary
+#: files *name* every knob and span and would self-match everything.
+CONTRACT_DIRS = ("tfidf_tpu", "tools")
+CONTRACT_FILES = ("bench.py",)
+EXCLUDE_DIRS = (os.path.join("tools", "analyze"), ".git",
+                "__pycache__", ".pytest_cache")
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One violated invariant.
+
+    Attributes:
+      code: checker id (``J001`` .. ``C0xx``), grouping for humans.
+      path: repo-relative file the finding anchors to.
+      line: 1-based line (display only — NOT part of the identity).
+      symbol: the stable subject (env var, span name, ``Class.attr``,
+        function name) the finding is about.
+      message: one human sentence.
+    """
+
+    code: str
+    path: str
+    line: int
+    symbol: str
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.code}:{self.path}:{self.symbol}"
+
+    def render(self) -> str:
+        return (f"{self.code} {self.path}:{self.line} [{self.symbol}] "
+                f"{self.message}")
+
+    def to_json(self) -> dict:
+        return {"code": self.code, "path": self.path, "line": self.line,
+                "symbol": self.symbol, "message": self.message,
+                "key": self.key}
+
+
+class Baseline:
+    """The grandfather file: ``{"version": 1, "entries": [{"key": ...,
+    "justification": ...}]}``. Unknown keys in the file are *stale*
+    (the finding they suppressed no longer fires) and are reported so
+    the file shrinks over time instead of rotting."""
+
+    def __init__(self, entries: Dict[str, str]):
+        self.entries = entries
+
+    @staticmethod
+    def load(path: Optional[str]) -> "Baseline":
+        if not path or not os.path.exists(path):
+            return Baseline({})
+        with open(path) as f:
+            data = json.load(f)
+        if data.get("version") != 1:
+            raise ValueError(
+                f"baseline {path}: unknown version {data.get('version')!r}")
+        entries = {}
+        for e in data.get("entries", []):
+            if not e.get("key") or not e.get("justification"):
+                raise ValueError(
+                    f"baseline {path}: entry missing key/justification: "
+                    f"{e!r}")
+            entries[e["key"]] = e["justification"]
+        return Baseline(entries)
+
+    def save(self, path: str) -> None:
+        data = {"version": 1, "entries": [
+            {"key": k, "justification": v}
+            for k, v in sorted(self.entries.items())]}
+        with open(path, "w") as f:
+            json.dump(data, f, indent=2)
+            f.write("\n")
+
+    def split(self, findings: Iterable[Finding]
+              ) -> Tuple[List[Finding], List[Finding], List[str]]:
+        """-> (new, suppressed, stale_keys)."""
+        new, suppressed = [], []
+        seen = set()
+        for f in findings:
+            seen.add(f.key)
+            (suppressed if f.key in self.entries else new).append(f)
+        stale = sorted(k for k in self.entries if k not in seen)
+        return new, suppressed, stale
+
+
+class Tree:
+    """One analysis run's view of the repo: file lists + a parse cache
+    (every checker shares one ``ast.parse`` per file)."""
+
+    def __init__(self, root: Optional[str] = None):
+        self.root = os.path.abspath(root or repo_root())
+        self._asts: Dict[str, ast.Module] = {}
+        self._texts: Dict[str, str] = {}
+
+    def _walk(self, dirs: Iterable[str], files: Iterable[str] = ()
+              ) -> List[str]:
+        out = []
+        for d in dirs:
+            top = os.path.join(self.root, d)
+            for dirpath, dirnames, filenames in os.walk(top):
+                rel_dir = os.path.relpath(dirpath, self.root)
+                if any(rel_dir == e or rel_dir.startswith(e + os.sep)
+                       for e in EXCLUDE_DIRS):
+                    dirnames[:] = []
+                    continue
+                dirnames[:] = [n for n in dirnames
+                               if n not in ("__pycache__", ".git")]
+                for name in sorted(filenames):
+                    if name.endswith(".py"):
+                        out.append(os.path.normpath(
+                            os.path.join(rel_dir, name)))
+        for f in files:
+            if os.path.exists(os.path.join(self.root, f)):
+                out.append(f)
+        return sorted(set(out))
+
+    def product_files(self) -> List[str]:
+        return self._walk(PRODUCT_DIRS)
+
+    def contract_files(self) -> List[str]:
+        return self._walk(CONTRACT_DIRS, CONTRACT_FILES)
+
+    def text(self, rel: str) -> str:
+        if rel not in self._texts:
+            with open(os.path.join(self.root, rel),
+                      encoding="utf-8") as f:
+                self._texts[rel] = f.read()
+        return self._texts[rel]
+
+    def tree(self, rel: str) -> ast.Module:
+        if rel not in self._asts:
+            self._asts[rel] = ast.parse(self.text(rel), filename=rel)
+        return self._asts[rel]
+
+    def exists(self, rel: str) -> bool:
+        return os.path.exists(os.path.join(self.root, rel))
+
+
+# --- small AST helpers shared by the checkers ------------------------
+
+def call_name(call: ast.Call) -> str:
+    """Dotted name of a call target: ``obs.span`` / ``span`` / ``''``
+    for anything not a plain name/attribute chain."""
+    parts: List[str] = []
+    node = call.func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif parts:
+        # a call like `foo().bar(...)`: keep the attribute tail so the
+        # last component is still matchable
+        parts.append("")
+    return ".".join(reversed(parts))
+
+
+def const_str(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def str_consts_in(node) -> List[str]:
+    """Every string literal anywhere inside ``node`` — how the seam
+    gate reads ``fire("a" if cond else "b")``."""
+    return [n.value for n in ast.walk(node)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)]
+
+
+def kwarg(call: ast.Call, name: str):
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None

@@ -1,0 +1,309 @@
+"""JAX hazard lints (J-family).
+
+Three bug classes nine rounds of dispatch machinery made possible and
+nothing checked statically:
+
+* **J001 use-after-donate** — a buffer named in ``donate_argnums`` /
+  ``donate_argnames`` of a jitted callable is read again after the
+  call. XLA may already have aliased its memory into the output; on
+  CPU the read *works*, on a real device it is garbage or a crash —
+  exactly the class of silent platform-dependent drift this repo
+  cannot afford (every donated wire buffer rides the ingest hot path).
+* **J002 host sync inside a device-hot span** — ``np.asarray`` /
+  ``.item()`` / ``float()`` on a device value between the enter/exit
+  of a span that claims to cover in-flight device work
+  (``vocab.DEVICE_HOT_SPANS``). The sync silently serializes the
+  overlap the span exists to prove, and the trace then *lies*.
+* **J003 Python control flow on a traced value** — ``if``/``while``
+  on a non-static parameter inside a ``@jit`` body. This raises
+  ``TracerBoolConversionError`` at trace time, but only on the first
+  call of that code path — a rarely-taken branch ships broken.
+
+All heuristics are intra-module and line-ordered: a use *textually*
+before the donating call but executed after it (loop carry) is out of
+scope — docs/ANALYSIS.md spells out the envelope.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import vocab
+from .core import Finding, Tree, call_name, const_str, kwarg
+
+_SYNC_CALLS = {"np.asarray", "numpy.asarray", "np.array", "numpy.array",
+               "jax.device_get"}
+
+
+# --- decorator / binding classification ------------------------------
+
+def _jit_call_info(call: ast.Call) -> Optional[dict]:
+    """``jax.jit(...)`` or ``functools.partial(jax.jit, ...)`` ->
+    {static_names, static_nums, donate_nums, donate_names} or None."""
+    name = call_name(call)
+    inner = None
+    if name.endswith("jit"):
+        inner = call
+    elif name.endswith("partial") and call.args:
+        first = call.args[0]
+        if (isinstance(first, (ast.Name, ast.Attribute))
+                and call_name(ast.Call(func=first, args=[],
+                                       keywords=[])).endswith("jit")):
+            inner = call
+    if inner is None:
+        return None
+    info = {"static_names": set(), "static_nums": set(),
+            "donate_nums": set(), "donate_names": set()}
+    for key, out, want in (("static_argnames", "static_names", str),
+                           ("donate_argnames", "donate_names", str),
+                           ("static_argnums", "static_nums", int),
+                           ("donate_argnums", "donate_nums", int)):
+        val = kwarg(call, key)
+        if val is None:
+            continue
+        elts = val.elts if isinstance(val, (ast.Tuple, ast.List)) \
+            else [val]
+        for e in elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, want):
+                info[out].add(e.value)
+    return info
+
+
+def _decorated_jit(fn: ast.FunctionDef) -> Optional[dict]:
+    for dec in fn.decorator_list:
+        if isinstance(dec, ast.Call):
+            info = _jit_call_info(dec)
+            if info is not None:
+                return info
+        elif isinstance(dec, (ast.Name, ast.Attribute)):
+            if call_name(ast.Call(func=dec, args=[],
+                                  keywords=[])).endswith("jit"):
+                return {"static_names": set(), "static_nums": set(),
+                        "donate_nums": set(), "donate_names": set()}
+    return None
+
+
+def _donating_callables(mod: ast.Module) -> Dict[str, dict]:
+    """Module-level names bound to a donating jitted callable: both
+    ``@partial(jax.jit, donate_argnums=...)`` defs and
+    ``name = jax.jit(f, donate_argnums=...)`` assignments."""
+    out: Dict[str, dict] = {}
+    for node in ast.walk(mod):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info = _decorated_jit(node)
+            if info and (info["donate_nums"] or info["donate_names"]):
+                out[node.name] = info
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call):
+            info = _jit_call_info(node.value)
+            if info and (info["donate_nums"] or info["donate_names"]):
+                out[node.targets[0].id] = info
+    return out
+
+
+# --- J001 ------------------------------------------------------------
+
+def _scope_walk(fn):
+    """Walk a function body WITHOUT descending into nested function /
+    lambda scopes — a closure's parameters shadow the outer names, so
+    its loads are not uses of the outer binding."""
+    work = list(ast.iter_child_nodes(fn))
+    while work:
+        node = work.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        work.extend(ast.iter_child_nodes(node))
+
+
+def _check_use_after_donate(rel: str, mod: ast.Module
+                            ) -> List[Finding]:
+    donors = _donating_callables(mod)
+    if not donors:
+        return []
+    findings: List[Finding] = []
+    funcs = [n for n in ast.walk(mod)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    for fn in funcs:
+        calls: List[Tuple[int, str, str]] = []  # (line, var, callee)
+        loads: Dict[str, List[int]] = {}
+        stores: Dict[str, List[int]] = {}
+        # donating calls whose value immediately leaves the function
+        # (`return f(buf, ...)`) end the scope — nothing after them
+        # runs, so they open no hazard window
+        returned_calls = {
+            id(c) for n in _scope_walk(fn)
+            if isinstance(n, ast.Return) and n.value is not None
+            for c in ast.walk(n.value) if isinstance(c, ast.Call)}
+        for node in _scope_walk(fn):
+            if isinstance(node, ast.Name):
+                book = loads if isinstance(node.ctx, ast.Load) else stores
+                book.setdefault(node.id, []).append(node.lineno)
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in donors
+                    and id(node) not in returned_calls):
+                continue
+            info = donors[node.func.id]
+            donated: List[str] = []
+            for idx in info["donate_nums"]:
+                if idx < len(node.args) \
+                        and isinstance(node.args[idx], ast.Name):
+                    donated.append(node.args[idx].id)
+            for kw in node.keywords:
+                if kw.arg in info["donate_names"] \
+                        and isinstance(kw.value, ast.Name):
+                    donated.append(kw.value.id)
+            for var in donated:
+                calls.append((node.lineno, var, node.func.id))
+        for line, var, callee in calls:
+            # the first rebind at/after the call line ends the hazard
+            # window (a store on the call line is the result binding
+            # `buf = f(buf, ...)` itself)
+            rebinds = [ln for ln in stores.get(var, []) if ln >= line]
+            horizon = min(rebinds) if rebinds else None
+            for use in loads.get(var, []):
+                if use > line and (horizon is None or use < horizon):
+                    findings.append(Finding(
+                        "J001", rel, use, f"{fn.name}:{var}",
+                        f"'{var}' is read after being donated to "
+                        f"{callee}() at line {line} — XLA may have "
+                        f"aliased its buffer into the output"))
+                    break
+    return findings
+
+
+# --- J002 ------------------------------------------------------------
+
+def _span_name_of(call: ast.Call) -> Optional[str]:
+    name = call_name(call)
+    if not (name.endswith("span") or name.endswith("device_span")):
+        return None
+    if not call.args:
+        return None
+    return const_str(call.args[0])
+
+
+def _sync_calls_in(body: List[ast.stmt]) -> List[Tuple[int, str]]:
+    hits: List[Tuple[int, str]] = []
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name in _SYNC_CALLS:
+                hits.append((node.lineno, name))
+            elif name.endswith(".item") and not node.args:
+                hits.append((node.lineno, ".item()"))
+            elif isinstance(node.func, ast.Name) \
+                    and node.func.id == "float" and node.args \
+                    and not isinstance(node.args[0], ast.Constant):
+                hits.append((node.lineno, "float()"))
+    return hits
+
+
+def _check_host_sync_in_span(rel: str, mod: ast.Module) -> List[Finding]:
+    findings: List[Finding] = []
+    for fn in ast.walk(mod):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.With):
+                continue
+            for item in node.items:
+                if not isinstance(item.context_expr, ast.Call):
+                    continue
+                span = _span_name_of(item.context_expr)
+                if span is None or span not in vocab.DEVICE_HOT_SPANS:
+                    continue
+                for line, what in _sync_calls_in(node.body):
+                    findings.append(Finding(
+                        "J002", rel, line,
+                        f"{fn.name}:{span}:{what}",
+                        f"{what} inside the device-hot span "
+                        f"'{span}' forces a host sync — the overlap "
+                        f"the span claims is silently serialized"))
+    return findings
+
+
+# --- J003 ------------------------------------------------------------
+
+def _traced_params(fn: ast.FunctionDef, info: dict) -> Set[str]:
+    names = [a.arg for a in fn.args.args]
+    traced = set()
+    for i, n in enumerate(names):
+        if n == "self":
+            continue
+        if n in info["static_names"] or i in info["static_nums"]:
+            continue
+        traced.add(n)
+    return traced
+
+
+_SHAPE_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "nbytes"}
+
+
+def _suspect_names(test: ast.expr, traced: Set[str]) -> List[str]:
+    """Traced parameter names the branch condition genuinely depends
+    on — attribute reads of static metadata (``x.shape``...) and
+    ``is None`` identity tests are trace-safe and excluded."""
+    if isinstance(test, ast.Compare) \
+            and all(isinstance(op, (ast.Is, ast.IsNot))
+                    for op in test.ops):
+        return []
+    shape_bases = {n.value.id for n in ast.walk(test)
+                   if isinstance(n, ast.Attribute)
+                   and n.attr in _SHAPE_ATTRS
+                   and isinstance(n.value, ast.Name)}
+    call_fn_names = set()
+    for n in ast.walk(test):
+        if isinstance(n, ast.Call):
+            cn = call_name(n)
+            if cn in ("isinstance", "len", "hasattr", "getattr"):
+                for sub in ast.walk(n):
+                    if isinstance(sub, ast.Name):
+                        call_fn_names.add(sub.id)
+    return sorted({n.id for n in ast.walk(test)
+                   if isinstance(n, ast.Name)
+                   and isinstance(n.ctx, ast.Load)
+                   and n.id in traced
+                   and n.id not in shape_bases
+                   and n.id not in call_fn_names})
+
+
+def _check_traced_control_flow(rel: str, mod: ast.Module
+                               ) -> List[Finding]:
+    findings: List[Finding] = []
+    for fn in ast.walk(mod):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        info = _decorated_jit(fn)
+        if info is None:
+            continue
+        traced = _traced_params(fn, info)
+        if not traced:
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            for name in _suspect_names(node.test, traced):
+                findings.append(Finding(
+                    "J003", rel, node.lineno, f"{fn.name}:{name}",
+                    f"Python {'if' if isinstance(node, ast.If) else 'while'}"
+                    f" on traced parameter '{name}' inside the @jit "
+                    f"body of {fn.name}() — TracerBoolConversionError "
+                    f"on the first call that reaches it"))
+    return findings
+
+
+def check(tree: Tree) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel in tree.product_files():
+        mod = tree.tree(rel)
+        findings += _check_use_after_donate(rel, mod)
+        findings += _check_host_sync_in_span(rel, mod)
+        findings += _check_traced_control_flow(rel, mod)
+    return findings

@@ -1,0 +1,319 @@
+"""Thread-discipline lint (T001): unlocked cross-thread attribute
+writes.
+
+Nine rounds built a small thread zoo — the ingest ``_PackAhead`` /
+``_DrainAhead`` jobs, the serve batcher loop, the health watchdog, the
+canary prober, the device monitor, supervisor restarts — and the
+convention holding it together is "shared ``self.*`` state is written
+under the object's lock/condition". Nothing checked that. This lint
+rebuilds the thread-entry graph per class and flags every attribute
+*mutated* from two or more entry domains where at least one mutation
+site holds no lock.
+
+Model (intra-class, heuristic — the envelope is in docs/ANALYSIS.md):
+
+* **Thread roots** — methods passed as ``threading.Thread(target=...)``
+  and local functions handed to an executor's ``.submit(...)`` (the
+  worker-job idiom of ``ingest.py``). Each root opens one *thread
+  domain* containing every method reachable from it through
+  ``self.m()`` calls.
+* **Main domain** — every public method (and every dunder except
+  ``__init__``) that is not itself a thread root, plus its reachable
+  helpers. ``__init__`` is excluded entirely: writes before
+  ``Thread.start()`` are ordered by the start's happens-before edge.
+* **Locked** — a write lexically inside ``with self.<lockish>:`` (attr
+  name matching lock/cond/mutex/mu), inside a method that calls
+  ``self.<lockish>.acquire()``, or inside a *private* method whose
+  every intra-class call site is itself lock-held (the
+  ``_pop_batch``-under-``_take_batch`` idiom).
+* **Mutation** — ``self.x = ...``, ``self.x op= ...``, and subscript
+  stores ``self.x[i] = ...``. Container *method* calls (``.append``)
+  are deliberately out of scope: too noisy, and the bounded deques in
+  this codebase pair them with condition waits.
+
+``vocab.THREAD_ALLOWLIST`` seeds the intentional exceptions (the
+lock-free rings in ``obs/tracer.py`` / ``obs/log.py``), each with its
+justification next to it.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import vocab
+from .core import Finding, Tree, call_name
+
+_LOCKISH = re.compile(r"(lock|cond|mutex|mu)$|^(lock|cond|mutex)",
+                      re.IGNORECASE)
+
+
+def _is_lockish_attr(node: ast.expr) -> bool:
+    """``self._lock`` / ``self._cond`` / ``self._lock.acquire`` ..."""
+    if isinstance(node, ast.Call):
+        node = node.func
+    if isinstance(node, ast.Attribute) and isinstance(node.value,
+                                                     ast.Attribute):
+        # self._lock.acquire -> look at the middle attribute
+        if _LOCKISH.search(node.attr) or _is_lockish_attr(node.value):
+            return True
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return bool(_LOCKISH.search(node.attr))
+    return False
+
+
+class _Site:
+    __slots__ = ("attr", "line", "locked", "owner")
+
+    def __init__(self, attr: str, line: int, locked: bool, owner: str):
+        self.attr = attr
+        self.line = line
+        self.locked = locked
+        self.owner = owner          # (virtual) method name
+
+
+class _Method:
+    """One method body, or one nested worker function promoted to a
+    virtual method (``method.inner``)."""
+
+    def __init__(self, name: str, node: ast.AST):
+        self.name = name
+        self.node = node
+        self.writes: List[_Site] = []
+        self.calls: Set[str] = set()          # self.m() targets
+        self.local_calls: Set[str] = set()    # bare-name calls
+        self.locked_calls: Set[str] = set()   # self.m() made under lock
+        self.acquires_lock = False
+        self.thread_root = False
+
+
+def _attr_store_target(node: ast.expr) -> Optional[str]:
+    """``self.x`` or ``self.x[...]`` store target -> ``x``."""
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _scan_body(m: _Method, body: List[ast.stmt], locked: bool,
+               nested: Dict[str, ast.AST]) -> None:
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            nested[stmt.name] = stmt
+            continue
+        lock_here = locked
+        if isinstance(stmt, ast.With):
+            if any(_is_lockish_attr(item.context_expr)
+                   for item in stmt.items):
+                lock_here = True
+            _scan_stmt_exprs(m, stmt, locked)
+            _scan_body(m, stmt.body, lock_here, nested)
+            continue
+        if isinstance(stmt, (ast.If, ast.While)):
+            _scan_stmt_exprs(m, stmt, locked)
+            _scan_body(m, stmt.body, locked, nested)
+            _scan_body(m, stmt.orelse, locked, nested)
+            continue
+        if isinstance(stmt, ast.For):
+            _scan_stmt_exprs(m, stmt, locked)
+            _scan_body(m, stmt.body, locked, nested)
+            _scan_body(m, stmt.orelse, locked, nested)
+            continue
+        if isinstance(stmt, ast.Try):
+            _scan_body(m, stmt.body, locked, nested)
+            for h in stmt.handlers:
+                _scan_body(m, h.body, locked, nested)
+            _scan_body(m, stmt.orelse, locked, nested)
+            _scan_body(m, stmt.finalbody, locked, nested)
+            continue
+        _scan_stmt_exprs(m, stmt, locked)
+
+
+def _scan_stmt_exprs(m: _Method, stmt: ast.stmt, locked: bool) -> None:
+    targets: List[ast.expr] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, ast.For):
+        targets = [stmt.target]
+    for t in targets:
+        elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+        for e in elts:
+            attr = _attr_store_target(e)
+            if attr is not None:
+                m.writes.append(_Site(attr, e.lineno, locked, m.name))
+    # calls (for the graph + lock inference + acquire detection)
+    for node in ast.walk(stmt):
+        if not isinstance(node, ast.Call):
+            continue
+        if _is_lockish_attr(node) and call_name(node).endswith("acquire"):
+            m.acquires_lock = True
+        if isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == "self":
+            m.calls.add(node.func.attr)
+            if locked:
+                m.locked_calls.add(node.func.attr)
+        elif isinstance(node.func, ast.Name):
+            m.local_calls.add(node.func.id)
+
+
+def _thread_roots(methods: Dict[str, _Method]) -> Set[str]:
+    roots: Set[str] = set()
+    for m in methods.values():
+        for node in ast.walk(m.node):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            target = None
+            if name.endswith("Thread"):
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        target = kw.value
+            elif name.endswith(".submit") and node.args:
+                target = node.args[0]
+            if target is None:
+                continue
+            if isinstance(target, ast.Attribute) \
+                    and isinstance(target.value, ast.Name) \
+                    and target.value.id == "self" \
+                    and target.attr in methods:
+                roots.add(target.attr)
+            elif isinstance(target, ast.Name):
+                qual = f"{m.name.split('.')[0]}.{target.id}"
+                if qual in methods:
+                    roots.add(qual)
+    return roots
+
+
+def _build_methods(cls: ast.ClassDef) -> Dict[str, _Method]:
+    methods: Dict[str, _Method] = {}
+
+    def add(name: str, node) -> None:
+        m = _Method(name, node)
+        nested: Dict[str, ast.AST] = {}
+        _scan_body(m, node.body, locked=False, nested=nested)
+        methods[name] = m
+        for nname, nnode in nested.items():
+            add(f"{name.split('.')[0]}.{nname}", nnode)
+
+    for stmt in cls.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            add(stmt.name, stmt)
+    # resolve bare-name calls to sibling virtual methods (a nested
+    # `job` calling nested `body`), and nested closures calling self.m
+    for m in methods.values():
+        base = m.name.split(".")[0]
+        for ln in m.local_calls:
+            if f"{base}.{ln}" in methods:
+                m.calls.add(f"{base}.{ln}")
+    return methods
+
+
+def _closure(methods: Dict[str, _Method], seeds: Set[str]) -> Set[str]:
+    seen: Set[str] = set()
+    work = [s for s in seeds if s in methods]
+    while work:
+        name = work.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        for callee in methods[name].calls:
+            if callee in methods and callee not in seen:
+                work.append(callee)
+            base = name.split(".")[0]
+            if f"{base}.{callee}" in methods:
+                work.append(f"{base}.{callee}")
+    return seen
+
+
+def _always_locked_methods(methods: Dict[str, _Method],
+                           roots: Set[str]) -> Set[str]:
+    """Private helpers whose every intra-class call site is lock-held
+    (single level — the ``_pop_batch`` idiom)."""
+    callers: Dict[str, List[Tuple[str, bool]]] = {}
+    for m in methods.values():
+        for callee in m.calls:
+            callers.setdefault(callee, []).append(
+                (m.name, callee in m.locked_calls))
+    out: Set[str] = set()
+    for name, m in methods.items():
+        short = name.split(".")[-1]
+        if not short.startswith("_") or short.startswith("__"):
+            continue
+        if name in roots:
+            continue
+        sites = callers.get(short, []) + callers.get(name, [])
+        if sites and all(locked for _, locked in sites):
+            out.add(name)
+    return out
+
+
+def _allowlisted(rel: str, cls: str, attr: str) -> bool:
+    for suffix, c, a in vocab.THREAD_ALLOWLIST:
+        if rel.endswith(suffix) and c in ("*", cls) \
+                and a in ("*", attr):
+            return True
+    return False
+
+
+def _check_class(rel: str, cls: ast.ClassDef) -> List[Finding]:
+    methods = _build_methods(cls)
+    if not methods:
+        return []
+    roots = _thread_roots(methods)
+    if not roots:
+        return []                     # no worker thread, no hazard
+    always_locked = _always_locked_methods(methods, roots)
+
+    domains: Dict[str, Set[str]] = {}
+    for r in roots:
+        domains[f"thread:{r}"] = _closure(methods, {r})
+    main_entries = {
+        name for name in methods
+        if name not in roots and "." not in name
+        and (not name.startswith("_") or
+             (name.startswith("__") and name != "__init__"))}
+    domains["main"] = _closure(methods, main_entries)
+
+    # attr -> {domain}, plus the unlocked write sites for the report
+    attr_domains: Dict[str, Set[str]] = {}
+    unlocked_sites: Dict[str, List[_Site]] = {}
+    for dom, members in domains.items():
+        for mname in members:
+            m = methods[mname]
+            held = m.acquires_lock or mname in always_locked
+            for w in m.writes:
+                attr_domains.setdefault(w.attr, set()).add(dom)
+                if not (w.locked or held):
+                    unlocked_sites.setdefault(w.attr, []).append(w)
+
+    findings: List[Finding] = []
+    for attr, doms in sorted(attr_domains.items()):
+        if len(doms) < 2 or attr not in unlocked_sites:
+            continue
+        if _allowlisted(rel, cls.name, attr):
+            continue
+        site = min(unlocked_sites[attr], key=lambda s: s.line)
+        findings.append(Finding(
+            "T001", rel, site.line, f"{cls.name}.{attr}",
+            f"'self.{attr}' is written from {len(doms)} thread entry "
+            f"domains ({', '.join(sorted(doms))}) and the write in "
+            f"{site.owner}() holds no lock"))
+    return findings
+
+
+def check(tree: Tree) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel in tree.product_files():
+        for node in ast.walk(tree.tree(rel)):
+            if isinstance(node, ast.ClassDef):
+                findings += _check_class(rel, node)
+    return findings

@@ -1,0 +1,112 @@
+"""The declared vocabularies the contract gates check code against.
+
+This file is the *extension point* (docs/ANALYSIS.md): when a PR adds
+a span name, a flight event kind, a fault seam consumer, or an env
+knob with a CLI mirror, it must extend the matching set here — and the
+gate then holds every other artifact (docs row, tool vocabulary, CLI
+flag) to the same name. A rename that touches only one side fails the
+run; that is the point.
+"""
+
+from __future__ import annotations
+
+#: Every span name the tracer may emit with a literal label
+#: (``obs.span`` / ``obs.device_span`` / ``obs.begin``). The trace
+#: tooling (tools/trace_check.py, tools/doctor.py) reads exactly these
+#: names; an undeclared label means the timeline grew a lane the tools
+#: cannot attribute.
+SPANS = {
+    # ingest main lane
+    "pack_wait", "dispatch", "device_tokenize", "phase_b",
+    "fetch_wait", "fetch",
+    # ingest worker lanes
+    "pack", "slab", "drain",
+    # streaming device phases
+    "stream_update", "stream_score",
+    # serve request lifecycle
+    "request", "queued", "batched", "device", "dispatch_retry",
+}
+
+#: Trace instants (``obs.instant``) — point events, not spans.
+INSTANTS = {"worker_restart", "recompile_in_batch"}
+
+#: Spans that cover *device work in flight* (dispatch staging, jitted
+#: calls, TraceAnnotation scopes). A host materialization inside one —
+#: ``np.asarray`` / ``.item()`` / ``float()`` on a device value —
+#: silently serializes the overlap machinery the span exists to prove;
+#: the J002 lint flags it. Host-side spans (``fetch``, ``drain``,
+#: ``pack``, ``slab``, ``batched``...) sync by design and are not
+#: listed.
+DEVICE_HOT_SPANS = {
+    "dispatch", "phase_b", "device", "stream_update", "stream_score",
+    "device_tokenize",
+}
+
+#: Outcome labels legal on a ``queued`` span's end in addition to the
+#: request-outcome vocabulary trace_check enforces (a queued span that
+#: reached a batch ends ``batched``; requests never do).
+QUEUED_OUTCOMES = {"batched"}
+
+#: Every flight-recorder event kind ``obs.log.log_event`` may emit
+#: with a literal name. tools/doctor.py folds a subset into its fault
+#: section and tools/trace_check.py cross-checks ``query_quarantined``
+#: — the C013 gate proves those consumers never go dark.
+FLIGHT_EVENTS = {
+    # recovery story (round 13)
+    "dispatch_retry", "worker_restart", "breaker_trip", "breaker_close",
+    "query_quarantined", "poison_isolated", "fault_injected",
+    # device truth (round 12)
+    "hbm_watermark", "hbm_watermark_clear", "hbm_census",
+    "devmon_error", "xla_recompile", "xla_compile", "compile_warm",
+    # serving lifecycle + self-watching (round 11)
+    "index_swap", "index_snapshot", "index_restored",
+    "health_state_change", "canary_parity_failure",
+    "canary_probe_error",
+    # engine/bench diagnostics (round 11 structured-logger migration)
+    "exact_engine_fallback", "margin_pressure", "bench_progress",
+}
+
+#: ``TFIDF_TPU_*`` env knobs mirrored by a CLI flag: the C004 gate
+#: requires each flag string to appear as an ``add_argument`` literal
+#: in tfidf_tpu/cli.py. Knobs without a CLI mirror (pure env tuning)
+#: are simply absent here.
+ENV_CLI_FLAGS = {
+    "TFIDF_TPU_WIRE": "--wire",
+    "TFIDF_TPU_PACK_THREADS": "--pack-threads",
+    "TFIDF_TPU_RESULT_WIRE": "--result-wire",
+    "TFIDF_TPU_FINISH": "--finish",
+    "TFIDF_TPU_COMPILE_CACHE": "--compile-cache",
+    "TFIDF_TPU_TRACE": "--trace",
+    "TFIDF_TPU_FLIGHT": "--flight",
+    "TFIDF_TPU_MAX_BATCH": "--max-batch",
+    "TFIDF_TPU_MAX_WAIT_MS": "--max-wait-ms",
+    "TFIDF_TPU_QUEUE_DEPTH": "--queue-depth",
+    "TFIDF_TPU_CACHE_ENTRIES": "--cache-entries",
+    "TFIDF_TPU_HEALTH_PERIOD_MS": "--health-period-ms",
+    "TFIDF_TPU_DEVMON_PERIOD_MS": "--devmon-period-ms",
+    "TFIDF_TPU_SNAPSHOT_DIR": "--snapshot-dir",
+    "TFIDF_TPU_FAULTS": "--faults",
+    "TFIDF_TPU_FAULT_SEED": "--fault-seed",
+}
+
+#: Shared attributes the T001 thread lint tolerates without a lock,
+#: as ``(path-suffix, Class, attr)`` — ``"*"`` matches every attr.
+#: Each entry is an intentional design decision, not an oversight;
+#: keep the justification next to it.
+THREAD_ALLOWLIST = (
+    # The tracer's span ring is deliberately lock-free: one atomic
+    # index bump per record (docs/OBSERVABILITY.md "overhead"); a
+    # lock here would cost more than the spans it records.
+    ("obs/tracer.py", "*", "*"),
+    # The flight recorder's event ring follows the same discipline —
+    # bounded, append-mostly, torn reads tolerated by the dump
+    # protocol's completeness header.
+    ("obs/log.py", "*", "*"),
+)
+
+#: Metric-name prefixes built dynamically (f-strings / loops) that the
+#: C011 docs gate matches by prefix instead of the full literal.
+METRIC_DYNAMIC_PREFIXES = (
+    "hbm_bytes_in_use_d", "hbm_peak_bytes_d", "hbm_bytes_limit_d",
+    "serve_",
+)

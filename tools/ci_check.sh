@@ -1,0 +1,67 @@
+#!/usr/bin/env bash
+# One gate for builders and CI: static analysis, lint, tier-1 tests,
+# perf gate — every stage runs (no fail-fast), one summary at the end,
+# exit non-zero if any stage failed. docs/ANALYSIS.md has the story.
+#
+# Usage: bash tools/ci_check.sh        (from anywhere; cd's to the repo)
+
+set -u
+cd "$(dirname "$0")/.."
+
+declare -a NAMES VERDICTS
+fail=0
+
+stage() {   # stage NAME CMD...
+    local name="$1"; shift
+    echo "=== ${name} ==="
+    if "$@"; then
+        VERDICTS+=("PASS")
+    else
+        VERDICTS+=("FAIL")
+        fail=1
+    fi
+    NAMES+=("${name}")
+    echo
+}
+
+skip() {    # skip NAME REASON
+    echo "=== $1 === SKIP: $2"
+    NAMES+=("$1"); VERDICTS+=("SKIP")
+    echo
+}
+
+# 1. repo-invariant static analysis (tools/analyze, baseline-gated)
+stage "analyze" python -m tools.analyze
+
+# 2. ruff (rule set in pyproject.toml) — skip cleanly where the image
+#    lacks it; the analyze stage above always runs
+if command -v ruff >/dev/null 2>&1; then
+    stage "ruff" ruff check .
+elif python -c "import ruff" >/dev/null 2>&1; then
+    stage "ruff" python -m ruff check .
+else
+    skip "ruff" "ruff not installed"
+fi
+
+# 3. tier-1 tests (the ROADMAP.md command, minus the log plumbing)
+stage "tier1" env JAX_PLATFORMS=cpu timeout -k 10 870 \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider
+
+# 4. perf gate: re-gate the committed newest artifacts against the
+#    ledger (unchanged artifacts must pass; a refreshed artifact that
+#    regressed fails here)
+for artifact in BENCH_r05.json SERVE_r01.json; do
+    if [ -f "${artifact}" ]; then
+        stage "perf_gate:${artifact}" \
+            python tools/perf_gate.py "${artifact}"
+    else
+        skip "perf_gate:${artifact}" "artifact not present"
+    fi
+done
+
+echo "=== summary ==="
+for i in "${!NAMES[@]}"; do
+    printf '%-28s %s\n' "${NAMES[$i]}" "${VERDICTS[$i]}"
+done
+exit "${fail}"

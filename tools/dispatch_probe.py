@@ -23,7 +23,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import sys
 import time
 
 import _common  # noqa: E402,F401  repo-root sys.path bootstrap

@@ -11,9 +11,7 @@ Usage: python tools/exact_probe.py [--docs 8192] [--len 256]
 from __future__ import annotations
 
 import argparse
-import os
 import shutil
-import sys
 import tempfile
 import time
 

@@ -12,7 +12,6 @@ measurable cost beyond the per-shard work itself.
 
 import functools
 import os
-import sys
 import time
 
 _flags = os.environ.get("XLA_FLAGS", "")

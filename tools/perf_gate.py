@@ -41,7 +41,7 @@ import argparse
 import json
 import statistics
 import sys
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 import _common  # noqa: E402,F401  repo-root sys.path bootstrap
 

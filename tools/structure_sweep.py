@@ -21,8 +21,6 @@ sequential per-variant timing confounds drift with structure.
 """
 
 import functools
-import os
-import sys
 import time
 
 import numpy as np

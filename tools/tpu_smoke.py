@@ -10,9 +10,6 @@ actual TPU backend, checking exact agreement between all engines.
 
 from __future__ import annotations
 
-import os
-import sys
-
 import _common  # noqa: E402,F401  repo-root sys.path bootstrap
 
 import numpy as np  # noqa: E402
