@@ -1,0 +1,806 @@
+"""Per-request forensics (ISSUE 11): request ids, phase breakdowns,
+slow-query events, exemplars, SLO burn, and cross-process federation.
+
+The tentpole invariants pinned here:
+
+* every admitted request carries a unique rid, stamped on its spans
+  (request/queued directly, batched/device via the batch's ``rids``)
+  and returned on the Future — trace, flight and response join on one
+  key, under the same 8-thread stress the serve parity suite runs;
+* a fault-stalled slow request emits a ``slow_query`` flight event
+  whose phase breakdown reconciles with the request's spans within
+  5% + 5 ms, and ``tools/doctor.py --request RID`` renders its full
+  causal timeline with rc 0 (the end-to-end forensic join);
+* exemplars ride ``LatencyHistogram.merge`` (replica aggregation) and
+  the Prometheus exposition (OpenMetrics ``# {rid=...}`` syntax);
+* the SLO tracker's burn rates degrade health on a fast burn and
+  recover when the window rolls clean;
+* ``obs_export`` bundles round-trip through
+  ``MetricsRegistry.import_state`` and ``tools/obs_agg.py`` renders a
+  merged view whose histogram counts equal the per-process sum with
+  at least one exemplar surviving (the two-live-servers acceptance is
+  the slow-marked TCP test).
+"""
+
+import importlib.util
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tfidf_tpu import obs
+from tfidf_tpu.config import PipelineConfig, ServeConfig, VocabMode
+from tfidf_tpu.io.corpus import Corpus
+from tfidf_tpu.models import TfidfRetriever
+from tfidf_tpu.obs import reqtrace
+from tfidf_tpu.obs.log import EventLog
+from tfidf_tpu.obs.registry import MetricsRegistry
+from tfidf_tpu.obs.slo import SloTracker
+from tfidf_tpu.serve import TfidfServer
+from tfidf_tpu.utils.timing import LatencyHistogram
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CFG = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=512,
+                     max_doc_len=16, doc_chunk=16)
+CORPUS = Corpus(
+    names=["doc1", "doc2", "doc3", "doc4"],
+    docs=[b"apple banana apple cherry",
+          b"banana banana date",
+          b"cherry date elder fig",
+          b"apple fig fig grape"])
+QUERIES = ["apple cherry", "banana", "grape date", "fig", "elder"]
+
+
+@pytest.fixture(scope="module")
+def retriever():
+    return TfidfRetriever(CFG).index(CORPUS)
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    """Fresh tracer/log/reqtrace state per test; nothing leaks into
+    the rest of the suite."""
+    from tfidf_tpu.obs import log as obs_log_mod
+    obs.set_tracer(None)
+    obs.set_log(EventLog(echo="off"))
+    reqtrace.configure(None)
+    flight_was = obs_log_mod._flight
+    yield
+    obs.set_tracer(None)
+    obs.set_log(None)
+    reqtrace.configure(None)
+    obs_log_mod._flight = flight_was   # no tmp-path dump leakage
+
+
+def quick_cfg(**kw):
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("max_wait_ms", 2)
+    kw.setdefault("queue_depth", 64)
+    kw.setdefault("cache_entries", 64)
+    return ServeConfig(**kw)
+
+
+def _load_tool(name):
+    tools = os.path.join(REPO, "tools")
+    if tools not in sys.path:
+        sys.path.append(tools)
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(tools, f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestRidMinting:
+    def test_rids_unique_and_compact(self):
+        rids = {reqtrace.next_rid() for _ in range(10_000)}
+        assert len(rids) == 10_000
+        rid = next(iter(rids))
+        assert rid.startswith("r") and "-" in rid
+        assert len(rid) <= 24
+
+    def test_disabled_mints_nothing(self, monkeypatch):
+        reqtrace.configure(False)
+        assert reqtrace.start(1, 2) is None
+        reqtrace.configure(None)
+        monkeypatch.setenv("TFIDF_TPU_REQTRACE", "off")
+        assert not reqtrace.enabled()
+        reqtrace.configure(None)
+        monkeypatch.delenv("TFIDF_TPU_REQTRACE")
+        assert reqtrace.enabled()
+
+    def test_finish_without_ctx_is_noop(self):
+        assert reqtrace.finish(None, "drained", slow_ms=0.0) is None
+
+    def test_minting_is_cheap(self):
+        """The admission-path cost: start()+finish() (no slow event)
+        must stay in the microsecond class — three orders of
+        magnitude under the <2% p50 budget at millisecond latencies."""
+        n = 20_000
+        t0 = time.perf_counter_ns()
+        for _ in range(n):
+            reqtrace.finish(reqtrace.start(1, 10), "drained")
+        per_us = (time.perf_counter_ns() - t0) / n / 1e3
+        assert per_us < 50, f"start+finish costs {per_us:.1f} us"
+
+
+class TestRidStamping:
+    def test_rid_on_spans_digest_and_future(self, retriever):
+        tracer = obs.Tracer()
+        obs.set_tracer(tracer)
+        srv = TfidfServer(retriever, quick_cfg(cache_entries=0))
+        try:
+            fut = srv.submit(QUERIES[:2], k=3)
+            fut.result(timeout=10)
+        finally:
+            srv.close(drain=True)
+        rid = fut.rid
+        assert rid
+        by_name = {}
+        for name, _tid, _t0, _dur, args in tracer.events():
+            by_name.setdefault(name, []).append(args or {})
+        assert by_name["request"][0]["rid"] == rid
+        assert by_name["queued"][0]["rid"] == rid
+        assert rid in by_name["batched"][0]["rids"]
+        assert rid in by_name["device"][0]["rids"]
+        digests = [d for d in obs.get_log().digests()
+                   if d.get("rid") == rid]
+        assert len(digests) == 1
+        assert digests[0]["outcome"] == "drained"
+
+    def test_reqtrace_off_stamps_nothing(self, retriever):
+        reqtrace.configure(False)
+        tracer = obs.Tracer()
+        obs.set_tracer(tracer)
+        srv = TfidfServer(retriever, quick_cfg(cache_entries=0))
+        try:
+            fut = srv.submit(QUERIES[:1], k=2)
+            fut.result(timeout=10)
+        finally:
+            srv.close(drain=True)
+        assert fut.rid is None
+        for name, _tid, _t0, _dur, args in tracer.events():
+            args = args or {}
+            assert "rid" not in args and "rids" not in args
+
+    def test_stress_rids_unique_and_join(self, retriever, tmp_path):
+        """8 threads x mixed sizes (the serve stress shape): every
+        request span carries a UNIQUE rid, every future's rid matches
+        a request span, queued rids are request rids, and trace_check
+        validates the rid invariants on the exported trace."""
+        tracer = obs.Tracer()
+        obs.set_tracer(tracer)
+        srv = TfidfServer(retriever, quick_cfg(max_wait_ms=1,
+                                               cache_entries=0))
+        fut_rids = []
+        errors = []
+        lock = threading.Lock()
+
+        def work(tid):
+            try:
+                rng = np.random.default_rng(tid)
+                for _ in range(5):
+                    qs = [QUERIES[i] for i in rng.integers(
+                        0, len(QUERIES), size=int(rng.integers(1, 4)))]
+                    fut = srv.submit(qs, k=3)
+                    fut.result(timeout=30)
+                    with lock:
+                        fut_rids.append(fut.rid)
+            except Exception as e:  # noqa: BLE001 — surface in-main
+                errors.append(e)
+
+        threads = [threading.Thread(target=work, args=(t,))
+                   for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        srv.close(drain=True)
+        assert not errors
+        assert len(fut_rids) == 40
+        assert len(set(fut_rids)) == 40           # unique per request
+        span_rids = [(args or {}).get("rid")
+                     for name, _t, _t0, _d, args in tracer.events()
+                     if name == "request"]
+        assert sorted(span_rids) == sorted(fut_rids)
+        queued_rids = {(args or {}).get("rid")
+                       for name, _t, _t0, _d, args in tracer.events()
+                       if name == "queued"}
+        assert queued_rids <= set(fut_rids)
+        # The exported trace passes trace_check's rid invariants.
+        path = str(tmp_path / "stress.json")
+        tracer.export(path)
+        tc = _load_tool("trace_check")
+        errs, notes = tc.check_trace(path, mode="serve",
+                                     min_threads=2)
+        assert errs == [], (errs, notes)
+        assert any("request ids" in n for n in notes)
+
+    def test_trace_check_flags_duplicate_rids(self, tmp_path):
+        doc = {"traceEvents": [
+            {"ph": "M", "pid": 1, "tid": 0, "name": "thread_name",
+             "args": {"name": "main"}},
+            {"ph": "X", "pid": 1, "tid": 0, "name": "request",
+             "ts": 0, "dur": 5,
+             "args": {"outcome": "drained", "rid": "rX-1"}},
+            {"ph": "X", "pid": 1, "tid": 0, "name": "request",
+             "ts": 10, "dur": 5,
+             "args": {"outcome": "drained", "rid": "rX-1"}},
+        ]}
+        path = tmp_path / "dup.json"
+        path.write_text(json.dumps(doc))
+        tc = _load_tool("trace_check")
+        errs, _notes = tc.check_trace(str(path), mode="serve",
+                                      min_threads=1)
+        assert any("duplicate request ids" in e for e in errs)
+
+
+class TestSlowQueryLog:
+    def _serve_slow(self, retriever, **cfg_kw):
+        """One stalled request (device_dispatch sleep fault) through a
+        slow-query-armed server; returns (future, tracer)."""
+        tracer = obs.Tracer()
+        obs.set_tracer(tracer)
+        cfg_kw.setdefault("slow_ms", 10.0)
+        srv = TfidfServer(retriever, quick_cfg(
+            cache_entries=0,
+            faults="device_dispatch:sleep:s=0.06:n=1", **cfg_kw))
+        try:
+            fut = srv.submit(QUERIES[:2], k=3)
+            fut.result(timeout=30)
+        finally:
+            srv.close(drain=True)
+        return fut, tracer
+
+    def test_slow_query_event_and_breakdown(self, retriever):
+        fut, tracer = self._serve_slow(retriever)
+        events = [e for e in obs.get_log().events()
+                  if e.get("event") == "slow_query"]
+        assert len(events) == 1
+        ev = events[0]
+        assert ev["rid"] == fut.rid
+        assert ev["outcome"] == "drained"
+        assert ev["batch"] is not None
+        assert ev["co_occupants"] >= 2
+        assert ev["epoch"] == 0
+        bd = ev["breakdown"]
+        assert set(bd) == set(reqtrace.PHASES)
+        assert bd["device"] >= 50.0      # the injected 60 ms stall
+        assert bd["total"] >= bd["device"]
+        # The breakdown reconciles with the request's spans: phases
+        # and spans record the same intervals (the acceptance's
+        # 5% + 5 ms bound).
+        spans = {}
+        for name, _tid, _t0, dur, args in tracer.events():
+            args = args or {}
+            if args.get("rid") == fut.rid \
+                    or fut.rid in (args.get("rids") or ()):
+                spans.setdefault(name, []).append(dur / 1e6)  # ms
+        tol = lambda ms: 0.05 * ms + 5.0  # noqa: E731
+        assert abs(bd["total"] - spans["request"][0]) \
+            <= tol(spans["request"][0])
+        assert abs(bd["queue_wait"] - spans["queued"][0]) \
+            <= tol(spans["queued"][0])
+        assert abs(bd["device"] - spans["device"][0]) \
+            <= tol(spans["device"][0])
+        # Phases don't overlap-count: their sum stays near total.
+        phase_sum = sum(v for k, v in bd.items() if k != "total")
+        assert phase_sum <= bd["total"] + tol(bd["total"])
+
+    def test_slow_queries_counter_and_metric(self, retriever):
+        srv = TfidfServer(retriever, quick_cfg(
+            cache_entries=0, slow_ms=0.0))  # everything is "slow"
+        try:
+            srv.search(QUERIES[:1], k=2)
+            snap = srv.metrics_snapshot()
+        finally:
+            srv.close()
+        assert snap["slow_queries"] == 1
+        assert srv.metrics.registry.get(
+            "serve_slow_queries_total").value == 1
+
+    def test_tail_sampling(self, retriever):
+        srv = TfidfServer(retriever, quick_cfg(
+            cache_entries=0, slow_sample=1))  # sample EVERY request
+        try:
+            srv.search(QUERIES[:1], k=2)
+        finally:
+            srv.close()
+        events = [e for e in obs.get_log().events()
+                  if e.get("event") == "slow_query"]
+        assert len(events) == 1
+        assert events[0]["sampled"] is True
+        assert events[0]["level"] == "info"
+
+    def test_fast_requests_emit_nothing(self, retriever):
+        srv = TfidfServer(retriever, quick_cfg(
+            cache_entries=0, slow_ms=60_000.0))
+        try:
+            srv.search(QUERIES[:1], k=2)
+        finally:
+            srv.close()
+        assert not [e for e in obs.get_log().events()
+                    if e.get("event") == "slow_query"]
+
+
+class TestFlightKindReservation:
+    def test_kind_field_cannot_tear_the_dump(self, tmp_path):
+        """Regression (found driving the round-16 stall path): a
+        flight event whose PAYLOAD carries a ``kind`` field — e.g.
+        ``fault_injected`` used to log ``kind="sleep"`` — must not
+        clobber the dump protocol's event/digest discriminator; the
+        dump stays complete and trace_check-valid, with the payload
+        preserved under ``field_kind``."""
+        log = obs.get_log()
+        log.log("warning", "fault_injected", kind="sleep", seam="x")
+        log.digest(outcome="drained", kind="weird")
+        path = str(tmp_path / "fl.jsonl")
+        log.dump(path)
+        recs = [json.loads(l) for l in open(path) if l.strip()]
+        assert recs[1]["kind"] == "event"
+        assert recs[1]["field_kind"] == "sleep"
+        assert recs[2]["kind"] == "digest"
+        tc = _load_tool("trace_check")
+        errs, _notes = tc.check_flight(path)
+        assert errs == [], errs
+
+    def test_fault_events_dump_clean(self, retriever, tmp_path):
+        """The real emitter: an injected fault's flight event rides a
+        dump that validates — the chaos evidence chain stays whole."""
+        srv = TfidfServer(retriever, quick_cfg(
+            cache_entries=0,
+            faults="device_dispatch:transient:n=1"))
+        try:
+            srv.search(QUERIES[:1], k=2)
+        finally:
+            srv.close(drain=True)
+        events = [e for e in obs.get_log().events()
+                  if e.get("event") == "fault_injected"]
+        assert events and events[0]["fault_kind"] == "transient"
+        path = str(tmp_path / "fl.jsonl")
+        obs.get_log().dump(path)
+        tc = _load_tool("trace_check")
+        errs, _notes = tc.check_flight(path)
+        assert errs == [], errs
+
+
+class TestDoctorForensics:
+    def test_request_timeline_and_slowest_table(self, retriever,
+                                                tmp_path):
+        fut, tracer = TestSlowQueryLog()._serve_slow(retriever)
+        trace = str(tmp_path / "t.json")
+        flight = str(tmp_path / "t.json.flight.jsonl")
+        tracer.export(trace)
+        obs.get_log().dump(flight)
+        doctor = _load_tool("doctor")
+        # Default report: the slowest-requests table carries the rid.
+        report = doctor.analyze_trace(trace)
+        slowest = report["slowest_requests"]
+        assert slowest and slowest[0]["rid"] == fut.rid
+        assert slowest[0]["ms"] >= 50.0
+        # --request RID: the full causal timeline renders.
+        rep = doctor.request_timeline(trace, flight, fut.rid)
+        assert rep is not None
+        span_names = {r["span"] for r in rep["spans"]}
+        assert {"request", "queued", "batched", "device"} <= span_names
+        assert rep["breakdown"]["device"] >= 50.0
+        assert any(e.get("event") == "slow_query"
+                   for e in rep["flight_events"])
+        assert rep["digests"] and rep["digests"][0]["rid"] == fut.rid
+        text = doctor.render_request(rep)
+        assert fut.rid in text and "breakdown" in text
+        # Unknown rid: None (the CLI exits 2 there).
+        assert doctor.request_timeline(trace, flight, "r-nope") is None
+
+    def test_doctor_request_subprocess_rc0(self, retriever, tmp_path):
+        """The acceptance join, CLI-shaped: doctor --request RID on
+        the dumped evidence exits 0 and renders the timeline."""
+        fut, tracer = TestSlowQueryLog()._serve_slow(retriever)
+        trace = str(tmp_path / "t.json")
+        flight = str(tmp_path / "fl.jsonl")
+        tracer.export(trace)
+        obs.get_log().dump(flight)
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "doctor.py"),
+             trace, "--flight", flight, "--request", fut.rid],
+            capture_output=True, text=True, timeout=120, cwd=REPO)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert fut.rid in proc.stdout
+        assert "slow_query" in proc.stdout
+        # An unknown rid is unreadable evidence: rc 2.
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "doctor.py"),
+             trace, "--flight", flight, "--request", "r-nope"],
+            capture_output=True, text=True, timeout=120, cwd=REPO)
+        assert proc.returncode == 2
+
+
+class TestExemplars:
+    def test_record_and_merge_round_trip(self):
+        a = LatencyHistogram(exemplars=True)
+        b = LatencyHistogram(exemplars=True)
+        a.record(0.010, exemplar="rA-1")
+        a.record(0.500, exemplar="rA-2")
+        b.record(0.011, exemplar="rB-1")
+        b.record(5.000, exemplar="rB-2")
+        a.merge(b)
+        got = dict((rid, secs) for secs, rid in a.exemplars())
+        # rB-1 lands in (and takes over) the same bucket as rA-1; the
+        # distinct-latency exemplars all survive the merge.
+        assert {"rA-2", "rB-1", "rB-2"} <= set(got)
+        assert a.count == 4
+
+    def test_state_dict_round_trip(self):
+        h = LatencyHistogram(exemplars=True)
+        for i, v in enumerate((0.001, 0.002, 0.02, 0.3)):
+            h.record(v, exemplar=f"r-{i}")
+        h2 = LatencyHistogram.from_state(h.state_dict())
+        assert h2.count == h.count
+        assert h2.sum_seconds == pytest.approx(h.sum_seconds)
+        assert h2.min == h.min and h2.max == h.max
+        for p in (50, 95, 99):
+            assert h2.percentile(p) == h.percentile(p)
+        assert h2.exemplars() == h.exemplars()
+        # And it merges with a live histogram (same geometry).
+        h.merge(h2)
+        assert h.count == 8
+
+    def test_prometheus_openmetrics_exemplar_syntax(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_seconds", "latency", exemplars=True)
+        h.observe(0.004, exemplar="rE-1")
+        h.observe(2.0, exemplar="rE-2")
+        text = reg.render_prom()
+        assert '# {rid="rE-1"}' in text
+        assert '# {rid="rE-2"}' in text
+        # Exemplars attach to bucket lines, not the sum/count.
+        for line in text.splitlines():
+            if "# {rid=" in line:
+                assert "_bucket{le=" in line
+        # Snapshot exposes them too.
+        snap = reg.snapshot()["lat_seconds"]
+        assert {e["rid"] for e in snap["exemplars"]} \
+            == {"rE-1", "rE-2"}
+
+    def test_serve_latency_exemplar_is_the_rid(self, retriever):
+        srv = TfidfServer(retriever, quick_cfg(cache_entries=0))
+        try:
+            fut = srv.submit(QUERIES[:1], k=2)
+            fut.result(timeout=10)
+            text = srv.metrics_prom()
+            snap = srv.metrics_snapshot()
+        finally:
+            srv.close()
+        assert f'# {{rid="{fut.rid}"}}' in text
+        assert any(e["rid"] == fut.rid
+                   for e in snap["latency_s"]["exemplars"])
+
+
+class TestSloTracker:
+    def _tracker(self, **kw):
+        clock = [1000.0]
+        kw.setdefault("objective_ms", 100.0)
+        kw.setdefault("target", 0.9)       # budget = 10%
+        kw.setdefault("fast_window_s", 60)
+        kw.setdefault("slow_window_s", 600)
+        kw.setdefault("min_count", 5)
+        t = SloTracker(clock=lambda: clock[0], **kw)
+        return t, clock
+
+    def test_compliance_and_burn(self):
+        t, clock = self._tracker()
+        for _ in range(8):
+            t.record(0.050)     # good
+        for _ in range(2):
+            t.record(0.500)     # bad
+        assert t.compliance() == pytest.approx(0.8)
+        # bad rate 0.2 over budget 0.1 -> burn 2.0
+        assert t.burn_rate(60) == pytest.approx(2.0)
+        snap = t.snapshot()
+        assert snap["good"] == 8 and snap["total"] == 10
+        assert snap["fast_burn"] == pytest.approx(2.0)
+        # Windows roll: 700 s later everything has aged out.
+        clock[0] += 700
+        assert t.compliance() == 1.0
+        assert t.burn_rate(60) == 0.0
+
+    def test_health_signal_degrades_and_recovers(self):
+        t, clock = self._tracker(fast_burn_degraded=2.0)
+        for _ in range(10):
+            t.record(0.500)     # all bad: burn 10x
+        value, reason = t.health_signal()
+        assert value >= 2.0
+        assert reason and "SLO fast burn" in reason
+        # Below min_count no single outlier degrades.
+        t2, _ = self._tracker(fast_burn_degraded=2.0)
+        t2.record(0.500)
+        _value, reason2 = t2.health_signal()
+        assert reason2 is None
+        # Recovery: the fast window rolls clean.
+        clock[0] += 120
+        _value, reason3 = t.health_signal()
+        assert reason3 is None
+
+    def test_gauges_publish(self):
+        reg = MetricsRegistry()
+        clock = [50.0]
+        t = SloTracker(objective_ms=100, target=0.9, registry=reg,
+                       clock=lambda: clock[0])
+        t.record(0.500)
+        t.snapshot()
+        snap = reg.snapshot()
+        assert snap["serve_slo_fast_burn_milli"]["value"] == 10_000
+        assert snap["serve_slo_compliance_milli"]["value"] == 0
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            SloTracker(objective_ms=0)
+        with pytest.raises(ValueError):
+            SloTracker(objective_ms=10, target=1.0)
+        with pytest.raises(ValueError):
+            ServeConfig(slo_target=0.0)
+        with pytest.raises(ValueError):
+            ServeConfig(slo_ms=-1)
+        with pytest.raises(ValueError):
+            ServeConfig(slow_sample=-1)
+
+    def test_serve_config_env_mirrors(self, monkeypatch):
+        monkeypatch.setenv("TFIDF_TPU_SLOW_MS", "125")
+        monkeypatch.setenv("TFIDF_TPU_SLOW_SAMPLE", "64")
+        monkeypatch.setenv("TFIDF_TPU_SLO_MS", "50")
+        monkeypatch.setenv("TFIDF_TPU_SLO_TARGET", "0.95")
+        cfg = ServeConfig.from_env()
+        assert (cfg.slow_ms, cfg.slow_sample, cfg.slo_ms,
+                cfg.slo_target) == (125.0, 64, 50.0, 0.95)
+        assert ServeConfig.from_env(slo_ms=75.0).slo_ms == 75.0
+
+    def test_fast_burn_degrades_admission(self, retriever):
+        """The feedback loop: a server blowing its objective goes
+        degraded and its admission bound shrinks — the same path
+        memory pressure drives."""
+        srv = TfidfServer(retriever, quick_cfg(
+            cache_entries=0, slo_ms=0.001, slo_target=0.9))
+        # objective 1 us: every request is "bad" -> fast burn 10x.
+        try:
+            srv.slo.min_count = 5
+            for _ in range(6):
+                srv.search(QUERIES[:1], k=2)
+            status = srv.health.evaluate()
+            assert status.state == "degraded"
+            assert any("SLO fast burn" in r for r in status.reasons)
+            bound = srv.health.admission_bound(
+                srv.config.queue_depth)
+            assert bound < srv.config.queue_depth
+        finally:
+            srv.close()
+
+
+class TestObsFederation:
+    def test_obs_export_bundle_round_trip(self, retriever):
+        srv = TfidfServer(retriever, quick_cfg(slo_ms=1000.0))
+        try:
+            srv.search(QUERIES[:2], k=3)
+            bundle = srv.obs_export()
+            direct = srv.metrics.registry.snapshot()
+        finally:
+            srv.close()
+        assert bundle["schema"] == "tfidf-obs/1"
+        assert bundle["epoch"] == 0
+        json.dumps(bundle)   # wire-serializable end to end
+        rebuilt = MetricsRegistry.import_state(bundle["registry"])
+        snap = rebuilt.snapshot()
+        assert snap["serve_requests_total"] \
+            == direct["serve_requests_total"]
+        lat = snap["serve_request_latency_seconds"]
+        assert lat["count"] == 1
+        assert lat["p50"] == pytest.approx(
+            direct["serve_request_latency_seconds"]["p50"])
+        assert lat["exemplars"]     # the rid survived the wire
+
+    def test_merge_counts_are_sums(self, retriever):
+        bundles = {}
+        for i, n in enumerate((1, 2)):
+            srv = TfidfServer(retriever, quick_cfg())
+            try:
+                for _ in range(n):
+                    srv.submit(QUERIES[:1], k=2,
+                               use_cache=False).result(timeout=10)
+                bundles[f"p{i}"] = srv.obs_export()
+            finally:
+                srv.close()
+        agg = _load_tool("obs_agg")
+        merged, per = agg.merge_bundles(bundles)
+        snap = merged.snapshot()
+        assert snap["serve_requests_total"] == 3
+        assert snap["serve_request_latency_seconds"]["count"] == 3
+        assert snap["serve_request_latency_seconds"]["exemplars"]
+        text = agg.render_prom(merged, per, bundles)
+        assert "serve_request_latency_seconds_count 3" in text
+        assert 'serve_requests_total{process="p0"} 1' in text
+        assert 'serve_requests_total{process="p1"} 2' in text
+        assert '# {rid="' in text   # an exemplar survived the merge
+
+    def test_obs_agg_bundles_cli(self, retriever, tmp_path):
+        paths = []
+        for i in range(2):
+            srv = TfidfServer(retriever, quick_cfg())
+            try:
+                srv.submit(QUERIES[:1], k=2,
+                           use_cache=False).result(timeout=10)
+                p = tmp_path / f"b{i}.json"
+                p.write_text(json.dumps(srv.obs_export()))
+                paths.append(str(p))
+            finally:
+                srv.close()
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "obs_agg.py"),
+             "--bundles", *paths],
+            capture_output=True, text=True, timeout=120, cwd=REPO)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "obs_agg_processes 2" in proc.stdout
+        assert "serve_requests_total 2" in proc.stdout
+        assert 'process="b0.json"' in proc.stdout
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "obs_agg.py"),
+             "--bundles", *paths, "--json"],
+            capture_output=True, text=True, timeout=120, cwd=REPO)
+        assert proc.returncode == 0
+        doc = json.loads(proc.stdout)
+        assert doc["merged"]["serve_requests_total"] == 2
+        assert set(doc["processes"]) == {"b0.json", "b1.json"}
+
+    def test_bundle_schema_mismatch_rejected(self):
+        agg = _load_tool("obs_agg")
+        with pytest.raises(ValueError, match="schema"):
+            agg.validate_bundle({"schema": "tfidf-obs/99",
+                                 "registry": {}}, "x")
+
+
+def _wait_port(port, timeout=60):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection(("127.0.0.1", port),
+                                          timeout=1):
+                return True
+        except OSError:
+            time.sleep(0.2)
+    return False
+
+
+@pytest.mark.slow
+class TestTwoProcessAggregation:
+    """The acceptance pin: obs_agg over TWO LIVE serve processes
+    renders merged Prometheus whose histogram counts equal the sum of
+    the per-process snapshots, with per-process labels and at least
+    one exemplar surviving the merge."""
+
+    def test_two_live_servers_merge(self, tmp_path):
+        d = tmp_path / "input"
+        d.mkdir()
+        for i, text in enumerate(
+                [b"apple banana", b"cherry date", b"elder fig",
+                 b"apple grape"], start=1):
+            (d / f"doc{i}").write_bytes(text)
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   TFIDF_TPU_LOG_ECHO="off")
+        ports = [19471, 19472]
+        procs = []
+        try:
+            for port in ports:
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-m", "tfidf_tpu.cli", "serve",
+                     "--input", str(d), "--vocab-size", "512",
+                     "--max-wait-ms", "1", "--port", str(port),
+                     "--canary-period-ms", "0",
+                     "--health-period-ms", "0",
+                     "--devmon-period-ms", "0"],
+                    env=env, cwd=REPO, stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE))
+            for port in ports:
+                assert _wait_port(port), "serve process did not bind"
+            # Drive a different request count through each process.
+            expect = {ports[0]: 1, ports[1]: 2}
+            for port, n in expect.items():
+                with socket.create_connection(("127.0.0.1", port),
+                                              timeout=10) as sock:
+                    f = sock.makefile("rw")
+                    for i in range(n):
+                        f.write(json.dumps(
+                            {"id": i,
+                             "queries": ["apple banana"]}) + "\n")
+                        f.flush()
+                        resp = json.loads(f.readline())
+                        assert "results" in resp
+                        assert resp.get("rid")    # the JSONL rid
+            proc = subprocess.run(
+                [sys.executable,
+                 os.path.join(REPO, "tools", "obs_agg.py"),
+                 "--endpoints",
+                 ",".join(f"127.0.0.1:{p}" for p in ports)],
+                capture_output=True, text=True, timeout=120, cwd=REPO)
+            assert proc.returncode == 0, proc.stderr[-2000:]
+            out = proc.stdout
+            assert "obs_agg_processes 2" in out
+            # Merged histogram count == sum of per-process counts.
+            assert "serve_request_latency_seconds_count 3" in out
+            for port in ports:
+                assert f'process="127.0.0.1:{port}"' in out
+            assert '# {rid="' in out    # exemplar survived the merge
+        finally:
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=20)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+
+class TestServeCliForensicJoin:
+    def test_jsonl_rid_to_slow_query_to_doctor(self, tmp_path,
+                                               monkeypatch, capsys):
+        """End-to-end acceptance: a fault-stalled request through the
+        serve CLI produces a JSONL response rid, a slow_query flight
+        event whose breakdown reconciles with the request's spans,
+        and doctor --request RID renders the timeline with rc 0."""
+        import io
+
+        from tfidf_tpu.cli import main
+        d = tmp_path / "input"
+        d.mkdir()
+        for i, text in enumerate(
+                [b"apple banana", b"cherry date", b"elder fig",
+                 b"apple grape"], start=1):
+            (d / f"doc{i}").write_bytes(text)
+        trace = str(tmp_path / "serve.json")
+        flight = str(tmp_path / "serve.flight.jsonl")
+        lines = [json.dumps({"id": 1, "queries": ["apple banana"],
+                             "k": 2}),
+                 json.dumps({"op": "shutdown"})]
+        monkeypatch.setattr("sys.stdin",
+                            io.StringIO("\n".join(lines) + "\n"))
+        rc = main(["serve", "--input", str(d), "--vocab-size", "512",
+                   "--max-wait-ms", "1", "--slow-ms", "10",
+                   "--canary-period-ms", "0",
+                   "--faults", "device_dispatch:sleep:s=0.06:n=1",
+                   "--trace", trace, "--flight", flight])
+        assert rc == 0
+        out = capsys.readouterr().out
+        resp = next(json.loads(l) for l in out.splitlines()
+                    if l and "results" in l)
+        rid = resp["rid"]
+        assert rid
+        # The flight dump carries the slow_query event on the SAME key
+        # and its breakdown shows the injected stall in the device
+        # phase.
+        with open(flight) as f:
+            recs = [json.loads(l) for l in f if l.strip()]
+        slow = [r for r in recs if r.get("event") == "slow_query"]
+        assert slow and slow[0]["rid"] == rid
+        assert slow[0]["breakdown"]["device"] >= 50.0
+        digests = [r for r in recs if r.get("kind") == "digest"
+                   and r.get("rid") == rid]
+        assert digests
+        # Breakdown-vs-span reconciliation (5% + 5 ms) on the
+        # exported trace, then doctor --request renders rc 0.
+        events = [e for e in json.load(open(trace))["traceEvents"]
+                  if e.get("ph") == "X"
+                  and (e.get("args") or {}).get("rid") == rid]
+        req_span = next(e for e in events if e["name"] == "request")
+        total_ms = slow[0]["breakdown"]["total"]
+        span_ms = req_span["dur"] / 1e3
+        assert abs(total_ms - span_ms) <= 0.05 * span_ms + 5.0
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "doctor.py"),
+             trace, "--flight", flight, "--request", rid],
+            capture_output=True, text=True, timeout=120, cwd=REPO)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert rid in proc.stdout

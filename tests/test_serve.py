@@ -468,6 +468,33 @@ class TestTfidfServer:
                 assert inner <= snap[key].keys(), (
                     f"pinned inner keys of {key!r} shrank: "
                     f"{inner - snap[key].keys()}")
+        # Round-16 additions pinned alongside: the slo object (the
+        # "SLO snapshot" the serve CLI metrics-op docstring promises)
+        # and the slow-query counter are part of the schema now.
+        assert "slo" in snap and "configured" in snap["slo"]
+        assert "slow_queries" in snap
+
+    def test_metrics_slo_snapshot_promise(self, retriever):
+        """Satellite (ISSUE 11): cli.py's metrics-op docstring
+        promises an "SLO snapshot" — true now: without an objective
+        the slo object is the typed not-configured marker; with
+        --slo-ms / ServeConfig.slo_ms it carries windowed compliance
+        and fast/slow burn rates."""
+        with TfidfServer(retriever, quick_cfg()) as srv:
+            assert srv.metrics_snapshot()["slo"] == {
+                "configured": False}
+        srv = TfidfServer(retriever, quick_cfg(slo_ms=10_000.0))
+        try:
+            srv.search(QUERIES[:2], k=3)
+            slo = srv.metrics_snapshot()["slo"]
+        finally:
+            srv.close()
+        assert slo["configured"] is True
+        assert {"objective_ms", "target", "compliance", "fast_burn",
+                "slow_burn", "good", "total"} <= slo.keys()
+        assert slo["total"] >= 1 and slo["good"] >= 1
+        assert slo["compliance"] == 1.0  # 10 s objective: all good
+        assert slo["fast_burn"] == 0.0
 
     def test_snapshot_is_self_describing(self, retriever):
         """Satellite (ISSUE 6): uptime_s / epoch / build fingerprint
@@ -698,15 +725,26 @@ class TestServeBenchSmoke:
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "tools", "serve_bench.py"),
              "--requests", "64", "--docs", "128", "--doc-len", "32",
-             "--out", str(out)],
+             "--ab-reqtrace", "--out", str(out)],
             capture_output=True, text=True, timeout=540, env=env,
             cwd=REPO)
         assert proc.returncode == 0, proc.stderr[-2000:]
         art = json.loads(out.read_text())
         for key in ("metric", "mode", "requests", "queries", "wall_s",
                     "throughput_rps", "throughput_qps", "latency_ms",
-                    "batch", "cache", "shed", "recompiles_after_warmup"):
+                    "batch", "cache", "shed", "recompiles_after_warmup",
+                    "slo", "slow_queries", "reqtrace"):
             assert key in art, key
+        # Round-16 receipts: the SLO snapshot rode the artifact and
+        # the request-identity overhead was measured on the
+        # device-bound path (absolute numbers are box noise; the
+        # structure and sanity bounds are the pin).
+        assert art["slo"]["configured"] is True
+        assert 0 <= art["slo"]["compliance"] <= 1
+        assert art["slow_queries"] >= 0
+        rq = art["reqtrace"]
+        assert rq["p50_ms_off"] > 0 and rq["p50_ms_on"] > 0
+        assert rq["p50_regression"] < 0.5  # sanity, not the 2% claim
         assert art["metric"] == "serve_bench"
         assert art["requests"] == 64
         assert art["queries"] >= 64

@@ -64,12 +64,17 @@ wire & pack knobs (round 14):
 _SERVE_EPILOG = """\
 protocol (one JSON object per line):
   {"id": 1, "queries": ["apple pie"], "k": 5}
-      -> {"id": 1, "results": [[["doc3", 0.81], ...]]}
+      -> {"id": 1, "results": [[["doc3", 0.81], ...]], "rid": "r..-1"}
+      ("rid" is the request's end-to-end forensic id: the same key is
+      stamped on its spans, its flight digest and any slow_query
+      event — tools/doctor.py --request RID renders the timeline)
   {"id": 2, "queries": [...], "deadline_ms": 50}
       -> {"id": 2, "error": "deadline_exceeded"} when shed
-  {"op": "metrics"}            -> {"metrics": {...}}  (SLO snapshot +
-      uptime_s / epoch / build fingerprint — self-describing for the
-      perf ledger, tools/perf_ledger.py)
+  {"op": "metrics"}            -> {"metrics": {...}}  (SLO snapshot —
+      the "slo" object carries windowed objective compliance and
+      fast/slow burn rates when --slo-ms is set — plus uptime_s /
+      epoch / build fingerprint — self-describing for the perf
+      ledger, tools/perf_ledger.py)
   {"op": "metrics_prom"}       -> {"metrics_prom": "..."}  (Prometheus
       text exposition incl. request-latency histogram buckets)
   {"op": "healthz"}            -> {"healthz": {"status": "ok" |
@@ -84,6 +89,11 @@ protocol (one JSON object per line):
       "memory_pressure": 0.12, "census": {...}}}  (one device-monitor
       sample + live-buffer census by owner; device entries carry HBM
       stats only on backends that report them)
+  {"op": "obs_export"}         -> {"obs_export": {"schema":
+      "tfidf-obs/1", "registry": {...}, "flight_tail": [...], ...}}
+      (the cross-process federation bundle: full metric state incl.
+      histogram buckets + exemplars; tools/obs_agg.py polls N serve
+      processes and renders one merged Prometheus/JSON view)
   {"op": "swap_index", "input": DIR}
       -> {"swapped": true, "epoch": N}  (hot re-index, no downtime;
       the canary oracle re-captures inside the swap; with
@@ -338,6 +348,28 @@ def _build_parser() -> argparse.ArgumentParser:
                          "TFIDF_TPU_DEVMON_PERIOD_MS). Backends "
                          "without memory stats (CPU) run the same "
                          "path with gauges absent")
+    sv.add_argument("--slow-ms", type=float, default=None,
+                    help="slow-query threshold: a resolved request "
+                         "over this total latency emits a slow_query "
+                         "flight event carrying its per-phase "
+                         "breakdown (queue/batch/device/drain/cache), "
+                         "batch id, co-occupant count and overlapping "
+                         "anomalies — the record doctor --request RID "
+                         "renders (env TFIDF_TPU_SLOW_MS; sampling "
+                         "mirror TFIDF_TPU_SLOW_SAMPLE = 1-in-N even "
+                         "when fast; default: off)")
+    sv.add_argument("--slo-ms", type=float, default=None,
+                    help="latency objective for the SLO burn gauges: "
+                         "requests slower than this are 'bad'; "
+                         "windowed fast/slow error-budget burn rates "
+                         "publish as serve_slo_* gauges, ride the "
+                         "metrics op's slo object, and a fast burn "
+                         "degrades health -> admission sheds at the "
+                         "gate (env TFIDF_TPU_SLO_MS; default: off)")
+    sv.add_argument("--slo-target", type=float, default=None,
+                    help="fraction of requests that must meet "
+                         "--slo-ms (error budget = 1 - target; "
+                         "default 0.99; env TFIDF_TPU_SLO_TARGET)")
     sv.add_argument("--no-warm", action="store_true",
                     help="skip the power-of-two query-bucket warm-up "
                          "(and its mark_warm() line): the compile "
@@ -859,6 +891,9 @@ def _serve_handle_line(server, line, write, default_k, build_retriever,
         write({"id": req.get("id"),
                "metrics_prom": server.metrics_prom()})
         return True
+    if op == "obs_export":
+        write({"id": req.get("id"), "obs_export": server.obs_export()})
+        return True
     if op == "healthz":
         write({"id": req.get("id"), "healthz": server.healthz()})
         return True
@@ -905,44 +940,52 @@ def _serve_handle_line(server, line, write, default_k, build_retriever,
         write({"id": req.get("id"), "error": f"unknown op {op!r}"})
         return True
 
-    rid = req.get("id")
+    line_id = req.get("id")
     queries = req.get("queries")
     if not isinstance(queries, list) or not all(
             isinstance(q, str) for q in queries):
-        write({"id": rid, "error": "bad request: 'queries' must be a "
+        write({"id": line_id, "error": "bad request: 'queries' must be a "
                                    "list of strings"})
         return True
     k = int(req.get("k", default_k))
     names = server.doc_names()
 
     def on_done(f):
+        # The request id (round 16) rides every response line — the
+        # client-visible half of the forensic join: the same rid is
+        # on the request's spans, its flight digest and any
+        # slow_query event.
+        extra = ({"rid": f.rid}
+                 if getattr(f, "rid", None) is not None else {})
         err = f.exception()
         if isinstance(err, Overloaded):
-            write({"id": rid, "error": "overloaded"})
+            write({"id": line_id, "error": "overloaded", **extra})
         elif isinstance(err, DeadlineExceeded):
-            write({"id": rid, "error": "deadline_exceeded"})
+            write({"id": line_id, "error": "deadline_exceeded", **extra})
         elif isinstance(err, PoisonQuery):
-            write({"id": rid, "error": "poison_query",
-                   "detail": str(err)})
+            write({"id": line_id, "error": "poison_query",
+                   "detail": str(err), **extra})
         elif err is not None:
-            write({"id": rid, "error": str(err)})
+            write({"id": line_id, "error": str(err), **extra})
         else:
             vals, idx = f.result()
-            write({"id": rid, "results": [
+            write({"id": line_id, "results": [
                 [[names[int(d)], float(v)]
                  for v, d in zip(vrow, irow) if d >= 0]
-                for vrow, irow in zip(vals, idx)]})
+                for vrow, irow in zip(vals, idx)], **extra})
 
     try:
         server.submit(queries, k,
                       deadline_ms=req.get("deadline_ms")
                       ).add_done_callback(on_done)
     except PoisonQuery as e:     # quarantined: the protocol's 4xx
-        write({"id": rid, "error": "poison_query", "detail": str(e)})
+        write({"id": line_id, "error": "poison_query", "detail": str(e),
+               **({"rid": e.rid} if getattr(e, "rid", None) else {})})
     except (Overloaded, ServeError) as e:
-        write({"id": rid,
+        write({"id": line_id,
                "error": "overloaded" if isinstance(e, Overloaded)
-               else str(e)})
+               else str(e),
+               **({"rid": e.rid} if getattr(e, "rid", None) else {})})
     return True
 
 
@@ -974,7 +1017,8 @@ def _run_serve(args) -> int:
         health_period_ms=args.health_period_ms,
         devmon_period_ms=args.devmon_period_ms,
         snapshot_dir=args.snapshot_dir, faults=args.faults,
-        fault_seed=args.fault_seed)
+        fault_seed=args.fault_seed, slow_ms=args.slow_ms,
+        slo_ms=args.slo_ms, slo_target=args.slo_target)
 
     # Crash-fast start: a committed snapshot with a matching config
     # fingerprint restores the resident index from disk — seconds, no

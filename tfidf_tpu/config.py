@@ -292,6 +292,24 @@ class ServeConfig:
       fault_seed: seed for the plan's probabilistic rules and the
         retry jitter, so chaos runs replay deterministically.
         Env ``TFIDF_TPU_FAULT_SEED``.
+      slow_ms: slow-query threshold — a resolved request whose total
+        latency exceeds this emits a ``slow_query`` flight event with
+        its per-phase breakdown, batch id, co-occupant count and
+        overlapping anomalies (``obs/reqtrace.py``; ``tools/doctor.py
+        --request RID`` renders the timeline). None = no slow-query
+        log. CLI ``--slow-ms`` / env ``TFIDF_TPU_SLOW_MS``.
+      slow_sample: 1-in-N tail sample — every Nth resolved request
+        emits the same event (``sampled: true``) even under the
+        threshold, so the forensic pipeline stays exercised when
+        nothing is slow. 0 disables. Env ``TFIDF_TPU_SLOW_SAMPLE``.
+      slo_ms: latency objective for the SLO burn gauges
+        (``obs/slo.py``): requests over this are "bad"; windowed
+        fast/slow burn rates publish as gauges and a fast burn feeds
+        the degraded-admission path. None = no SLO tracking. CLI
+        ``--slo-ms`` / env ``TFIDF_TPU_SLO_MS``.
+      slo_target: fraction of requests that must meet ``slo_ms``
+        (error budget = 1 - target). CLI ``--slo-target`` / env
+        ``TFIDF_TPU_SLO_TARGET``.
     """
 
     max_batch: int = 64
@@ -311,6 +329,10 @@ class ServeConfig:
     snapshot_dir: Optional[str] = None
     faults: Optional[str] = None
     fault_seed: int = 0
+    slow_ms: Optional[float] = None
+    slow_sample: int = 0
+    slo_ms: Optional[float] = None
+    slo_target: float = 0.99
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -347,6 +369,14 @@ class ServeConfig:
             raise ValueError("breaker_cooldown_ms must be positive")
         if self.restart_budget < 0:
             raise ValueError("restart_budget must be >= 0")
+        if self.slow_ms is not None and self.slow_ms < 0:
+            raise ValueError("slow_ms must be >= 0")
+        if self.slow_sample < 0:
+            raise ValueError("slow_sample must be >= 0")
+        if self.slo_ms is not None and self.slo_ms <= 0:
+            raise ValueError("slo_ms must be positive")
+        if not 0 < self.slo_target < 1:
+            raise ValueError("slo_target must be in (0, 1)")
 
     @staticmethod
     def from_env(**overrides) -> "ServeConfig":
@@ -377,7 +407,11 @@ class ServeConfig:
                 ("restart_budget", "TFIDF_TPU_RESTART_BUDGET", int),
                 ("snapshot_dir", "TFIDF_TPU_SNAPSHOT_DIR", str),
                 ("faults", "TFIDF_TPU_FAULTS", str),
-                ("fault_seed", "TFIDF_TPU_FAULT_SEED", int)):
+                ("fault_seed", "TFIDF_TPU_FAULT_SEED", int),
+                ("slow_ms", "TFIDF_TPU_SLOW_MS", float),
+                ("slow_sample", "TFIDF_TPU_SLOW_SAMPLE", int),
+                ("slo_ms", "TFIDF_TPU_SLO_MS", float),
+                ("slo_target", "TFIDF_TPU_SLO_TARGET", float)):
             val = pick(key, env, cast)
             if val is not None:
                 kw[key] = val

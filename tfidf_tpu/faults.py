@@ -239,7 +239,12 @@ class FaultRegistry:
                 "warning", "fault_injected",
                 msg=f"fault injected at {seam}: {rule.spec} "
                     f"(firing {rule.fired})",
-                seam=seam, kind=rule.kind, rule=rule.spec,
+                # fault_kind, not "kind": the flight-dump protocol
+                # reserves "kind" as its event/digest discriminator
+                # (obs/log.py dump) — a payload field named "kind"
+                # would clobber it and tear every dump that carries a
+                # fault event.
+                seam=seam, fault_kind=rule.kind, rule=rule.spec,
                 firing=rule.fired, **info)
             if rule.kind == "sleep":
                 time.sleep(rule.sleep_s)

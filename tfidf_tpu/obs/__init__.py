@@ -60,10 +60,10 @@ __all__ = [
     "EventLog", "get_log", "set_log", "log_event", "record_digest",
     "configure_flight", "flight_path", "dump_flight",
     # lazy (tfidf_tpu.obs.registry / tfidf_tpu.obs.health /
-    # tfidf_tpu.obs.devmon):
+    # tfidf_tpu.obs.devmon / tfidf_tpu.obs.slo):
     "MetricsRegistry", "Counter", "Gauge", "Histogram",
     "HealthMonitor", "HealthThresholds", "HealthStatus",
-    "DeviceMonitor", "CompileWatch",
+    "DeviceMonitor", "CompileWatch", "SloTracker",
 ]
 
 
@@ -78,4 +78,7 @@ def __getattr__(name):  # PEP 562: heavier members load on demand
     if name in ("DeviceMonitor", "CompileWatch"):
         from tfidf_tpu.obs import devmon
         return getattr(devmon, name)
+    if name == "SloTracker":
+        from tfidf_tpu.obs import slo
+        return slo.SloTracker
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

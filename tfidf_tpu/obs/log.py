@@ -130,6 +130,14 @@ class EventLog:
         if msg is not None:
             rec["msg"] = msg
         if fields:
+            # "kind" is RESERVED by the dump protocol (the
+            # event/digest discriminator each JSONL line leads with);
+            # a payload field with that name would clobber it and
+            # tear the dump. Store it under "field_kind" instead of
+            # silently corrupting the recorder.
+            if "kind" in fields:
+                fields = dict(fields)
+                fields["field_kind"] = fields.pop("kind")
             rec.update(fields)
         if dropped:
             rec["suppressed"] = dropped  # events throttled since last
@@ -161,6 +169,9 @@ class EventLog:
         digest per request is already bounded by the serve rate, and a
         gappy digest ring would defeat its purpose."""
         rec = {"t": round(time.time(), 6)}
+        if "kind" in fields:   # reserved by the dump protocol
+            fields = dict(fields)
+            fields["field_kind"] = fields.pop("kind")
         rec.update(fields)
         self._digests.append(rec)
 

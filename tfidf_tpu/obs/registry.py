@@ -28,7 +28,7 @@ its high-water mark between snapshots (``snapshot(reset_peaks=True)``).
 from __future__ import annotations
 
 import threading
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from tfidf_tpu.utils.timing import LatencyHistogram
 
@@ -85,6 +85,14 @@ class Counter:
     def merge(self, other: "Counter") -> None:
         """Fold another replica's count in (totals add)."""
         self.inc(other.value)
+
+    def state_dict(self) -> dict:
+        return {"kind": "counter", "help": self.help,
+                "value": self._v}
+
+    def load_state(self, state: dict) -> None:
+        with self._lock:
+            self._v = state["value"]
 
     def reset(self) -> None:
         with self._lock:
@@ -153,6 +161,15 @@ class Gauge:
             if self._v > self._peak:
                 self._peak = self._v
 
+    def state_dict(self) -> dict:
+        return {"kind": "gauge", "help": self.help,
+                "value": self._v, "peak": self._peak}
+
+    def load_state(self, state: dict) -> None:
+        with self._lock:
+            self._v = state["value"]
+            self._peak = state["peak"]
+
     def reset(self) -> None:
         with self._lock:
             self._v = 0
@@ -167,18 +184,22 @@ class Histogram:
 
     def __init__(self, name: str, help: str = "",
                  buckets=DEFAULT_BUCKETS, lo: float = 1e-6,
-                 hi: float = 1e3, resolution: float = 0.02):
+                 hi: float = 1e3, resolution: float = 0.02,
+                 exemplars: bool = False):
         self.name = name
         self.help = help
         self.buckets = tuple(sorted(buckets))
         # Kept so a registry merge can create a compatible twin.
-        self._geometry = {"lo": lo, "hi": hi, "resolution": resolution}
-        self._h = LatencyHistogram(lo=lo, hi=hi, resolution=resolution)
+        self._geometry = {"lo": lo, "hi": hi, "resolution": resolution,
+                          "exemplars": exemplars}
+        self._h = LatencyHistogram(lo=lo, hi=hi, resolution=resolution,
+                                   exemplars=exemplars)
         self._lock = threading.Lock()
 
-    def observe(self, seconds: float) -> None:
+    def observe(self, seconds: float,
+                exemplar: Optional[str] = None) -> None:
         with self._lock:
-            self._h.record(seconds)
+            self._h.record(seconds, exemplar=exemplar)
 
     @property
     def count(self) -> int:
@@ -193,25 +214,64 @@ class Histogram:
         with self._lock:
             cum = self._h.cumulative(list(self.buckets))
             count, total = self._h.count, self._h.sum_seconds
+            exemplars = self._h.exemplars()
+        # OpenMetrics exemplar exposition: each ``le`` bucket line may
+        # carry `# {rid="..."} value` naming the LAST request id that
+        # landed under that bound — "p99 got worse" links straight to
+        # one replayable trace (tools/doctor.py --request RID). An
+        # exemplar attaches to the smallest ladder bound that covers
+        # it, the bucket it is an example OF.
+        by_le = {}
+        for secs, rid in exemplars:
+            for le in self.buckets:
+                if secs <= le:
+                    by_le[le] = (rid, secs)
+                    break
+            else:
+                by_le[float("inf")] = (rid, secs)
         lines = [f"# HELP {self.name} {h}",
                  f"# TYPE {self.name} histogram"]
         for le, c in zip(self.buckets, cum):
-            lines.append(f'{self.name}_bucket{{le="{_fmt(le)}"}} {c}')
-        lines.append(f'{self.name}_bucket{{le="+Inf"}} {count}')
+            line = f'{self.name}_bucket{{le="{_fmt(le)}"}} {c}'
+            if le in by_le:
+                rid, secs = by_le[le]
+                line += f' # {{rid="{rid}"}} {repr(float(secs))}'
+            lines.append(line)
+        inf_line = f'{self.name}_bucket{{le="+Inf"}} {count}'
+        if float("inf") in by_le:
+            rid, secs = by_le[float("inf")]
+            inf_line += f' # {{rid="{rid}"}} {repr(float(secs))}'
+        lines.append(inf_line)
         lines.append(f"{self.name}_sum {repr(float(total))}")
         lines.append(f"{self.name}_count {count}")
         return lines
 
     def snapshot_value(self):
         with self._lock:
-            return self._h.as_dict()
+            out = self._h.as_dict()
+            exemplars = self._h.exemplars()
+        if exemplars:
+            out["exemplars"] = [{"rid": rid, "value": round(secs, 6)}
+                                for secs, rid in exemplars]
+        return out
 
     def merge(self, other: "Histogram") -> None:
         """Fold another replica's distribution in
         (:meth:`LatencyHistogram.merge` — identical geometry required,
-        bucket counts add, count/sum/min/max exact)."""
+        bucket counts add, count/sum/min/max exact; exemplars ride
+        along per bucket)."""
         with self._lock, other._lock:
             self._h.merge(other._h)
+
+    def state_dict(self) -> dict:
+        with self._lock:
+            return {"kind": "histogram", "help": self.help,
+                    "buckets": list(self.buckets),
+                    "state": self._h.state_dict()}
+
+    def load_state(self, state: dict) -> None:
+        with self._lock:
+            self._h = LatencyHistogram.from_state(state["state"])
 
     def reset(self) -> None:
         with self._lock:
@@ -291,6 +351,42 @@ class MetricsRegistry:
                 self.histogram(name, inst.help, inst.buckets,
                                **inst._geometry).merge(inst)
         return self
+
+    def export_state(self) -> dict:
+        """Wire-format state of every instrument, keyed by name — the
+        ``obs_export`` bundle's ``registry`` object. Unlike
+        :meth:`snapshot` (lossy percentiles), this carries full
+        histogram bucket state + exemplars, so a receiver can
+        :meth:`import_state` an equivalent registry and :meth:`merge`
+        it — the cross-process federation transport
+        ``tools/obs_agg.py`` rides."""
+        with self._lock:
+            items = list(self._instruments.items())
+        return {name: inst.state_dict() for name, inst in items}
+
+    @classmethod
+    def import_state(cls, state: dict) -> "MetricsRegistry":
+        """Rebuild a registry from :meth:`export_state` output (e.g.
+        parsed from another process's ``obs_export`` bundle)."""
+        reg = cls()
+        for name, s in state.items():
+            kind = s.get("kind")
+            if kind == "counter":
+                reg.counter(name, s.get("help", "")).load_state(s)
+            elif kind == "gauge":
+                reg.gauge(name, s.get("help", "")).load_state(s)
+            elif kind == "histogram":
+                inner = s["state"]
+                h = reg.histogram(
+                    name, s.get("help", ""), s["buckets"],
+                    lo=inner["lo"], hi=inner["hi"],
+                    resolution=inner["resolution"],
+                    exemplars="exemplars" in inner)
+                h.load_state(s)
+            else:
+                raise ValueError(
+                    f"unknown instrument kind {kind!r} for {name!r}")
+        return reg
 
     def render_prom(self) -> str:
         """Prometheus text exposition format 0.0.4 of every

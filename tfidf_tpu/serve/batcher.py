@@ -92,20 +92,29 @@ def _pow2(n: int) -> int:
 
 class _Pending:
     __slots__ = ("queries", "k", "group", "future", "deadline",
-                 "enqueued_at", "obs")
+                 "enqueued_at", "obs", "ctx")
 
-    def __init__(self, queries, k, group, deadline):
+    def __init__(self, queries, k, group, deadline, ctx=None):
         self.queries = queries
         self.k = k
         self.group = group
         self.future: Future = Future()
         self.deadline = deadline          # absolute monotonic, or None
         self.enqueued_at = time.monotonic()
+        # Request forensics (round 16): the server's RequestContext
+        # rides the pending entry so the batcher can stamp its rid on
+        # the queued span and mark the queue/batch/device phases the
+        # slow-query breakdown reports.
+        self.ctx = ctx
         # Queue-wait span: opens at submit, closes when the batch forms
         # (batch-id attributed) or the request sheds — the "queued"
         # stage of the request lifecycle chain (docs/OBSERVABILITY.md).
-        self.obs = obs.begin("queued", queries=len(self.queries),
-                             k=self.k)
+        if ctx is not None:
+            self.obs = obs.begin("queued", queries=len(self.queries),
+                                 k=self.k, rid=ctx.rid)
+        else:
+            self.obs = obs.begin("queued", queries=len(self.queries),
+                                 k=self.k)
 
 
 class MicroBatcher:
@@ -168,13 +177,16 @@ class MicroBatcher:
 
     # --- submit side ---
     def submit(self, queries: Sequence[Union[str, bytes]], k: int,
-               group=None, deadline: Optional[float] = None) -> Future:
+               group=None, deadline: Optional[float] = None,
+               ctx=None) -> Future:
         """Enqueue one request; the Future resolves to the ``(vals,
         ids)`` pair for exactly these queries (rows in submit order).
         ``deadline`` is an absolute ``time.monotonic()`` instant; a
         request still queued past it fails with
-        :class:`DeadlineExceeded`."""
-        p = _Pending(list(queries), int(k), group, deadline)
+        :class:`DeadlineExceeded`. ``ctx`` is the server's optional
+        :class:`~tfidf_tpu.obs.reqtrace.RequestContext` — the request
+        identity stamped through the span chain."""
+        p = _Pending(list(queries), int(k), group, deadline, ctx=ctx)
         with self._cond:
             if self._closed:
                 raise ServerClosed("batcher is closed")
@@ -315,12 +327,24 @@ class MicroBatcher:
             return
         bid = self._batch_seq
         self._batch_seq += 1
+        t_formed = time.monotonic()
         queries: List = []
         offsets = [0]
         for p in live:
             obs.end(p.obs, outcome="batched", batch=bid)
+            if p.ctx is not None:
+                # queue_wait measured at the same instant the queued
+                # span ends — the breakdown and the trace record one
+                # interval (the 5%+5ms reconciliation pin).
+                p.ctx.mark("queue_wait", t_formed - p.enqueued_at)
             queries.extend(p.queries)
             offsets.append(len(queries))
+        rids = [p.ctx.rid for p in live if p.ctx is not None]
+        span_extra = {"rids": rids} if rids else {}
+        for p in live:
+            if p.ctx is not None:
+                p.ctx.batch = bid
+                p.ctx.co_occupants = len(queries)
         # Recompile attribution (round 12): with a warm CompileWatch
         # armed, a recompile-count delta across THIS batch's device
         # call pins the offending batch on the trace timeline — the
@@ -329,31 +353,52 @@ class MicroBatcher:
         watch = obs_devmon.get_watch()
         pre_rc = (watch.recompile_count
                   if watch is not None and watch.warm else None)
+        # Retry attribution (round 16): the counter delta across this
+        # batch's supervised dispatch charges dispatch_retry backoffs
+        # to the requests that rode the batch — a slow_query event
+        # then SAYS its tail came from retries, not queueing.
+        pre_retries = self._retry_count()
         with obs.span("batched", batch=bid, queries=len(queries),
-                      requests=len(live)):
+                      requests=len(live), **span_extra):
             poison: List[int] = []
             try:
                 # TraceAnnotation-wrapped: the device lanes of a
                 # profiler capture carry the same batch id.
+                t_dev0 = time.monotonic()
                 with obs.device_span("device", batch=bid,
-                                     queries=len(queries)):
+                                     queries=len(queries),
+                                     **span_extra):
                     if self._supervisor is not None:
                         vals, ids, poison = self._supervisor.run_batch(
                             queries, live[0].k, live[0].group,
-                            batch_id=bid)
+                            batch_id=bid, rids=rids or None)
                     else:
                         faults.fire("device_dispatch",
                                     queries=len(queries), batch=bid)
                         vals, ids = self._search_fn(queries, live[0].k,
                                                     live[0].group)
+                t_dev1 = time.monotonic()
+                for p in live:
+                    if p.ctx is not None:
+                        p.ctx.mark("batch_wait", t_dev0 - t_formed)
+                        p.ctx.mark("device", t_dev1 - t_dev0)
+                        p.ctx.mark_device_end(t_dev1)
             except BaseException as e:  # noqa: BLE001 — deliver
                 for p in live:
                     p.future.set_exception(e)
                 return
+            retry_delta = self._retry_count() - pre_retries
+            if retry_delta:
+                for p in live:
+                    if p.ctx is not None:
+                        p.ctx.note("dispatch_retry", n=retry_delta)
             if (pre_rc is not None
                     and watch.recompile_count > pre_rc):
                 obs.instant("recompile_in_batch", batch=bid,
                             queries=len(queries))
+                for p in live:
+                    if p.ctx is not None:
+                        p.ctx.note("recompile_in_batch")
             if self._metrics is not None:
                 self._metrics.observe_batch(len(queries),
                                             _pow2(len(queries)))
@@ -377,6 +422,14 @@ class MicroBatcher:
                         queries=[p.queries[b] for b in bad]))
                 else:
                     p.future.set_result((vals[lo:hi], ids[lo:hi]))
+
+    def _retry_count(self):
+        """Current ``serve_dispatch_retries_total`` (0 without metrics
+        or before the first retry created the counter)."""
+        if self._metrics is None:
+            return 0
+        inst = self._metrics.registry.get("serve_dispatch_retries_total")
+        return inst.value if inst is not None else 0
 
     # --- shutdown ---
     def close(self, drain: bool = True) -> None:

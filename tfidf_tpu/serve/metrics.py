@@ -35,6 +35,9 @@ _COUNTERS = {
                       "requests shed on an expired deadline"),
     "cache_hits": ("serve_cache_hits_total", "result-cache hits"),
     "cache_misses": ("serve_cache_misses_total", "result-cache misses"),
+    "slow_queries": ("serve_slow_queries_total",
+                     "requests over the slow-query threshold "
+                     "(TFIDF_TPU_SLOW_MS)"),
 }
 
 
@@ -61,9 +64,14 @@ class ServeMetrics:
             "sum of per-batch occupancy (real/padded)")
         self._queue = self.registry.gauge(
             "serve_queue_depth", "admitted, unresolved queries")
+        # exemplars=True: each latency bucket retains the LAST request
+        # id that landed in it, exposed as OpenMetrics exemplars on
+        # the Prometheus buckets and in the JSON snapshot — the link
+        # from "p99 got worse" to one replayable trace (round 16).
         self._latency = self.registry.histogram(
             "serve_request_latency_seconds",
-            "request latency, submit to resolution")
+            "request latency, submit to resolution",
+            exemplars=True)
 
     # Kept for callers that poke the histogram directly (the round-9
     # attribute name); the instrument's inner LatencyHistogram.
@@ -78,10 +86,11 @@ class ServeMetrics:
             self._counters[name] = c
         c.inc(n)
 
-    def observe_request(self, seconds: float, queries: int) -> None:
+    def observe_request(self, seconds: float, queries: int,
+                        rid: Optional[str] = None) -> None:
         self._counters["requests"].inc()
         self._counters["queries"].inc(queries)
-        self._latency.observe(seconds)
+        self._latency.observe(seconds, exemplar=rid)
 
     def observe_batch(self, real_queries: int, padded: int) -> None:
         self._counters["batches"].inc()
@@ -123,6 +132,7 @@ class ServeMetrics:
             "queue": {"depth": self._queue.value,
                       "peak": self._queue.peak},
             "latency_s": self._latency.snapshot_value(),
+            "slow_queries": c["slow_queries"],
         }
         if reset_peaks:
             self._queue.reset_peak()

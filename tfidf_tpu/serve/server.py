@@ -43,6 +43,7 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import json
+import os
 import threading
 import time
 from concurrent.futures import Future
@@ -55,7 +56,9 @@ from tfidf_tpu.config import ServeConfig
 from tfidf_tpu.models.retrieval import TfidfRetriever
 from tfidf_tpu.obs import devmon as obs_devmon
 from tfidf_tpu.obs import log as obs_log
+from tfidf_tpu.obs import reqtrace
 from tfidf_tpu.obs.health import HealthMonitor, HealthThresholds
+from tfidf_tpu.obs.slo import SloTracker
 from tfidf_tpu.serve.batcher import (DeadlineExceeded, MicroBatcher,
                                      Overloaded, PoisonQuery,
                                      ServeError, ServerClosed)
@@ -157,6 +160,20 @@ class TfidfServer:
         self.health.add_signal("circuit_breaker",
                                self.breaker.health_signal)
         self.quarantine = QuarantineList(registry=self.metrics.registry)
+        # Per-request forensics (round 16): slow-query threshold and
+        # 1-in-N tail sample (obs/reqtrace.py), and the SLO burn
+        # tracker (obs/slo.py) whose fast-burn signal degrades
+        # admission exactly like memory pressure does — a server
+        # blowing its latency objective sheds at the gate.
+        self._slow_ms = self.config.slow_ms
+        self._slow_sample = self.config.slow_sample
+        self.slo: Optional[SloTracker] = None
+        if self.config.slo_ms is not None:
+            self.slo = SloTracker(
+                objective_ms=self.config.slo_ms,
+                target=self.config.slo_target,
+                registry=self.metrics.registry)
+            self.health.add_signal("slo_burn", self.slo.health_signal)
         self._dispatcher = SupervisedDispatch(
             self._run_batch,
             RetryPolicy(max_attempts=1 + self.config.dispatch_retries,
@@ -201,16 +218,28 @@ class TfidfServer:
         :class:`DeadlineExceeded` when the deadline expires first.
         ``use_cache=False`` bypasses the result cache on both probe
         and fill — the canary prober's lever: its parity check must
-        exercise the device path, not a memoized row."""
+        exercise the device path, not a memoized row.
+
+        The returned Future carries the request id as ``.rid`` (None
+        with ``TFIDF_TPU_REQTRACE=off``) — the key that joins the
+        JSONL response, the request's spans, its flight digest and
+        any ``slow_query`` event (round 16)."""
         t0 = time.monotonic()
         queries = list(queries)
         n = len(queries)
+        # Request identity (round 16): minted at admission, carried on
+        # the request through batcher -> cache -> supervisor -> device
+        # dispatch -> drain, stamped on every span it touches.
+        ctx = reqtrace.start(n, k)
+        rid = ctx.rid if ctx is not None else None
         # The request lifecycle span: begun on the submitting thread,
         # ended (cross-thread) wherever the request resolves, with the
         # outcome as an arg — every submitted request appears exactly
         # once in a trace as drained / cache_hit / shed_* / error
         # (pinned by tests/test_obs.py).
-        req = obs.begin("request", queries=n, k=k)
+        req = (obs.begin("request", queries=n, k=k, rid=rid)
+               if rid is not None else
+               obs.begin("request", queries=n, k=k))
         if deadline_ms is None:
             deadline_ms = self.config.default_deadline_ms
         deadline = None if deadline_ms is None else t0 + deadline_ms / 1e3
@@ -228,10 +257,13 @@ class TfidfServer:
             if bad:
                 self.metrics.count("poisoned")
                 obs.end(req, outcome="poisoned")
-                self._digest(t0, n, k, "poisoned")
-                raise PoisonQuery(
+                self._resolve_forensics(ctx, "poisoned")
+                self._digest(t0, n, k, "poisoned", rid=rid)
+                err = PoisonQuery(
                     f"{len(bad)} of {n} queries are quarantined as "
                     f"poison", queries=bad)
+                err.rid = rid
+                raise err
         bound = self.health.admission_bound(self.config.queue_depth)
         with self._lock:
             if self._closed:
@@ -240,30 +272,41 @@ class TfidfServer:
             if self._inflight + n > bound:
                 self.metrics.count("shed_overload")
                 obs.end(req, outcome="shed_overload")
-                self._digest(t0, n, k, "shed_overload")
-                raise Overloaded(
+                self._resolve_forensics(ctx, "shed_overload")
+                self._digest(t0, n, k, "shed_overload", rid=rid)
+                err = Overloaded(
                     f"{self._inflight} queries in flight + {n} exceeds "
                     f"admission bound {bound} (configured queue_depth="
                     f"{self.config.queue_depth})")
+                err.rid = rid
+                raise err
             self._inflight += n
             self.metrics.set_queue_depth(self._inflight)
             retriever, epoch = self._retriever, self._epoch
         cfg = retriever.config
+        if ctx is not None:
+            ctx.epoch = epoch
 
         out: Future = Future()
+        out.rid = rid
         if n == 0:
             width = min(k, retriever._num_docs)
             out.set_result((np.zeros((0, width), np.float32),
                             np.zeros((0, width), np.int64)))
-            self.metrics.observe_request(time.monotonic() - t0, 0)
+            self.metrics.observe_request(time.monotonic() - t0, 0,
+                                         rid=rid)
             obs.end(req, outcome="empty")
+            self._resolve_forensics(ctx, "empty")
             return out
 
         if use_cache:
+            t_cache = time.monotonic()
             keys = [self._cache.key(normalize_query(q, cfg), k, epoch)
                     for q in queries]
             rows = [self._cache.get(key) for key in keys]
             hits = sum(r is not None for r in rows)
+            if ctx is not None:
+                ctx.mark("cache", time.monotonic() - t_cache)
             self.metrics.count("cache_hits", hits)
             self.metrics.count("cache_misses", n - hits)
         else:  # canary probes neither read nor skew the cache
@@ -273,10 +316,14 @@ class TfidfServer:
         def resolve(vals: np.ndarray, ids: np.ndarray,
                     outcome: str) -> None:
             self._finish(n)
-            self.metrics.observe_request(time.monotonic() - t0, n)
+            latency = time.monotonic() - t0
+            self.metrics.observe_request(latency, n, rid=rid)
+            if self.slo is not None:
+                self.slo.record(latency)
             obs.end(req, outcome=outcome, cache_hits=hits)
+            self._resolve_forensics(ctx, outcome)
             self._digest(t0, n, k, outcome, epoch=epoch,
-                         cache_hits=hits)
+                         cache_hits=hits, rid=rid)
             out.set_result((vals, ids))
 
         if not miss_pos:
@@ -286,7 +333,7 @@ class TfidfServer:
 
         inner = self._batcher.submit([queries[i] for i in miss_pos], k,
                                      group=(epoch, retriever),
-                                     deadline=deadline)
+                                     deadline=deadline, ctx=ctx)
 
         def on_done(f: Future) -> None:
             err = f.exception()
@@ -310,9 +357,10 @@ class TfidfServer:
                         if isinstance(err, Overloaded)
                         else "error")
                 obs.end(req, outcome=outcome)
+                self._resolve_forensics(ctx, outcome)
                 self._digest(t0, n, k, outcome, epoch=epoch,
                              error=(None if outcome != "error"
-                                    else repr(err)))
+                                    else repr(err)), rid=rid)
                 out.set_exception(err)
                 return
             mvals, mids = f.result()
@@ -501,6 +549,13 @@ class TfidfServer:
         snap["uptime_s"] = round(time.monotonic() - self._t0, 3)
         snap["epoch"] = self._epoch
         snap["fingerprint"] = self.fingerprint()
+        # The SLO snapshot the serve CLI's ``metrics`` op promises:
+        # windowed objective compliance + fast/slow burn rates when an
+        # objective is configured (--slo-ms / TFIDF_TPU_SLO_MS), a
+        # typed "not configured" marker otherwise — the key is always
+        # present (pinned by tests/test_serve.py).
+        snap["slo"] = (self.slo.snapshot() if self.slo is not None
+                       else {"configured": False})
         return snap
 
     def metrics_prom(self) -> str:
@@ -508,6 +563,30 @@ class TfidfServer:
         latency histogram buckets included) — the ``metrics_prom``
         JSONL op and anything scraping a long-running server."""
         return self.metrics.render_prom()
+
+    def obs_export(self) -> dict:
+        """The cross-process federation bundle (``obs_export`` JSONL
+        op): a versioned snapshot of this process's observability
+        state — full registry instrument state (histogram buckets +
+        exemplars, so :meth:`~tfidf_tpu.obs.registry.MetricsRegistry.
+        merge` works losslessly on the receiving side), the recent
+        flight-event tail and request digests, plus identity. This is
+        what ``tools/obs_agg.py`` polls from N replicas and renders as
+        one merged Prometheus/JSON view — the front-of-replicas
+        aggregation of ROADMAP item 3, shipped ahead of the front."""
+        if self.slo is not None:
+            self.slo.snapshot()   # refresh the slo gauges pre-export
+        log = obs_log.get_log()
+        return {
+            "schema": "tfidf-obs/1",
+            "pid": os.getpid(),
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+            "epoch": self._epoch,
+            "fingerprint": self.fingerprint(),
+            "registry": self.metrics.registry.export_state(),
+            "flight_tail": log.events()[-64:],
+            "digest_tail": log.digests()[-32:],
+        }
 
     def close(self, drain: bool = True) -> None:
         """Stop admitting; ``drain=True`` serves the queued backlog
@@ -546,15 +625,30 @@ class TfidfServer:
             self._inflight -= n
             self.metrics.set_queue_depth(self._inflight)
 
+    def _resolve_forensics(self, ctx, outcome: str) -> None:
+        """Close one request's forensic record (obs/reqtrace.py): the
+        phase breakdown resolves, and a request over the slow-query
+        threshold (or the 1-in-N tail sample) emits its ``slow_query``
+        flight event and bumps ``serve_slow_queries_total``."""
+        tag = reqtrace.finish(ctx, outcome, slow_ms=self._slow_ms,
+                              sample_every=self._slow_sample)
+        if tag == "slow":
+            self.metrics.count("slow_queries")
+
     def _digest(self, t0: float, n: int, k: int, outcome: str,
                 epoch: Optional[int] = None,
                 cache_hits: Optional[int] = None,
-                error: Optional[str] = None) -> None:
+                error: Optional[str] = None,
+                rid: Optional[str] = None) -> None:
         """One request digest into the flight recorder's last-N ring —
         sizes, outcome and latency, never query text (the dump may
-        leave the machine). Cheap enough to record unconditionally."""
+        leave the machine). Cheap enough to record unconditionally.
+        ``rid`` joins the digest to the request's spans and its JSONL
+        response (round 16)."""
         rec = {"outcome": outcome, "queries": n, "k": k,
                "ms": round((time.monotonic() - t0) * 1e3, 3)}
+        if rid is not None:
+            rec["rid"] = rid
         if epoch is not None:
             rec["epoch"] = epoch
         if cache_hits:

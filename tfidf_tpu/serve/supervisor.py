@@ -290,13 +290,16 @@ class SupervisedDispatch:
 
     # --- one dispatch with retry ---
     def run(self, queries: Sequence, k: int, group,
-            batch_id: Optional[int] = None) -> Tuple[np.ndarray,
-                                                     np.ndarray]:
+            batch_id: Optional[int] = None,
+            rids: Optional[Sequence[str]] = None
+            ) -> Tuple[np.ndarray, np.ndarray]:
         """Dispatch with bounded retry on transient failures; raises
         the final error when the budget is exhausted or the failure is
         not retryable. The ``device_dispatch`` fault seam fires inside
         each attempt, so injected transients exercise this exact
-        loop."""
+        loop. ``rids`` (the batch's request ids, round 16) stamp the
+        ``dispatch_retry`` spans and flight events so a retry's
+        backoff is attributable to the requests that paid it."""
         attempt = 0
         text = _match_text(queries)
         while True:
@@ -325,15 +328,16 @@ class SupervisedDispatch:
                     self._rng)
                 if self._metrics is not None:
                     self._metrics.count("dispatch_retries")
+                extra = {"rids": list(rids)} if rids else {}
                 obs_log.log_event(
                     "warning", "dispatch_retry",
                     msg=f"dispatch attempt {attempt} failed "
                         f"({type(e).__name__}); retrying in "
                         f"{delay * 1e3:.1f} ms",
                     attempt=attempt, batch=batch_id,
-                    error=type(e).__name__)
+                    error=type(e).__name__, **extra)
                 with obs.span("dispatch_retry", attempt=attempt,
-                              batch=batch_id):
+                              batch=batch_id, **extra):
                     time.sleep(delay)
                 continue
             if self.breaker is not None:
@@ -342,7 +346,8 @@ class SupervisedDispatch:
 
     # --- batch-level: retry then bisect ---
     def run_batch(self, queries: Sequence, k: int, group,
-                  batch_id: Optional[int] = None
+                  batch_id: Optional[int] = None,
+                  rids: Optional[Sequence[str]] = None
                   ) -> Tuple[Optional[np.ndarray],
                              Optional[np.ndarray], List[int]]:
         """Dispatch the whole batch; on persistent failure, bisect to
@@ -359,7 +364,8 @@ class SupervisedDispatch:
         quarantining innocent queries. Raises too when the full batch
         fails but no subset does (a non-separable failure)."""
         try:
-            vals, ids = self.run(queries, k, group, batch_id)
+            vals, ids = self.run(queries, k, group, batch_id,
+                                 rids=rids)
             return np.asarray(vals), np.asarray(ids), []
         except BaseException as root:  # noqa: BLE001 — bisect below
             if self._retryable(root):
@@ -371,14 +377,15 @@ class SupervisedDispatch:
             poison: List[int] = []
             mid = len(queries) // 2
             self._bisect(list(range(mid)), queries, k, group,
-                         batch_id, results, poison)
+                         batch_id, results, poison, rids)
             self._bisect(list(range(mid, len(queries))), queries, k,
-                         group, batch_id, results, poison)
+                         group, batch_id, results, poison, rids)
             if not poison:
                 # Every subset passed but the whole batch failed — a
                 # batch-shape-dependent fault, not a poison query.
                 # One last full try; its error is the batch's error.
-                vals, ids = self.run(queries, k, group, batch_id)
+                vals, ids = self.run(queries, k, group, batch_id,
+                                     rids=rids)
                 return np.asarray(vals), np.asarray(ids), []
             self._log_poison(poison, batch_id, root)
             if len(results) == 0:
@@ -393,12 +400,13 @@ class SupervisedDispatch:
             return vals, ids, sorted(poison)
 
     def _bisect(self, idxs: List[int], queries, k, group, batch_id,
-                results: dict, poison: List[int]) -> None:
+                results: dict, poison: List[int],
+                rids: Optional[Sequence[str]] = None) -> None:
         if not idxs:
             return
         sub = [queries[i] for i in idxs]
         try:
-            vals, ids = self.run(sub, k, group, batch_id)
+            vals, ids = self.run(sub, k, group, batch_id, rids=rids)
         except BaseException as e:  # noqa: BLE001 — recurse or isolate
             if self._retryable(e):
                 raise   # a transient storm mid-bisect aborts cleanly
@@ -407,9 +415,9 @@ class SupervisedDispatch:
                 return
             mid = len(idxs) // 2
             self._bisect(idxs[:mid], queries, k, group, batch_id,
-                         results, poison)
+                         results, poison, rids)
             self._bisect(idxs[mid:], queries, k, group, batch_id,
-                         results, poison)
+                         results, poison, rids)
             return
         vals, ids = np.asarray(vals), np.asarray(ids)
         for j, i in enumerate(idxs):

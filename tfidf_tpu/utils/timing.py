@@ -109,12 +109,15 @@ class LatencyHistogram:
     """
 
     def __init__(self, lo: float = 1e-6, hi: float = 1e3,
-                 resolution: float = 0.02) -> None:
+                 resolution: float = 0.02,
+                 exemplars: bool = False) -> None:
         if not (0 < lo < hi):
             raise ValueError("need 0 < lo < hi")
         if resolution <= 0:
             raise ValueError("resolution must be positive")
         self._lo = lo
+        self._hi = hi
+        self._resolution = resolution
         self._log_step = math.log1p(resolution)
         n = int(math.ceil(math.log(hi / lo) / self._log_step)) + 1
         self._counts = [0] * (n + 1)  # +1: underflow bucket at index 0
@@ -122,8 +125,16 @@ class LatencyHistogram:
         self._sum = 0.0
         self._min = math.inf
         self._max = -math.inf
+        # Exemplars (round 16): the LAST request id to land in each
+        # bucket, kept as {bucket_idx: (exemplar, seconds)} — O(live
+        # buckets) memory, and what links "p99 got worse" to one
+        # replayable trace (OpenMetrics exemplar exposition in
+        # obs/registry.py). None = feature off (zero cost).
+        self._exemplars: Optional[Dict[int, Tuple[str, float]]] = (
+            {} if exemplars else None)
 
-    def record(self, seconds: float) -> None:
+    def record(self, seconds: float,
+               exemplar: Optional[str] = None) -> None:
         if seconds < 0:
             seconds = 0.0
         self._count += 1
@@ -136,6 +147,8 @@ class LatencyHistogram:
             idx = 1 + int(math.log(seconds / self._lo) / self._log_step)
             idx = min(idx, len(self._counts) - 1)
         self._counts[idx] += 1
+        if self._exemplars is not None and exemplar is not None:
+            self._exemplars[idx] = (exemplar, seconds)
 
     @property
     def count(self) -> int:
@@ -224,7 +237,64 @@ class LatencyHistogram:
         self._sum += other._sum
         self._min = min(self._min, other._min)
         self._max = max(self._max, other._max)
+        # Exemplars survive aggregation: the other side's (newer, in
+        # the replica-poll sense) entries win per bucket — one
+        # replayable rid per bucket is the contract, not a history.
+        if self._exemplars is not None and other._exemplars:
+            self._exemplars.update(other._exemplars)
         return self
+
+    def exemplars(self) -> List[Tuple[float, str]]:
+        """``(seconds, rid)`` per live exemplar bucket, ascending by
+        latency — empty when the feature is off."""
+        if not self._exemplars:
+            return []
+        return sorted((secs, rid)
+                      for rid, secs in self._exemplars.values())
+
+    def state_dict(self) -> Dict:
+        """Wire-format state for cross-process aggregation (the
+        ``obs_export`` bundle): geometry + sparse bucket counts +
+        exact count/sum/min/max + exemplars. :meth:`from_state`
+        rebuilds an identical histogram, so ``merge`` federates
+        replicas without sharing memory."""
+        state = {
+            "lo": self._lo, "hi": self._hi,
+            "resolution": self._resolution,
+            "n_buckets": len(self._counts),
+            "counts": {str(i): c for i, c in enumerate(self._counts)
+                       if c},
+            "count": self._count, "sum": self._sum,
+        }
+        if self._count:
+            state["min"] = self._min
+            state["max"] = self._max
+        if self._exemplars:
+            state["exemplars"] = {
+                str(i): [rid, secs]
+                for i, (rid, secs) in self._exemplars.items()}
+        return state
+
+    @classmethod
+    def from_state(cls, state: Dict) -> "LatencyHistogram":
+        h = cls(lo=state["lo"], hi=state["hi"],
+                resolution=state["resolution"],
+                exemplars="exemplars" in state)
+        if len(h._counts) != state["n_buckets"]:
+            raise ValueError(
+                f"histogram state geometry mismatch: rebuilt "
+                f"{len(h._counts)} buckets, state carries "
+                f"{state['n_buckets']}")
+        for i, c in state.get("counts", {}).items():
+            h._counts[int(i)] = int(c)
+        h._count = int(state["count"])
+        h._sum = float(state["sum"])
+        if h._count:
+            h._min = float(state["min"])
+            h._max = float(state["max"])
+        for i, (rid, secs) in state.get("exemplars", {}).items():
+            h._exemplars[int(i)] = (rid, float(secs))
+        return h
 
     def as_dict(self, ndigits: int = 6) -> Dict[str, float]:
         """JSON-artifact form: count/mean/min/max plus p50/p95/p99."""
@@ -244,6 +314,8 @@ class LatencyHistogram:
         self._sum = 0.0
         self._min = math.inf
         self._max = -math.inf
+        if self._exemplars is not None:
+            self._exemplars.clear()
 
 
 class _TimedSpan:

@@ -58,6 +58,10 @@ FLIGHT_EVENTS = {
     # device truth (round 12)
     "hbm_watermark", "hbm_watermark_clear", "hbm_census",
     "devmon_error", "xla_recompile", "xla_compile", "compile_warm",
+    # per-request forensics (round 16): the slow-query log — emitted
+    # at request resolution with the phase breakdown + rid, consumed
+    # by tools/doctor.py --request
+    "slow_query",
     # serving lifecycle + self-watching (round 11)
     "index_swap", "index_snapshot", "index_restored",
     "health_state_change", "canary_parity_failure",
@@ -87,6 +91,9 @@ ENV_CLI_FLAGS = {
     "TFIDF_TPU_SNAPSHOT_DIR": "--snapshot-dir",
     "TFIDF_TPU_FAULTS": "--faults",
     "TFIDF_TPU_FAULT_SEED": "--fault-seed",
+    "TFIDF_TPU_SLOW_MS": "--slow-ms",
+    "TFIDF_TPU_SLO_MS": "--slo-ms",
+    "TFIDF_TPU_SLO_TARGET": "--slo-target",
 }
 
 #: Shared attributes the T001 thread lint tolerates without a lock,

@@ -149,6 +149,19 @@ def analyze_trace(path: str) -> dict:
             "outcomes": dict(outcomes),
             "batches": phases.get("batched", {}).get("spans", 0),
         }
+        # The slowest requests by span duration, rid-joined (round
+        # 16): the default report's entry point into per-request
+        # forensics — feed any rid to ``--request`` for the full
+        # causal timeline.
+        req_spans = sorted(
+            (e for e in xs if e["name"] == "request"),
+            key=lambda e: -e.get("dur", 0.0))[:5]
+        out["slowest_requests"] = [
+            {"rid": (e.get("args") or {}).get("rid"),
+             "ms": round(e.get("dur", 0.0) / 1e3, 3),
+             "outcome": (e.get("args") or {}).get("outcome"),
+             "queries": (e.get("args") or {}).get("queries")}
+            for e in req_spans]
     out["recompile_instants"] = sum(
         1 for e in events
         if e.get("ph") == "i" and e.get("name") == "recompile_in_batch")
@@ -209,6 +222,85 @@ def analyze_flight(path: str) -> dict:
         out["digest_outcomes"] = dict(Counter(
             d.get("outcome") for d in digests))
     return out
+
+
+def _span_has_rid(e: dict, rid: str) -> bool:
+    a = e.get("args") or {}
+    return a.get("rid") == rid or rid in (a.get("rids") or ())
+
+
+def request_timeline(trace: str, flight: Optional[str],
+                     rid: str) -> Optional[dict]:
+    """The full causal timeline of ONE request (round 16): every span
+    stamped with its rid (directly, or via a batch's ``rids`` list),
+    time-ordered with lane labels, plus the flight events and digests
+    carrying the same key — trace, flight and response joined on the
+    one id the serve layer minted at admission. None when the rid
+    appears nowhere."""
+    events = _tracer.load_chrome_trace(trace)
+    lane_names: Dict[tuple, str] = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            lane_names[(e.get("pid"), e.get("tid"))] = \
+                e.get("args", {}).get("name", "")
+    spans = [e for e in events if e.get("ph") == "X"
+             and _span_has_rid(e, rid)]
+    spans.sort(key=lambda e: e.get("ts", 0.0))
+    flight_events: List[dict] = []
+    digests: List[dict] = []
+    if flight and os.path.exists(flight):
+        _header, fevents, fdigests = load_flight(flight)
+        flight_events = [e for e in fevents
+                         if e.get("rid") == rid
+                         or rid in (e.get("rids") or ())]
+        digests = [d for d in fdigests if d.get("rid") == rid]
+    if not spans and not flight_events and not digests:
+        return None
+    t_base = spans[0]["ts"] if spans else 0.0
+    rows = []
+    for e in spans:
+        lane = lane_names.get((e.get("pid"), e.get("tid")),
+                              f"{e.get('pid')}/{e.get('tid')}")
+        args = dict(e.get("args") or {})
+        args.pop("rids", None)   # batch-mate list: noise in one
+        rows.append({                            # request's view
+            "span": e["name"], "lane": lane,
+            "at_ms": round((e["ts"] - t_base) / 1e3, 3),
+            "dur_ms": round(e.get("dur", 0.0) / 1e3, 3),
+            "args": args})
+    slow = [e for e in flight_events if e.get("event") == "slow_query"]
+    return {
+        "rid": rid,
+        "spans": rows,
+        "flight_events": [
+            {k: v for k, v in e.items() if k not in ("kind",)}
+            for e in flight_events],
+        "digests": digests,
+        "breakdown": (slow[-1].get("breakdown") if slow else None),
+    }
+
+
+def render_request(rep: dict) -> str:
+    lines = [f"request {rep['rid']}: {len(rep['spans'])} span(s), "
+             f"{len(rep['flight_events'])} flight event(s), "
+             f"{len(rep['digests'])} digest(s)"]
+    if rep["spans"]:
+        lines.append(f"  {'at ms':>9} {'dur ms':>9} {'lane':<10} "
+                     f"{'span':<16} args")
+        for r in rep["spans"]:
+            lines.append(
+                f"  {r['at_ms']:>9.3f} {r['dur_ms']:>9.3f} "
+                f"{r['lane']:<10} {r['span']:<16} {r['args']}")
+    if rep["breakdown"]:
+        parts = ", ".join(f"{k}={v}" for k, v in
+                          rep["breakdown"].items())
+        lines.append(f"  breakdown (ms): {parts}")
+    for e in rep["flight_events"]:
+        lines.append(f"  flight [{e.get('level')}] {e.get('event')}: "
+                     f"{e.get('msg', '')}")
+    for d in rep["digests"]:
+        lines.append(f"  digest: {d}")
+    return "\n".join(lines)
 
 
 def tail_ledger(path: str, n: int = 5) -> List[dict]:
@@ -290,6 +382,13 @@ def render(report: dict) -> str:
         lines.append(f"  serve: {sv['requests']} requests in "
                      f"{sv['batches']} batches, outcomes "
                      f"{sv['outcomes']}")
+    if report.get("slowest_requests"):
+        lines.append("  slowest requests (doctor --request RID for "
+                     "the timeline):")
+        for r in report["slowest_requests"]:
+            lines.append(
+                f"    {r['ms']:>9.1f} ms  {(r['rid'] or '-'):<20} "
+                f"{r['outcome']} ({r['queries']} queries)")
     fl = report.get("flight")
     if fl:
         lines.append(f"  flight: {fl['events']} events, "
@@ -356,6 +455,14 @@ def main() -> int:
                     metavar="PHASE=SECONDS",
                     help="per-phase wall budget, repeatable "
                          "(e.g. --budget pack=0.5)")
+    ap.add_argument("--request", metavar="RID", default=None,
+                    help="render ONE request's full causal timeline "
+                         "(every span carrying this rid directly or "
+                         "via its batch, plus matching flight events "
+                         "and digests) instead of the aggregate "
+                         "report — the rid comes from a JSONL "
+                         "response, a slow_query event, or the "
+                         "slowest-requests table")
     ap.add_argument("--json", action="store_true",
                     help="print the machine-readable report")
     args = ap.parse_args()
@@ -373,6 +480,22 @@ def main() -> int:
     if flight is None:
         candidate = f"{args.trace}.flight.jsonl"
         flight = candidate if os.path.exists(candidate) else None
+
+    if args.request:
+        try:
+            rep = request_timeline(args.trace, flight, args.request)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"doctor: cannot read inputs: {e}", file=sys.stderr)
+            return 2
+        if rep is None:
+            print(f"doctor: rid {args.request!r} appears in neither "
+                  f"the trace nor the flight dump", file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(rep, sort_keys=True))
+        else:
+            print(render_request(rep))
+        return 0
 
     try:
         report = diagnose(args.trace, flight, args.ledger,

@@ -1,0 +1,270 @@
+"""Cross-process obs federation: merge N serve processes into one view.
+
+One ``TfidfServer`` renders its own metrics; a replicated tier
+(ROADMAP item 3) needs the FRONT's view — one Prometheus page whose
+counters are fleet totals and whose latency histogram is the merged
+distribution. ``MetricsRegistry.merge`` (round 11) was built for
+exactly this; this tool is the transport: it polls each serve
+process's ``{"op": "obs_export"}`` JSONL op (a versioned bundle of
+full instrument state — histogram buckets AND exemplars, so the merge
+is lossless — plus the flight tail), rebuilds a registry per process
+via ``MetricsRegistry.import_state``, merges them, and renders:
+
+* the MERGED Prometheus exposition (counters add, gauges sum,
+  histogram buckets add elementwise; request-id exemplars survive the
+  merge, so a fleet p99 still links to one replayable trace);
+* per-process labeled samples (``serve_requests_total{process="..."}``
+  — which replica is hot, which is shedding);
+* or ``--json``: the merged snapshot + per-process metadata.
+
+Usage::
+
+    python tools/obs_agg.py --endpoints 127.0.0.1:9101,127.0.0.1:9102
+    python tools/obs_agg.py --endpoints ... --period 15   # poll loop
+    python tools/obs_agg.py --bundles a.json b.json       # offline
+
+Pure stdlib when the package is not already loaded — the registry and
+histogram modules are loaded standalone (the doctor/trace_check
+pattern), so this runs in a bare CI interpreter with no jax at all.
+Exit 0 = rendered, 1 = some endpoint unreachable (partial render
+still printed when at least one answered), 2 = nothing usable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import socket
+import sys
+import time
+import types
+from typing import Dict, List, Optional, Tuple
+
+import _common  # noqa: E402,F401  repo-root sys.path bootstrap
+
+OBS_SCHEMA = "tfidf-obs/1"
+
+_REG_MOD = None   # cached standalone load (None until first use)
+
+
+def _load_registry_module():
+    """The shared registry/merge logic lives in
+    ``tfidf_tpu/obs/registry.py``; importing it THROUGH the package
+    would pull in jax. When the package is already imported (in-
+    process tests) use it; otherwise load the two stdlib-only modules
+    standalone with a transient package shim so registry's
+    ``from tfidf_tpu.utils.timing import LatencyHistogram``
+    resolves."""
+    global _REG_MOD
+    if "tfidf_tpu" in sys.modules:
+        from tfidf_tpu.obs import registry
+        return registry
+    if _REG_MOD is not None:
+        return _REG_MOD
+
+    def load(rel: str, name: str):
+        spec = importlib.util.spec_from_file_location(
+            name, os.path.join(_common.REPO, rel))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+        return mod
+
+    loaded = []
+    try:
+        timing = load("tfidf_tpu/utils/timing.py",
+                      "tfidf_tpu.utils.timing")
+        loaded.append("tfidf_tpu.utils.timing")
+        for name in ("tfidf_tpu", "tfidf_tpu.utils"):
+            if name not in sys.modules:
+                mod = types.ModuleType(name)
+                mod.__path__ = []  # mark as package
+                sys.modules[name] = mod
+                loaded.append(name)
+        sys.modules["tfidf_tpu.utils"].timing = timing
+        registry = load("tfidf_tpu/obs/registry.py",
+                        "tfidf_tpu.obs.registry")
+        loaded.append("tfidf_tpu.obs.registry")
+    finally:
+        # The shims exist only to satisfy registry's import line —
+        # drop every transient entry so a LATER real
+        # `import tfidf_tpu` in the same process is unaffected.
+        for name in loaded:
+            sys.modules.pop(name, None)
+    _REG_MOD = registry
+    return registry
+
+
+def fetch_bundle(host: str, port: int,
+                 timeout_s: float = 5.0) -> dict:
+    """One ``{"op": "obs_export"}`` round-trip over the serve TCP
+    JSONL protocol."""
+    with socket.create_connection((host, port),
+                                  timeout=timeout_s) as sock:
+        sock.sendall(b'{"op": "obs_export"}\n')
+        buf = b""
+        sock.settimeout(timeout_s)
+        while not buf.endswith(b"\n"):
+            chunk = sock.recv(1 << 16)
+            if not chunk:
+                break
+            buf += chunk
+    resp = json.loads(buf.decode())
+    if "obs_export" not in resp:
+        raise ValueError(f"endpoint answered without obs_export: "
+                         f"{list(resp)}")
+    return resp["obs_export"]
+
+
+def validate_bundle(bundle: dict, label: str) -> None:
+    if bundle.get("schema") != OBS_SCHEMA:
+        raise ValueError(
+            f"{label}: bundle schema {bundle.get('schema')!r} != "
+            f"{OBS_SCHEMA!r} — mixed versions cannot merge safely")
+    if not isinstance(bundle.get("registry"), dict):
+        raise ValueError(f"{label}: bundle carries no registry state")
+
+
+def merge_bundles(bundles: Dict[str, dict]):
+    """label -> bundle mapping -> (merged registry, per-process
+    registries). Counters add, gauges sum, histograms merge bucket-
+    wise with exemplars surviving."""
+    reg_mod = _load_registry_module()
+    per = {label: reg_mod.MetricsRegistry.import_state(b["registry"])
+           for label, b in bundles.items()}
+    merged = reg_mod.MetricsRegistry()
+    for reg in per.values():
+        merged.merge(reg)
+    return merged, per
+
+
+def _esc(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"')
+
+
+def render_prom(merged, per: Dict, bundles: Dict[str, dict]) -> str:
+    """Merged exposition + per-process labeled samples. The merged
+    half is the fleet view (histogram counts are the SUM of the
+    per-process snapshots — pinned by tests); the labeled half says
+    which replica contributed what."""
+    lines = [f"# obs_agg: {len(per)} process(es) merged",
+             f"obs_agg_processes {len(per)}"]
+    lines.append(merged.render_prom().rstrip("\n"))
+    for label, reg in sorted(per.items()):
+        bundle = bundles[label]
+        plabel = f'process="{_esc(label)}"'
+        lines.append(f"# process {label}: pid={bundle.get('pid')} "
+                     f"epoch={bundle.get('epoch')} "
+                     f"uptime_s={bundle.get('uptime_s')}")
+        snap = reg.snapshot()
+        for name, value in sorted(snap.items()):
+            if isinstance(value, (int, float)):
+                lines.append(f"{name}{{{plabel}}} {value}")
+            elif isinstance(value, dict) and "value" in value:
+                lines.append(f"{name}{{{plabel}}} {value['value']}")
+            elif isinstance(value, dict) and "count" in value:
+                lines.append(f"{name}_count{{{plabel}}} "
+                             f"{value['count']}")
+    return "\n".join(lines) + "\n"
+
+
+def render_json(merged, per: Dict, bundles: Dict[str, dict]) -> str:
+    doc = {
+        "schema": OBS_SCHEMA,
+        "processes": {
+            label: {"pid": b.get("pid"), "epoch": b.get("epoch"),
+                    "uptime_s": b.get("uptime_s"),
+                    "fingerprint": b.get("fingerprint"),
+                    "registry": per[label].snapshot(),
+                    "flight_events": len(b.get("flight_tail", []))}
+            for label, b in bundles.items()},
+        "merged": merged.snapshot(),
+    }
+    return json.dumps(doc, sort_keys=True)
+
+
+def collect(endpoints: List[Tuple[str, int]],
+            bundle_paths: List[str]) -> Tuple[Dict[str, dict],
+                                              List[str]]:
+    """-> (label -> validated bundle, per-source error strings)."""
+    bundles: Dict[str, dict] = {}
+    errors: List[str] = []
+    for host, port in endpoints:
+        label = f"{host}:{port}"
+        try:
+            b = fetch_bundle(host, port)
+            validate_bundle(b, label)
+            bundles[label] = b
+        except (OSError, ValueError) as e:
+            errors.append(f"{label}: {e}")
+    for path in bundle_paths:
+        label = os.path.basename(path)
+        try:
+            with open(path) as f:
+                b = json.load(f)
+            b = b.get("obs_export", b)  # raw bundle or full response
+            validate_bundle(b, label)
+            bundles[label] = b
+        except (OSError, ValueError) as e:
+            errors.append(f"{label}: {e}")
+    return bundles, errors
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.split("\n")[0],
+        epilog="exit 0 = rendered, 1 = endpoint errors (partial "
+               "render when possible), 2 = nothing usable")
+    ap.add_argument("--endpoints", default="",
+                    help="comma-separated host:port list of serve "
+                         "--port processes to poll via the "
+                         "obs_export op")
+    ap.add_argument("--bundles", nargs="*", default=[],
+                    help="obs_export bundle JSON files to merge "
+                         "offline (a saved op response or the bare "
+                         "bundle)")
+    ap.add_argument("--period", type=float, default=0.0,
+                    help="poll every N seconds and re-render "
+                         "(0 = once)")
+    ap.add_argument("--json", action="store_true",
+                    help="render merged JSON instead of Prometheus "
+                         "text")
+    args = ap.parse_args()
+
+    endpoints: List[Tuple[str, int]] = []
+    for spec in (s.strip() for s in args.endpoints.split(",")):
+        if not spec:
+            continue
+        host, _, port = spec.rpartition(":")
+        try:
+            endpoints.append((host or "127.0.0.1", int(port)))
+        except ValueError:
+            print(f"obs_agg: bad endpoint {spec!r} (want host:port)",
+                  file=sys.stderr)
+            return 2
+    if not endpoints and not args.bundles:
+        print("obs_agg: nothing to aggregate (pass --endpoints or "
+              "--bundles)", file=sys.stderr)
+        return 2
+
+    while True:
+        bundles, errors = collect(endpoints, args.bundles)
+        for err in errors:
+            print(f"obs_agg: {err}", file=sys.stderr)
+        if not bundles:
+            print("obs_agg: no endpoint answered", file=sys.stderr)
+            return 2
+        merged, per = merge_bundles(bundles)
+        out = (render_json(merged, per, bundles) if args.json
+               else render_prom(merged, per, bundles))
+        sys.stdout.write(out)
+        sys.stdout.flush()
+        if args.period <= 0:
+            return 1 if errors else 0
+        time.sleep(args.period)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -88,6 +88,13 @@ _SERVE_METRICS = {
     "recompiles_after_warmup": "recompiles_after_warmup",
     "peak_hbm_bytes": "peak_hbm_bytes",
     "xla_compiles": "xla_compiles",
+    # Round 16 forensics receipts: windowed SLO compliance (gated
+    # directionally — a PR that quietly blows the latency objective
+    # fails perf_gate), the slow-query count (trend context), and the
+    # measured request-identity overhead (--ab-reqtrace runs).
+    "slo_compliance": "slo.compliance",
+    "slow_queries": "slow_queries",
+    "reqtrace_p50_regression": "reqtrace.p50_regression",
 }
 # Chaos artifacts (serve_bench --chaos): the fault-plan receipts. The
 # gated metric is parity_ok — every non-shed non-poisoned response

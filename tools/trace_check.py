@@ -300,6 +300,28 @@ def _check_serve(by_name, notes) -> List[str]:
     if retries:
         notes.append(f"dispatch retries: {len(retries)} "
                      f"(all nested in batches)")
+    # Round 16 request identity: when the trace carries rids, every
+    # request span's rid is unique (a reused id would alias two
+    # requests' forensics), and every rid-stamped queued span names a
+    # rid some request span owns — the span chain joins on one key.
+    req_rids = [(e.get("args") or {}).get("rid") for e in requests]
+    stamped = [r for r in req_rids if r]
+    if stamped:
+        if len(set(stamped)) != len(stamped):
+            dupes = sorted({r for r in stamped
+                            if stamped.count(r) > 1})
+            errors.append(f"duplicate request ids in trace: {dupes} "
+                          f"— rids must be unique per request")
+        rid_set = set(stamped)
+        for e in by_name.get("queued", []):
+            qrid = (e.get("args") or {}).get("rid")
+            if qrid is not None and qrid not in rid_set:
+                errors.append(
+                    f"queued span carries rid {qrid!r} but no "
+                    f"request span owns it (orphaned stamp)")
+                break
+        notes.append(f"request ids: {len(stamped)}/{len(requests)} "
+                     f"stamped, unique")
     return errors
 
 
