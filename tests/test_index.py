@@ -1,0 +1,760 @@
+"""Segmented index (ISSUE 12): live add/update/delete without
+rebuilding the world.
+
+The load-bearing contract everywhere: under ANY interleaving of
+add/update/delete/seal/compaction/save+restore, a search of the
+segmented index is BIT-IDENTICAL — (score bytes, doc names), tie order
+included — to a from-scratch rebuild of the live corpus at the same
+pinned token length. Plus the serving-side visibility pins: every
+change a query could observe bumps the epoch (no stale cache hit can
+serve a deleted doc), the canary oracle re-captures on every bump, and
+a compactor killed mid-merge via the ``swap`` fault seam leaves the
+index byte-for-byte untouched.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from tfidf_tpu import checkpoint as ckpt
+from tfidf_tpu import faults
+from tfidf_tpu.config import PipelineConfig, ServeConfig, VocabMode
+from tfidf_tpu.index import Compactor, Segment, SegmentedIndex
+from tfidf_tpu.io.corpus import Corpus
+from tfidf_tpu.models import TfidfRetriever
+
+CFG = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=512,
+                     max_doc_len=16, doc_chunk=16)
+DOCS = {
+    "doc1": "apple banana apple cherry",
+    "doc2": "banana banana date",
+    "doc3": "cherry date elder fig",
+    "doc4": "apple fig fig fig",
+    "doc5": "grape grape grape grape",
+}
+QUERIES = ["apple cherry", "banana", "grape date", "fig", "elder",
+           "apple fig", "date banana cherry", "nosuchword"]
+
+
+def corpus_of(docs):
+    return Corpus(names=list(docs), docs=[t.encode()
+                                          for t in docs.values()])
+
+
+def build(docs=DOCS, delta_docs=4, compact_at=2):
+    return SegmentedIndex.from_corpus(corpus_of(docs), CFG,
+                                      delta_docs=delta_docs,
+                                      compact_at=compact_at)
+
+
+def names_of(names, ids):
+    return [[names[i] if i >= 0 else None for i in row] for row in ids]
+
+
+def assert_rebuild_parity(idx, queries=QUERIES, k=3):
+    """Search the segmented view and a FROM-SCRATCH retriever rebuild
+    of the live corpus; (scores, names) must match byte for byte."""
+    view = idx.view()
+    vals, ids = view.search(queries, k)
+    oracle = idx.rebuild_retriever()
+    ovals, oids = oracle.search(queries, k)
+    np.testing.assert_array_equal(vals, ovals)
+    assert names_of(view.names, ids) == names_of(oracle.names, oids)
+
+
+# --- primitives ------------------------------------------------------
+
+def test_host_sorted_counts_matches_device():
+    import jax.numpy as jnp
+
+    from tfidf_tpu.ops.sparse import (sorted_term_counts,
+                                      sorted_term_counts_host)
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 64, size=(7, 12)).astype(np.int32)
+    lens = rng.integers(0, 13, size=(7,)).astype(np.int32)
+    ids_d, counts_d, head_d = sorted_term_counts(jnp.asarray(toks),
+                                                 jnp.asarray(lens))
+    ids_h, counts_h, head_h = sorted_term_counts_host(toks, lens)
+    np.testing.assert_array_equal(np.asarray(ids_d), ids_h)
+    np.testing.assert_array_equal(np.asarray(head_d), head_h)
+    # counts are garbage-by-contract off head slots: compare there only
+    np.testing.assert_array_equal(np.asarray(counts_d)[head_h],
+                                  counts_h[head_h])
+
+
+def test_masked_topk_pins():
+    import jax.numpy as jnp
+
+    from tfidf_tpu.ops.topk import masked_topk, merge_topk
+    scores = jnp.asarray([[0.5, 0.9, 0.9, 0.1]])
+    # all dead: every slot comes back with the sub-zero sentinel
+    vals, _ = masked_topk(scores, jnp.zeros((4,), bool), k=3)
+    assert np.all(np.asarray(vals) < 0)
+    # dead doc cannot displace a live one; ties keep lowest index
+    live = jnp.asarray([True, False, True, True])
+    vals, idx = masked_topk(scores, live, k=3)
+    np.testing.assert_array_equal(np.asarray(idx), [[2, 0, 3]])
+    # merge keeps concat order among equal values (global insertion
+    # order by construction: earlier segments concatenate first)
+    mv = jnp.asarray([[0.9, 0.2, 0.9, 0.9]])
+    mi = jnp.asarray([[3, 9, 11, 12]])
+    vals, idx = merge_topk(mv, mi, k=3)
+    np.testing.assert_array_equal(np.asarray(idx), [[3, 11, 12]])
+
+
+# --- bit-parity vs from-scratch rebuild ------------------------------
+
+def test_initial_build_parity():
+    assert_rebuild_parity(build())
+
+
+def test_parity_vs_natural_retriever_build():
+    # The stronger oracle: a plain TfidfRetriever.index over the same
+    # corpus (its own packing) — byte parity of (scores, names).
+    idx = build()
+    view = idx.view()
+    r = TfidfRetriever(CFG).index(corpus_of(DOCS))
+    vals, ids = view.search(QUERIES, 3)
+    ovals, oids = r.search(QUERIES, 3)
+    np.testing.assert_array_equal(vals, ovals)
+    assert names_of(view.names, ids) == names_of(r.names, oids)
+
+
+def test_add_update_delete_parity():
+    idx = build()
+    idx.add_docs(["doc6", "doc7"], ["grape melon", "melon apple date"])
+    assert_rebuild_parity(idx)
+    idx.add_docs(["doc2"], ["banana melon melon"])     # update
+    assert_rebuild_parity(idx)
+    idx.delete_docs(["doc5", "doc1"])
+    assert_rebuild_parity(idx)
+
+
+def test_property_random_interleavings(tmp_path):
+    """The acceptance property: random mutation streams with seals,
+    threshold compactions and a mid-sequence save/restore, parity
+    held after every visibility change."""
+    rng = np.random.default_rng(7)
+    words = ["apple", "banana", "cherry", "date", "elder", "fig",
+             "grape", "melon", "kiwi", "lime"]
+
+    def synth():
+        n = int(rng.integers(1, 9))
+        return " ".join(words[int(rng.integers(0, len(words)))]
+                        for _ in range(n))
+
+    for seed in (0, 1):
+        rng = np.random.default_rng(seed)
+        idx = build(delta_docs=3, compact_at=2)
+        alive = set(DOCS)
+        next_id = 6
+        for step in range(28):
+            op = int(rng.integers(0, 4))
+            if op == 0 or len(alive) <= 2:          # add
+                name = f"doc{next_id}"
+                next_id += 1
+                idx.add_docs([name], [synth()])
+                alive.add(name)
+            elif op == 1:                           # update in place
+                name = sorted(alive)[int(rng.integers(0, len(alive)))]
+                idx.add_docs([name], [synth()])
+            elif op == 2:                           # delete
+                name = sorted(alive)[int(rng.integers(0, len(alive)))]
+                idx.delete_docs([name])
+                alive.discard(name)
+            else:                                   # compact
+                idx.compact(force=True)
+            if step == 13:                          # crash + restore
+                d = str(tmp_path / f"snap{seed}")
+                idx.save(d, epoch=step)
+                idx, meta = SegmentedIndex.restore(d, CFG)
+                assert meta["epoch"] == 13
+            assert_rebuild_parity(idx)
+        assert idx.num_docs == len(alive)
+
+
+def test_all_deleted_and_width():
+    idx = build(delta_docs=4)
+    view = idx.view()
+    assert view.search(QUERIES[:2], 10)[0].shape == (2, 5)  # min(k, D)
+    idx.delete_docs(list(DOCS))
+    view = idx.view()
+    vals, ids = view.search(QUERIES[:2], 3)
+    assert vals.shape == (2, 0) and ids.shape == (2, 0)
+    assert idx.num_docs == 0
+
+
+def test_tie_order_matches_rebuild():
+    # identical docs => identical scores; the winners must come out in
+    # insertion order on both paths, across segment boundaries
+    docs = {f"t{i}": "same same words" for i in range(7)}
+    docs["x"] = "other content"
+    idx = build(docs, delta_docs=3, compact_at=2)
+    idx.add_docs(["t7", "t8"], ["same same words"] * 2)
+    idx.delete_docs(["t2"])
+    assert_rebuild_parity(idx, ["same words", "other"], k=6)
+    idx.compact(force=True)
+    assert_rebuild_parity(idx, ["same words", "other"], k=6)
+
+
+# --- segment lifecycle ----------------------------------------------
+
+def test_seal_on_full_delta():
+    idx = build(delta_docs=2)
+    assert idx.sealed_count == 1            # the bulk-load base
+    out = idx.add_docs(["a1", "a2", "a3"], ["kiwi", "lime", "melon"])
+    assert out["sealed"] == 1               # 2 filled the delta
+    assert idx.sealed_count == 2
+    assert idx.stats()["delta_used"] == 1
+    assert_rebuild_parity(idx)
+
+
+def test_compaction_drops_tombstones_preserves_order():
+    idx = build(delta_docs=2, compact_at=2)
+    idx.add_docs(["a1", "a2", "a3", "a4"],
+                 ["kiwi", "lime", "melon", "kiwi lime"])
+    idx.delete_docs(["doc2", "a1"])
+    assert idx.needs_compaction
+    before = idx.stats()["tombstones"]
+    assert before >= 2
+    summary = idx.compact()
+    assert summary["dropped_tombstones"] >= 2
+    assert idx.sealed_count == 1
+    assert idx.stats()["tombstones"] == 0
+    assert_rebuild_parity(idx)
+
+
+def test_compact_below_threshold_noop():
+    idx = build(delta_docs=8, compact_at=4)
+    assert idx.compact() is None            # 1 sealed < threshold
+    assert idx.compact(force=True) is None  # force still needs >= 2
+
+
+def test_delete_missing_is_not_a_visibility_change():
+    idx = build()
+    v0 = idx.version
+    out = idx.delete_docs(["nope"])
+    assert out == {"deleted": 0, "missing": 1, "version": v0}
+
+
+# --- persistence -----------------------------------------------------
+
+def test_save_restore_roundtrip(tmp_path):
+    idx = build(delta_docs=3)
+    idx.add_docs(["a1", "a2"], ["kiwi lime", "melon"])
+    idx.delete_docs(["doc3"])
+    d = str(tmp_path / "snap")
+    idx.save(d, epoch=5)
+    idx2, meta = SegmentedIndex.restore(d, CFG)
+    assert meta["epoch"] == 5 and meta["num_docs"] == idx.num_docs
+    v1, i1 = idx.view().search(QUERIES, 3)
+    v2, i2 = idx2.view().search(QUERIES, 3)
+    np.testing.assert_array_equal(v1, v2)
+    assert names_of(idx.view().names, i1) == names_of(
+        idx2.view().names, i2)
+    # tombstones survived: the deleted doc stays deleted
+    assert "doc3" not in [n for row in names_of(idx2.view().names, i2)
+                          for n in row]
+    # ...and mutation continues from the restored state
+    idx2.add_docs(["a3"], ["elder kiwi"])
+    assert_rebuild_parity(idx2)
+
+
+def test_restore_rejects_plain_retriever_snapshot(tmp_path):
+    r = TfidfRetriever(CFG).index(corpus_of(DOCS))
+    d = str(tmp_path / "plain")
+    r.snapshot(d)
+    with pytest.raises(ckpt.SnapshotMismatch):
+        SegmentedIndex.restore(d, CFG)
+
+
+def test_restore_rejects_config_mismatch(tmp_path):
+    idx = build()
+    d = str(tmp_path / "snap")
+    idx.save(d)
+    other = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=256,
+                           max_doc_len=16, doc_chunk=16)
+    with pytest.raises(ckpt.SnapshotMismatch):
+        SegmentedIndex.restore(d, other)
+
+
+def test_segment_validation():
+    with pytest.raises(ValueError):
+        Segment(0, 16, 512)
+    with pytest.raises(ValueError):
+        SegmentedIndex(CFG, delta_docs=0)
+    with pytest.raises(ValueError):
+        SegmentedIndex(CFG, compact_at=1)
+    with pytest.raises(ValueError):
+        SegmentedIndex(PipelineConfig())    # EXACT vocab
+
+
+# --- serving integration --------------------------------------------
+
+def serve_cfg(**kw):
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("max_wait_ms", 5)
+    kw.setdefault("queue_depth", 64)
+    kw.setdefault("cache_entries", 64)
+    return ServeConfig(**kw)
+
+
+@pytest.fixture
+def served():
+    from tfidf_tpu.serve import TfidfServer
+    idx = build(delta_docs=2, compact_at=2)
+    server = TfidfServer(idx.view(), serve_cfg())
+    server.attach_segments(idx)
+    yield server, idx
+    server.close(drain=True)
+
+
+def test_every_visibility_change_bumps_epoch(served):
+    """The cache-staleness satellite: add (plain), add-causing-seal,
+    delete, and compaction install EACH bump the epoch exactly once;
+    a no-op delete bumps nothing."""
+    server, idx = served
+    e = server.epoch
+    out = server.add_docs(["a1"], ["kiwi"])           # plain add
+    assert out["epoch"] == e + 1 == server.epoch
+    out = server.add_docs(["a2", "a3"], ["lime", "melon"])  # seals
+    assert out["sealed"] == 1 and out["epoch"] == e + 2
+    out = server.delete_docs(["a1"])                  # delete
+    assert out["epoch"] == e + 3
+    out = server.delete_docs(["a1"])                  # no-op delete
+    assert out["deleted"] == 0 and out["epoch"] == e + 3
+    assert server.epoch == e + 3
+    summary = server.compact_now(force=True)          # compaction
+    assert summary is not None and summary["epoch"] == e + 4
+
+
+def test_no_stale_cache_hit_serves_a_deleted_doc(served):
+    server, idx = served
+    vals, ids = server.search(["grape grape"], k=3)
+    assert server.doc_names()[ids[0][0]] == "doc5"
+    # hot row is cached now; the delete must invalidate it
+    server.search(["grape grape"], k=3)
+    server.delete_docs(["doc5"])
+    vals, ids = server.search(["grape grape"], k=3)
+    got = [server.doc_names()[i] for i in ids[0] if i >= 0]
+    assert "doc5" not in got
+    # parity with rebuild on the exact query that was cached
+    oracle = idx.rebuild_retriever()
+    ovals, _ = oracle.search(["grape grape"], k=3)
+    np.testing.assert_array_equal(vals, ovals)
+
+
+def test_served_responses_bit_identical_under_mutation(served):
+    server, idx = served
+    server.add_docs(["a1", "a2", "a3"],
+                    ["kiwi lime", "melon kiwi", "lime lime"])
+    server.delete_docs(["doc4"])
+    server.compact_now(force=True)
+    vals, ids = server.submit(QUERIES, 4,
+                              use_cache=False).result(timeout=30)
+    oracle = idx.rebuild_retriever()
+    ovals, oids = oracle.search(QUERIES, 4)
+    np.testing.assert_array_equal(vals, ovals)
+    assert names_of(server.doc_names(), ids) == names_of(
+        oracle.names, oids)
+
+
+def test_canary_recaptures_on_every_visibility_bump(served):
+    from tfidf_tpu.serve import CanaryProber
+    server, idx = served
+    canary = CanaryProber(server, ["apple cherry", "grape grape"], k=3)
+    try:
+        assert canary.probe() == 1.0
+        server.add_docs(["a1"], ["grape kiwi"])   # changes grape DF
+        assert canary.probe() == 1.0              # oracle re-captured
+        server.delete_docs(["doc5"])
+        assert canary.probe() == 1.0
+        server.compact_now(force=True)
+        assert canary.probe() == 1.0
+        snap = server.metrics.registry.snapshot()
+        assert snap.get("serve_canary_failures_total", 0) == 0
+    finally:
+        canary.close()
+
+
+def test_canary_races_mutation_skips_not_fails(served):
+    """A probe straddling a visibility bump must SKIP (epoch moved
+    between submit and compare), never alarm."""
+    from tfidf_tpu.serve import CanaryProber
+    server, idx = served
+    canary = CanaryProber(server, ["apple cherry"], k=3)
+    try:
+        orig = server.submit
+
+        def racing_submit(queries, k=10, **kw):
+            fut = orig(queries, k, **kw)
+            server.add_docs([f"race{server.epoch}"], ["kiwi race"])
+            return fut
+
+        server.submit = racing_submit
+        try:
+            assert canary.probe() is None
+        finally:
+            server.submit = orig
+        snap = server.metrics.registry.snapshot()
+        assert snap.get("serve_canary_skipped_total", 0) >= 1
+        assert snap.get("serve_canary_failures_total", 0) == 0
+    finally:
+        canary.close()
+
+
+def test_segment_gauges_published(served):
+    server, idx = served
+    server.add_docs(["a1"], ["kiwi"])
+    snap = server.metrics.registry.snapshot()
+    stats = idx.stats()
+    assert snap["serve_segment_count"]["value"] == stats["segments"]
+    assert snap["serve_delta_fill_milli"]["value"] == int(
+        round(stats["delta_fill"] * 1000))
+    assert snap["serve_tombstones"]["value"] == stats["tombstones"]
+
+
+def test_swap_index_fallback_bit_identical_and_detaches(served):
+    server, idx = served
+    server.add_docs(["a1", "a2"], ["kiwi lime", "melon"])
+    server.delete_docs(["doc1"])
+    before = server.submit(QUERIES, 3,
+                           use_cache=False).result(timeout=30)
+    names_before = names_of(server.doc_names(), before[1])
+    # the full-rebuild fallback: swap in a from-scratch retriever of
+    # the same live corpus — responses must not move a byte
+    rebuild = idx.rebuild_retriever()
+    server.swap_index(rebuild)
+    after = server.submit(QUERIES, 3,
+                          use_cache=False).result(timeout=30)
+    np.testing.assert_array_equal(before[0], after[0])
+    assert names_before == names_of(server.doc_names(), after[1])
+    # the swap detached the segmented index: mutations now reject
+    with pytest.raises(RuntimeError):
+        server.add_docs(["a3"], ["x"])
+    assert server.compact_now(force=True) is None
+
+
+def test_mutation_without_segments_raises():
+    from tfidf_tpu.serve import TfidfServer
+    r = TfidfRetriever(CFG).index(corpus_of(DOCS))
+    server = TfidfServer(r, serve_cfg())
+    try:
+        with pytest.raises(RuntimeError):
+            server.add_docs(["a"], ["x"])
+        with pytest.raises(RuntimeError):
+            server.delete_docs(["a"])
+        assert server.compact_now() is None
+    finally:
+        server.close(drain=True)
+
+
+def test_inflight_requests_keep_their_admitted_view(served):
+    """Batcher epoch grouping: a request admitted before a mutation
+    scores on the pre-mutation view even when it drains after."""
+    server, idx = served
+    expect, _ = idx.rebuild_retriever().search(["grape grape"], k=3)
+    fut = server.submit(["grape grape"], k=3, use_cache=False)
+    # the admitted (epoch, view) pair rides the batch group; the
+    # mutation lands while the request may still be queued
+    server.delete_docs(["doc5"])
+    vals, _ids = fut.result(timeout=30)
+    # whichever epoch the batch drained under, the response must equal
+    # THAT epoch's from-scratch rebuild — never a mix of the two
+    after, _ = idx.rebuild_retriever().search(["grape grape"], k=3)
+    assert (np.array_equal(vals, expect)
+            or np.array_equal(vals, after[:, :vals.shape[1]]))
+
+
+# --- compactor chaos -------------------------------------------------
+
+def test_compactor_killed_mid_merge_leaves_index_untouched():
+    idx = build(delta_docs=2, compact_at=2)
+    idx.add_docs(["a1", "a2", "a3"], ["kiwi", "lime", "melon"])
+    assert idx.needs_compaction
+    v0 = idx.version
+    before = idx.view().search(QUERIES, 3)
+    faults.arm(faults.FaultPlan.parse("swap:fatal:n=1"))
+    try:
+        with pytest.raises(faults.FatalFault):
+            idx.compact()
+    finally:
+        faults.disarm()
+    # mid-merge kill: no visibility change, no state change, parity
+    assert idx.version == v0
+    assert idx.sealed_count >= 2
+    after = idx.view().search(QUERIES, 3)
+    np.testing.assert_array_equal(before[0], after[0])
+    assert_rebuild_parity(idx)
+    # the retry (post-fault) succeeds and parity still holds
+    assert idx.compact() is not None
+    assert_rebuild_parity(idx)
+
+
+def test_supervised_compactor_retries_within_budget(served):
+    server, idx = served
+    server.add_docs(["a1", "a2", "a3"], ["kiwi", "lime", "melon"])
+    assert idx.needs_compaction
+    faults.arm(faults.FaultPlan.parse("swap:fatal:n=2"))
+    try:
+        compactor = Compactor(server.compact_now, period_s=0.01,
+                              restart_budget=3).start()
+        try:
+            deadline = 5.0
+            import time as _t
+            t0 = _t.monotonic()
+            while idx.needs_compaction and _t.monotonic() - t0 < deadline:
+                _t.sleep(0.02)
+        finally:
+            compactor.stop()
+    finally:
+        faults.disarm()
+    assert not idx.needs_compaction        # recovered within budget
+    assert compactor.restarts == 2 and not compactor.dead
+    assert_rebuild_parity(idx)
+
+
+def test_compactor_dies_past_budget(served):
+    server, idx = served
+    server.add_docs(["a1", "a2", "a3"], ["kiwi", "lime", "melon"])
+    faults.arm(faults.FaultPlan.parse("swap:fatal:n=-1"))
+    try:
+        compactor = Compactor(server.compact_now, period_s=0.01,
+                              restart_budget=1).start()
+        try:
+            import time as _t
+            t0 = _t.monotonic()
+            while not compactor.dead and _t.monotonic() - t0 < 5.0:
+                _t.sleep(0.02)
+        finally:
+            compactor.stop()
+    finally:
+        faults.disarm()
+    assert compactor.dead and compactor.restarts == 2
+    assert idx.needs_compaction            # nothing corrupted, just
+    assert_rebuild_parity(idx)             # nothing compacted
+
+
+# --- serve JSONL ops -------------------------------------------------
+
+def test_serve_ops_add_and_delete(served):
+    from tfidf_tpu.cli import _serve_handle_line
+    server, idx = served
+    out = []
+    write = out.append
+    line = json.dumps({"op": "add_docs", "id": 1, "docs": [
+        {"name": "a1", "text": "kiwi lime"},
+        {"name": "doc2", "text": "banana melon"}]})
+    assert _serve_handle_line(server, line, write, 3, None)
+    assert out[-1] == {"id": 1, "added": 1, "updated": 1, "sealed": 0,
+                       "epoch": server.epoch}
+    line = json.dumps({"op": "delete_docs", "id": 2,
+                       "names": ["doc5", "ghost"]})
+    assert _serve_handle_line(server, line, write, 3, None)
+    assert out[-1] == {"id": 2, "deleted": 1, "missing": 1,
+                       "epoch": server.epoch}
+    assert_rebuild_parity(idx)
+    # malformed payloads answer typed errors, not tracebacks
+    for bad in ({"op": "add_docs", "docs": []},
+                {"op": "add_docs", "docs": [{"name": 3, "text": "x"}]},
+                {"op": "delete_docs", "names": "doc1"}):
+        _serve_handle_line(server, json.dumps(bad), write, 3, None)
+        assert "error" in out[-1]
+
+
+def test_serve_ops_reject_without_segments():
+    from tfidf_tpu.cli import _serve_handle_line
+    from tfidf_tpu.serve import TfidfServer
+    r = TfidfRetriever(CFG).index(corpus_of(DOCS))
+    server = TfidfServer(r, serve_cfg())
+    out = []
+    try:
+        _serve_handle_line(server, json.dumps(
+            {"op": "add_docs",
+             "docs": [{"name": "a", "text": "x"}]}), out.append, 3,
+            None)
+        assert "error" in out[-1] and "delta-docs" in out[-1]["error"]
+    finally:
+        server.close(drain=True)
+
+
+# --- doctor compaction section --------------------------------------
+
+def test_doctor_reads_segment_lifecycle_events(tmp_path):
+    import importlib.util
+    import sys
+    tools = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools")
+    if tools not in sys.path:
+        sys.path.append(tools)
+    spec = importlib.util.spec_from_file_location(
+        "doctor", os.path.join(tools, "doctor.py"))
+    doctor = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(doctor)
+    dump = tmp_path / "flight.jsonl"
+    lines = [json.dumps({"schema": "tfidf-flight/1", "suppressed": {}})]
+    lines.append(json.dumps(
+        {"kind": "event", "event": "segment_seal", "docs": 4}))
+    for pause in (0.002, 0.005):
+        lines.append(json.dumps(
+            {"kind": "event", "event": "compaction", "pause_s": pause,
+             "dropped_tombstones": 3}))
+    lines.append(json.dumps(
+        {"kind": "event", "event": "index_mutation", "epoch": 2}))
+    dump.write_text("\n".join(lines) + "\n")
+    rep = doctor.analyze_flight(str(dump))
+    seg = rep["segments"]
+    assert seg["seals"] == 1 and seg["compactions"] == 2
+    assert seg["mutations"] == 1 and seg["tombstones_dropped"] == 6
+    assert seg["total_pause_ms"] == pytest.approx(7.0)
+    assert seg["max_pause_ms"] == pytest.approx(5.0)
+
+
+# --- CLI acceptance (slow) -------------------------------------------
+
+def _serve_proc(args, repo):
+    import subprocess
+    import sys
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.Popen(
+        [sys.executable, "-m", "tfidf_tpu.cli", "serve"] + args,
+        stdin=__import__("subprocess").PIPE,
+        stdout=__import__("subprocess").PIPE,
+        stderr=__import__("subprocess").PIPE, env=env, cwd=repo,
+        text=True)
+
+
+def _ask(proc, obj, timeout=120):
+    proc.stdin.write(json.dumps(obj) + "\n")
+    proc.stdin.flush()
+    line = proc.stdout.readline()
+    assert line, "server died: " + proc.stderr.read()[-2000:]
+    resp = json.loads(line)
+    resp.pop("rid", None)
+    return resp
+
+
+@pytest.mark.slow
+def test_segmented_sigkill_restore_serves_mutated_corpus(tmp_path):
+    """The mutation acceptance's crash leg: mutate over JSONL, commit
+    (explicit snapshot), SIGKILL mid-traffic, restart with the CORPUS
+    DELETED — the restored server answers bit-identically, mutations
+    (including the tombstone) intact."""
+    import shutil
+    import signal
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    input_dir = str(tmp_path / "input")
+    snap = str(tmp_path / "snap")
+    os.makedirs(input_dir)
+    for i, text in enumerate(["kumquat lychee mango kumquat",
+                              "nectar lychee papaya",
+                              "mango papaya quince raisin",
+                              "kumquat raisin raisin nectar"], 1):
+        with open(os.path.join(input_dir, f"doc{i}"), "w") as f:
+            f.write(text)
+    queries = [{"id": i, "queries": [q], "k": 3}
+               for i, q in enumerate(["kumquat", "papaya quince",
+                                      "tamarind nectar", "raisin"])]
+    common = ["--input", input_dir, "--vocab-size", "512",
+              "--max-wait-ms", "1", "--canary-period-ms", "0",
+              "--devmon-period-ms", "0", "--snapshot-dir", snap,
+              "--delta-docs", "4", "--compact-at", "2"]
+    proc = _serve_proc(common, repo)
+    try:
+        r = _ask(proc, {"op": "add_docs", "docs": [
+            {"name": "doc5", "text": "tamarind nectar tamarind"},
+            {"name": "doc2", "text": "nectar quince"}]})
+        assert r["added"] == 1 and r["updated"] == 1
+        r = _ask(proc, {"op": "delete_docs", "names": ["doc3"]})
+        assert r["deleted"] == 1
+        first = [_ask(proc, q) for q in queries]
+        assert "snapshot" in _ask(proc, {"op": "snapshot"})
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    assert ckpt.exists(snap)
+
+    shutil.rmtree(input_dir)                   # the corpus is GONE
+    proc = _serve_proc(common, repo)
+    try:
+        second = [_ask(proc, q) for q in queries]
+        # ...and the restored index keeps mutating
+        r = _ask(proc, {"op": "add_docs", "docs": [
+            {"name": "doc6", "text": "quince quince"}]})
+        assert r["added"] == 1
+        proc.stdin.write('{"op": "shutdown"}\n')
+        proc.stdin.flush()
+        proc.wait(timeout=60)
+        banner = proc.stderr.read()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    assert second == first                     # bit-identical restore
+    assert "segments=on" in banner
+    assert "snapshot=restored" in banner
+    # the deleted doc stayed deleted across the crash
+    assert not any("doc3" == name
+                   for resp in second for row in resp["results"]
+                   for name, _score in row)
+
+
+@pytest.mark.slow
+def test_mutate_chaos_acceptance(tmp_path):
+    """The mutation acceptance: a continuous add/update/delete stream
+    with --chaos compactor kills — every served response bit-identical
+    to the from-scratch rebuild oracle, compactor restarted within
+    budget, final health ok, breaker closed, zero recompiles."""
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = tmp_path / "MUTATE_chaos.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    rc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "serve_bench.py"),
+         "--requests", "48", "--mutate", "200", "--mutations", "30",
+         "--docs", "128", "--delta-docs", "8", "--compact-at", "2",
+         "--pool", "16", "--concurrency", "2",
+         "--chaos", "swap:fatal:n=2", "--out", str(out)],
+        cwd=repo, env=env, capture_output=True, timeout=600)
+    assert rc.returncode == 0, rc.stdout[-2000:] + rc.stderr[-2000:]
+    mut = json.loads(out.read_text())["mutate"]
+    assert mut["parity_ok"] == 1
+    assert mut["xla_recompiles_after_warm"] == 0
+    assert mut["final_health"] == "ok"
+    assert mut["breaker_open_at_exit"] == 0
+    assert mut["compaction"]["compactor_restarts"] == 2  # both kills
+    assert mut["compaction"]["compactor_dead"] == 0      # contained
+    assert mut["chaos_plan"] == "swap:fatal:n=2"
+
+
+# --- mutate bench smoke (slow) ---------------------------------------
+
+@pytest.mark.slow
+def test_mutate_bench_smoke(tmp_path):
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = tmp_path / "MUTATE_smoke.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    rc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "serve_bench.py"),
+         "--requests", "32", "--mutate", "200", "--mutations", "18",
+         "--docs", "128", "--delta-docs", "8", "--compact-at", "2",
+         "--pool", "16", "--concurrency", "2", "--out", str(out)],
+        cwd=repo, env=env, capture_output=True, timeout=600)
+    assert rc.returncode == 0, rc.stdout[-2000:] + rc.stderr[-2000:]
+    artifact = json.loads(out.read_text())
+    mut = artifact["mutate"]
+    assert mut["parity_ok"] == 1
+    assert mut["xla_recompiles_after_warm"] == 0
+    assert artifact["recompiles_after_warmup"] == 0
+    assert mut["ops"] == 18
+    assert {"p50", "p99", "max"} <= set(mut["visibility_lag_ms"])
+    assert mut["compaction"]["compactor_dead"] == 0

@@ -156,6 +156,54 @@ class TestGate:
         assert all(c["verdict"] == "skipped"
                    for c in verdict["checks"])
 
+    def test_mutate_artifact_classifies_and_gates(self, tmp_path):
+        """The --mutate artifact (ISSUE 12) is its own ledger kind:
+        parity and the zero-recompile pin gate absolutely; lag/pause
+        percentiles gate directionally."""
+        doc = {
+            "metric": "serve_bench", "mode": "mutate",
+            "backend": "cpu", "docs": 300, "k": 10, "requests": 64,
+            "max_batch": 64, "throughput_qps": 4000.0,
+            "latency_ms": {"p50": 4.0, "p99": 30.0},
+            "recompiles_after_warmup": 0,
+            "mutate": {
+                "rate": 50.0, "ops": 24, "mutation_qps": 100.0,
+                "delta_docs": 16, "compact_at": 2,
+                "visibility_lag_ms": {"p50": 2.0, "p99": 6.0,
+                                      "max": 8.0},
+                "compaction": {"count": 1,
+                               "pause_ms": {"p50": 1.0, "p99": 2.0,
+                                            "max": 2.0},
+                               "compactor_restarts": 0,
+                               "compactor_dead": 0},
+                "xla_recompiles_after_warm": 0, "parity_ok": 1,
+            },
+        }
+        good = tmp_path / "MUTATE_r01.json"
+        good.write_text(json.dumps(doc))
+        cand, _ = perf_ledger.normalize(str(good))
+        assert cand["kind"] == "mutate"
+        assert cand["metrics"]["parity_ok"] == 1
+        assert cand["metrics"]["visibility_lag_p99_ms"] == 6.0
+        assert cand["context"]["delta_docs"] == 16
+        ledger = str(tmp_path / "L.jsonl")
+        perf_ledger.append([str(good)], ledger, quiet=True)
+        # unchanged re-run passes by construction
+        verdict = perf_gate.gate(cand, perf_ledger.load_ledger(ledger))
+        assert verdict["ok"] and verdict["baseline_runs"] == 1
+        # a parity break or a steady-state recompile is zero-tolerance
+        doc["mutate"]["parity_ok"] = 0
+        doc["recompiles_after_warmup"] = 2
+        bad = tmp_path / "MUTATE_bad.json"
+        bad.write_text(json.dumps(doc))
+        cand_bad, _ = perf_ledger.normalize(str(bad))
+        verdict = perf_gate.gate(cand_bad,
+                                 perf_ledger.load_ledger(ledger))
+        regressed = {c["metric"] for c in verdict["checks"]
+                     if c["verdict"] == "REGRESSED"}
+        assert {"parity_ok", "recompiles_after_warmup"} <= regressed
+        assert not verdict["ok"]
+
     def test_noise_widens_tolerance(self):
         # Three noisy baseline runs: the spread-derived tolerance must
         # beat the base 30%, so a value inside the band passes.

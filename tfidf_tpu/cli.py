@@ -100,6 +100,14 @@ protocol (one JSON object per line):
       --snapshot-dir the NEW epoch is snapshotted before the flip)
   {"op": "snapshot"}           -> {"snapshot": DIR, "epoch": N}
       (persist the resident index now; needs --snapshot-dir)
+  {"op": "add_docs", "docs": [{"name": N, "text": T}, ...]}
+      -> {"added": 2, "updated": 1, "sealed": 0, "epoch": N}
+      (live mutation — needs --delta-docs; an existing name updates in
+      place; the new epoch is visible before the response line)
+  {"op": "delete_docs", "names": [N, ...]}
+      -> {"deleted": 1, "missing": 0, "epoch": N}
+      (tombstone by name; a deleted doc can never be served again,
+      cached or not — the epoch bump invalidates the cache)
   {"op": "shutdown"}           -> drains in-flight work and exits
 overload responses carry {"error": "overloaded"}; back off and retry.
 quarantined queries answer {"error": "poison_query"} — the request
@@ -396,6 +404,23 @@ def _build_parser() -> argparse.ArgumentParser:
                          "(checkpoint.py seq+LATEST protocol). "
                          "JSONL op {\"op\": \"snapshot\"} snapshots "
                          "on demand")
+    sv.add_argument("--delta-docs", type=int, default=None,
+                    help="serve an LSM-style SEGMENTED index with a "
+                         "delta segment of this capacity: the "
+                         "add_docs/delete_docs JSONL ops mutate the "
+                         "live index (tombstone masks, epoch-bumped "
+                         "visibility, bit-identical to a full "
+                         "rebuild); a full delta seals into an "
+                         "immutable segment (default: off — classic "
+                         "immutable-except-swap serving; env "
+                         "TFIDF_TPU_DELTA_DOCS; docs/SERVING.md "
+                         "'Live mutation')")
+    sv.add_argument("--compact-at", type=int, default=None,
+                    help="sealed-segment count at which the "
+                         "supervised background compactor merges "
+                         "them into one, dropping tombstones "
+                         "(default 4; env TFIDF_TPU_COMPACT_AT; "
+                         "needs --delta-docs)")
     sv.add_argument("--faults", metavar="PLAN", default=None,
                     help="arm a deterministic fault-injection plan "
                          "(chaos testing; also env TFIDF_TPU_FAULTS; "
@@ -936,6 +961,40 @@ def _serve_handle_line(server, line, write, default_k, build_retriever,
         except (ValueError, OSError, RuntimeError) as e:
             write({"id": req.get("id"), "error": f"snapshot failed: {e}"})
         return True
+    if op == "add_docs":
+        docs = req.get("docs")
+        if (not isinstance(docs, list) or not docs or not all(
+                isinstance(d, dict) and isinstance(d.get("name"), str)
+                and isinstance(d.get("text"), str) for d in docs)):
+            write({"id": req.get("id"),
+                   "error": "bad request: 'docs' must be a non-empty "
+                            "list of {\"name\": str, \"text\": str}"})
+            return True
+        try:
+            out = server.add_docs([d["name"] for d in docs],
+                                  [d["text"] for d in docs])
+            write({"id": req.get("id"), "added": out["added"],
+                   "updated": out["updated"], "sealed": out["sealed"],
+                   "epoch": out["epoch"]})
+        except (RuntimeError, ValueError) as e:
+            write({"id": req.get("id"), "error": f"add_docs failed: {e}"})
+        return True
+    if op == "delete_docs":
+        names = req.get("names")
+        if (not isinstance(names, list) or not names
+                or not all(isinstance(n, str) for n in names)):
+            write({"id": req.get("id"),
+                   "error": "bad request: 'names' must be a non-empty "
+                            "list of strings"})
+            return True
+        try:
+            out = server.delete_docs(names)
+            write({"id": req.get("id"), "deleted": out["deleted"],
+                   "missing": out["missing"], "epoch": out["epoch"]})
+        except (RuntimeError, ValueError) as e:
+            write({"id": req.get("id"),
+                   "error": f"delete_docs failed: {e}"})
+        return True
     if op is not None:
         write({"id": req.get("id"), "error": f"unknown op {op!r}"})
         return True
@@ -1018,7 +1077,8 @@ def _run_serve(args) -> int:
         devmon_period_ms=args.devmon_period_ms,
         snapshot_dir=args.snapshot_dir, faults=args.faults,
         fault_seed=args.fault_seed, slow_ms=args.slow_ms,
-        slo_ms=args.slo_ms, slo_target=args.slo_target)
+        slo_ms=args.slo_ms, slo_target=args.slo_target,
+        delta_docs=args.delta_docs, compact_at=args.compact_at)
 
     # Crash-fast start: a committed snapshot with a matching config
     # fingerprint restores the resident index from disk — seconds, no
@@ -1029,7 +1089,39 @@ def _run_serve(args) -> int:
     from tfidf_tpu.obs import log as obs_log
     retriever = None
     restored_meta = None
-    if serve_cfg.snapshot_dir and ckpt.exists(serve_cfg.snapshot_dir):
+    segments = None
+    if serve_cfg.delta_docs:
+        # Segmented serving (round 17): the resident index is an
+        # LSM-style SegmentedIndex; the server holds its current VIEW
+        # and the add_docs/delete_docs ops mutate it live.
+        from tfidf_tpu.index import SegmentedIndex
+        if serve_cfg.snapshot_dir and ckpt.exists(serve_cfg.snapshot_dir):
+            t0 = time.monotonic()
+            try:
+                segments, restored_meta = SegmentedIndex.restore(
+                    serve_cfg.snapshot_dir, cfg)
+            except ckpt.SnapshotMismatch as e:
+                sys.stderr.write(
+                    f"snapshot at {serve_cfg.snapshot_dir} unusable "
+                    f"({e}); rebuilding from --input\n")
+            else:
+                obs_log.log_event(
+                    "info", "index_restored",
+                    msg=f"segmented index restored from "
+                        f"{serve_cfg.snapshot_dir} "
+                        f"(epoch {restored_meta.get('epoch', 0)}, "
+                        f"{segments.num_docs} live docs) in "
+                        f"{time.monotonic() - t0:.3f}s",
+                    epoch=restored_meta.get("epoch", 0),
+                    docs=segments.num_docs,
+                    restore_s=round(time.monotonic() - t0, 4))
+        if segments is None:
+            segments = SegmentedIndex.from_dir(
+                args.input, cfg, delta_docs=serve_cfg.delta_docs,
+                compact_at=serve_cfg.compact_at,
+                strict=not args.no_strict)
+        retriever = segments.view()
+    elif serve_cfg.snapshot_dir and ckpt.exists(serve_cfg.snapshot_dir):
         t0 = time.monotonic()
         try:
             retriever, restored_meta = TfidfRetriever.restore(
@@ -1055,6 +1147,13 @@ def _run_serve(args) -> int:
         retriever, serve_cfg,
         initial_epoch=(int(restored_meta.get("epoch", 0))
                        if restored_meta else 0))
+    compactor = None
+    if segments is not None:
+        from tfidf_tpu.index import Compactor
+        server.attach_segments(segments)
+        compactor = Compactor(
+            server.compact_now,
+            restart_budget=serve_cfg.restart_budget).start()
     if serve_cfg.snapshot_dir and restored_meta is None:
         # First boot on this snapshot root: persist the fresh build
         # so the NEXT start (or a crash one second from now) restores.
@@ -1100,7 +1199,9 @@ def _run_serve(args) -> int:
                      f"health_period_ms={serve_cfg.health_period_ms}, "
                      f"canary={'on' if canary else 'off'}, "
                      f"snapshot={snap_state}, "
-                     f"faults={'armed' if serve_cfg.faults else 'off'}"
+                     f"faults={'armed' if serve_cfg.faults else 'off'}, "
+                     f"segments="
+                     f"{'on' if segments is not None else 'off'}"
                      f")\n")
 
     prev_term = _install_sigterm_dump()
@@ -1128,6 +1229,8 @@ def _run_serve(args) -> int:
             server.close(drain=True)
         return 0
     finally:
+        if compactor is not None:
+            compactor.stop()
         _restore_sigterm(prev_term)
         obs_health.set_monitor(None)
 

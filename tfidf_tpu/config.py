@@ -310,6 +310,16 @@ class ServeConfig:
       slo_target: fraction of requests that must meet ``slo_ms``
         (error budget = 1 - target). CLI ``--slo-target`` / env
         ``TFIDF_TPU_SLO_TARGET``.
+      delta_docs: delta-segment capacity of the LSM-style segmented
+        index (``tfidf_tpu/index``) — serving with this set builds a
+        :class:`~tfidf_tpu.index.SegmentedIndex` instead of a
+        monolithic retriever, turning the ``add_docs`` /
+        ``delete_docs`` JSONL ops on; a full delta seals into an
+        immutable segment. None = classic immutable-except-full-swap
+        serving. CLI ``--delta-docs`` / env ``TFIDF_TPU_DELTA_DOCS``.
+      compact_at: sealed-segment count at which the background
+        compactor merges them into one (dropping tombstones). CLI
+        ``--compact-at`` / env ``TFIDF_TPU_COMPACT_AT``.
     """
 
     max_batch: int = 64
@@ -333,6 +343,8 @@ class ServeConfig:
     slow_sample: int = 0
     slo_ms: Optional[float] = None
     slo_target: float = 0.99
+    delta_docs: Optional[int] = None
+    compact_at: int = 4
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -377,6 +389,11 @@ class ServeConfig:
             raise ValueError("slo_ms must be positive")
         if not 0 < self.slo_target < 1:
             raise ValueError("slo_target must be in (0, 1)")
+        if self.delta_docs is not None and self.delta_docs < 1:
+            raise ValueError("delta_docs must be >= 1 "
+                             "(None disables segmented serving)")
+        if self.compact_at < 2:
+            raise ValueError("compact_at must be >= 2")
 
     @staticmethod
     def from_env(**overrides) -> "ServeConfig":
@@ -411,7 +428,9 @@ class ServeConfig:
                 ("slow_ms", "TFIDF_TPU_SLOW_MS", float),
                 ("slow_sample", "TFIDF_TPU_SLOW_SAMPLE", int),
                 ("slo_ms", "TFIDF_TPU_SLO_MS", float),
-                ("slo_target", "TFIDF_TPU_SLO_TARGET", float)):
+                ("slo_target", "TFIDF_TPU_SLO_TARGET", float),
+                ("delta_docs", "TFIDF_TPU_DELTA_DOCS", int),
+                ("compact_at", "TFIDF_TPU_COMPACT_AT", int)):
             val = pick(key, env, cast)
             if val is not None:
                 kw[key] = val

@@ -1,0 +1,26 @@
+"""LSM-style segmented index: live add/update/delete without
+rebuilding the world (ROADMAP item 2; docs/SERVING.md "Live
+mutation").
+
+Composition::
+
+    SegmentedIndex ── delta Segment (absorbing adds/updates)
+        │                 └─ seals when full  -> sealed Segment
+        ├─ sealed Segments (immutable, compacted in the background)
+        └─ view() -> IndexView  (immutable snapshot; duck-types the
+                                 TfidfRetriever search contract)
+
+Every mutation bumps the visibility version; ``TfidfServer`` maps
+bumps onto its epoch (cache keys, canary oracle re-capture, in-flight
+snapshot isolation all ride the same bump). Search = per-segment fused
+score/top-k + device top-k-of-top-k merge against the corrected global
+DF/IDF — bit-identical to a from-scratch rebuild of the live corpus.
+"""
+
+from tfidf_tpu.index.compactor import Compactor
+from tfidf_tpu.index.segment import Segment
+from tfidf_tpu.index.segmented import (IndexView, SegmentedIndex,
+                                       index_compile_cache_size)
+
+__all__ = ["SegmentedIndex", "IndexView", "Segment", "Compactor",
+           "index_compile_cache_size"]

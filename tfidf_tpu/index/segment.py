@@ -1,0 +1,175 @@
+"""One segment of the LSM-style index: a fixed-capacity slab of docs.
+
+A segment is the unit everything else composes: the **delta** is a
+segment still absorbing rows; **sealing** just flips it immutable;
+**compaction** copies live rows of many sealed segments into one fresh
+segment; a **snapshot** is its arrays through ``checkpoint.save_index``.
+
+Host-master representation. Each row holds one document's row-sparse
+triple — ``(ids, counts, head)`` exactly as
+``ops.sparse.sorted_term_counts`` would produce it (derived on host by
+the bit-identical numpy mirror ``sorted_term_counts_host``, so a
+streaming add never traces a fresh device program per batch size) —
+plus its token count, name, and a live bit (tombstones). The per-
+segment DF vector is maintained *incrementally* in exact integer
+arithmetic: a row's distinct-term histogram is added on insert and
+subtracted on tombstone, so the global DF over live segments is always
+equal to what a from-scratch rebuild of the live corpus would count.
+
+Device state is derived, never authoritative: the int triple uploads
+once per content revision (adds/seals/compaction), and only the float
+weights — which depend on the *global* IDF, i.e. on every mutation
+anywhere — are recomputed per visibility change
+(``segmented._refresh_weights``). All jitted shapes are pinned by the
+segment's (capacity, length), so steady-state mutation re-runs warm
+programs instead of tracing new ones.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["Segment"]
+
+
+class Segment:
+    """A fixed-capacity document slab (see module docstring).
+
+    Not thread-safe on its own: ``SegmentedIndex`` owns the lock.
+    """
+
+    def __init__(self, capacity: int, length: int, vocab_size: int,
+                 seg_id: int = 0) -> None:
+        if capacity < 1 or length < 1:
+            raise ValueError("segment capacity/length must be >= 1")
+        self.capacity = capacity
+        self.length = length
+        self.vocab_size = vocab_size
+        self.seg_id = seg_id
+        self.ids = np.zeros((capacity, length), np.int32)
+        self.counts = np.zeros((capacity, length), np.int32)
+        self.head = np.zeros((capacity, length), bool)
+        self.lengths = np.zeros((capacity,), np.int32)
+        self.live = np.zeros((capacity,), bool)
+        self.names: List[Optional[str]] = [None] * capacity
+        self.df = np.zeros((vocab_size,), np.int32)
+        self.used = 0          # rows ever filled (append-only)
+        self.sealed = False
+        # content_rev: bumps on any change to the INT arrays (adds,
+        # never tombstones — the live mask rides separately), the key
+        # the device triple cache invalidates on.
+        self.content_rev = 0
+        self._dev: Optional[tuple] = None  # (rev, ids, counts, head, lens)
+
+    # --- derived counts ---
+    @property
+    def live_docs(self) -> int:
+        return int(self.live.sum())
+
+    @property
+    def tombstones(self) -> int:
+        return self.used - self.live_docs
+
+    @property
+    def full(self) -> bool:
+        return self.used >= self.capacity
+
+    # --- mutation (delta only; SegmentedIndex holds the lock) ---
+    def _row_df(self, row: int) -> np.ndarray:
+        """One row's distinct-term histogram — its exact (integer) DF
+        contribution, derived from the head-masked triple."""
+        terms = self.ids[row][self.head[row]]
+        return np.bincount(terms, minlength=self.vocab_size).astype(
+            np.int32)
+
+    def add_row(self, ids_row: np.ndarray, counts_row: np.ndarray,
+                head_row: np.ndarray, length: int, name: str) -> int:
+        """Append one document; returns its row. Caller checks
+        :attr:`full` first and seals on overflow."""
+        if self.sealed:
+            raise RuntimeError("segment is sealed")
+        if self.full:
+            raise RuntimeError("segment is full")
+        row = self.used
+        self.ids[row] = ids_row
+        self.counts[row] = counts_row
+        self.head[row] = head_row
+        self.lengths[row] = length
+        self.live[row] = True
+        self.names[row] = name
+        self.df += self._row_df(row)
+        self.used += 1
+        self.content_rev += 1
+        return row
+
+    def tombstone(self, row: int) -> None:
+        """Delete one document: flip its live bit and subtract its DF
+        contribution — the mask half happens at search time
+        (``ops.topk.segment_score_topk``), the scoring half here, so
+        global IDF stays equal to a rebuild of the live corpus."""
+        if not self.live[row]:
+            return
+        self.live[row] = False
+        self.df -= self._row_df(row)
+
+    def seal(self) -> None:
+        self.sealed = True
+
+    # --- device triple cache ---
+    def device_triple(self):
+        """The int triple as device arrays, uploaded once per content
+        revision (tombstones do NOT re-upload — the live mask is a
+        separate tiny array the view ships per visibility change)."""
+        import jax.numpy as jnp
+        if self._dev is None or self._dev[0] != self.content_rev:
+            self._dev = (self.content_rev,
+                         jnp.asarray(self.ids),
+                         jnp.asarray(self.counts),
+                         jnp.asarray(self.head),
+                         jnp.asarray(self.lengths))
+        return self._dev[1:]
+
+    # --- persistence (checkpoint.save_index array dict) ---
+    def to_arrays(self, prefix: str) -> Dict[str, np.ndarray]:
+        blob = np.frombuffer(
+            "\x00".join(n if n is not None else ""
+                        for n in self.names).encode("utf-8"),
+            dtype=np.uint8)
+        return {
+            f"{prefix}ids": self.ids,
+            f"{prefix}counts": self.counts,
+            f"{prefix}head": self.head,
+            f"{prefix}lengths": self.lengths,
+            f"{prefix}live": self.live,
+            f"{prefix}names_blob": blob,
+        }
+
+    @classmethod
+    def from_arrays(cls, prefix: str, arrays: Dict[str, np.ndarray],
+                    meta: Dict, vocab_size: int) -> "Segment":
+        ids = np.asarray(arrays[f"{prefix}ids"], np.int32)
+        capacity, length = ids.shape
+        seg = cls(capacity, length, vocab_size,
+                  seg_id=int(meta.get("seg_id", 0)))
+        seg.ids = ids
+        seg.counts = np.asarray(arrays[f"{prefix}counts"], np.int32)
+        seg.head = np.asarray(arrays[f"{prefix}head"], bool)
+        seg.lengths = np.asarray(arrays[f"{prefix}lengths"], np.int32)
+        seg.live = np.asarray(arrays[f"{prefix}live"], bool)
+        blob = arrays[f"{prefix}names_blob"]
+        names = (bytes(blob.tobytes()).decode("utf-8").split("\x00")
+                 if blob.size else [""] * capacity)
+        seg.names = [n if n else None for n in names]
+        seg.used = int(meta["used"])
+        seg.sealed = bool(meta.get("sealed", True))
+        # DF is derived state: recompute from the live triples rather
+        # than trusting a stored vector to stay consistent with them.
+        df = np.zeros((vocab_size,), np.int64)
+        for row in range(seg.used):
+            if seg.live[row]:
+                df += seg._row_df(row)
+        seg.df = df.astype(np.int32)
+        seg.content_rev = 1
+        return seg

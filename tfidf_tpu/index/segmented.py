@@ -1,0 +1,642 @@
+"""SegmentedIndex: live add/update/delete without rebuilding the world.
+
+``swap_index()`` rebuilds and re-uploads the entire index to change one
+document. This module is ROADMAP item 2's fix — the LSM-tree / Lucene
+segment model:
+
+* a **delta segment** absorbs streaming ``add_docs`` /
+  ``update`` / ``delete_docs`` (packing rides the ``StreamingTfidf``
+  machinery with its fixed-length pin; the per-doc sorted triple is
+  derived on host by the bit-identical numpy mirror, so mutation never
+  traces a fresh device program);
+* the delta **seals** into an immutable segment when full
+  (``segment_seal`` flight event);
+* deletes/updates are **tombstone mask bits** applied before top-k
+  (``ops.topk.segment_score_topk`` — the document-filter building
+  block ROADMAP item 4 wants), with the doc's DF contribution
+  subtracted in exact integer arithmetic;
+* search = per-segment fused score/top-k (PR 3's BCOO kernel,
+  unchanged) + device-side **top-k-of-top-k merge**
+  (``ops.topk.merge_topk``), against the **corrected global DF/IDF**
+  over live segments — so every response is bit-identical to a
+  from-scratch rebuild of the live corpus (:meth:`rebuild_retriever`,
+  pinned by tests/test_index.py);
+* **compaction** merges sealed segments through one pass
+  (``compaction`` flight event, rehearsable mid-merge via the ``swap``
+  fault seam), dropping tombstones;
+* **epoch-based visibility**: every mutation bumps :attr:`version` and
+  invalidates the cached :class:`IndexView`; views are immutable
+  snapshots that duck-type the ``TfidfRetriever`` search contract, so
+  in-flight server queries keep the view they were admitted under.
+
+Persistence reuses ``checkpoint.save_index`` (seq+LATEST, per-array
+sha256, typed ``SnapshotMismatch``): a sealed segment *is* a
+``save_index`` snapshot, flattened under per-segment key prefixes.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from tfidf_tpu import faults, obs
+from tfidf_tpu.config import PipelineConfig, VocabMode
+from tfidf_tpu.index.segment import Segment
+from tfidf_tpu.io.corpus import Corpus, discover_corpus
+from tfidf_tpu.models.retrieval import (TfidfRetriever, _build_index,
+                                        config_fingerprint, query_matrix)
+from tfidf_tpu.obs import log as obs_log
+from tfidf_tpu.ops.sparse import sorted_term_counts_host, sparse_scores
+from tfidf_tpu.ops.scoring import idf_from_df
+from tfidf_tpu.ops.topk import merge_topk, segment_score_topk
+from tfidf_tpu.streaming import StreamingTfidf
+
+__all__ = ["SegmentedIndex", "IndexView"]
+
+
+def _jax():  # deferred so tools can import the module without a backend
+    import jax
+    import jax.numpy as jnp
+    return jax, jnp
+
+
+@functools.lru_cache(maxsize=1)
+def _jitted():
+    """The two per-visibility-change device programs, shaped only by
+    (capacity, length) / vocab — steady-state mutation re-runs warm
+    executables, never traces (the zero-recompiles pin)."""
+    jax, jnp = _jax()
+
+    @jax.jit
+    def idf_fn(df, num_docs):
+        return idf_from_df(df, num_docs, jnp.float32)
+
+    @jax.jit
+    def refresh_weights(ids, counts, head, lengths, idf):
+        # Identical float sequence to retrieval._build_index's tail:
+        # gather-scored rows, L2 norm, guard — per-row ops, so a row's
+        # weights match a from-scratch rebuild of the same row at the
+        # same L bit-for-bit.
+        scores = sparse_scores(ids, counts, head, lengths, idf)
+        norm = jnp.sqrt(jnp.sum(scores * scores, axis=1, keepdims=True))
+        weights = scores / jnp.maximum(norm, 1e-30)
+        data = jnp.where(head, weights, 0.0)
+        cols = jnp.where(head, ids, 0)
+        return data, cols
+
+    return idf_fn, refresh_weights
+
+
+def index_compile_cache_size() -> int:
+    """Total compiled-program count across the segmented search path —
+    the mutate bench's recompile receipt (diffed across the measured
+    window; must be flat after warm-up)."""
+    idf_fn, refresh_weights = _jitted()
+    return sum(f._cache_size() for f in
+               (idf_fn, refresh_weights, segment_score_topk, merge_topk))
+
+
+class _ViewPart:
+    """One segment's device-resident face inside a view."""
+
+    __slots__ = ("data", "cols", "live", "base", "rows")
+
+    def __init__(self, data, cols, live, base: int, rows: int) -> None:
+        self.data = data
+        self.cols = cols
+        self.live = live
+        self.base = base
+        self.rows = rows
+
+
+class IndexView:
+    """An immutable snapshot of the segmented index at one version.
+
+    Duck-types the ``TfidfRetriever`` search contract (``search`` /
+    ``names`` / ``config`` / ``indexed`` / ``_num_docs`` /
+    ``snapshot``), which is exactly what lets ``TfidfServer`` hold a
+    view where it held a retriever: in-flight requests finish on the
+    view they were admitted under while mutations install newer views.
+
+    ``names`` is positional over PADDED rows (tombstoned and unused
+    rows hold ``""``); only live rows can surface in results, so the
+    holes are unreachable by construction.
+    """
+
+    def __init__(self, owner: "SegmentedIndex", version: int,
+                 config: PipelineConfig, parts: List[_ViewPart],
+                 names: List[str], idf, idf_np: np.ndarray,
+                 num_live: int) -> None:
+        self.owner = owner
+        self.version = version
+        self.config = config
+        self._parts = parts
+        self.names = names
+        self._idf = idf
+        self._idf_np = idf_np
+        self._num_docs = num_live
+
+    @property
+    def indexed(self) -> bool:
+        return True
+
+    @property
+    def num_segments(self) -> int:
+        return len(self._parts)
+
+    def index_arrays(self) -> list:
+        """Live device arrays for the HBM census owner registration."""
+        out = [self._idf]
+        for p in self._parts:
+            out += [p.data, p.cols, p.live]
+        return out
+
+    def snapshot(self, path: str, epoch: int = 0,
+                 extra_meta: Optional[dict] = None) -> str:
+        """Persist the owning index's CURRENT state (which may be a
+        version or two ahead of this view — a snapshot is a restart
+        artifact, not a historical one)."""
+        return self.owner.save(path, epoch=epoch, extra_meta=extra_meta)
+
+    def search(self, queries: Sequence[Union[str, bytes]], k: int = 10
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        """Ranked retrieval over the live segments: (scores, doc
+        positions), each [Q, k'] with k' = min(k, live docs).
+        ``doc positions`` index :attr:`names`; -1 marks padding. Same
+        blocking/bucketing discipline as ``TfidfRetriever.search``, so
+        the compiled-program budget is shared."""
+        _, jnp = _jax()
+        block = max(1, int(os.environ.get("TFIDF_TPU_QUERY_BLOCK",
+                                          "64")))
+        if len(queries) > block:
+            parts = [self.search(queries[s:s + block], k)
+                     for s in range(0, len(queries), block)]
+            return (np.concatenate([p[0] for p in parts]),
+                    np.concatenate([p[1] for p in parts]))
+        nq = len(queries)
+        width = min(k, self._num_docs)
+        if not self._parts or width == 0:
+            return (np.zeros((nq, width), np.float32),
+                    np.full((nq, width), -1, np.int64))
+        bucket = 1 << max(0, nq - 1).bit_length()
+        qmat = jnp.asarray(query_matrix(queries, self.config,
+                                        self._idf_np, pad_to=bucket))
+        vals_parts, ids_parts = [], []
+        for part in self._parts:
+            kk = min(k, part.rows)
+            vals, idx = segment_score_topk(part.data, part.cols,
+                                           part.live, qmat, k=kk)
+            vals_parts.append(vals)
+            ids_parts.append(idx + part.base)
+        if len(vals_parts) == 1:
+            vals_cat, ids_cat = vals_parts[0], ids_parts[0]
+        else:
+            vals_cat = jnp.concatenate(vals_parts, axis=1)
+            ids_cat = jnp.concatenate(ids_parts, axis=1)
+        ksel = min(k, vals_cat.shape[1])
+        vals, idx = merge_topk(vals_cat, ids_cat, k=ksel)
+        vals = np.asarray(vals)[:nq, :width]
+        idx = np.asarray(idx)[:nq, :width]
+        ok = vals > 0
+        return np.where(ok, vals, 0.0), np.where(ok, idx, -1)
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, n - 1).bit_length()
+
+
+class SegmentedIndex:
+    """The mutable LSM-style index (see module docstring).
+
+    Thread-safe: every mutation and every :meth:`view` build runs
+    under one re-entrant lock. Views themselves are immutable and
+    lock-free to search.
+
+    Args:
+      config: HASHED-vocab pipeline config; ``max_doc_len`` pins the
+        token axis of EVERY segment (the one static L all compiled
+        programs share — and the L the rebuild oracle packs at).
+      delta_docs: delta-segment capacity; a full delta seals.
+      compact_at: sealed-segment count at which :meth:`compact`
+        actually merges (``force=True`` merges from 2).
+    """
+
+    def __init__(self, config: Optional[PipelineConfig] = None,
+                 delta_docs: int = 1024, compact_at: int = 4) -> None:
+        cfg = config or PipelineConfig(vocab_mode=VocabMode.HASHED)
+        if cfg.vocab_mode is not VocabMode.HASHED:
+            raise ValueError("SegmentedIndex requires HASHED vocab "
+                             "(fixed id space across mutations)")
+        if delta_docs < 1:
+            raise ValueError("delta_docs must be >= 1")
+        if compact_at < 2:
+            raise ValueError("compact_at must be >= 2")
+        self.config = cfg
+        self.delta_docs = delta_docs
+        self.compact_at = compact_at
+        self._length = cfg.max_doc_len
+        # Packing reuses the streaming ingest machinery: fixed_len pins
+        # the token axis so every mutation batch shares one shape.
+        self._stream = StreamingTfidf(cfg)
+        self._lock = threading.RLock()
+        self._sealed: List[Segment] = []
+        self._delta = Segment(delta_docs, self._length, cfg.vocab_size,
+                              seg_id=0)
+        self._next_seg_id = 1
+        self._loc: Dict[str, Tuple[Segment, int]] = {}
+        self._version = 1
+        self._view: Optional[IndexView] = None
+        self.compactions: List[dict] = []   # last-N summaries (bench)
+
+    # --- construction -------------------------------------------------
+    @classmethod
+    def from_corpus(cls, corpus: Corpus,
+                    config: Optional[PipelineConfig] = None,
+                    delta_docs: int = 1024,
+                    compact_at: int = 4) -> "SegmentedIndex":
+        """Bulk-load a corpus as ONE sealed base segment (capacity the
+        next power of two — compaction keeps that discipline, so
+        steady-state segment shapes cycle within a small warmable
+        set), then open a fresh delta for mutations."""
+        idx = cls(config, delta_docs=delta_docs, compact_at=compact_at)
+        if len(corpus):
+            base = Segment(
+                _next_pow2(max(len(corpus), delta_docs)),
+                idx._length, idx.config.vocab_size, seg_id=0)
+            ids, counts, head, lengths = idx._pack_rows(
+                corpus.names, corpus.docs)
+            with idx._lock:
+                for i, name in enumerate(corpus.names):
+                    row = base.add_row(ids[i], counts[i], head[i],
+                                       int(lengths[i]), name)
+                    idx._loc[name] = (base, row)
+                base.seal()
+                idx._sealed.append(base)
+                idx._delta.seg_id = idx._next_seg_id
+                idx._next_seg_id += 1
+                idx._bump_locked()
+        return idx
+
+    @classmethod
+    def from_dir(cls, input_dir: str,
+                 config: Optional[PipelineConfig] = None,
+                 delta_docs: int = 1024, compact_at: int = 4,
+                 strict: bool = True) -> "SegmentedIndex":
+        return cls.from_corpus(discover_corpus(input_dir, strict),
+                               config, delta_docs=delta_docs,
+                               compact_at=compact_at)
+
+    # --- state --------------------------------------------------------
+    @property
+    def version(self) -> int:
+        """Visibility version: bumps on EVERY change a query could
+        observe (add, update, delete, seal, compaction install). The
+        server maps bumps onto its epoch, which keys the result
+        cache — the no-stale-hit contract."""
+        with self._lock:
+            return self._version
+
+    @property
+    def num_docs(self) -> int:
+        with self._lock:
+            return self._live_locked()
+
+    @property
+    def sealed_count(self) -> int:
+        with self._lock:
+            return len(self._sealed)
+
+    def stats(self) -> dict:
+        """Gauge feed: segment/delta/tombstone counts."""
+        with self._lock:
+            segs = self._sealed + ([self._delta] if self._delta.used
+                                   else [])
+            return {
+                "segments": len(segs),
+                "sealed": len(self._sealed),
+                "delta_used": self._delta.used,
+                "delta_capacity": self._delta.capacity,
+                "delta_fill": self._delta.used / self._delta.capacity,
+                "tombstones": sum(s.tombstones for s in segs),
+                "live_docs": self._live_locked(),
+                "version": self._version,
+            }
+
+    def _live_locked(self) -> int:
+        total = sum(s.live_docs for s in self._sealed)
+        return total + self._delta.live_docs
+
+    def _bump_locked(self) -> None:
+        self._version += 1
+        self._view = None
+
+    # --- mutation -----------------------------------------------------
+    def _pack_rows(self, names: Sequence[str], docs: Sequence[bytes]):
+        """Docs -> host row-sparse triples at the pinned L, through the
+        streaming packer + the numpy sorted-counts mirror."""
+        docs = [d.encode() if isinstance(d, str) else bytes(d)
+                for d in docs]
+        batch = self._stream.pack(Corpus(names=list(names), docs=docs),
+                                  fixed_len=self._length)
+        ids, counts, head = sorted_term_counts_host(
+            batch.token_ids, batch.lengths)
+        return ids, counts, head, batch.lengths
+
+    def add_docs(self, names: Sequence[str],
+                 docs: Sequence[Union[str, bytes]]) -> dict:
+        """Add (or update — same name replaces) documents. Returns
+        ``{"added", "updated", "sealed", "version"}``. One visibility
+        bump per call, covering any seal it triggered."""
+        if len(names) != len(docs):
+            raise ValueError("names and docs must align")
+        if not names:
+            return {"added": 0, "updated": 0, "sealed": 0,
+                    "version": self.version}
+        ids, counts, head, lengths = self._pack_rows(names, docs)
+        added = updated = sealed = 0
+        with self._lock:
+            for i, name in enumerate(names):
+                old = self._loc.get(name)
+                if old is not None:
+                    old[0].tombstone(old[1])
+                    updated += 1
+                else:
+                    added += 1
+                if self._delta.full:
+                    self._seal_locked()
+                    sealed += 1
+                row = self._delta.add_row(ids[i], counts[i], head[i],
+                                          int(lengths[i]), name)
+                self._loc[name] = (self._delta, row)
+            self._bump_locked()
+            version = self._version
+        return {"added": added, "updated": updated, "sealed": sealed,
+                "version": version}
+
+    def delete_docs(self, names: Sequence[str]) -> dict:
+        """Tombstone documents by name. Returns ``{"deleted",
+        "missing", "version"}``; no visibility bump when nothing was
+        actually deleted (deleting a missing doc changes nothing a
+        query could observe)."""
+        deleted = missing = 0
+        with self._lock:
+            for name in names:
+                loc = self._loc.pop(name, None)
+                if loc is None:
+                    missing += 1
+                    continue
+                loc[0].tombstone(loc[1])
+                deleted += 1
+            if deleted:
+                self._bump_locked()
+            version = self._version
+        return {"deleted": deleted, "missing": missing,
+                "version": version}
+
+    def _seal_locked(self) -> None:
+        delta = self._delta
+        delta.seal()
+        self._sealed.append(delta)
+        self._delta = Segment(self.delta_docs, self._length,
+                              self.config.vocab_size,
+                              seg_id=self._next_seg_id)
+        self._next_seg_id += 1
+        obs_log.log_event(
+            "info", "segment_seal",
+            msg=f"delta sealed: segment {delta.seg_id} "
+                f"({delta.live_docs}/{delta.used} live), "
+                f"{len(self._sealed)} sealed segment(s)",
+            seg_id=delta.seg_id, docs=delta.used,
+            live=delta.live_docs, sealed_segments=len(self._sealed))
+
+    # --- compaction ---------------------------------------------------
+    @property
+    def needs_compaction(self) -> bool:
+        with self._lock:
+            return len(self._sealed) >= self.compact_at
+
+    def compact(self, force: bool = False) -> Optional[dict]:
+        """Merge the sealed segments into one, dropping tombstones and
+        preserving insertion order. Runs under the index lock:
+        mutations pause (the measured ``pause_s``), searches on
+        existing views do not. The merged state installs atomically
+        AFTER the ``swap`` fault seam fires — a compactor killed
+        mid-merge leaves the index exactly as it was (the chaos pin).
+        Returns the summary dict, or None when below threshold."""
+        t0 = time.monotonic()
+        with self._lock:
+            inputs = list(self._sealed)
+            threshold = 2 if force else self.compact_at
+            if len(inputs) < threshold:
+                return None
+            with obs.span("compact", segments=len(inputs)):
+                live_total = sum(s.live_docs for s in inputs)
+                dropped = sum(s.tombstones for s in inputs)
+                merged = Segment(
+                    _next_pow2(max(live_total, self.delta_docs)),
+                    self._length, self.config.vocab_size,
+                    seg_id=self._next_seg_id)
+                mapping: List[Tuple[str, int]] = []
+                for seg in inputs:           # insertion order
+                    for row in range(seg.used):
+                        if not seg.live[row]:
+                            continue
+                        r2 = merged.add_row(
+                            seg.ids[row], seg.counts[row],
+                            seg.head[row], int(seg.lengths[row]),
+                            seg.names[row])
+                        mapping.append((seg.names[row], r2))
+                merged.seal()
+                # The rehearsable crash point: a fault here kills the
+                # compactor AFTER the merge work, BEFORE any state
+                # changed — the supervised restart retries cleanly.
+                faults.fire("swap", op="compact", segments=len(inputs),
+                            docs=live_total)
+                self._next_seg_id += 1
+                self._sealed = [merged]
+                for name, row in mapping:
+                    self._loc[name] = (merged, row)
+                self._bump_locked()
+                version = self._version
+        pause_s = time.monotonic() - t0
+        summary = {"segments_in": len(inputs), "docs": live_total,
+                   "dropped_tombstones": dropped,
+                   "capacity": merged.capacity,
+                   "pause_s": round(pause_s, 6), "version": version}
+        with self._lock:
+            self.compactions.append(summary)
+            del self.compactions[:-64]
+        obs_log.log_event(
+            "info", "compaction",
+            msg=f"compacted {len(inputs)} segments -> {live_total} "
+                f"live docs (dropped {dropped} tombstones) in "
+                f"{pause_s * 1e3:.1f} ms",
+            **summary)
+        return summary
+
+    # --- visibility ---------------------------------------------------
+    def view(self) -> IndexView:
+        """The current immutable snapshot (cached per version). Builds
+        the corrected global DF/IDF over live segments and refreshes
+        every segment's weights against it — the price of scores that
+        are bit-identical to a from-scratch rebuild of the live
+        corpus."""
+        _, jnp = _jax()
+        idf_fn, refresh_weights = _jitted()
+        with self._lock:
+            if self._view is not None:
+                return self._view
+            src = self._sealed + ([self._delta] if self._delta.used
+                                  else [])
+            df = np.zeros((self.config.vocab_size,), np.int64)
+            for seg in src:
+                df += seg.df
+            num_live = self._live_locked()
+            idf = idf_fn(jnp.asarray(df.astype(np.int32)),
+                         jnp.int32(num_live))
+            idf_np = np.asarray(idf)
+            parts: List[_ViewPart] = []
+            names: List[str] = []
+            base = 0
+            for seg in src:
+                ids_d, counts_d, head_d, lens_d = seg.device_triple()
+                data, cols = refresh_weights(ids_d, counts_d, head_d,
+                                             lens_d, idf)
+                parts.append(_ViewPart(data, cols,
+                                       jnp.asarray(seg.live), base,
+                                       seg.capacity))
+                names += [n if n is not None else ""
+                          for n in seg.names]
+                base += seg.capacity
+            self._view = IndexView(self, self._version, self.config,
+                                   parts, names, idf, idf_np, num_live)
+            return self._view
+
+    # --- oracle / fallback --------------------------------------------
+    def live_rows(self):
+        """(token_rows [D_live, L], lengths, names) of the live corpus
+        in insertion order. The stored SORTED ids are a valid token
+        sequence for a rebuild — sorting a sorted row is the identity,
+        so the rebuilt triple is bit-identical to the original's."""
+        with self._lock:
+            src = self._sealed + ([self._delta] if self._delta.used
+                                  else [])
+            toks, lens, names = [], [], []
+            for seg in src:
+                for row in range(seg.used):
+                    if not seg.live[row]:
+                        continue
+                    toks.append(seg.ids[row])
+                    lens.append(int(seg.lengths[row]))
+                    names.append(seg.names[row])
+        if not toks:
+            return (np.zeros((0, self._length), np.int32),
+                    np.zeros((0,), np.int32), [])
+        return (np.stack(toks).astype(np.int32),
+                np.asarray(lens, np.int32), names)
+
+    def rebuild_retriever(self) -> TfidfRetriever:
+        """A FROM-SCRATCH ``TfidfRetriever`` over the live corpus —
+        packed at the same pinned L, built through the retriever's own
+        ``_build_index`` program (fresh sort, fresh DF, fresh IDF,
+        fresh weights). This is both the bit-parity oracle the tests
+        hold every served response against and the ``swap_index``
+        full-rebuild fallback."""
+        _, jnp = _jax()
+        toks, lens, names = self.live_rows()
+        if not len(names):
+            raise RuntimeError("rebuild_retriever needs >= 1 live doc")
+        r = TfidfRetriever(self.config)
+        ids, weights, head, idf = _build_index(
+            jnp.asarray(toks), jnp.asarray(lens),
+            jnp.int32(len(names)), vocab_size=self.config.vocab_size)
+        r._ids, r._weights, r._head, r._idf = ids, weights, head, idf
+        r.names = names
+        r._num_docs = len(names)
+        return r
+
+    # --- persistence --------------------------------------------------
+    def save(self, path: str, epoch: int = 0,
+             extra_meta: Optional[dict] = None) -> str:
+        """Persist every segment (sealed + delta) as ONE
+        ``checkpoint.save_index`` commit — seq+LATEST atomicity and
+        per-array checksums for free. A process killed at any instant
+        restores the previous committed state."""
+        from tfidf_tpu import checkpoint as ckpt
+        with self._lock:
+            segs = self._sealed + [self._delta]
+            arrays: Dict[str, np.ndarray] = {}
+            seg_meta = []
+            for i, seg in enumerate(segs):
+                arrays.update(seg.to_arrays(f"seg{i}_"))
+                seg_meta.append({"used": seg.used,
+                                 "sealed": seg.sealed,
+                                 "seg_id": seg.seg_id})
+            meta = {
+                "num_docs": self._live_locked(),
+                "epoch": int(epoch),
+                "config_sha": config_fingerprint(self.config),
+                "vocab_size": int(self.config.vocab_size),
+                "segmented": {
+                    "delta_docs": self.delta_docs,
+                    "compact_at": self.compact_at,
+                    "length": self._length,
+                    "next_seg_id": self._next_seg_id,
+                    "segments": seg_meta,
+                },
+            }
+            if extra_meta:
+                meta.update(extra_meta)
+            return ckpt.save_index(path, arrays, meta)
+
+    @classmethod
+    def restore(cls, path: str,
+                config: Optional[PipelineConfig] = None
+                ) -> Tuple["SegmentedIndex", dict]:
+        """Rebuild a SegmentedIndex from a committed snapshot:
+        ``(index, meta)``. Raises ``checkpoint.SnapshotMismatch`` on a
+        config-fingerprint mismatch or a non-segmented snapshot."""
+        from tfidf_tpu import checkpoint as ckpt
+        arrays, meta = ckpt.restore_index(path)
+        seg_info = meta.get("segmented")
+        if not isinstance(seg_info, dict):
+            raise ckpt.SnapshotMismatch(
+                "committed snapshot is not a segmented index "
+                "(plain retriever snapshot? restore it with "
+                "TfidfRetriever.restore)")
+        if config is None:
+            config = PipelineConfig(
+                vocab_mode=VocabMode.HASHED,
+                vocab_size=int(meta.get("vocab_size", 1 << 16)),
+                max_doc_len=int(seg_info.get("length", 256)))
+        want = config_fingerprint(config)
+        if meta.get("config_sha") != want:
+            raise ckpt.SnapshotMismatch(
+                f"snapshot config fingerprint "
+                f"{meta.get('config_sha')!r} != running config "
+                f"{want!r} — rebuild instead of serving a mismatched "
+                f"index")
+        idx = cls(config, delta_docs=int(seg_info["delta_docs"]),
+                  compact_at=int(seg_info["compact_at"]))
+        segs = []
+        for i, sm in enumerate(seg_info["segments"]):
+            segs.append(Segment.from_arrays(
+                f"seg{i}_", arrays, sm, config.vocab_size))
+        with idx._lock:
+            idx._sealed = segs[:-1]
+            idx._delta = segs[-1]
+            idx._delta.sealed = False
+            idx._next_seg_id = int(seg_info.get("next_seg_id",
+                                                len(segs)))
+            idx._loc = {}
+            for seg in segs:
+                for row in range(seg.used):
+                    if seg.live[row] and seg.names[row] is not None:
+                        idx._loc[seg.names[row]] = (seg, row)
+            idx._bump_locked()
+        return idx, meta

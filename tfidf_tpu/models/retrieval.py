@@ -134,6 +134,35 @@ def _make_search_sharded(plan: MeshPlan, k: int):
         check_vma=False))
 
 
+def query_matrix(queries: Sequence[Union[str, bytes]],
+                 config: PipelineConfig, idf: np.ndarray,
+                 pad_to: Optional[int] = None) -> np.ndarray:
+    """Host-side packing of queries into a dense [V, Q] cosine block.
+
+    Shared by :meth:`TfidfRetriever.search` and the segmented index's
+    views (``tfidf_tpu/index``) so both paths build byte-identical
+    query columns from the same ``idf`` vector — half the segment-vs-
+    rebuild bit-parity contract. ``pad_to`` widens the block with
+    all-zero columns (query-count bucketing); a zero column scores 0
+    against every document, so padded rows fall out of results via the
+    ``vals > 0`` mask.
+    """
+    idf = np.asarray(idf)
+    q = np.zeros((config.vocab_size, pad_to or len(queries)), np.float32)
+    for j, text in enumerate(queries):
+        data = text.encode() if isinstance(text, str) else text
+        words = whitespace_tokenize(data, config.truncate_tokens_at)
+        if not words:
+            continue
+        ids = words_to_ids(words, config.vocab_size, config.hash_seed)
+        counts = np.bincount(ids, minlength=config.vocab_size)
+        vec = counts.astype(np.float32) / len(words) * idf
+        norm = float(np.sqrt((vec * vec).sum()))
+        if norm > 0:
+            q[:, j] = vec / norm
+    return q
+
+
 def config_fingerprint(cfg: PipelineConfig) -> str:
     """Stable hash over the config fields that determine index BYTES
     and query packing — the compatibility contract between a snapshot
@@ -334,28 +363,10 @@ class TfidfRetriever:
     # --- querying ---
     def _query_matrix(self, queries: Sequence[Union[str, bytes]],
                       pad_to: Optional[int] = None) -> np.ndarray:
-        """Host-side packing of queries into a dense [V, Q] cosine block.
-
-        ``pad_to`` widens the block with all-zero columns (the query-
-        count bucketing of :meth:`search`); a zero column scores 0
-        against every document, so padded rows fall out of results via
-        the existing ``vals > 0`` mask.
-        """
-        cfg = self.config
-        idf = np.asarray(self._idf)
-        q = np.zeros((cfg.vocab_size, pad_to or len(queries)), np.float32)
-        for j, text in enumerate(queries):
-            data = text.encode() if isinstance(text, str) else text
-            words = whitespace_tokenize(data, cfg.truncate_tokens_at)
-            if not words:
-                continue
-            ids = words_to_ids(words, cfg.vocab_size, cfg.hash_seed)
-            counts = np.bincount(ids, minlength=cfg.vocab_size)
-            vec = counts.astype(np.float32) / len(words) * idf
-            norm = float(np.sqrt((vec * vec).sum()))
-            if norm > 0:
-                q[:, j] = vec / norm
-        return q
+        """Module-level :func:`query_matrix` over this retriever's
+        config and IDF (kept as a method for the round-9 callers)."""
+        return query_matrix(queries, self.config, self._idf,
+                            pad_to=pad_to)
 
     def search(self, queries: Sequence[Union[str, bytes]], k: int = 10
                ) -> Tuple[np.ndarray, np.ndarray]:

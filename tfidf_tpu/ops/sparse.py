@@ -61,6 +61,34 @@ def sorted_term_counts_masked(token_ids: jax.Array, valid: jax.Array
                                valid.sum(axis=1, dtype=jnp.int32))
 
 
+def sorted_term_counts_host(token_ids, lengths):
+    """Numpy mirror of :func:`sorted_term_counts`, bit-identical by
+    construction (pure integer sort/compare/cumulative ops — pinned by
+    tests/test_index.py). The segmented index (``tfidf_tpu/index``)
+    derives each delta document's triple on HOST with this, so a
+    streaming add never traces a fresh device program per batch size —
+    the zero-recompiles-under-mutation contract rides on it."""
+    import numpy as np
+    token_ids = np.asarray(token_ids, np.int32)
+    lengths = np.asarray(lengths, np.int32)
+    d, length = token_ids.shape
+    pos = np.arange(length, dtype=np.int32)[None, :]
+    live = pos < lengths[:, None]
+    sentinel = np.iinfo(np.int32).max
+    sorted_ids = np.sort(
+        np.where(live, token_ids, sentinel), axis=1).astype(np.int32)
+    prev = np.concatenate(
+        [np.full((d, 1), -1, np.int32), sorted_ids[:, :-1]], axis=1)
+    head = live & (sorted_ids != prev)
+    hpos = np.where(head, pos, length).astype(np.int32)
+    suffix_min = np.minimum.accumulate(hpos[:, ::-1], axis=1)[:, ::-1]
+    next_head = np.concatenate(
+        [suffix_min[:, 1:], np.full((d, 1), length, np.int32)], axis=1)
+    counts = (np.minimum(next_head, lengths[:, None]) - pos).astype(
+        np.int32)
+    return sorted_ids, counts, head
+
+
 def _sorted_counts_core(token_ids, valid, lengths):
     d, length = token_ids.shape
     pos = jnp.arange(length, dtype=jnp.int32)[None, :]

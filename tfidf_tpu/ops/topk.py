@@ -10,10 +10,13 @@ ever cross the PCIe/host boundary.
 
 from __future__ import annotations
 
+import functools
 from typing import Tuple
 
 import jax
+import jax.numpy as jnp
 from jax import lax
+from jax.experimental import sparse as jsparse
 
 # lax.top_k over a flattened [D*V] stream returns int32 indices, and the
 # doc/vocab split (flat // v, flat % v) silently wraps past 2^31 slots —
@@ -68,6 +71,65 @@ def _topk_global_two_stage(scores: jax.Array, k: int
     per_vals, per_ids = lax.top_k(scores, kk)        # [D, kk]
     vals, flat = lax.top_k(per_vals.reshape(-1), k)  # over D*kk < 2^31
     return vals, flat // kk, per_ids.reshape(-1)[flat]
+
+
+# --- segmented retrieval (round 17): mask, per-segment select, merge.
+#
+# The LSM-style index (tfidf_tpu/index) scores each segment with the
+# SAME BCOO-dot kernel the retriever uses, masks tombstoned rows to a
+# sub-zero sentinel BEFORE selection (a deleted doc must never displace
+# a live one from the top-k), and merges the per-segment winners with
+# one more device top-k. Tie discipline: lax.top_k breaks equal scores
+# by LOWEST index, per-segment candidates are concatenated in segment
+# (= insertion) order, and within a segment ties already sit in row
+# order — so equal-score winners come out in global insertion order,
+# exactly the order a from-scratch rebuild of the live corpus (which
+# compacts positions but preserves relative order) would pick. That is
+# the tie half of the bit-parity contract tests/test_index.py pins.
+
+_DEAD = -1.0  # below any cosine score (>= 0); masked rows lose to all
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def masked_topk(scores: jax.Array, live: jax.Array, k: int
+                ) -> Tuple[jax.Array, jax.Array]:
+    """Top-k over [Q, D] scores with dead docs masked out first.
+
+    ``live`` is the [D] bool tombstone complement; dead columns score
+    ``_DEAD`` so they only surface when a row has fewer than k live
+    candidates — and then with a negative value the caller's
+    ``vals > 0`` result mask drops, same as rebuild padding."""
+    masked = jnp.where(live[None, :], scores, _DEAD)
+    return lax.top_k(masked, k)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def segment_score_topk(data: jax.Array, cols: jax.Array,
+                       live: jax.Array, qmat: jax.Array, k: int
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """One segment's fused score/top-k: the retriever's BCOO sparse x
+    dense MXU matmul (PR 3's kernel, unchanged math) over this
+    segment's rows, tombstone mask applied, per-query top-k selected
+    on device. [D, L] triple x [V, Q] queries -> ([Q, k], [Q, k])
+    with SEGMENT-LOCAL row indices (the caller globalizes by base)."""
+    d = data.shape[0]
+    mat = jsparse.BCOO((data, cols[..., None]),
+                       shape=(d, qmat.shape[0]))
+    sims = jsparse.bcoo_dot_general(
+        mat, qmat, dimension_numbers=(((1,), (0,)), ((), ())))  # [D, Q]
+    masked = jnp.where(live[None, :], sims.T, _DEAD)            # [Q, D]
+    return lax.top_k(masked, k)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def merge_topk(vals: jax.Array, ids: jax.Array, k: int
+               ) -> Tuple[jax.Array, jax.Array]:
+    """Top-k-of-top-k: merge per-segment candidate lists (already
+    concatenated along axis 1, in segment order, ids globalized) into
+    the final [Q, k] selection — the same primitive the mesh-sharded
+    serving of ROADMAP item 1 rides after its all_gather."""
+    best, sel = lax.top_k(vals, k)
+    return best, jnp.take_along_axis(ids, sel, axis=1)
 
 
 def topk_terms(scores: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:

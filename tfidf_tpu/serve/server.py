@@ -102,6 +102,14 @@ class TfidfServer:
         self._t0 = time.monotonic()     # uptime_s anchor
         self._swap_listeners: List[Callable] = []
         self._cache = ResultCache(self.config.cache_entries)
+        # Live mutation (round 17): an attached SegmentedIndex turns
+        # add_docs/delete_docs on; every visibility change funnels
+        # through _install_index (epoch bump + cache clear + listener
+        # notify — the one path, so no mutation can leave a stale
+        # cache row or an un-recaptured canary oracle behind).
+        self._segments = None
+        self._mutate_lock = threading.Lock()
+        self._g_segments = self._g_delta_fill = self._g_tombstones = None
         # Fault plan (round 13): arming is the server's job when the
         # config names one (the chaos path — serve_bench --chaos /
         # TFIDF_TPU_FAULTS); disarmed again on close so an embedded
@@ -415,6 +423,32 @@ class TfidfServer:
             # index the swap was installing, never a torn state.
             retriever.snapshot(self.config.snapshot_dir,
                                epoch=self._epoch + 1)
+        # Swapping in an index that is NOT a view of the attached
+        # segments detaches them: the full-rebuild fallback replaces
+        # the segmented world wholesale, and further mutations must
+        # say so instead of mutating a detached index nobody serves.
+        with self._lock:
+            if (self._segments is not None
+                    and getattr(retriever, "owner", None)
+                    is not self._segments):
+                self._segments = None
+                obs_log.log_event(
+                    "warning", "index_swap",
+                    msg="full-rebuild swap detached the segmented "
+                        "index; add_docs/delete_docs now reject",
+                    epoch=self._epoch + 1, reason="detach_segments")
+        return self._install_index(retriever, "swap_index")
+
+    def _install_index(self, retriever: TfidfRetriever,
+                       reason: str) -> int:
+        """THE visibility transition: atomically install ``retriever``
+        (a plain retriever or a segmented :class:`~tfidf_tpu.index.
+        IndexView`), bump the epoch, clear the epoch-keyed result
+        cache and run the swap listeners (canary oracle re-capture)
+        synchronously — every path that changes what a query could
+        observe (swap, add, delete, seal, compaction install) funnels
+        here, which is the no-stale-cache / no-false-canary contract
+        tests/test_index.py pins."""
         with self._lock:
             if self._closed:
                 raise ServerClosed("server is closed")
@@ -422,13 +456,123 @@ class TfidfServer:
             self._epoch += 1
             epoch = self._epoch
         self._cache.clear()
-        obs_log.log_event("info", "index_swap",
-                          msg=f"index swapped to epoch {epoch} "
-                              f"({retriever._num_docs} docs)",
-                          epoch=epoch, docs=retriever._num_docs)
+        if reason == "swap_index":
+            obs_log.log_event(
+                "info", "index_swap",
+                msg=f"index swapped to epoch {epoch} "
+                    f"({retriever._num_docs} docs)",
+                epoch=epoch, docs=retriever._num_docs)
+        else:
+            obs_log.log_event(
+                "info", "index_mutation",
+                msg=f"index visibility -> epoch {epoch} "
+                    f"({retriever._num_docs} docs, {reason})",
+                epoch=epoch, docs=retriever._num_docs, reason=reason)
         for listener in list(self._swap_listeners):
             listener(epoch, retriever)
         return epoch
+
+    # --- live mutation (round 17) ---
+    def attach_segments(self, segments) -> None:
+        """Wire a :class:`~tfidf_tpu.index.SegmentedIndex` into this
+        server: :meth:`add_docs` / :meth:`delete_docs` /
+        :meth:`compact_now` mutate it and install fresh views through
+        :meth:`_install_index`, and the segment gauges
+        (``serve_segment_count`` / ``serve_delta_fill_milli`` /
+        ``serve_tombstones``) publish its shape."""
+        reg = self.metrics.registry
+        with self._lock:
+            self._segments = segments
+            if self._g_segments is None:
+                self._g_segments = reg.gauge(
+                    "serve_segment_count",
+                    "segments serving (sealed + non-empty delta)")
+                self._g_delta_fill = reg.gauge(
+                    "serve_delta_fill_milli",
+                    "delta-segment fill fraction in 1/1000")
+                self._g_tombstones = reg.gauge(
+                    "serve_tombstones",
+                    "tombstoned (deleted/updated) rows awaiting "
+                    "compaction")
+        self._update_segment_gauges()
+
+    def _segments_or_raise(self):
+        with self._lock:
+            segments = self._segments
+        if segments is None:
+            raise RuntimeError(
+                "no segmented index attached (serve with --delta-docs, "
+                "or TfidfServer.attach_segments)")
+        return segments
+
+    def _update_segment_gauges(self) -> None:
+        with self._lock:
+            segments, g_seg = self._segments, self._g_segments
+        if segments is None or g_seg is None:
+            return
+        stats = segments.stats()
+        g_seg.set(stats["segments"])
+        self._g_delta_fill.set(int(round(stats["delta_fill"] * 1000)))
+        self._g_tombstones.set(stats["tombstones"])
+
+    def add_docs(self, names: Sequence[str],
+                 docs: Sequence[Union[str, bytes]]) -> dict:
+        """Add/update documents in the attached segmented index and
+        make them visible: one mutation, one epoch bump, cache cleared,
+        canary re-captured — all before this returns (visibility lag
+        IS this call's latency; the mutate bench measures it)."""
+        segments = self._segments_or_raise()
+        with self._mutate_lock:
+            summary = segments.add_docs(names, docs)
+            epoch = self._install_index(segments.view(), "add_docs")
+        self._update_segment_gauges()
+        summary["epoch"] = epoch
+        return summary
+
+    def delete_docs(self, names: Sequence[str]) -> dict:
+        """Tombstone documents by name. A delete that removed nothing
+        installs nothing (no visibility change to publish)."""
+        segments = self._segments_or_raise()
+        with self._mutate_lock:
+            summary = segments.delete_docs(names)
+            if summary["deleted"]:
+                summary["epoch"] = self._install_index(
+                    segments.view(), "delete_docs")
+            else:
+                summary["epoch"] = self.epoch
+        self._update_segment_gauges()
+        return summary
+
+    def compact_now(self, force: bool = False):
+        """One threshold-checked compaction pass + view install — the
+        :class:`~tfidf_tpu.index.Compactor`'s tick, also callable
+        directly (tests, ops). Returns the compaction summary dict
+        (with the installed epoch) or None when below threshold or
+        when no segmented index is attached (a detached compactor tick
+        is a no-op, not a crash)."""
+        with self._lock:
+            segments = self._segments
+            if segments is None or self._closed:
+                return None
+        with self._mutate_lock:
+            summary = segments.compact(force=force)
+            if summary is None:
+                return None
+            try:
+                summary["epoch"] = self._install_index(
+                    segments.view(), "compaction")
+            except ServerClosed:
+                return None   # close raced the tick; nothing serves it
+            if self.config.snapshot_dir:
+                # Compaction is a durability point: the merged state
+                # commits atomically, so a SIGKILL at any later
+                # instant restores at worst the last compaction (plus
+                # the boot/explicit-snapshot commits) — the classic
+                # LSM trade of an unfsynced memtable tail.
+                segments.save(self.config.snapshot_dir,
+                              epoch=summary["epoch"])
+        self._update_segment_gauges()
+        return summary
 
     def snapshot(self, snapshot_dir: Optional[str] = None) -> str:
         """Persist the CURRENT resident index (CSR arrays + IDF +
@@ -475,6 +619,8 @@ class TfidfServer:
 
     def _index_arrays(self):
         r = self._retriever
+        if hasattr(r, "index_arrays"):   # segmented IndexView
+            return r.index_arrays()
         return [r._ids, r._weights, r._head, r._idf]
 
     def add_swap_listener(self, fn: Callable) -> None:

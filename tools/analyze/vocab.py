@@ -25,6 +25,8 @@ SPANS = {
     "stream_update", "stream_score",
     # serve request lifecycle
     "request", "queued", "batched", "device", "dispatch_retry",
+    # segmented index (round 17): the compaction merge pass
+    "compact",
 }
 
 #: Trace instants (``obs.instant``) — point events, not spans.
@@ -66,6 +68,11 @@ FLIGHT_EVENTS = {
     "index_swap", "index_snapshot", "index_restored",
     "health_state_change", "canary_parity_failure",
     "canary_probe_error",
+    # live mutation (round 17): segment lifecycle + visibility bumps —
+    # segment_seal / compaction carry the lifecycle receipts (docs,
+    # tombstones dropped, pause_s — tools/doctor.py budgets the
+    # pauses); index_mutation marks every non-swap epoch bump
+    "segment_seal", "compaction", "index_mutation",
     # engine/bench diagnostics (round 11 structured-logger migration)
     "exact_engine_fallback", "margin_pressure", "bench_progress",
 }
@@ -94,6 +101,8 @@ ENV_CLI_FLAGS = {
     "TFIDF_TPU_SLOW_MS": "--slow-ms",
     "TFIDF_TPU_SLO_MS": "--slo-ms",
     "TFIDF_TPU_SLO_TARGET": "--slo-target",
+    "TFIDF_TPU_DELTA_DOCS": "--delta-docs",
+    "TFIDF_TPU_COMPACT_AT": "--compact-at",
 }
 
 #: Shared attributes the T001 thread lint tolerates without a lock,

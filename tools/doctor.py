@@ -197,11 +197,29 @@ def analyze_flight(path: str) -> dict:
         if e.get("event") == "worker_restart")
     if restarts_by_worker:
         faults_out["restarts_by_worker"] = dict(restarts_by_worker)
+    # Live-mutation receipts (round 17): seals and compactions are
+    # flight events carrying their lifecycle numbers; the doctor folds
+    # them into one section and (via --compaction-budget-ms) gates the
+    # total mutation pause a run is allowed to spend compacting.
+    seals = [e for e in events if e.get("event") == "segment_seal"]
+    compactions = [e for e in events if e.get("event") == "compaction"]
+    pause_ms = [e.get("pause_s", 0.0) * 1e3 for e in compactions]
+    segments_out = {
+        "seals": len(seals),
+        "compactions": len(compactions),
+        "total_pause_ms": round(sum(pause_ms), 3),
+        "max_pause_ms": round(max(pause_ms), 3) if pause_ms else 0.0,
+        "tombstones_dropped": sum(
+            e.get("dropped_tombstones", 0) for e in compactions),
+        "mutations": sum(1 for e in events
+                         if e.get("event") == "index_mutation"),
+    }
     out = {
         "events": len(events),
         "digests": len(digests),
         "suppressed": header.get("suppressed", {}),
         "faults": faults_out,
+        "segments": segments_out,
         "recompiles": [
             {k: v for k, v in e.items()
              if k not in ("t", "kind", "level", "msg")}
@@ -318,12 +336,14 @@ def tail_ledger(path: str, n: int = 5) -> List[dict]:
 def diagnose(trace: str, flight: Optional[str], ledger: str,
              allow_recompiles: int = 0, allow_watermarks: int = 0,
              allow_breaker_open: bool = False,
-             budgets: Optional[Dict[str, float]] = None) -> dict:
+             budgets: Optional[Dict[str, float]] = None,
+             compaction_budget_ms: Optional[float] = None) -> dict:
     report: dict = {"trace": trace}
     report.update(analyze_trace(trace))
     recompile_count = report["recompile_instants"]
     watermark_count = 0
     breaker_open = False
+    compaction_pause_ms = 0.0
     if flight and os.path.exists(flight):
         report["flight"] = analyze_flight(flight)
         recompile_count = max(recompile_count,
@@ -331,6 +351,8 @@ def diagnose(trace: str, flight: Optional[str], ledger: str,
         watermark_count = len(report["flight"]["watermarks"])
         breaker_open = report["flight"]["faults"][
             "breaker_open_at_exit"]
+        compaction_pause_ms = report["flight"]["segments"][
+            "total_pause_ms"]
     report["ledger_tail"] = tail_ledger(ledger)
 
     violations: List[str] = []
@@ -347,6 +369,12 @@ def diagnose(trace: str, flight: Optional[str], ledger: str,
             "circuit breaker OPEN at exit (last breaker event is a "
             "trip with no close after it — the server never "
             "recovered; --allow-breaker-open to tolerate)")
+    if compaction_budget_ms is not None \
+            and compaction_pause_ms > compaction_budget_ms:
+        violations.append(
+            f"compaction paused mutation for "
+            f"{compaction_pause_ms:.1f} ms total > budget "
+            f"{compaction_budget_ms} ms (--compaction-budget-ms)")
     for name, budget in (budgets or {}).items():
         got = report["phases"].get(name, {}).get("total_s", 0.0)
         if got > budget:
@@ -408,6 +436,16 @@ def render(report: dict) -> str:
                 f"({'OPEN' if fa['breaker_open_at_exit'] else 'closed'}"
                 f" at exit), {fa['query_quarantined']} quarantined, "
                 f"{fa['fault_injected']} injected")
+        sg = fl.get("segments", {})
+        if sg.get("seals") or sg.get("compactions") \
+                or sg.get("mutations"):
+            lines.append(
+                f"  segments: {sg['mutations']} mutation install(s), "
+                f"{sg['seals']} seal(s), {sg['compactions']} "
+                f"compaction(s) (total pause "
+                f"{sg['total_pause_ms']:.1f} ms, max "
+                f"{sg['max_pause_ms']:.1f} ms, "
+                f"{sg['tombstones_dropped']} tombstones dropped)")
         if "hbm_owners" in fl:
             owners = ", ".join(
                 f"{name} {info.get('bytes', 0) / 1e6:.1f} MB"
@@ -455,6 +493,12 @@ def main() -> int:
                     metavar="PHASE=SECONDS",
                     help="per-phase wall budget, repeatable "
                          "(e.g. --budget pack=0.5)")
+    ap.add_argument("--compaction-budget-ms", type=float, default=None,
+                    help="total milliseconds the run may spend with "
+                         "mutation paused for compaction (summed "
+                         "pause_s over the flight dump's compaction "
+                         "events); past it exit 1 (default: report "
+                         "only)")
     ap.add_argument("--request", metavar="RID", default=None,
                     help="render ONE request's full causal timeline "
                          "(every span carrying this rid directly or "
@@ -502,7 +546,8 @@ def main() -> int:
                           allow_recompiles=args.allow_recompiles,
                           allow_watermarks=args.allow_watermarks,
                           allow_breaker_open=args.allow_breaker_open,
-                          budgets=budgets)
+                          budgets=budgets,
+                          compaction_budget_ms=args.compaction_budget_ms)
     except (OSError, ValueError, KeyError) as e:
         print(f"doctor: cannot read inputs: {e}", file=sys.stderr)
         return 2

@@ -93,6 +93,22 @@ _GATES = {
         # p50/p99 stay inside their (wide) noise tolerances.
         "slo_compliance": ("higher", 0.10),
     },
+    # Mutation workloads (serve_bench --mutate): parity under a live
+    # add/update/delete stream is zero-tolerance (served bytes must
+    # equal the from-scratch rebuild oracle's), as are steady-state
+    # recompiles and a dead compactor; visibility lag and compaction
+    # pauses gate directionally with wide bands (shared-box timing of
+    # sub-ms installs jitters hard), so only a real slowdown fails.
+    "mutate": {
+        "mutation_qps": ("higher", 0.50),
+        "throughput_qps": ("higher", 0.50),
+        "visibility_lag_p50_ms": ("lower", 0.60),
+        "visibility_lag_p99_ms": ("lower", 0.80),
+        "compaction_pause_max_ms": ("lower", 1.00),
+        "recompiles_after_warmup": ("lower", 0.0),
+        "parity_ok": ("higher", 0.0),
+        "compactor_dead": ("lower", 0.0),
+    },
     # The mesh dryrun verdict: ok must STAY 1 (zero-tolerance, the
     # absolute zero-baseline rule below never fires because ok is the
     # higher-is-better direction with a nonzero baseline).
@@ -117,6 +133,8 @@ _MATCH_KEYS = {"bench": ("backend", "n_docs", "wire"),
                "serve_bench": ("backend", "docs", "k", "max_batch"),
                "chaos": ("backend", "docs", "k", "max_batch", "plan",
                          "seed"),
+               "mutate": ("backend", "k", "max_batch", "rate",
+                          "delta_docs", "compact_at", "chaos_plan"),
                "multichip": ("n_devices",)}
 # Defaults applied to BOTH sides of a match when the key is absent —
 # how records that predate a context key stay comparable to their

@@ -116,6 +116,29 @@ _CHAOS_METRICS = {
 _CHAOS_CONTEXT = {"backend": "backend", "docs": "docs", "k": "k",
                   "requests": "requests", "max_batch": "max_batch",
                   "plan": "chaos.plan", "seed": "chaos.seed"}
+# Mutation workloads (serve_bench --mutate): the live-index receipts.
+# parity_ok (served == from-scratch rebuild, byte for byte, under a
+# mutation stream) and the zero-recompile pin gate absolutely; the
+# lag/pause percentiles gate directionally so a PR that makes
+# visibility or compaction quietly slower fails CI.
+_MUTATE_METRICS = {
+    "throughput_qps": "throughput_qps",
+    "p99_ms": "latency_ms.p99",
+    "mutation_qps": "mutate.mutation_qps",
+    "visibility_lag_p50_ms": "mutate.visibility_lag_ms.p50",
+    "visibility_lag_p99_ms": "mutate.visibility_lag_ms.p99",
+    "compactions": "mutate.compaction.count",
+    "compaction_pause_max_ms": "mutate.compaction.pause_ms.max",
+    "recompiles_after_warmup": "recompiles_after_warmup",
+    "parity_ok": "mutate.parity_ok",
+    "compactor_dead": "mutate.compaction.compactor_dead",
+}
+_MUTATE_CONTEXT = {"backend": "backend", "docs": "docs", "k": "k",
+                   "requests": "requests", "max_batch": "max_batch",
+                   "rate": "mutate.rate",
+                   "delta_docs": "mutate.delta_docs",
+                   "compact_at": "mutate.compact_at",
+                   "chaos_plan": "mutate.chaos_plan"}
 # Multi-chip dryrun artifacts (MULTICHIP_r0X.json): a driver wrapper
 # with no parsed payload — just the mesh smoke's verdict. "ok" is the
 # gated metric (1 must stay 1); n_devices is comparability context.
@@ -162,9 +185,12 @@ def unwrap(doc: dict) -> Optional[dict]:
 
 def classify(payload: dict) -> Optional[str]:
     if payload.get("metric") == "serve_bench":
-        # A serve_bench run under an armed fault plan is its own kind:
-        # chaos runs are only comparable to chaos runs with the SAME
-        # plan (context below), never to clean serving baselines.
+        # A serve_bench run under an armed fault plan (or a mutation
+        # stream) is its own kind: chaos/mutate runs are only
+        # comparable to runs of the same shape (context below), never
+        # to clean serving baselines.
+        if "mutate" in payload:
+            return "mutate"
         return "chaos" if "chaos" in payload else "serve_bench"
     if payload.get("unit") == "docs/sec" or "vs_baseline" in payload:
         return "bench"
@@ -190,10 +216,12 @@ def normalize(path: str) -> Tuple[Optional[dict], Optional[str]]:
     metric_paths = {"serve_bench": _SERVE_METRICS,
                     "bench": _BENCH_METRICS,
                     "chaos": _CHAOS_METRICS,
+                    "mutate": _MUTATE_METRICS,
                     "multichip": _MULTICHIP_METRICS}[kind]
     ctx_paths = {"serve_bench": _SERVE_CONTEXT,
                  "bench": _BENCH_CONTEXT,
                  "chaos": _CHAOS_CONTEXT,
+                 "mutate": _MUTATE_CONTEXT,
                  "multichip": _MULTICHIP_CONTEXT}[kind]
     metrics = {name: (int(v) if isinstance(v, bool) else v)
                for name, p in metric_paths.items()
@@ -281,7 +309,9 @@ def backfill_paths() -> List[str]:
             + sorted(glob.glob(os.path.join(_common.REPO,
                                             "MULTICHIP_r*.json")))
             + sorted(glob.glob(os.path.join(_common.REPO,
-                                            "SERVE_r*.json"))))
+                                            "SERVE_r*.json")))
+            + sorted(glob.glob(os.path.join(_common.REPO,
+                                            "MUTATE_r*.json"))))
 
 
 def main() -> int:

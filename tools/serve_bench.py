@@ -44,6 +44,274 @@ def make_queries(rng, pool_size, n_words, qlen):
     return draw
 
 
+def _percentiles(xs):
+    if not xs:
+        return {"p50": 0.0, "p99": 0.0, "max": 0.0}
+    xs = sorted(xs)
+    pick = lambda q: xs[min(len(xs) - 1, int(q * len(xs)))]  # noqa: E731
+    return {"p50": round(pick(0.50), 3), "p99": round(pick(0.99), 3),
+            "max": round(xs[-1], 3)}
+
+
+def run_mutate(args, input_dir) -> int:
+    """The --mutate workload: Zipf queries + a live add/update/delete
+    stream against one SegmentedIndex-backed server. Every mutation's
+    visibility lag (op issue -> epoch installed) is measured, the
+    background compactor runs supervised, and the run ends with a
+    from-scratch rebuild-parity verdict — the acceptance receipts of
+    ROADMAP item 2 in one MUTATE_r0x.json artifact."""
+    import bench as benchmod
+    import jax
+
+    from tfidf_tpu import obs
+    from tfidf_tpu.config import PipelineConfig, ServeConfig, VocabMode
+    from tfidf_tpu.index import (Compactor, SegmentedIndex,
+                                 index_compile_cache_size)
+    from tfidf_tpu.serve import ServeError, TfidfServer
+
+    log = obs.get_log()
+    cfg = PipelineConfig(vocab_mode=VocabMode.HASHED,
+                         vocab_size=benchmod.VOCAB,
+                         max_doc_len=args.doc_len)
+    t0 = time.perf_counter()
+    segidx = SegmentedIndex.from_dir(input_dir, cfg, strict=False,
+                                     delta_docs=args.delta_docs,
+                                     compact_at=args.compact_at)
+    index_s = time.perf_counter() - t0
+    # Chaos arms AFTER the warm cycle (below): the warm compactions
+    # must run clean so the injected kills land in the measured
+    # window, where the supervised compactor has to absorb them.
+    serve_cfg = ServeConfig(
+        max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+        queue_depth=args.queue_depth, cache_entries=args.cache_entries,
+        default_deadline_ms=args.deadline_ms,
+        delta_docs=args.delta_docs, compact_at=args.compact_at)
+    server = TfidfServer(segidx.view(), serve_cfg)
+    server.attach_segments(segidx)
+    rng = np.random.default_rng(args.seed)
+    draw = make_queries(rng, args.pool, benchmod.N_WORDS, qlen=4)
+    sizes = [int(s) for s in args.queries_per_request.split(",")]
+
+    def synth_doc():
+        return " ".join(f"w{rng.integers(0, benchmod.N_WORDS)}"
+                        for _ in range(16))
+
+    buckets, b = set(), 1
+    while b < max(args.max_batch, max(sizes)):
+        buckets.add(b)
+        b *= 2
+    buckets.add(b)
+
+    def bucket_warm():
+        # Cache BYPASSED: a partial cache hit would shrink the
+        # coalesced batch below nb and leave that (Q-bucket x
+        # segment-count) program uncompiled — to surface as a
+        # steady-state recompile the moment a real batch misses.
+        for nb in sorted(buckets):
+            server.submit([draw() for _ in range(nb)], args.k,
+                          use_cache=False).result(timeout=120)
+
+    # Warm-up: one full segment LIFECYCLE (delta fill -> seal ->
+    # compaction, twice — the second pass runs at the post-compaction
+    # merged capacity) with the query buckets touched at every
+    # segment-count state, so steady-state mutation re-runs warm
+    # programs only. Everything after mark_warm must be 0 recompiles.
+    bucket_warm()
+    warm_i = 0
+    compactions_done = 0
+    while compactions_done < 2:
+        server.add_docs([f"warm{warm_i}"], [synth_doc()])
+        warm_i += 1
+        if segidx.needs_compaction:
+            bucket_warm()          # warm the max-segment-count shapes
+            server.compact_now()
+            compactions_done += 1
+            bucket_warm()          # warm the post-compaction shapes
+    bucket_warm()
+    compiles_warm = index_compile_cache_size()
+    server.mark_warm()
+    log.info("serve_bench",
+             msg=f"mutate warm cycle: {warm_i} adds, "
+                 f"{compactions_done} compactions, "
+                 f"{compiles_warm} index programs compiled")
+
+    armed_plan = None
+    if args.chaos:
+        from tfidf_tpu import faults as faults_mod
+        armed_plan = faults_mod.FaultPlan.parse(args.chaos,
+                                                seed=args.chaos_seed)
+        faults_mod.arm(armed_plan)
+    compactor = Compactor(server.compact_now, period_s=0.05,
+                          restart_budget=serve_cfg.restart_budget
+                          ).start()
+    pauses_before = len(segidx.compactions)
+
+    lags_ms = []
+    mut_counts = {"add": 0, "update": 0, "delete": 0, "failed": 0}
+    added = []
+    lock = threading.Lock()
+    shed = [0]
+    done = [0]
+
+    def mutator():
+        i = 0
+        while i < args.mutations:
+            t1 = time.perf_counter()
+            try:
+                if i % 3 == 0 or not added:
+                    name = f"mut{i}"
+                    server.add_docs([name], [synth_doc()])
+                    with lock:
+                        added.append(name)
+                        mut_counts["add"] += 1
+                elif i % 3 == 1:
+                    with lock:
+                        name = added[i % len(added)]
+                    server.add_docs([name], [synth_doc()])
+                    with lock:
+                        mut_counts["update"] += 1
+                else:
+                    with lock:
+                        name = added.pop(0)
+                    server.delete_docs([name])
+                    with lock:
+                        mut_counts["delete"] += 1
+                with lock:
+                    lags_ms.append((time.perf_counter() - t1) * 1e3)
+            except Exception:  # noqa: BLE001 — count and keep loading
+                with lock:
+                    mut_counts["failed"] += 1
+            i += 1
+            if args.mutate > 0:
+                time.sleep(1.0 / args.mutate)
+
+    def query_worker():
+        while True:
+            with lock:
+                if done[0] >= args.requests:
+                    return
+                i = done[0]
+                done[0] += 1
+            qs = [draw() for _ in range(sizes[i % len(sizes)])]
+            try:
+                server.search(qs, k=args.k)
+            except ServeError:
+                with lock:
+                    shed[0] += 1
+
+    t_run = time.perf_counter()
+    mut_thread = threading.Thread(target=mutator)
+    workers = [threading.Thread(target=query_worker)
+               for _ in range(args.concurrency)]
+    mut_thread.start()
+    for th in workers:
+        th.start()
+    mut_thread.join()
+    for th in workers:
+        th.join()
+    wall = time.perf_counter() - t_run
+    # Let the supervised compactor drain any pending merge (absorbing
+    # every armed kill) before stopping — the chaos receipts below
+    # must reflect a settled index, not a race with shutdown.
+    t_wait = time.perf_counter()
+    while (segidx.needs_compaction and not compactor.dead
+           and time.perf_counter() - t_wait < 10.0):
+        time.sleep(0.02)
+    compactor.stop()
+    if armed_plan is not None:
+        from tfidf_tpu import faults as faults_mod
+        faults_mod.disarm()
+    recompiles = index_compile_cache_size() - compiles_warm
+
+    # Parity verdict: the quiesced live index vs a FROM-SCRATCH
+    # rebuild of the live corpus — responses must map to identical
+    # (name, score) rows, byte for byte.
+    pinned = [draw() for _ in range(8)]
+    svals, sids = server.submit(pinned, args.k,
+                                use_cache=False).result(timeout=60)
+    names = server.doc_names()
+    # Final health: two evaluations so chaos-provoked shed windows
+    # have decayed (the chaos path's discipline); the breaker must
+    # have closed for the run to count as recovered.
+    server.health.evaluate()
+    final_health = server.health.evaluate().state
+    breaker_open = int(server.breaker.state != "closed")
+    # Close BEFORE the oracle search: the rebuild compiles its own
+    # search program, which must not register as a steady-state serve
+    # recompile on the (then-uninstalled) compile watch.
+    server.close(drain=True)
+    rebuild = segidx.rebuild_retriever()
+    rvals, rids = rebuild.search(pinned, args.k)
+    parity_ok = int(
+        np.array_equal(svals, rvals)
+        and [[names[i] if i >= 0 else None for i in row]
+             for row in sids]
+        == [[rebuild.names[i] if i >= 0 else None for i in row]
+            for row in rids])
+
+    pauses = [c["pause_s"] * 1e3
+              for c in segidx.compactions[pauses_before:]]
+    snap = server.metrics_snapshot()
+    lat = snap["latency_s"]
+    n_muts = sum(mut_counts[k] for k in ("add", "update", "delete"))
+    artifact = {
+        "metric": "serve_bench",
+        "mode": "mutate",
+        "backend": jax.default_backend(),
+        "docs": segidx.num_docs,
+        "k": args.k,
+        "requests": args.requests,
+        "concurrency": args.concurrency,
+        "max_batch": args.max_batch,
+        "wall_s": round(wall, 4),
+        "throughput_qps": round(snap["queries"] / wall, 2),
+        "throughput_rps": round(snap["requests"] / wall, 2),
+        "latency_ms": {p: round(lat[p] * 1e3, 3)
+                       for p in ("p50", "p95", "p99", "mean", "max")
+                       if p in lat},
+        "cache": snap["cache"],
+        "shed": snap["shed"],
+        "index_s": round(index_s, 3),
+        "recompiles_after_warmup": recompiles,
+        "mutate": {
+            "rate": args.mutate,
+            "ops": n_muts,
+            "counts": dict(mut_counts),
+            "mutation_qps": round(n_muts / wall, 2) if wall else 0.0,
+            "visibility_lag_ms": _percentiles(lags_ms),
+            "compaction": {
+                "count": len(pauses),
+                "pause_ms": _percentiles(pauses),
+                "compactor_restarts": compactor.restarts,
+                "compactor_dead": int(compactor.dead),
+            },
+            "delta_docs": args.delta_docs,
+            "compact_at": args.compact_at,
+            "xla_recompiles_after_warm": recompiles,
+            "parity_ok": parity_ok,
+            "final_health": final_health,
+            "breaker_open_at_exit": breaker_open,
+        },
+    }
+    if args.chaos:
+        artifact["mutate"]["chaos_plan"] = args.chaos
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(artifact, sort_keys=True))
+    if recompiles:
+        log.warning("serve_bench_recompiles",
+                    msg=f"warning: {recompiles} recompiles after "
+                        f"warmup (expected 0)", recompiles=recompiles)
+        return 1
+    if not parity_ok:
+        log.error("serve_bench_chaos_parity",
+                  msg="mutate parity FAILED: served responses diverge "
+                      "from the from-scratch rebuild oracle")
+        return 1
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(
         description=__doc__.split("\n")[0],
@@ -107,6 +375,22 @@ def main() -> int:
                          "bench inject matching poison requests")
     ap.add_argument("--chaos-seed", type=int, default=0,
                     help="fault-plan + jitter seed (replayable chaos)")
+    ap.add_argument("--mutate", type=float, default=0.0, metavar="RATE",
+                    help="mixed read/write workload: serve an LSM-"
+                         "segmented index and stream add/update/"
+                         "delete mutations at RATE ops/sec alongside "
+                         "the Zipf query load (MUTATE_r0x.json "
+                         "artifact: mutation qps, visibility lag "
+                         "p50/p99, compaction pause stats, recompile "
+                         "receipt, rebuild-parity verdict). 0 = off")
+    ap.add_argument("--mutations", type=int, default=64,
+                    help="total mutation ops the --mutate stream "
+                         "issues")
+    ap.add_argument("--delta-docs", type=int, default=256,
+                    help="--mutate: delta-segment capacity")
+    ap.add_argument("--compact-at", type=int, default=2,
+                    help="--mutate: sealed-segment compaction "
+                         "threshold")
     ap.add_argument("--out", default="SERVE_r01.json")
     ap.add_argument("--trace", metavar="OUT.json", default=None,
                     help="record the host span timeline (request "
@@ -143,6 +427,8 @@ def main() -> int:
     else:
         input_dir = args.input
     try:
+        if args.mutate > 0:
+            return run_mutate(args, input_dir)
         cfg = PipelineConfig(vocab_mode=VocabMode.HASHED,
                              vocab_size=benchmod.VOCAB,
                              max_doc_len=args.doc_len)
