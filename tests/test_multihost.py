@@ -47,11 +47,12 @@ assert topo.global_devices == 2 * topo.local_devices, topo
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
+from tfidf_tpu.parallel.compat import shard_map
 devs = jax.devices()
 mesh = Mesh(devs, ("d",))
 got = jax.jit(
-    jax.shard_map(lambda x: jax.lax.psum(x, "d"), mesh=mesh,
-                  in_specs=P("d"), out_specs=P()),
+    shard_map(lambda x: jax.lax.psum(x, "d"), mesh=mesh,
+              in_specs=P("d"), out_specs=P()),
 )(jnp.arange(len(devs), dtype=jnp.float32))
 assert float(got[0]) == sum(range(len(devs))), got
 

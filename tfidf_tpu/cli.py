@@ -404,6 +404,17 @@ def _build_parser() -> argparse.ArgumentParser:
                          "(checkpoint.py seq+LATEST protocol). "
                          "JSONL op {\"op\": \"snapshot\"} snapshots "
                          "on demand")
+    sv.add_argument("--mesh-shards", type=int, default=None,
+                    help="serve ONE logical index doc-sharded across "
+                         "this many devices (0 = all): per-shard "
+                         "fused score/top-k under shard_map, a "
+                         "device-side top-k-of-top-k merge riding one "
+                         "collective back — responses BIT-identical "
+                         "to single-device serving; swap/mutation/"
+                         "snapshot installs re-shard automatically "
+                         "(default: off — single device; env "
+                         "TFIDF_TPU_MESH_SHARDS; docs/SERVING.md "
+                         "'Sharded serving')")
     sv.add_argument("--delta-docs", type=int, default=None,
                     help="serve an LSM-style SEGMENTED index with a "
                          "delta segment of this capacity: the "
@@ -1078,7 +1089,8 @@ def _run_serve(args) -> int:
         snapshot_dir=args.snapshot_dir, faults=args.faults,
         fault_seed=args.fault_seed, slow_ms=args.slow_ms,
         slo_ms=args.slo_ms, slo_target=args.slo_target,
-        delta_docs=args.delta_docs, compact_at=args.compact_at)
+        delta_docs=args.delta_docs, compact_at=args.compact_at,
+        mesh_shards=args.mesh_shards)
 
     # Crash-fast start: a committed snapshot with a matching config
     # fingerprint restores the resident index from disk — seconds, no
@@ -1163,10 +1175,23 @@ def _run_serve(args) -> int:
         # (empty queries compile the same Q-shaped programs), then
         # draw the warm line: from here the compile watchdog flags
         # any fresh search program as a steady-state recompile —
-        # flight event + windowed degraded health reason.
+        # flight event + windowed degraded health reason. Warm the
+        # INSTALLED index (the server may have mesh-sharded it):
+        # warming the single-device program under --mesh-shards would
+        # leave every sharded program cold, to surface as a
+        # steady-state recompile on the first real batch.
+        _, installed = server.current_index()
+        warm_targets = [installed]
+        # A mesh-sharded index keeps its single-device source as the
+        # canary parity oracle; its buckets must be warm too, or the
+        # first oracle capture would read as a steady-state recompile.
+        oracle = getattr(installed, "parity_oracle", lambda: None)()
+        if oracle is not None:
+            warm_targets.append(oracle)
         b = 1
         while b <= serve_cfg.max_batch:
-            retriever.search([""] * b, k=args.k)
+            for target in warm_targets:
+                target.search([""] * b, k=args.k)
             b *= 2
         server.mark_warm()
     # The serve process's monitor is THE process monitor: reindex
@@ -1201,7 +1226,9 @@ def _run_serve(args) -> int:
                      f"snapshot={snap_state}, "
                      f"faults={'armed' if serve_cfg.faults else 'off'}, "
                      f"segments="
-                     f"{'on' if segments is not None else 'off'}"
+                     f"{'on' if segments is not None else 'off'}, "
+                     f"mesh="
+                     f"{serve_cfg.mesh_shards if serve_cfg.mesh_shards is not None else 'off'}"
                      f")\n")
 
     prev_term = _install_sigterm_dump()

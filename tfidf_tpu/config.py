@@ -320,6 +320,17 @@ class ServeConfig:
       compact_at: sealed-segment count at which the background
         compactor merges them into one (dropping tombstones). CLI
         ``--compact-at`` / env ``TFIDF_TPU_COMPACT_AT``.
+      mesh_shards: serve ONE logical index doc-sharded across this
+        many devices (``0`` = every visible device): the resident
+        index's BCOO blocks live block-sharded over the mesh's
+        ``docs`` axis, queries broadcast to all shards, each shard
+        runs the fused score/top-k over its rows and a device-side
+        top-k-of-top-k merge rides one collective back — responses
+        BIT-identical to single-device serving
+        (``tfidf_tpu/parallel/serving.py``). Every index install
+        (swap, mutation, restore) re-shards through the same
+        transform. None = classic single-device serving. CLI
+        ``--mesh-shards`` / env ``TFIDF_TPU_MESH_SHARDS``.
     """
 
     max_batch: int = 64
@@ -345,6 +356,7 @@ class ServeConfig:
     slo_target: float = 0.99
     delta_docs: Optional[int] = None
     compact_at: int = 4
+    mesh_shards: Optional[int] = None
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -394,6 +406,9 @@ class ServeConfig:
                              "(None disables segmented serving)")
         if self.compact_at < 2:
             raise ValueError("compact_at must be >= 2")
+        if self.mesh_shards is not None and self.mesh_shards < 0:
+            raise ValueError("mesh_shards must be >= 0 (0 = all "
+                             "devices; None disables mesh serving)")
 
     @staticmethod
     def from_env(**overrides) -> "ServeConfig":
@@ -430,7 +445,8 @@ class ServeConfig:
                 ("slo_ms", "TFIDF_TPU_SLO_MS", float),
                 ("slo_target", "TFIDF_TPU_SLO_TARGET", float),
                 ("delta_docs", "TFIDF_TPU_DELTA_DOCS", int),
-                ("compact_at", "TFIDF_TPU_COMPACT_AT", int)):
+                ("compact_at", "TFIDF_TPU_COMPACT_AT", int),
+                ("mesh_shards", "TFIDF_TPU_MESH_SHARDS", int)):
             val = pick(key, env, cast)
             if val is not None:
                 kw[key] = val

@@ -55,6 +55,7 @@ from jax import lax
 from tfidf_tpu import faults, obs
 from tfidf_tpu.config import (PipelineConfig, TokenizerKind, VocabMode,
                               apply_compile_cache)
+from tfidf_tpu.parallel.compat import shard_map
 from tfidf_tpu.io import fast_tokenizer
 from tfidf_tpu.io.corpus import discover_names, pack_corpus
 from tfidf_tpu.obs.health import beat as _health_beat
@@ -826,7 +827,7 @@ def _mesh_chunk_step_fn(plan: "MeshPlan", vocab_size: int):
             df_part + sparse_df(ids, head, vocab_size)[None, :]
 
     sharded = (P(DOCS_AXIS, None), P(DOCS_AXIS), P(DOCS_AXIS, None))
-    mapped = jax.shard_map(body, mesh=plan.mesh, in_specs=sharded,
+    mapped = shard_map(body, mesh=plan.mesh, in_specs=sharded,
                            out_specs=(P(DOCS_AXIS, None),) * 4)
     return jax.jit(mapped)
 
@@ -845,7 +846,7 @@ def _mesh_phase_a_fn(plan: "MeshPlan", vocab_size: int):
         ids, _, head = sorted_term_counts(tokens, lengths)
         return df_part + sparse_df(ids, head, vocab_size)[None, :]
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         body, mesh=plan.mesh,
         in_specs=(P(DOCS_AXIS, None), P(DOCS_AXIS), P(DOCS_AXIS, None)),
         out_specs=P(DOCS_AXIS, None))
@@ -862,7 +863,7 @@ def _mesh_idf_fn(plan: "MeshPlan", score_dtype):
         df_total = lax.psum(df_part[0], DOCS_AXIS)  # the ONE collective
         return df_total, idf_from_df(df_total, num_docs, score_dtype)
 
-    mapped = jax.shard_map(body, mesh=plan.mesh,
+    mapped = shard_map(body, mesh=plan.mesh,
                            in_specs=(P(DOCS_AXIS, None), P()),
                            out_specs=(P(), P()), check_vma=False)
     return jax.jit(mapped)
@@ -879,7 +880,7 @@ def _mesh_phase_b_fn(plan: "MeshPlan", topk: int):
         scores = sparse_scores(ids, counts, head, lengths, idf)
         return sparse_topk(scores, ids, head, topk)
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         body, mesh=plan.mesh,
         in_specs=(P(DOCS_AXIS, None), P(DOCS_AXIS), P()),
         out_specs=(P(DOCS_AXIS, None), P(DOCS_AXIS, None)),
@@ -897,7 +898,7 @@ def _mesh_phase_b_cached_fn(plan: "MeshPlan", topk: int):
         scores = sparse_scores(ids, counts, head, lengths, idf)
         return sparse_topk(scores, ids, head, topk)
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         body, mesh=plan.mesh,
         in_specs=(P(DOCS_AXIS, None),) * 3 + (P(DOCS_AXIS), P()),
         out_specs=(P(DOCS_AXIS, None), P(DOCS_AXIS, None)),
@@ -1100,7 +1101,7 @@ def _mesh_finish_fn(plan: "MeshPlan", n_chunks: int, topk: int, score_dtype):
     # docs-sharded. check_vma=False: the static replication checker
     # cannot infer the psum-made replication.
     out_specs = (P(), P(DOCS_AXIS, None), P(DOCS_AXIS, None))
-    mapped = jax.shard_map(body, mesh=plan.mesh, in_specs=in_specs,
+    mapped = shard_map(body, mesh=plan.mesh, in_specs=in_specs,
                            out_specs=out_specs, check_vma=False)
     return jax.jit(mapped)
 

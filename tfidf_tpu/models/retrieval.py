@@ -46,6 +46,7 @@ from tfidf_tpu.ops.sparse import (sorted_term_counts, sparse_df,
                                   sparse_scores)
 from tfidf_tpu.ops.tokenize import whitespace_tokenize
 from tfidf_tpu.parallel.mesh import DOCS_AXIS, MeshPlan
+from tfidf_tpu.parallel.compat import shard_map
 
 
 @functools.partial(jax.jit, static_argnames=("vocab_size",))
@@ -126,7 +127,7 @@ def _make_search_sharded(plan: MeshPlan, k: int):
         best, sel = lax.top_k(vals, min(k, local_k * n_shards))
         return best, jnp.take_along_axis(idx, sel, axis=1)
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         body, mesh=mesh,
         in_specs=(P(DOCS_AXIS, None), P(DOCS_AXIS, None), P(DOCS_AXIS, None),
                   P(None, None)),

@@ -104,6 +104,8 @@ class DeviceMonitor:
         self._gauges: Dict[str, object] = {}
         self._pressure = 0.0            # last sampled max fraction
         self._peak_bytes = 0            # max peak_bytes_in_use seen
+        self._shards_fn: Optional[Callable] = None
+        self._last_shard_bytes: Optional[Tuple[int, ...]] = None
         self._armed_mark: Optional[float] = None  # highest rung crossed
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -121,6 +123,21 @@ class DeviceMonitor:
     def unregister_owner(self, name: str) -> None:
         with self._lock:
             self._owners.pop(name, None)
+
+    def register_shards(self, shards_fn: Optional[Callable]) -> None:
+        """Attach a mesh-shard balance feed: ``shards_fn()`` returns
+        the :meth:`~tfidf_tpu.parallel.serving.MeshShardedRetriever.
+        shard_stats` dict (``n_shards`` / ``shard_bytes`` /
+        ``imbalance``) or None while the index is not sharded. Every
+        :meth:`sample` publishes the ``shard_bytes_d*`` gauge family
+        plus ``shard_imbalance_milli``, and logs an edge-triggered
+        ``shard_balance`` flight event when the per-shard bytes
+        change (index installs are the only thing that moves them) —
+        the record ``tools/doctor.py``'s shards section and
+        ``--shard-imbalance`` budget read."""
+        with self._lock:
+            self._shards_fn = shards_fn
+            self._last_shard_bytes = None
 
     # --- sampling -----------------------------------------------------
     def _device_stats(self, dev):
@@ -142,6 +159,14 @@ class DeviceMonitor:
         its own cadence, and the peak/gauge/watermark updates are
         read-modify-writes."""
         import jax
+        with self._lock:
+            shards_fn = self._shards_fn
+        shard_stats = None
+        if shards_fn is not None:
+            try:
+                shard_stats = shards_fn()
+            except Exception:   # a mid-swap index must not kill sampling
+                shard_stats = None
         with self._lock:
             devices = []
             pressure = 0.0
@@ -178,7 +203,40 @@ class DeviceMonitor:
                     "memory_pressure": round(pressure, 4),
                     "peak_bytes": self._peak_bytes,
                     "samples": self._samples}
+        if shard_stats:
+            self._publish_shards(shard_stats)
+            snap["shards"] = shard_stats
         return snap
+
+    def _publish_shards(self, stats: dict) -> None:
+        """Gauge + flight publication of one shard-balance reading
+        (takes the lock itself — the gauge map and the edge state are
+        the same cross-thread RMWs :meth:`sample` serializes). Per-
+        shard bytes move only when an index installs, so the
+        ``shard_balance`` event is edge-triggered on the bytes vector
+        — sparse by construction."""
+        per = stats.get("shard_bytes") or []
+        imbalance = stats.get("imbalance", 1.0)
+        with self._lock:
+            for i, b in enumerate(per):
+                self._gauge(f"shard_bytes_d{i}",
+                            "index bytes resident on this docs-shard"
+                            ).set(int(b))
+            self._gauge("shard_imbalance_milli",
+                        "max/mean per-shard index bytes, in 1/1000"
+                        ).set(int(round(imbalance * 1000)))
+            key = tuple(int(b) for b in per)
+            changed = key != self._last_shard_bytes
+            self._last_shard_bytes = key
+        if changed:
+            obs_log.log_event(
+                "info", "shard_balance",
+                msg=f"index sharded {len(per)} ways: "
+                    f"{[round(b / 1e6, 2) for b in per]} MB/shard, "
+                    f"imbalance {imbalance:.3f}",
+                n_shards=stats.get("n_shards", len(per)),
+                shard_bytes=list(key),
+                imbalance=imbalance)
 
     def _gauge(self, name: str, help: str):
         g = self._gauges.get(name)

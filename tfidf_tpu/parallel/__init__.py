@@ -7,6 +7,7 @@ per-shard program, and XLA collectives over ICI — the reduce+bcast
 pair of the reference (``TFIDF.c:215,220``) is one ``lax.psum``.
 """
 
+from tfidf_tpu.parallel.compat import shard_map
 from tfidf_tpu.parallel.mesh import MeshPlan, DOCS_AXIS, VOCAB_AXIS, SEQ_AXIS
 from tfidf_tpu.parallel.sharded import ShardedPipeline
 from tfidf_tpu.parallel.collectives import sharded_tf_df
@@ -18,4 +19,5 @@ __all__ = [
     "SEQ_AXIS",
     "ShardedPipeline",
     "sharded_tf_df",
+    "shard_map",
 ]

@@ -28,6 +28,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from tfidf_tpu.ops.histogram import tf_counts_masked
+from tfidf_tpu.parallel.compat import shard_map
 from tfidf_tpu.ops.scoring import idf_from_df
 from tfidf_tpu.parallel.mesh import DOCS_AXIS, MeshPlan, SEQ_AXIS, VOCAB_AXIS
 
@@ -121,7 +122,7 @@ def make_sharded_forward(plan: MeshPlan, vocab_size: int, score_dtype,
     # check_vma=False: the top-k outputs are replicated across the vocab
     # axis by the all_gather+re-select, which the static replication
     # checker cannot infer.
-    mapped = jax.shard_map(
+    mapped = shard_map(
         body, mesh=plan.mesh,
         in_specs=(plan.batch_spec(), plan.lengths_spec(), P()),
         out_specs=out_specs, check_vma=False)
@@ -163,7 +164,7 @@ def make_sparse_sharded_forward(plan: MeshPlan, vocab_size: int, score_dtype,
                              score_dtype=score_dtype, topk=topk)
     n_out = 3 if topk is not None else 5
     out_specs = (P(VOCAB_AXIS),) + (P(DOCS_AXIS, None),) * (n_out - 1)
-    mapped = jax.shard_map(
+    mapped = shard_map(
         body, mesh=plan.mesh,
         in_specs=(plan.batch_spec(), plan.lengths_spec(), P()),
         out_specs=out_specs, check_vma=False)
@@ -213,7 +214,7 @@ def make_chargram_sharded_forward(plan: MeshPlan, vocab_size: int,
 
     out_specs = (P(VOCAB_AXIS), P(DOCS_AXIS), P(DOCS_AXIS, None),
                  P(DOCS_AXIS, None))
-    mapped = jax.shard_map(
+    mapped = shard_map(
         body, mesh=plan.mesh,
         in_specs=(plan.batch_spec(), plan.lengths_spec(), P()),
         out_specs=out_specs, check_vma=False)

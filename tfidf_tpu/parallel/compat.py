@@ -1,0 +1,60 @@
+"""``shard_map`` compatibility shim.
+
+Every mesh code path in this repo maps its per-shard body with one
+call shape::
+
+    shard_map(body, mesh=plan.mesh, in_specs=..., out_specs=...,
+              check_vma=False)
+
+Modern jax exports that as top-level ``jax.shard_map`` (with the
+replication checker knob spelled ``check_vma``); the 0.4.x line this
+environment deploys only ships ``jax.experimental.shard_map.shard_map``
+(knob spelled ``check_rep``) — and until round 18 that single missing
+export kept all 37 mesh tests dark. This module is the one place that
+difference lives: call sites import :func:`shard_map` from here and
+never touch ``jax.shard_map`` directly.
+
+Resolution order (decided once, at import):
+
+* ``jax.shard_map`` when the running jax exports it — the call is
+  passed through untouched;
+* else ``jax.experimental.shard_map.shard_map`` with ``check_vma``
+  translated to ``check_rep`` (same meaning: disable the static
+  replication checker where collectives make replication the checker
+  cannot infer).
+
+``tests/conftest.py`` probes THIS function at collection time; an
+environment where neither spelling works still turns the mesh tests
+into skips carrying the probe's error (the round-7 machinery, kept for
+genuinely broken envs).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+
+__all__ = ["shard_map", "HAS_NATIVE_SHARD_MAP"]
+
+#: True when the running jax exports top-level ``jax.shard_map`` (the
+#: passthrough path); False means the experimental fallback carries
+#: every mesh program. Exposed so tests can pin which branch is live.
+HAS_NATIVE_SHARD_MAP = hasattr(jax, "shard_map")
+
+
+def shard_map(f: Callable, *, mesh: Any, in_specs: Any, out_specs: Any,
+              check_vma: bool = True) -> Callable:
+    """Map ``f`` over ``mesh`` shards — ``jax.shard_map`` everywhere.
+
+    Keyword-only, matching how every call site in the repo spells it.
+    ``check_vma=False`` disables the static replication checker on
+    both lowerings (it is ``check_rep`` on the 0.4.x experimental
+    export).
+    """
+    if HAS_NATIVE_SHARD_MAP:
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)

@@ -26,6 +26,7 @@ from jax.sharding import PartitionSpec as P
 
 from tfidf_tpu.ops.histogram import tf_counts_masked
 from tfidf_tpu.parallel.mesh import DOCS_AXIS, MeshPlan, SEQ_AXIS, VOCAB_AXIS
+from tfidf_tpu.parallel.compat import shard_map
 
 _ALL_AXES = (DOCS_AXIS, SEQ_AXIS, VOCAB_AXIS)
 
@@ -54,7 +55,7 @@ def make_long_doc_histogram(plan: MeshPlan, vocab_size: int):
     for scoring against a DF table.
     """
     body = functools.partial(_body, vocab_size=vocab_size)
-    mapped = jax.shard_map(body, mesh=plan.mesh,
+    mapped = shard_map(body, mesh=plan.mesh,
                            in_specs=(P(_ALL_AXES), P()),
                            out_specs=P())
     return jax.jit(mapped)

@@ -1,0 +1,340 @@
+"""Mesh-sharded serving: ONE logical index doc-sharded across the chips.
+
+ROADMAP item 1's serving half. The serve tier held a single-device
+``TfidfRetriever`` — "millions of documents" capped by one HBM. This
+module shards the *retriever* the way ``parallel.collectives`` shards
+the ingest: the row-sparse BCOO index blocks live block-sharded over
+the mesh's ``docs`` axis (``NamedSharding`` over
+``MeshPlan.batch_spec``-shaped arrays), a query batch is replicated to
+every shard, each shard runs PR 3's fused score/top-k
+(``ops.topk.segment_score_topk`` — the BCOO sparse x dense MXU matmul,
+unchanged) over ITS rows only, and the per-shard [Q, k] candidates
+merge with a device-side top-k-of-top-k (``ops.topk.merge_topk``)
+riding ONE ``all_gather`` back — the reference's serial
+``MPI_Recv`` gather loop (``TFIDF.c:256-270``) done as a collective.
+
+Parity is the contract, not a hope: every response is BIT-identical —
+scores, doc indices, tie order — to the single-device
+``TfidfRetriever.search`` of the same corpus (pinned by
+tests/test_mesh_serve.py):
+
+* per-row BCOO scoring is row-parallel, so a shard's rows reduce in
+  the same order the full matrix would;
+* ``lax.top_k`` breaks equal scores by LOWEST index; per-shard
+  candidates concatenate in shard (= global row) order through the
+  tiled ``all_gather``, so the merge's tie-break reproduces the
+  single-device lowest-global-index discipline exactly — the same
+  argument ``ops/topk.py`` makes for the segmented index, because it
+  is the same primitive.
+
+:class:`MeshShardedRetriever` duck-types the retriever search contract
+(``search`` / ``names`` / ``config`` / ``indexed`` / ``_num_docs`` /
+``snapshot``) the same way the segmented index's ``IndexView`` does —
+which is exactly what lets ``TfidfServer`` hold one where it held a
+retriever, and lets every install path (swap, add/delete mutation,
+compaction, snapshot-restore) re-shard through one transform.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from tfidf_tpu.parallel.compat import shard_map
+from tfidf_tpu.parallel.mesh import DOCS_AXIS, MeshPlan
+
+__all__ = ["MeshShardedRetriever", "make_serving_plan", "shard_index",
+           "mesh_search_cache_size"]
+
+
+def _jax():  # deferred so tools can import the module without a backend
+    import jax
+    import jax.numpy as jnp
+    return jax, jnp
+
+
+def make_serving_plan(n_shards: int,
+                      devices: Optional[Sequence] = None) -> MeshPlan:
+    """A docs-only serving mesh over the first ``n_shards`` devices
+    (``0`` = every device) — the ``--mesh-shards`` resolution."""
+    jax, _ = _jax()
+    devs = list(devices if devices is not None else jax.devices())
+    if n_shards == 0:
+        n_shards = len(devs)
+    if n_shards < 1:
+        raise ValueError("mesh_shards must be >= 1 (0 = all devices)")
+    if n_shards > len(devs):
+        raise ValueError(
+            f"mesh_shards={n_shards} exceeds the {len(devs)} visible "
+            f"device(s)")
+    return MeshPlan.create(docs=n_shards, devices=devs[:n_shards])
+
+
+def shard_index(index, plan: MeshPlan,
+                keep_source: bool = True) -> "MeshShardedRetriever":
+    """Shard any retriever-contract index over ``plan`` (idempotent:
+    an already-sharded index on the same plan passes through). The one
+    transform every serve install path applies under ``--mesh-shards``."""
+    if isinstance(index, MeshShardedRetriever):
+        if index.plan is plan:
+            return index
+        source = index.parity_oracle()
+        if source is None:
+            raise ValueError("cannot re-shard onto a different plan: "
+                             "the single-device source was dropped "
+                             "(keep_source=False)")
+        index = source
+    return MeshShardedRetriever(index, plan, keep_source=keep_source)
+
+
+# One jitted sharded-search program per (plan, k); module-level so the
+# cache survives server installs (steady-state mutation re-runs warm
+# programs) and so the bench can read one compiled-program count.
+_MESH_SEARCH_FNS: Dict[Tuple, object] = {}
+_FNS_LOCK = threading.Lock()
+
+
+def _make_mesh_search(plan: MeshPlan, k: int):
+    """The sharded serving program: per-shard fused score/top-k, one
+    all_gather, device-side merge. Blocks: data/cols [D/s, L] + live
+    [D/s] local rows; qmat [V, Q] replicated."""
+    jax, jnp = _jax()
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from tfidf_tpu.ops.topk import merge_topk, segment_score_topk
+
+    def body(data, cols, live, qmat):
+        d = data.shape[0]
+        kk = min(k, d)
+        # PR 3's fused BCOO score + tombstone mask + top-k, unchanged:
+        # this shard scores only its own rows. Ids come back shard-
+        # local; the axis index globalizes them.
+        vals, ids = segment_score_topk(data, cols, live, qmat, k=kk)
+        ids = ids + lax.axis_index(DOCS_AXIS) * d
+        # The ONE collective of the query path: k-sized candidate
+        # lists (never [D, Q] score rows) gather in shard order...
+        vals_g = lax.all_gather(vals, DOCS_AXIS, axis=1, tiled=True)
+        ids_g = lax.all_gather(ids, DOCS_AXIS, axis=1, tiled=True)
+        # ...and the segmented index's top-k-of-top-k merge re-selects
+        # on device. Tie discipline: candidates sit in ascending
+        # global-row order among equal scores, so the merge's
+        # lowest-position tie-break IS the single-device
+        # lowest-doc-index tie-break.
+        return merge_topk(vals_g, ids_g, k=min(k, vals_g.shape[1]))
+
+    # check_vma=False: the all_gather+top_k merge replicates the
+    # outputs in a way the static replication checker cannot infer —
+    # same waiver as every mesh program in parallel/collectives.py.
+    return jax.jit(shard_map(
+        body, mesh=plan.mesh,
+        in_specs=(P(DOCS_AXIS, None), P(DOCS_AXIS, None), P(DOCS_AXIS),
+                  P(None, None)),
+        out_specs=(P(None, None), P(None, None)), check_vma=False))
+
+
+def _mesh_search_fn(plan: MeshPlan, k: int):
+    with _FNS_LOCK:
+        fn = _MESH_SEARCH_FNS.get((plan, k))
+        if fn is None:
+            fn = _MESH_SEARCH_FNS[(plan, k)] = _make_mesh_search(plan, k)
+        return fn
+
+
+def mesh_search_cache_size() -> int:
+    """Total compiled-program count across every sharded-search
+    function built in this process — the mesh serve bench's recompile
+    receipt (must be flat after warm-up), the
+    ``_search_bcoo._cache_size()`` twin."""
+    with _FNS_LOCK:
+        fns = list(_MESH_SEARCH_FNS.values())
+    return sum(f._cache_size() for f in fns)
+
+
+class MeshShardedRetriever:
+    """One doc-sharded serving index across a device mesh.
+
+    Built FROM an indexed single-device source — a plain
+    :class:`~tfidf_tpu.models.retrieval.TfidfRetriever` (snapshot-
+    restored ones included) or a segmented
+    :class:`~tfidf_tpu.index.IndexView` — whose row-sparse blocks are
+    padded to a shard multiple and re-placed block-sharded over the
+    plan's ``docs`` axis. Rows keep their global order, so result
+    indices (and therefore :attr:`names` positions) are the source's.
+
+    Args:
+      source: the indexed retriever-contract object to shard.
+      plan: docs-only :class:`MeshPlan` (seq=1, vocab=1).
+      keep_source: retain ``source`` as the live single-device parity
+        oracle (:meth:`parity_oracle` — what the canary prober
+        captures against) and the :meth:`snapshot` delegate. Costs the
+        source's HBM on its home device; pass False on deployments
+        where the whole point is that one device cannot hold it.
+    """
+
+    def __init__(self, source, plan: MeshPlan,
+                 keep_source: bool = True) -> None:
+        jax, jnp = _jax()
+        from jax.sharding import PartitionSpec as P
+
+        if plan.n_vocab_shards != 1 or plan.n_seq_shards != 1:
+            raise ValueError("serving shards the docs axis only; build "
+                             "the MeshPlan with seq=1, vocab=1")
+        if not getattr(source, "indexed", False):
+            raise ValueError("shard_index needs an indexed retriever "
+                             "(index()/index_dir() first)")
+        self.plan = plan
+        self.config = source.config
+        self.names: List[str] = list(source.names)
+        self._num_docs = int(source._num_docs)
+        # A sharded view keeps its segmented owner: the server's
+        # swap-vs-mutation detach check still sees who the index
+        # belongs to through the wrapper.
+        self.owner = getattr(source, "owner", None)
+        self._source = source if keep_source else None
+
+        data, cols, live, idf = self._host_blocks(source)
+        rows = data.shape[0]
+        pad = plan.pad_docs(rows) - rows
+        if pad:
+            data = np.pad(data, ((0, pad), (0, 0)))
+            cols = np.pad(cols, ((0, pad), (0, 0)))
+            live = np.pad(live, (0, pad))
+        self._rows = rows + pad
+        sh2 = plan.sharding(P(DOCS_AXIS, None))
+        sh1 = plan.sharding(P(DOCS_AXIS))
+        self._data = jax.device_put(data, sh2)
+        self._cols = jax.device_put(cols, sh2)
+        self._live = jax.device_put(live, sh1)
+        self._idf = jnp.asarray(idf)
+        self._idf_np = np.asarray(idf)
+
+    @staticmethod
+    def _host_blocks(source):
+        """Source -> host (data, cols, live, idf) row blocks, values
+        byte-identical to what the source's own search scores with.
+
+        * plain retriever: ``where(head, weights, 0)`` /
+          ``where(head, ids, 0)`` — exactly the arrays
+          ``_search_bcoo`` derives per call; live = the real-doc rows
+          (chunk-padding tail rows are all-zero and dead).
+        * segmented IndexView: the per-segment parts concatenate in
+          segment (= insertion) order — the same padded positional row
+          space ``names`` indexes, tombstones riding the live mask.
+        """
+        parts = getattr(source, "_parts", None)
+        if parts is not None:   # segmented IndexView
+            data = np.concatenate([np.asarray(p.data) for p in parts])
+            cols = np.concatenate([np.asarray(p.cols) for p in parts])
+            live = np.concatenate(
+                [np.asarray(p.live, dtype=bool) for p in parts])
+            return data, cols, live, np.asarray(source._idf)
+        head = np.asarray(source._head)
+        data = np.where(head, np.asarray(source._weights),
+                        np.float32(0.0)).astype(np.float32, copy=False)
+        cols = np.where(head, np.asarray(source._ids), 0)
+        live = np.arange(head.shape[0]) < source._num_docs
+        return data, cols, live, np.asarray(source._idf)
+
+    # --- retriever contract -------------------------------------------
+    @property
+    def indexed(self) -> bool:
+        return True
+
+    @property
+    def n_shards(self) -> int:
+        return self.plan.n_docs_shards
+
+    def parity_oracle(self):
+        """The retained single-device source (None when dropped) — the
+        bit-parity reference the canary prober captures its oracle
+        from, so the live parity gauge pins sharded-vs-single-device,
+        not sharded-vs-itself."""
+        return self._source
+
+    def snapshot(self, path: str, epoch: int = 0,
+                 extra_meta: Optional[dict] = None) -> str:
+        """Persist through the retained source (host-side protocol;
+        sharding is a placement, not a format — a restore re-shards)."""
+        if self._source is None:
+            raise ValueError(
+                "snapshot needs the retained single-device source "
+                "(shard_index(..., keep_source=True))")
+        return self._source.snapshot(path, epoch=epoch,
+                                     extra_meta=extra_meta)
+
+    def index_arrays(self) -> list:
+        """Live device arrays for the HBM census owner registration."""
+        return [self._idf, self._data, self._cols, self._live]
+
+    def shard_stats(self) -> dict:
+        """Per-shard HBM truth: bytes each docs-shard holds (summed
+        over the sharded index arrays' addressable shards) and the
+        max/mean imbalance ratio — what the DeviceMonitor publishes as
+        the ``shard_bytes_d*`` gauge family and the doctor budgets
+        with ``--shard-imbalance``."""
+        dev_to_shard = {}
+        devs = np.asarray(self.plan.mesh.devices).reshape(-1)
+        for i, dev in enumerate(devs):
+            dev_to_shard[dev.id] = i
+        per = [0] * self.n_shards
+        for arr in (self._data, self._cols, self._live):
+            for s in arr.addressable_shards:
+                i = dev_to_shard.get(s.device.id)
+                if i is not None:
+                    per[i] += int(s.data.nbytes)
+        mean = sum(per) / max(1, len(per))
+        imbalance = (max(per) / mean) if mean else 1.0
+        return {"n_shards": self.n_shards, "shard_bytes": per,
+                "imbalance": round(imbalance, 4),
+                "total_bytes": sum(per)}
+
+    # --- querying ------------------------------------------------------
+    def search(self, queries: Sequence[Union[str, bytes]], k: int = 10
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        """Ranked retrieval: (scores, doc_indices), each [Q, k'] with
+        k' = min(k, num_docs) — bit-identical to the source's
+        single-device ``search`` (same blocking, same query bucketing,
+        same compiled-program budget discipline)."""
+        _, jnp = _jax()
+        from tfidf_tpu.models.retrieval import query_matrix
+        from tfidf_tpu.obs import devmon
+
+        block = max(1, int(os.environ.get("TFIDF_TPU_QUERY_BLOCK",
+                                          "64")))
+        if len(queries) > block:
+            parts = [self.search(queries[s:s + block], k)
+                     for s in range(0, len(queries), block)]
+            return (np.concatenate([p[0] for p in parts]),
+                    np.concatenate([p[1] for p in parts]))
+        nq = len(queries)
+        width = min(k, self._num_docs)
+        if width == 0 or nq == 0:
+            return (np.zeros((nq, width), np.float32),
+                    np.full((nq, width), -1, np.int64))
+        bucket = 1 << max(0, nq - 1).bit_length()
+        qmat = jnp.asarray(query_matrix(queries, self.config,
+                                        self._idf_np, pad_to=bucket))
+        fn = _mesh_search_fn(self.plan, k)
+        # Compile fingerprinting (round 12): a cache-size delta across
+        # the call = a fresh sharded-search program; with a
+        # CompileWatch armed past mark_warm that is a steady-state
+        # recompile flight event. Same seam retrieval.search uses.
+        watch = devmon.get_watch()
+        before = fn._cache_size() if watch is not None else None
+        vals, idx = fn(self._data, self._cols, self._live, qmat)
+        if before is not None and fn._cache_size() > before:
+            devmon.note_compile(
+                "mesh_search", shards=self.n_shards,
+                queries=int(qmat.shape[1]), k=k, rows=self._rows,
+                dtype=str(qmat.dtype))
+        vals = np.asarray(vals)[:nq, :width]
+        idx = np.asarray(idx)[:nq, :width]
+        # Dead/padding rows score the sub-zero sentinel and zero-score
+        # rows are padding either way — the same result mask the
+        # single-device paths apply, so bytes match.
+        ok = vals > 0
+        return np.where(ok, vals, 0.0), np.where(ok, idx, -1)

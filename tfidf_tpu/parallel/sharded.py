@@ -142,8 +142,26 @@ class ShardedPipeline(PhaseTimedMixin):
                 id_to_word=batch.id_to_word or {},
             )
             if cfg.topk is not None:
-                result.topk_vals = np.asarray(out[1])
-                result.topk_ids = np.asarray(out[2])
+                # The round-7 packed result wire, same resolution as
+                # TfidfPipeline._fetch_topk: the [D, K] selection
+                # crosses the link as device-packed uint32 words —
+                # HALF the pair bytes per shard, and (the part the
+                # round-18 shim made visible) fp16-rounded scores
+                # IDENTICAL to the single-device sparse path, which
+                # has packed since round 7. The mesh path had drifted
+                # to a full-precision fetch while its tests were dark.
+                from tfidf_tpu.ops.downlink import (pack_words,
+                                                    unpack_result_words,
+                                                    use_packed_result_wire)
+                if use_packed_result_wire(cfg,
+                                          vocab_size=batch.vocab_size):
+                    words = np.asarray(pack_words(out[1], out[2]))
+                    result.topk_vals, result.topk_ids = \
+                        unpack_result_words(
+                            words, score_dtype=cfg.score_dtype)
+                else:
+                    result.topk_vals = np.asarray(out[1])
+                    result.topk_ids = np.asarray(out[2])
             else:
                 result.sparse_ids = np.asarray(out[1])
                 result.sparse_counts = np.asarray(out[2])

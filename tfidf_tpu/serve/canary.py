@@ -126,8 +126,16 @@ class CanaryProber:
     def _capture(self, epoch: int, retriever) -> None:
         # Direct search — the bit-parity reference the serve tests pin
         # served responses against; NOT through the batcher, so the
-        # oracle is independent of the path under test.
-        vals, ids = retriever.search(self._queries, self._k)
+        # oracle is independent of the path under test. A mesh-sharded
+        # index offers its retained SINGLE-DEVICE source as the oracle
+        # (``parity_oracle``): probes then replay through the sharded
+        # path and bit-compare against single-device search — the live
+        # sharded-vs-single parity pin of ROADMAP item 1, not a
+        # sharded-vs-itself tautology.
+        oracle_fn = getattr(retriever, "parity_oracle", None)
+        source = oracle_fn() if oracle_fn is not None else None
+        vals, ids = (source if source is not None
+                     else retriever).search(self._queries, self._k)
         with self._lock:
             self._oracle[epoch] = (np.asarray(vals), np.asarray(ids))
             # Keep the previous epoch for probes racing a swap; drop

@@ -91,6 +91,21 @@ class TfidfServer:
                              "call index()/index_dir() first")
         self.config = config or ServeConfig.from_env()
         self.metrics = metrics or ServeMetrics()
+        # Mesh-sharded serving (round 18): with mesh_shards set, the
+        # resident index is ONE logical index doc-sharded across the
+        # chip mesh, and EVERY install path — this constructor, hot
+        # swaps, mutation view installs — re-shards through the same
+        # transform, so a swap or an add_docs can never quietly
+        # install a single-device index into a sharded server.
+        self._mesh_plan = None
+        self._index_transform = None
+        if self.config.mesh_shards is not None:
+            from tfidf_tpu.parallel.serving import (make_serving_plan,
+                                                    shard_index)
+            self._mesh_plan = make_serving_plan(self.config.mesh_shards)
+            plan = self._mesh_plan
+            self._index_transform = lambda r: shard_index(r, plan)
+            retriever = self._index_transform(retriever)
         self._retriever = retriever
         # initial_epoch: a snapshot-restored server resumes at the
         # epoch it snapshotted (cache keys and canary oracles stay
@@ -448,7 +463,11 @@ class TfidfServer:
         synchronously — every path that changes what a query could
         observe (swap, add, delete, seal, compaction install) funnels
         here, which is the no-stale-cache / no-false-canary contract
-        tests/test_index.py pins."""
+        tests/test_index.py pins. Under ``mesh_shards`` the incoming
+        index is re-sharded across the mesh first (outside the
+        admission lock — placement is slow; the flip stays atomic)."""
+        if self._index_transform is not None:
+            retriever = self._index_transform(retriever)
         with self._lock:
             if self._closed:
                 raise ServerClosed("server is closed")
@@ -607,7 +626,15 @@ class TfidfServer:
         pressure becomes a degraded health signal — high HBM shrinks
         the admission bound exactly like queue saturation does."""
         monitor.register_owner("resident_index", self._index_arrays)
+        monitor.register_shards(self._shard_stats)
         self.health.add_signal("memory_pressure", monitor.health_signal)
+
+    def _shard_stats(self):
+        """Per-shard HBM balance of the CURRENT index (None when the
+        resident index is not mesh-sharded) — the DeviceMonitor's
+        ``shard_bytes_d*`` / ``shard_imbalance_milli`` gauge feed."""
+        fn = getattr(self._retriever, "shard_stats", None)
+        return fn() if fn is not None else None
 
     def mark_warm(self) -> None:
         """Declare serve warm-up complete: the compile watchdog flags

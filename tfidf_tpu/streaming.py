@@ -42,6 +42,7 @@ from tfidf_tpu.ops.scoring import idf_from_df, tfidf_dense
 from tfidf_tpu.ops.sparse import (score_topk, sorted_term_counts,
                                   sparse_df, sparse_scores, sparse_topk)
 from tfidf_tpu.parallel.mesh import DOCS_AXIS, MeshPlan
+from tfidf_tpu.parallel.compat import shard_map
 
 
 @functools.partial(jax.jit, static_argnames=("vocab_size",), donate_argnums=(0,))
@@ -98,7 +99,7 @@ def _mesh_update_sparse_fn(plan: MeshPlan, vocab_size: int):
         return df_state + lax.psum(sparse_df(ids, head, vocab_size),
                                    DOCS_AXIS)
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         body, mesh=plan.mesh,
         in_specs=(P(None), P(DOCS_AXIS, None), P(DOCS_AXIS)),
         out_specs=P(None), check_vma=False)
@@ -114,7 +115,7 @@ def _mesh_score_sparse_fn(plan: MeshPlan, vocab_size: int, topk: int,
         scores = sparse_scores(ids, counts, head, lens, idf)
         return sparse_topk(scores, ids, head, topk)
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         body, mesh=plan.mesh,
         in_specs=(P(None), P(), P(DOCS_AXIS, None), P(DOCS_AXIS)),
         out_specs=(P(DOCS_AXIS, None), P(DOCS_AXIS, None)),

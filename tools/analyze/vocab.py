@@ -73,6 +73,10 @@ FLIGHT_EVENTS = {
     # tombstones dropped, pause_s — tools/doctor.py budgets the
     # pauses); index_mutation marks every non-swap epoch bump
     "segment_seal", "compaction", "index_mutation",
+    # mesh-sharded serving (round 18): edge-triggered per-shard index
+    # bytes + imbalance ratio on every install — tools/doctor.py's
+    # shards section and --shard-imbalance budget read it
+    "shard_balance",
     # engine/bench diagnostics (round 11 structured-logger migration)
     "exact_engine_fallback", "margin_pressure", "bench_progress",
 }
@@ -103,6 +107,7 @@ ENV_CLI_FLAGS = {
     "TFIDF_TPU_SLO_TARGET": "--slo-target",
     "TFIDF_TPU_DELTA_DOCS": "--delta-docs",
     "TFIDF_TPU_COMPACT_AT": "--compact-at",
+    "TFIDF_TPU_MESH_SHARDS": "--mesh-shards",
 }
 
 #: Shared attributes the T001 thread lint tolerates without a lock,
@@ -124,5 +129,5 @@ THREAD_ALLOWLIST = (
 #: C011 docs gate matches by prefix instead of the full literal.
 METRIC_DYNAMIC_PREFIXES = (
     "hbm_bytes_in_use_d", "hbm_peak_bytes_d", "hbm_bytes_limit_d",
-    "serve_",
+    "serve_", "shard_bytes_d",
 )

@@ -214,12 +214,29 @@ def analyze_flight(path: str) -> dict:
         "mutations": sum(1 for e in events
                          if e.get("event") == "index_mutation"),
     }
+    # Mesh-sharded serving receipts (round 18): the DeviceMonitor logs
+    # an edge-triggered shard_balance event whenever the per-shard
+    # index bytes change (i.e. on index installs); the NEWEST one is
+    # the serving layout the run ended with — per-shard bytes plus the
+    # max/mean imbalance ratio --shard-imbalance budgets.
+    shard_events = [e for e in events
+                    if e.get("event") == "shard_balance"]
+    shards_out = None
+    if shard_events:
+        latest = shard_events[-1]
+        shards_out = {
+            "n_shards": latest.get("n_shards"),
+            "shard_bytes": latest.get("shard_bytes"),
+            "imbalance": latest.get("imbalance"),
+            "installs_seen": len(shard_events),
+        }
     out = {
         "events": len(events),
         "digests": len(digests),
         "suppressed": header.get("suppressed", {}),
         "faults": faults_out,
         "segments": segments_out,
+        "shards": shards_out,
         "recompiles": [
             {k: v for k, v in e.items()
              if k not in ("t", "kind", "level", "msg")}
@@ -337,13 +354,15 @@ def diagnose(trace: str, flight: Optional[str], ledger: str,
              allow_recompiles: int = 0, allow_watermarks: int = 0,
              allow_breaker_open: bool = False,
              budgets: Optional[Dict[str, float]] = None,
-             compaction_budget_ms: Optional[float] = None) -> dict:
+             compaction_budget_ms: Optional[float] = None,
+             shard_imbalance: Optional[float] = None) -> dict:
     report: dict = {"trace": trace}
     report.update(analyze_trace(trace))
     recompile_count = report["recompile_instants"]
     watermark_count = 0
     breaker_open = False
     compaction_pause_ms = 0.0
+    shards = None
     if flight and os.path.exists(flight):
         report["flight"] = analyze_flight(flight)
         recompile_count = max(recompile_count,
@@ -353,6 +372,7 @@ def diagnose(trace: str, flight: Optional[str], ledger: str,
             "breaker_open_at_exit"]
         compaction_pause_ms = report["flight"]["segments"][
             "total_pause_ms"]
+        shards = report["flight"].get("shards")
     report["ledger_tail"] = tail_ledger(ledger)
 
     violations: List[str] = []
@@ -375,6 +395,12 @@ def diagnose(trace: str, flight: Optional[str], ledger: str,
             f"compaction paused mutation for "
             f"{compaction_pause_ms:.1f} ms total > budget "
             f"{compaction_budget_ms} ms (--compaction-budget-ms)")
+    if shard_imbalance is not None and shards \
+            and (shards.get("imbalance") or 0) > shard_imbalance:
+        violations.append(
+            f"index shard imbalance {shards['imbalance']:.3f} "
+            f"(max/mean bytes across {shards['n_shards']} shards) > "
+            f"budget {shard_imbalance} (--shard-imbalance)")
     for name, budget in (budgets or {}).items():
         got = report["phases"].get(name, {}).get("total_s", 0.0)
         if got > budget:
@@ -446,6 +472,14 @@ def render(report: dict) -> str:
                 f"{sg['total_pause_ms']:.1f} ms, max "
                 f"{sg['max_pause_ms']:.1f} ms, "
                 f"{sg['tombstones_dropped']} tombstones dropped)")
+        sh = fl.get("shards")
+        if sh:
+            per = ", ".join(f"d{i} {b / 1e6:.2f} MB" for i, b in
+                            enumerate(sh.get("shard_bytes") or []))
+            lines.append(
+                f"  shards: {sh['n_shards']} docs-shards ({per}), "
+                f"imbalance {sh['imbalance']:.3f} "
+                f"({sh['installs_seen']} install(s) seen)")
         if "hbm_owners" in fl:
             owners = ", ".join(
                 f"{name} {info.get('bytes', 0) / 1e6:.1f} MB"
@@ -499,6 +533,11 @@ def main() -> int:
                          "pause_s over the flight dump's compaction "
                          "events); past it exit 1 (default: report "
                          "only)")
+    ap.add_argument("--shard-imbalance", type=float, default=None,
+                    help="max tolerated index shard imbalance "
+                         "(max/mean per-shard bytes from the newest "
+                         "shard_balance flight event); past it exit 1 "
+                         "(default: report only)")
     ap.add_argument("--request", metavar="RID", default=None,
                     help="render ONE request's full causal timeline "
                          "(every span carrying this rid directly or "
@@ -547,7 +586,8 @@ def main() -> int:
                           allow_watermarks=args.allow_watermarks,
                           allow_breaker_open=args.allow_breaker_open,
                           budgets=budgets,
-                          compaction_budget_ms=args.compaction_budget_ms)
+                          compaction_budget_ms=args.compaction_budget_ms,
+                          shard_imbalance=args.shard_imbalance)
     except (OSError, ValueError, KeyError) as e:
         print(f"doctor: cannot read inputs: {e}", file=sys.stderr)
         return 2

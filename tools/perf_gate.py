@@ -109,6 +109,23 @@ _GATES = {
         "parity_ok": ("higher", 0.0),
         "compactor_dead": ("lower", 0.0),
     },
+    # Mesh-sharded serving (serve_bench --mesh-shards): the ISSUE's
+    # directional gates. Parity is the contract — sharded serve bytes
+    # must equal the single-device source's (zero-tolerance), as must
+    # steady-state recompiles; throughput gates higher and p99 lower
+    # so a fatter collective or a slower merge fails CI; the shard
+    # imbalance ratio is allocator-deterministic at a fixed corpus
+    # shape, so its band is tight.
+    "mesh_serve": {
+        "throughput_qps": ("higher", 0.30),
+        "throughput_rps": ("higher", 0.30),
+        "p50_ms": ("lower", 0.60),
+        "p99_ms": ("lower", 0.60),
+        "parity_ok": ("higher", 0.0),
+        "recompiles_after_warmup": ("lower", 0.0),
+        "shard_imbalance": ("lower", 0.10),
+        "slo_compliance": ("higher", 0.10),
+    },
     # The mesh dryrun verdict: ok must STAY 1 (zero-tolerance, the
     # absolute zero-baseline rule below never fires because ok is the
     # higher-is-better direction with a nonzero baseline).
@@ -135,6 +152,8 @@ _MATCH_KEYS = {"bench": ("backend", "n_docs", "wire"),
                          "seed"),
                "mutate": ("backend", "k", "max_batch", "rate",
                           "delta_docs", "compact_at", "chaos_plan"),
+               "mesh_serve": ("backend", "docs", "k", "max_batch",
+                              "n_shards"),
                "multichip": ("n_devices",)}
 # Defaults applied to BOTH sides of a match when the key is absent —
 # how records that predate a context key stay comparable to their

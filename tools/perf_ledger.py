@@ -139,6 +139,28 @@ _MUTATE_CONTEXT = {"backend": "backend", "docs": "docs", "k": "k",
                    "delta_docs": "mutate.delta_docs",
                    "compact_at": "mutate.compact_at",
                    "chaos_plan": "mutate.chaos_plan"}
+# Mesh-sharded serving (serve_bench --mesh-shards): one logical index
+# doc-sharded across the chip mesh. parity_ok (sharded serve responses
+# bit-identical to the single-device source's direct search) and the
+# zero-recompile pin gate absolutely; qps/p99 gate directionally so
+# the collective's cost cannot quietly grow; shard_imbalance is the
+# HBM-balance receipt. n_shards is comparability context — a 2-shard
+# and a 4-shard run are different protocols.
+_MESH_SERVE_METRICS = {
+    "throughput_qps": "throughput_qps",
+    "throughput_rps": "throughput_rps",
+    "p50_ms": "latency_ms.p50",
+    "p99_ms": "latency_ms.p99",
+    "cache_hit_rate": "cache.hit_rate",
+    "recompiles_after_warmup": "recompiles_after_warmup",
+    "parity_ok": "mesh.parity_ok",
+    "shard_imbalance": "mesh.shard_imbalance",
+    "slo_compliance": "slo.compliance",
+}
+_MESH_SERVE_CONTEXT = {"backend": "backend", "docs": "docs", "k": "k",
+                       "requests": "requests", "max_batch": "max_batch",
+                       "concurrency": "concurrency", "mode": "mode",
+                       "n_shards": "mesh.n_shards"}
 # Multi-chip dryrun artifacts (MULTICHIP_r0X.json): a driver wrapper
 # with no parsed payload — just the mesh smoke's verdict. "ok" is the
 # gated metric (1 must stay 1); n_devices is comparability context.
@@ -191,7 +213,9 @@ def classify(payload: dict) -> Optional[str]:
         # to clean serving baselines.
         if "mutate" in payload:
             return "mutate"
-        return "chaos" if "chaos" in payload else "serve_bench"
+        if "chaos" in payload:
+            return "chaos"
+        return "mesh_serve" if "mesh" in payload else "serve_bench"
     if payload.get("unit") == "docs/sec" or "vs_baseline" in payload:
         return "bench"
     if "n_devices" in payload and "ok" in payload:
@@ -217,11 +241,13 @@ def normalize(path: str) -> Tuple[Optional[dict], Optional[str]]:
                     "bench": _BENCH_METRICS,
                     "chaos": _CHAOS_METRICS,
                     "mutate": _MUTATE_METRICS,
+                    "mesh_serve": _MESH_SERVE_METRICS,
                     "multichip": _MULTICHIP_METRICS}[kind]
     ctx_paths = {"serve_bench": _SERVE_CONTEXT,
                  "bench": _BENCH_CONTEXT,
                  "chaos": _CHAOS_CONTEXT,
                  "mutate": _MUTATE_CONTEXT,
+                 "mesh_serve": _MESH_SERVE_CONTEXT,
                  "multichip": _MULTICHIP_CONTEXT}[kind]
     metrics = {name: (int(v) if isinstance(v, bool) else v)
                for name, p in metric_paths.items()
@@ -311,7 +337,9 @@ def backfill_paths() -> List[str]:
             + sorted(glob.glob(os.path.join(_common.REPO,
                                             "SERVE_r*.json")))
             + sorted(glob.glob(os.path.join(_common.REPO,
-                                            "MUTATE_r*.json"))))
+                                            "MUTATE_r*.json")))
+            + sorted(glob.glob(os.path.join(_common.REPO,
+                                            "MESH_SERVE_r*.json"))))
 
 
 def main() -> int:

@@ -375,6 +375,15 @@ def main() -> int:
                          "bench inject matching poison requests")
     ap.add_argument("--chaos-seed", type=int, default=0,
                     help="fault-plan + jitter seed (replayable chaos)")
+    ap.add_argument("--mesh-shards", type=int, default=None,
+                    help="serve ONE logical index doc-sharded across "
+                         "this many devices (0 = all): the artifact "
+                         "gains a 'mesh' object (n_shards, per-shard "
+                         "bytes + imbalance, parity verdict vs the "
+                         "single-device source, recompile receipt) "
+                         "and perf_ledger files it as kind=mesh_serve "
+                         "— MESH_SERVE_r0x.json is the committed "
+                         "round artifact (default: off)")
     ap.add_argument("--mutate", type=float, default=0.0, metavar="RATE",
                     help="mixed read/write workload: serve an LSM-"
                          "segmented index and stream add/update/"
@@ -446,8 +455,19 @@ def main() -> int:
             faults=args.chaos, fault_seed=args.chaos_seed,
             slo_ms=args.slo_ms or None,
             slo_target=args.slo_target,
-            slow_ms=args.slow_ms if args.slow_ms > 0 else None)
+            slow_ms=args.slow_ms if args.slow_ms > 0 else None,
+            mesh_shards=args.mesh_shards)
         server = TfidfServer(retriever, serve_cfg)
+        # Mesh mode (round 18): the server sharded the index across
+        # the mesh; warm-up and the recompile receipt must watch the
+        # SHARDED search programs, and the untouched single-device
+        # `retriever` stays alive as the parity oracle.
+        _, installed = server.current_index()
+        if args.mesh_shards is not None:
+            from tfidf_tpu.parallel.serving import mesh_search_cache_size
+            compiled_programs = mesh_search_cache_size
+        else:
+            compiled_programs = _search_bcoo._cache_size
 
         rng = np.random.default_rng(args.seed)
         draw = make_queries(rng, args.pool, benchmod.N_WORDS, qlen=4)
@@ -475,8 +495,8 @@ def main() -> int:
             b *= 2
         buckets.add(b)
         for nb in sorted(buckets):
-            retriever.search([draw() for _ in range(nb)], k=args.k)
-        compiles_warm = _search_bcoo._cache_size()
+            installed.search([draw() for _ in range(nb)], k=args.k)
+        compiles_warm = compiled_programs()
         # Round 12: the LIVE recompile signal draws the same warm line
         # — any fingerprinted compile past here is a flight event and
         # a degraded health reason, not just a post-hoc count.
@@ -485,6 +505,13 @@ def main() -> int:
         # monitor (absent on CPU — memory_stats() is None there) and
         # total XLA compiles from the watch.
         devmon = obs.DeviceMonitor(registry=server.metrics.registry)
+        if args.mesh_shards is not None:
+            # Publish the shard_bytes_d* gauges and the shard_balance
+            # flight event — the doctor's shards section reads the
+            # latter out of the flight dump.
+            devmon.register_shards(
+                lambda: getattr(server.current_index()[1],
+                                "shard_stats", lambda: None)())
         devmon.sample()
 
         def drive(target, n_requests):
@@ -645,8 +672,36 @@ def main() -> int:
                 "parity_mismatches": mismatches,
                 "parity_ok": int(mismatches == 0 and len(completed) > 0),
             }
+        # Mesh receipts: pinned queries replayed through the full
+        # sharded serve path (cache bypassed, before close) must be
+        # bit-identical to the single-device source's direct search —
+        # the sharded-vs-single parity verdict perf_gate
+        # zero-tolerates — plus the per-shard HBM balance. The oracle
+        # search runs AFTER close (mutate-bench discipline): it
+        # compiles its own single-device program, which must not
+        # register as a steady-state serve recompile on the
+        # then-uninstalled compile watch.
+        mesh = None
+        mesh_served = None
+        if args.mesh_shards is not None:
+            pinned = [draw() for _ in range(16)]
+            mesh_served = server.submit(
+                pinned, args.k, use_cache=False).result(timeout=60)
+            stats = installed.shard_stats()
         server.close(drain=True)
-        recompiles = _search_bcoo._cache_size() - compiles_warm
+        recompiles = compiled_programs() - compiles_warm
+        if mesh_served is not None:
+            mvals, mids = mesh_served
+            dvals, dids = retriever.search(pinned, k=args.k)
+            mesh_mismatch = int(not (np.array_equal(mvals, dvals)
+                                     and np.array_equal(mids, dids)))
+            mesh = {
+                "n_shards": stats["n_shards"],
+                "shard_bytes": stats["shard_bytes"],
+                "shard_imbalance": stats["imbalance"],
+                "parity_checked": len(pinned),
+                "parity_ok": int(mesh_mismatch == 0),
+            }
 
         snap = server.metrics_snapshot()
         lat = snap["latency_s"]
@@ -690,6 +745,8 @@ def main() -> int:
                          f"({reqtrace_ab['p50_regression']:+.1%})")
         if chaos is not None:
             artifact["chaos"] = chaos
+        if mesh is not None:
+            artifact["mesh"] = mesh
         if devmon.peak_bytes:   # backends without memory stats omit
             artifact["peak_hbm_bytes"] = devmon.peak_bytes
             artifact["memory_pressure"] = devmon.memory_pressure
@@ -715,6 +772,11 @@ def main() -> int:
                           f"{chaos['parity_checked']} responses "
                           f"diverged from direct search",
                       mismatches=chaos["parity_mismatches"])
+            return 1
+        if mesh is not None and not mesh["parity_ok"]:
+            log.error("serve_bench_mesh_parity",
+                      msg="mesh parity FAILED: sharded serve responses "
+                          "diverge from the single-device source")
             return 1
         return 0
     finally:
