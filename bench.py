@@ -470,6 +470,25 @@ def main() -> None:
                     N_DOCS / ser["compute_marginal"], 1)
             record["link_tax_s"] = round(ser.get("upload", 0.0)
                                          + ser.get("fetch", 0.0), 3)
+            # Attributed link columns (round 19): the aggregate
+            # link_tax_s splits into the H2D staging wall (upload_s)
+            # and the synchronizing D2H result round trip (sync_s), so
+            # the ledger tracks the column the multi-process sharded
+            # ingest attacks — not just the sum. link_utilization is
+            # per-worker: the fraction of each link-owning process's
+            # end-to-end wall spent driving its link (one entry here;
+            # tools/ingest_mh_bench.py reports N under --workers N).
+            up_s, sync_s = ser.get("upload", 0.0), ser.get("fetch", 0.0)
+            record["upload_s"] = round(up_s, 3)
+            record["sync_s"] = round(sync_s, 3)
+            record["link"] = {
+                "upload_s": round(up_s, 3),
+                "sync_s": round(sync_s, 3),
+                "n_workers": 1,
+                "link_utilization": [
+                    round(min(1.0, (up_s + sync_s) / tpu_s), 3)
+                    if tpu_s > 0 else 0.0],
+            }
             record["north_star_projection"] = {
                 # measured: one chip's fenced compute vs the measured
                 # 8-worker CPU oracle on this host

@@ -6,11 +6,114 @@ The reference cannot run at all without an MPI runtime (``MPI_Init``,
 on one host and a real DCN bring-up when a coordinator is configured.
 """
 
+import json
 import os
+import socket
 import subprocess
 import sys
+import threading
 
-from tfidf_tpu.parallel.multihost import HostTopology, initialize
+import numpy as np
+import pytest
+
+from tfidf_tpu.parallel.multihost import (HostTopology, MpiLiteComm,
+                                          MpiLiteError, initialize,
+                                          shard_bounds)
+
+
+def _make_comms(n):
+    """A size-n mpi_lite world over in-process socketpairs (one comm
+    per 'rank', driven from threads) — the launcher's fd topology
+    without the subprocesses."""
+    pair = [[-1] * n for _ in range(n)]
+    for i in range(n):
+        for j in range(i + 1, n):
+            a, b = socket.socketpair(socket.AF_UNIX, socket.SOCK_STREAM)
+            pair[i][j] = a.detach()
+            pair[j][i] = b.detach()
+    return [MpiLiteComm(r, n, [pair[r][j] for j in range(n)])
+            for r in range(n)]
+
+
+def _run_ranks(comms, fn):
+    """Run fn(comm) on every rank concurrently; returns rank-ordered
+    results, re-raising the first rank failure."""
+    results = [None] * len(comms)
+    errors = []
+
+    def body(r):
+        try:
+            results[r] = fn(comms[r])
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errors.append((r, e))
+
+    threads = [threading.Thread(target=body, args=(r,))
+               for r in range(len(comms))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    for comm in comms:
+        comm.close()
+    if errors:
+        raise errors[0][1]
+    return results
+
+
+class TestMpiLiteComm:
+    """The Python mpi_lite runtime: frame protocol + root-sequenced
+    collectives, the rendezvous under the sharded ingest."""
+
+    @pytest.mark.parametrize("n", [2, 3, 4])
+    def test_allreduce_sum_is_exact_and_replicated(self, n):
+        rng = np.random.default_rng(n)
+        parts = [rng.integers(0, 1000, 64).astype(np.int32)
+                 for _ in range(n)]
+        want = np.sum(parts, axis=0, dtype=np.int32)
+        got = _run_ranks(_make_comms(n),
+                         lambda c: c.allreduce_sum(parts[c.rank]))
+        for g in got:
+            np.testing.assert_array_equal(g, want)
+
+    def test_barrier_and_bcast(self):
+        def body(comm):
+            comm.barrier()
+            return comm.bcast_bytes(b"payload" if comm.rank == 0
+                                    else None)
+        assert _run_ranks(_make_comms(3), body) == [b"payload"] * 3
+
+    def test_tag_mismatch_aborts_loudly(self):
+        def body(comm):
+            if comm.rank == 0:
+                comm.send(1, 7, b"x")
+                return None
+            with pytest.raises(MpiLiteError, match="tag mismatch"):
+                comm.recv(0, 8)
+            return True
+        _run_ranks(_make_comms(2), body)
+
+    def test_from_env_requires_launcher(self, monkeypatch):
+        for var in ("MPILITE_RANK", "MPILITE_SIZE", "MPILITE_FDS"):
+            monkeypatch.delenv(var, raising=False)
+        with pytest.raises(MpiLiteError, match="launcher"):
+            MpiLiteComm.from_env()
+
+    def test_from_env_rejects_malformed_fds(self, monkeypatch):
+        monkeypatch.setenv("MPILITE_RANK", "0")
+        monkeypatch.setenv("MPILITE_SIZE", "2")
+        monkeypatch.setenv("MPILITE_FDS", "-1,notanint")
+        with pytest.raises(MpiLiteError, match="malformed"):
+            MpiLiteComm.from_env()
+
+    def test_shard_bounds_cover_contiguously(self):
+        for docs, workers in ((26, 4), (5, 2), (8, 8), (3, 7), (0, 2)):
+            bounds = shard_bounds(docs, workers)
+            assert bounds[0][0] == 0 and bounds[-1][1] == docs
+            for (_, a_hi), (b_lo, _) in zip(bounds, bounds[1:]):
+                assert a_hi == b_lo
+            # Never more shards than documents (empty shards would
+            # make run_overlapped raise in a worker).
+            assert all(hi > lo for lo, hi in bounds) or docs == 0
 
 
 class TestSingleHost:
@@ -323,3 +426,152 @@ class TestTwoProcessStreamingMesh:
             assert p.returncode == 0, f"rc={p.returncode}\n{out}\n{err}"
         assert sorted(o.strip().splitlines()[-1]
                       for o, _ in outs) == ["OK 0", "OK 1"]
+
+
+def _write_corpus(path, n_docs, seed, n_words=300, max_len=40):
+    rng = np.random.default_rng(seed)
+    path.mkdir()
+    for i in range(1, n_docs + 1):
+        (path / f"doc{i}").write_text(
+            " ".join(f"w{rng.integers(0, n_words)}"
+                     for _ in range(rng.integers(1, max_len))))
+    return str(path)
+
+
+def _assert_bit_identical(ref, got):
+    """The full round-19 parity contract: DF, scores (IDF-weighted),
+    ids (tie order rides in them — lax.top_k per row), lengths, names."""
+    np.testing.assert_array_equal(np.asarray(ref.df), np.asarray(got.df))
+    np.testing.assert_array_equal(ref.topk_vals, got.topk_vals)
+    np.testing.assert_array_equal(ref.topk_ids, got.topk_ids)
+    np.testing.assert_array_equal(ref.lengths, got.lengths)
+    assert ref.names == got.names
+    assert ref.df_occupied == got.df_occupied
+
+
+class TestShardedIngest:
+    """Multi-process sharded ingest (round 19): N OS-process workers
+    over mpi_lite-style channels must merge to a BIT-identical index.
+    Per-doc rows depend only on the doc's own tokens + the global
+    DF/IDF, so the property must hold across worker counts, chunk
+    boundaries, and a ragged last shard."""
+
+    def _cfg(self):
+        from tfidf_tpu.config import PipelineConfig, VocabMode
+        return PipelineConfig(vocab_mode=VocabMode.HASHED,
+                              vocab_size=2048, topk=4, engine="sparse")
+
+    def test_two_worker_bit_parity(self, tmp_path):
+        from tfidf_tpu.ingest import run_overlapped
+        from tfidf_tpu.parallel.multihost import run_sharded_ingest
+        d = _write_corpus(tmp_path / "input", 25, seed=11)  # ragged
+        cfg = self._cfg()
+        ref = run_overlapped(d, cfg, chunk_docs=8, doc_len=32)
+        got, info = run_sharded_ingest(d, cfg, n_workers=2,
+                                       chunk_docs=8, doc_len=32)
+        _assert_bit_identical(ref, got)
+        assert info.n_workers == 2
+        assert info.shards == [(0, 12), (12, 25)]
+        assert len(info.link_utilization) == 2
+        assert got.path.startswith("sharded-2proc")
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("n_workers,n_docs,seed", [
+        (2, 30, 21), (4, 26, 22), (3, 17, 23)])
+    def test_sharded_parity_property(self, tmp_path, n_workers, n_docs,
+                                     seed):
+        """Random corpora x worker counts, every last shard ragged —
+        the CI smoke stage (tools/ci_check.sh) runs exactly this."""
+        from tfidf_tpu.ingest import run_overlapped
+        from tfidf_tpu.parallel.multihost import run_sharded_ingest
+        d = _write_corpus(tmp_path / "input", n_docs, seed=seed)
+        cfg = self._cfg()
+        ref = run_overlapped(d, cfg, chunk_docs=8, doc_len=32)
+        got, info = run_sharded_ingest(d, cfg, n_workers=n_workers,
+                                       chunk_docs=8, doc_len=32)
+        _assert_bit_identical(ref, got)
+        assert [lo for lo, _ in info.shards][0] == 0
+        assert info.shards[-1][1] == n_docs
+
+    @pytest.mark.slow
+    def test_sharded_parity_streaming_regime(self, tmp_path,
+                                             monkeypatch):
+        """Workers forced past the resident budget: the DF allreduce
+        slots into the streaming pass-A/B boundary instead."""
+        from tfidf_tpu.ingest import run_overlapped
+        from tfidf_tpu.parallel.multihost import run_sharded_ingest
+        monkeypatch.setenv("TFIDF_TPU_RESIDENT_ELEMS", "0")
+        d = _write_corpus(tmp_path / "input", 24, seed=31)
+        cfg = self._cfg()
+        ref = run_overlapped(d, cfg, chunk_docs=8, doc_len=32)
+        assert ref.path == "streaming"
+        got, _ = run_sharded_ingest(d, cfg, n_workers=2,
+                                    chunk_docs=8, doc_len=32)
+        assert got.path == "sharded-2proc:streaming"
+        _assert_bit_identical(ref, got)
+
+    @pytest.mark.slow
+    def test_sharded_parity_pair_result_wire(self, tmp_path):
+        """The pair-wire fused finish must route the merged DF through
+        the gather join (sort-join's per-slot DF is local-triples-only
+        — the mesh rule); parity pins it."""
+        from tfidf_tpu.config import PipelineConfig, VocabMode
+        from tfidf_tpu.ingest import run_overlapped
+        from tfidf_tpu.parallel.multihost import run_sharded_ingest
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED,
+                             vocab_size=2048, topk=4, engine="sparse",
+                             result_wire="pair")
+        d = _write_corpus(tmp_path / "input", 20, seed=41)
+        ref = run_overlapped(d, cfg, chunk_docs=8, doc_len=32)
+        assert ref.result_wire == "pair"
+        got, _ = run_sharded_ingest(d, cfg, n_workers=2,
+                                    chunk_docs=8, doc_len=32)
+        _assert_bit_identical(ref, got)
+
+    def test_mesh_plan_excludes_process_hooks(self, tmp_path):
+        import jax
+
+        from tfidf_tpu.ingest import run_overlapped
+        from tfidf_tpu.parallel.mesh import MeshPlan
+        d = _write_corpus(tmp_path / "input", 4, seed=51)
+        plan = MeshPlan.create(docs=1, devices=jax.devices("cpu")[:1])
+        with pytest.raises(ValueError, match="multi-PROCESS"):
+            run_overlapped(d, self._cfg(), chunk_docs=4, doc_len=16,
+                           plan=plan, shard=(0, 2))
+
+    def test_shard_slice_validates(self, tmp_path):
+        from tfidf_tpu.ingest import run_overlapped
+        d = _write_corpus(tmp_path / "input", 4, seed=52)
+        with pytest.raises(ValueError, match="shard"):
+            run_overlapped(d, self._cfg(), chunk_docs=4, doc_len=16,
+                           shard=(2, 99))
+
+    @pytest.mark.slow
+    def test_ingest_mh_bench_artifact_and_ledger(self, tmp_path):
+        """The tool end-to-end on a tiny corpus: artifact schema,
+        parity verdict, and the ledger files it as kind=ingest_mh."""
+        out = tmp_path / "INGEST_MH_test.json"
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools",
+                                          "ingest_mh_bench.py"),
+             "--docs", "96", "--doc-len", "32", "--workers", "2",
+             "--repeat", "1", "--out", str(out)],
+            capture_output=True, text=True, timeout=300,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        art = json.loads(out.read_text())
+        assert art["metric"] == "ingest_mh"
+        assert art["parity_ok"] == 1
+        assert art["n_workers"] == 2
+        assert len(art["link_utilization"]) == 2
+        assert art["upload_s"] > 0 and art["upload_s_1p"] > 0
+        sys.path.insert(0, os.path.join(repo, "tools"))
+        try:
+            import perf_ledger
+            rec, reason = perf_ledger.normalize(str(out))
+        finally:
+            sys.path.pop(0)
+        assert reason is None and rec["kind"] == "ingest_mh"
+        assert rec["metrics"]["parity_ok"] == 1
+        assert rec["context"]["n_workers"] == 2

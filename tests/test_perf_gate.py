@@ -108,9 +108,18 @@ class TestGate:
             assert verdict["baseline_runs"] >= 1
 
     def test_flags_2x_latency_regression(self, ledger, tmp_path):
+        # 2x the rolling BASELINE (the median over every comparable
+        # serve round — SERVE_r01 + SERVE_r02 as of round 19), so the
+        # test stays valid as the ledger accumulates rounds.
+        import statistics
+        records = perf_ledger.load_ledger(ledger)
         doc = json.load(open(os.path.join(REPO, "SERVE_r01.json")))
-        doc["latency_ms"]["p50"] *= 2
-        doc["latency_ms"]["p99"] *= 2
+        base = [r for r in records if r["kind"] == "serve_bench"]
+        for pct in ("p50", "p99"):
+            med = statistics.median(r["metrics"][f"{pct}_ms"]
+                                    for r in base
+                                    if f"{pct}_ms" in r["metrics"])
+            doc["latency_ms"][pct] = med * 2
         bad = tmp_path / "regressed.json"
         bad.write_text(json.dumps(doc))
         cand, _ = perf_ledger.normalize(str(bad))
@@ -203,6 +212,113 @@ class TestGate:
                      if c["verdict"] == "REGRESSED"}
         assert {"parity_ok", "recompiles_after_warmup"} <= regressed
         assert not verdict["ok"]
+
+    def test_ingest_mh_artifact_classifies_and_gates(self, tmp_path):
+        """The multi-process sharded ingest artifact (round 19) is its
+        own ledger kind: parity zero-tolerance, upload wall lower-is-
+        better, n_workers comparability context."""
+        doc = {
+            "metric": "ingest_mh", "backend": "cpu", "n_docs": 32768,
+            "doc_len": 256, "chunk_docs": 8192, "n_workers": 2,
+            "wire": "ragged", "parity_ok": 1,
+            "upload_s": 0.5, "upload_s_1p": 1.0, "upload_ratio": 0.5,
+            "speedup_vs_1p": 2.0, "wall_s": 4.0, "wall_s_1p": 7.0,
+            "link_utilization": [0.2, 0.21],
+        }
+        good = tmp_path / "INGEST_MH_t.json"
+        good.write_text(json.dumps(doc))
+        cand, reason = perf_ledger.normalize(str(good))
+        assert reason is None and cand["kind"] == "ingest_mh"
+        assert cand["metrics"]["upload_s"] == 0.5
+        assert cand["context"]["n_workers"] == 2
+        ledger = str(tmp_path / "L.jsonl")
+        perf_ledger.append([str(good)], ledger, quiet=True)
+        verdict = perf_gate.gate(cand, perf_ledger.load_ledger(ledger))
+        assert verdict["ok"] and verdict["baseline_runs"] == 1
+        # parity flip = zero-tolerance fail; 2x upload wall = fail
+        doc["parity_ok"] = 0
+        doc["upload_s"] = 1.1
+        bad = tmp_path / "INGEST_MH_bad.json"
+        bad.write_text(json.dumps(doc))
+        cand_bad, _ = perf_ledger.normalize(str(bad))
+        verdict = perf_gate.gate(cand_bad,
+                                 perf_ledger.load_ledger(ledger))
+        regressed = {c["metric"] for c in verdict["checks"]
+                     if c["verdict"] == "REGRESSED"}
+        assert {"parity_ok", "upload_s"} <= regressed
+        assert not verdict["ok"]
+        # a 4-worker run is a DIFFERENT protocol: no baseline match
+        doc["n_workers"] = 4
+        other = tmp_path / "INGEST_MH_4w.json"
+        other.write_text(json.dumps(doc))
+        cand4, _ = perf_ledger.normalize(str(other))
+        verdict = perf_gate.gate(cand4, perf_ledger.load_ledger(ledger))
+        assert verdict["baseline_runs"] == 0
+
+    def test_serve_slab_receipts_gate(self, tmp_path):
+        """--ab-slab receipts (round 19): slab parity zero-tolerance;
+        allocs/batch must stay 0 (absolute zero-baseline rule) and
+        h2d copies/batch must stay 1."""
+        doc = {
+            "metric": "serve_bench", "mode": "closed", "backend": "cpu",
+            "docs": 4096, "k": 10, "requests": 512, "max_batch": 64,
+            "throughput_qps": 3000.0, "throughput_rps": 1200.0,
+            "latency_ms": {"p50": 0.03, "p99": 100.0},
+            "recompiles_after_warmup": 0,
+            "slab": {"parity_ok": 1, "allocs_per_batch": 0.0,
+                     "h2d_copies_per_batch": 1.0, "batches": 100},
+        }
+        good = tmp_path / "SERVE_slab.json"
+        good.write_text(json.dumps(doc))
+        cand, _ = perf_ledger.normalize(str(good))
+        assert cand["kind"] == "serve_bench"
+        assert cand["metrics"]["slab_allocs_per_batch"] == 0.0
+        assert cand["metrics"]["slab_h2d_per_batch"] == 1.0
+        ledger = str(tmp_path / "L2.jsonl")
+        perf_ledger.append([str(good)], ledger, quiet=True)
+        verdict = perf_gate.gate(cand, perf_ledger.load_ledger(ledger))
+        assert verdict["ok"]
+        doc["slab"] = {"parity_ok": 0, "allocs_per_batch": 0.5,
+                       "h2d_copies_per_batch": 2.0, "batches": 100}
+        bad = tmp_path / "SERVE_slab_bad.json"
+        bad.write_text(json.dumps(doc))
+        cand_bad, _ = perf_ledger.normalize(str(bad))
+        verdict = perf_gate.gate(cand_bad,
+                                 perf_ledger.load_ledger(ledger))
+        regressed = {c["metric"] for c in verdict["checks"]
+                     if c["verdict"] == "REGRESSED"}
+        assert {"slab_parity_ok", "slab_allocs_per_batch",
+                "slab_h2d_per_batch"} <= regressed
+
+    def test_bench_link_columns_map_and_gate(self, tmp_path):
+        """bench.py's round-19 link split: upload_s/sync_s ride the
+        ledger and gate lower-is-better, separately from link_tax_s."""
+        doc = {
+            "metric": "m", "unit": "docs/sec", "value": 1000.0,
+            "vs_baseline": 4.0, "backend": "cpu", "n_docs": 32768,
+            "engine": "sparse", "wire": "ragged",
+            "link_tax_s": 1.0,
+            "link": {"upload_s": 0.4, "sync_s": 0.6, "n_workers": 1,
+                     "link_utilization": [0.3]},
+        }
+        good = tmp_path / "BENCH_link.json"
+        good.write_text(json.dumps(doc))
+        cand, _ = perf_ledger.normalize(str(good))
+        assert cand["kind"] == "bench"
+        assert cand["metrics"]["upload_s"] == 0.4
+        assert cand["metrics"]["sync_s"] == 0.6
+        ledger = str(tmp_path / "L3.jsonl")
+        perf_ledger.append([str(good)], ledger, quiet=True)
+        doc["link"]["upload_s"] = 1.2  # 3x the column, inside the
+        doc["link_tax_s"] = 1.3        # aggregate's noise band? no —
+        bad = tmp_path / "BENCH_link_bad.json"
+        bad.write_text(json.dumps(doc))
+        cand_bad, _ = perf_ledger.normalize(str(bad))
+        verdict = perf_gate.gate(cand_bad,
+                                 perf_ledger.load_ledger(ledger))
+        regressed = {c["metric"] for c in verdict["checks"]
+                     if c["verdict"] == "REGRESSED"}
+        assert "upload_s" in regressed
 
     def test_noise_widens_tolerance(self):
         # Three noisy baseline runs: the spread-derived tolerance must

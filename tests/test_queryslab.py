@@ -1,0 +1,257 @@
+"""Zero-allocation query hot path (round 19): the staging-ring slab,
+the in-place fill's bit-parity with the allocating packer, and the
+serve-path safety properties — slot reuse only after results land,
+ring wraparound order, oversize fallback, 8-thread stress parity.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from tfidf_tpu.config import PipelineConfig, ServeConfig, VocabMode
+from tfidf_tpu.io.corpus import Corpus
+from tfidf_tpu.models import TfidfRetriever
+from tfidf_tpu.models.retrieval import fill_query_matrix, query_matrix
+from tfidf_tpu.ops.queryslab import QuerySlab, use_query_slab
+
+VOCAB = 2048
+
+
+def _corpus(n=40, seed=3):
+    rng = np.random.default_rng(seed)
+    docs = [" ".join(f"w{rng.integers(0, 200)}"
+                     for _ in range(rng.integers(2, 30))).encode()
+            for _ in range(n)]
+    return Corpus(names=[f"doc{i + 1}" for i in range(n)], docs=docs)
+
+
+def _queries(rng, n, pool=200, qlen=4):
+    return [" ".join(f"w{rng.integers(0, pool)}" for _ in range(qlen))
+            for _ in range(n)]
+
+
+@pytest.fixture
+def retriever():
+    cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=VOCAB)
+    return TfidfRetriever(cfg).index(_corpus())
+
+
+class TestFillParity:
+    """One packing implementation: the in-place fill must produce the
+    exact bytes query_matrix always produced."""
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_fill_matches_query_matrix_property(self, seed):
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED,
+                             vocab_size=VOCAB)
+        rng = np.random.default_rng(seed)
+        idf = rng.random(VOCAB).astype(np.float32) * 3.0
+        queries = _queries(rng, 6) + [
+            "", "w1", "w1 w1 w1", "unknown zz9",
+            " ".join(f"w{j}" for j in range(80))]
+        ref = query_matrix(queries, cfg, idf, pad_to=16)
+        out = np.full((VOCAB, 16), 7.0, np.float32)  # dirty buffer
+        scratch = np.empty((VOCAB,), np.float32)
+        fill_query_matrix(queries, cfg, idf, out, scratch=scratch)
+        np.testing.assert_array_equal(ref, out)
+
+    def test_refill_after_dirty_use_is_clean(self):
+        """A reused ring buffer carries the previous batch's bytes;
+        the fill must fully overwrite (incl. the zero columns)."""
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED,
+                             vocab_size=VOCAB)
+        idf = np.ones(VOCAB, np.float32)
+        out = np.empty((VOCAB, 4), np.float32)
+        fill_query_matrix(["w1 w2", "w3", "w4", "w5"], cfg, idf, out)
+        fill_query_matrix(["w9"], cfg, idf, out)
+        np.testing.assert_array_equal(
+            out, query_matrix(["w9"], cfg, idf, pad_to=4))
+
+
+class TestSlabRing:
+    def test_fifo_reuse_and_wraparound(self):
+        slab = QuerySlab(VOCAB, max_bucket=8)
+        b0, _, s0 = slab.checkout(4)
+        slab.release(s0)
+        b1, _, s1 = slab.checkout(4)
+        assert b1 is b0 and s1 == s0  # same buffer object, reused
+        # Two in flight -> ring grows; releases then reuse FIFO.
+        b2, _, s2 = slab.checkout(4)
+        assert b2 is not b1
+        slab.release(s1)
+        slab.release(s2)
+        b3, _, s3 = slab.checkout(4)
+        assert s3 == s1  # oldest-released first
+        st = slab.stats()
+        assert st["allocs"] == 2 and st["packs"] == 4
+        assert slab.ring_depth(4) == 2
+
+    def test_buckets_are_independent(self):
+        slab = QuerySlab(VOCAB, max_bucket=8)
+        b4, _, _ = slab.checkout(4)
+        b8, _, _ = slab.checkout(8)
+        assert b4.shape == (VOCAB, 4) and b8.shape == (VOCAB, 8)
+        assert slab.stats()["allocs"] == 2
+
+    def test_oversize_bucket_raises(self):
+        slab = QuerySlab(VOCAB, max_bucket=8)
+        with pytest.raises(ValueError, match="max_bucket"):
+            slab.checkout(16)
+
+    def test_env_knob_parsing(self, monkeypatch):
+        for raw, want in (("", True), ("1", True), ("on", True),
+                          ("0", False), ("off", False),
+                          ("false", False), ("no", False)):
+            monkeypatch.setenv("TFIDF_TPU_QUERY_SLAB", raw)
+            assert use_query_slab() is want, raw
+        monkeypatch.delenv("TFIDF_TPU_QUERY_SLAB")
+        assert use_query_slab() is True          # default ON
+        assert use_query_slab(False) is False    # explicit wins
+        assert use_query_slab(True) is True
+
+
+class TestRetrieverSlabPath:
+    def test_slab_on_off_bit_parity(self, retriever):
+        rng = np.random.default_rng(9)
+        other = TfidfRetriever(retriever.config).index(_corpus())
+        other.query_slab = False
+        for n in (1, 3, 8):
+            qs = _queries(rng, n)
+            v1, i1 = retriever.search(qs, k=5)
+            v2, i2 = other.search(qs, k=5)
+            np.testing.assert_array_equal(v1, v2)
+            np.testing.assert_array_equal(i1, i2)
+
+    def test_steady_state_zero_allocs_one_h2d_per_batch(self,
+                                                        retriever):
+        rng = np.random.default_rng(10)
+        retriever.search(_queries(rng, 4), k=5)  # warm: ring allocates
+        slab = retriever._slab
+        st0 = slab.stats()
+        for _ in range(12):
+            retriever.search(_queries(rng, 4), k=5)
+        st1 = slab.stats()
+        assert st1["allocs"] == st0["allocs"]           # ZERO new
+        assert st1["packs"] - st0["packs"] == 12
+        assert st1["h2d_copies"] - st0["h2d_copies"] == 12  # ONE each
+        assert st1["fallbacks"] == st0["fallbacks"]
+
+    def test_oversize_batch_falls_back_bit_identical(self, retriever):
+        rng = np.random.default_rng(11)
+        qs = _queries(rng, 4)
+        want = retriever.search(qs, k=5)
+        slab = retriever._resolve_slab()
+        slab.max_bucket = 2  # shrink under the batch's bucket
+        got = retriever.search(qs, k=5)
+        assert slab.stats()["fallbacks"] >= 1
+        np.testing.assert_array_equal(want[0], got[0])
+        np.testing.assert_array_equal(want[1], got[1])
+
+    def test_mesh_plan_keeps_legacy_path(self):
+        import jax
+
+        from tfidf_tpu.parallel.mesh import MeshPlan
+        plan = MeshPlan.create(docs=1, devices=jax.devices("cpu")[:1])
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED,
+                             vocab_size=VOCAB)
+        r = TfidfRetriever(cfg, plan=plan).index(_corpus(8))
+        assert r._resolve_slab() is None
+        r.search(["w1"], k=2)  # and the search path still works
+        assert r._slab is None
+
+    def test_eight_thread_stress_reuse_safety(self, retriever):
+        """Concurrent slab searches: every response bit-identical to
+        the single-threaded oracle — no torn staging buffer, no
+        refill racing an unconsumed upload (slots release only after
+        results materialize)."""
+        rng = np.random.default_rng(12)
+        batches = [_queries(rng, n) for n in (1, 2, 4, 8) for _ in
+                   range(4)]
+        oracle = [retriever.search(qs, k=5) for qs in batches]
+        errors = []
+
+        def worker(idx):
+            try:
+                for j in range(idx, len(batches), 8):
+                    v, i = retriever.search(batches[j], k=5)
+                    np.testing.assert_array_equal(v, oracle[j][0])
+                    np.testing.assert_array_equal(i, oracle[j][1])
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors[0]
+        # The ring grew at most to the concurrency level.
+        st = retriever._slab.stats()
+        assert st["buffers"] <= 8 * 4
+        assert st["packs"] == st["h2d_copies"]
+
+    def test_h2d_span_byte_stamped_once_per_batch(self, retriever,
+                                                  tmp_path):
+        from tfidf_tpu import obs
+        path = str(tmp_path / "trace.json")
+        assert obs.configure(path) is not None
+        try:
+            rng = np.random.default_rng(13)
+            for _ in range(3):
+                retriever.search(_queries(rng, 4), k=5)
+            out = obs.export()
+        finally:
+            obs.set_tracer(None)
+        spans = [e for e in obs.load_chrome_trace(out)
+                 if e.get("ph") == "X" and e.get("name") == "h2d"]
+        assert len(spans) == 3
+        for s in spans:
+            assert s["args"]["bytes"] == VOCAB * 4 * 4  # [V, 4] f32
+
+
+class TestServeWiring:
+    def test_serve_config_env_mirror(self, monkeypatch):
+        monkeypatch.setenv("TFIDF_TPU_QUERY_SLAB", "0")
+        assert ServeConfig.from_env().query_slab is False
+        monkeypatch.setenv("TFIDF_TPU_QUERY_SLAB", "on")
+        assert ServeConfig.from_env().query_slab is True
+        monkeypatch.delenv("TFIDF_TPU_QUERY_SLAB")
+        assert ServeConfig.from_env().query_slab is None
+        assert ServeConfig.from_env(query_slab=False).query_slab is False
+
+    def test_server_applies_knob_on_install(self, retriever):
+        from tfidf_tpu.serve import TfidfServer
+        server = TfidfServer(retriever, ServeConfig(
+            query_slab=False, cache_entries=0))
+        try:
+            assert retriever.query_slab is False
+            rng = np.random.default_rng(14)
+            qs = _queries(rng, 3)
+            served = server.search(qs, k=5)
+            direct = retriever.search(qs, k=5)
+            np.testing.assert_array_equal(served[0], direct[0])
+            np.testing.assert_array_equal(served[1], direct[1])
+            assert retriever._slab is None  # off really means off
+        finally:
+            server.close(drain=True)
+
+    def test_served_rows_bit_identical_slab_on(self, retriever):
+        from tfidf_tpu.serve import TfidfServer
+        oracle = TfidfRetriever(retriever.config).index(_corpus())
+        oracle.query_slab = False
+        server = TfidfServer(retriever, ServeConfig(
+            query_slab=True, cache_entries=0))
+        try:
+            rng = np.random.default_rng(15)
+            for n in (1, 2, 5):
+                qs = _queries(rng, n)
+                served = server.search(qs, k=5)
+                want = oracle.search(qs, k=5)
+                np.testing.assert_array_equal(served[0], want[0])
+                np.testing.assert_array_equal(served[1], want[1])
+            assert retriever._slab is not None
+            assert retriever._slab.stats()["h2d_copies"] >= 3
+        finally:
+            server.close(drain=True)

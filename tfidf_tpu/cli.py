@@ -57,6 +57,15 @@ wire & pack knobs (round 14):
                           Env: TFIDF_TPU_PACK_THREADS
   TFIDF_TPU_DEVICE_TOKENIZE  xla|pallas — bytes-wire hash lowering
                           (pallas = Mosaic doc-tile kernel, A/B probe)
+
+link knobs (round 19):
+  --ingest-workers N      multi-PROCESS sharded ingest: N workers
+                          rendezvous over mpi_lite-style channels,
+                          each packs+uploads its contiguous shard
+                          over its own link; one [V] DF allreduce
+                          merges — index bit-identical to a single
+                          process, upload wall divided by N.
+                          Env: TFIDF_TPU_INGEST_WORKERS
 """
 
 
@@ -184,6 +193,20 @@ def _build_parser() -> argparse.ArgumentParser:
                           "move on the shared ParallelFor pool); "
                           "default every core (env "
                           "TFIDF_TPU_PACK_THREADS)")
+    run.add_argument("--ingest-workers", type=int, default=None,
+                     help="multi-PROCESS sharded ingest (--doc-len "
+                          "runs): N worker processes rendezvous over "
+                          "mpi_lite-style socketpair channels, each "
+                          "packs+uploads its contiguous document "
+                          "shard over its own link concurrently, and "
+                          "local DF merges through one allreduce — "
+                          "the merged index is bit-identical to a "
+                          "single-process run while the upload wall "
+                          "divides by worker count (the reference's "
+                          "rank-partitioned loop, TFIDF.c:130; "
+                          "docs/SCALING.md round 19). Default 1; env "
+                          "TFIDF_TPU_INGEST_WORKERS. Excludes --mesh "
+                          "and --exact-terms")
     run.add_argument("--result-wire", choices=["packed", "pair"],
                      default="packed",
                      help="device->host top-k result wire: 'packed' "
@@ -415,6 +438,17 @@ def _build_parser() -> argparse.ArgumentParser:
                          "(default: off — single device; env "
                          "TFIDF_TPU_MESH_SHARDS; docs/SERVING.md "
                          "'Sharded serving')")
+    sv.add_argument("--query-slab", choices=["on", "off"], default=None,
+                    help="zero-allocation query hot path: a donated, "
+                         "persistently-recycled device query block "
+                         "per pow2 bucket fed by a pinned host "
+                         "staging ring — steady-state serving does "
+                         "zero Python-side array allocations and "
+                         "exactly ONE H2D copy per batch (byte-"
+                         "stamped h2d trace spans; serve_bench "
+                         "--ab-slab measures it). 'off' forces the "
+                         "legacy per-batch allocation, bit-identical "
+                         "(default on; env TFIDF_TPU_QUERY_SLAB)")
     sv.add_argument("--delta-docs", type=int, default=None,
                     help="serve an LSM-style SEGMENTED index with a "
                          "delta segment of this capacity: the "
@@ -625,6 +659,23 @@ def _run_tpu(args) -> int:
                 "warning: --wire=bytes needs a single-device hashed "
                 "whitespace --doc-len run with vocab <= 2^16; falling "
                 "back to the ragged/padded id wire\n")
+    # Multi-process sharded ingest (round 19): flag > env > 1. The
+    # worker processes re-run this config through run_overlapped with
+    # shard + DF-allreduce hooks — bit-identical merge, divided link.
+    ingest_workers = getattr(args, "ingest_workers", None)
+    if ingest_workers is None:
+        ingest_workers = int(os.environ.get("TFIDF_TPU_INGEST_WORKERS",
+                                            "1") or 1)
+    if ingest_workers < 1:
+        sys.stderr.write("error: --ingest-workers must be >= 1\n")
+        return 2
+    if ingest_workers > 1 and (mesh_shape or exact_terms
+                               or not overlapped):
+        sys.stderr.write(
+            "warning: --ingest-workers needs a single-device hashed "
+            "--doc-len run (no --mesh, no --exact-terms); running "
+            "single-process\n")
+        ingest_workers = 1
     if overlapped and exact_terms and not mesh_shape:
         # Exact-terms with automatic engine choice (rerank.exact_terms):
         # device-exact intern ids when the corpus fits the vocab (no
@@ -673,11 +724,23 @@ def _run_tpu(args) -> int:
         t0 = time.perf_counter()
         # Exact-terms runs read only candidate buckets from the device,
         # so they take the ids-only wire (no score fetch bytes).
-        r = run_overlapped(args.input, cfg, doc_len=args.doc_len,
-                           chunk_docs=args.chunk_docs or 8192,
-                           strict=not args.no_strict,
-                           spill=args.spill or "auto",
-                           wire_vals=not exact_terms, plan=plan)
+        if ingest_workers > 1 and plan is None:
+            from tfidf_tpu.parallel.multihost import run_sharded_ingest
+            r, mh_info = run_sharded_ingest(
+                args.input, cfg, n_workers=ingest_workers,
+                chunk_docs=args.chunk_docs or 8192,
+                doc_len=args.doc_len, strict=not args.no_strict,
+                spill=args.spill or "auto")
+            sys.stderr.write(
+                f"sharded ingest: {mh_info.n_workers} workers, "
+                f"upload {mh_info.upload_s:.3f}s (max over links), "
+                f"utilization {mh_info.link_utilization}\n")
+        else:
+            r = run_overlapped(args.input, cfg, doc_len=args.doc_len,
+                               chunk_docs=args.chunk_docs or 8192,
+                               strict=not args.no_strict,
+                               spill=args.spill or "auto",
+                               wire_vals=not exact_terms, plan=plan)
         throughput.record(r.num_docs, time.perf_counter() - t0)
         result = types.SimpleNamespace(
             num_docs=r.num_docs, names=r.names, df=r.df,
@@ -1090,7 +1153,9 @@ def _run_serve(args) -> int:
         fault_seed=args.fault_seed, slow_ms=args.slow_ms,
         slo_ms=args.slo_ms, slo_target=args.slo_target,
         delta_docs=args.delta_docs, compact_at=args.compact_at,
-        mesh_shards=args.mesh_shards)
+        mesh_shards=args.mesh_shards,
+        query_slab=(None if args.query_slab is None
+                    else args.query_slab == "on"))
 
     # Crash-fast start: a committed snapshot with a matching config
     # fingerprint restores the resident index from disk — seconds, no

@@ -320,6 +320,19 @@ class ServeConfig:
       compact_at: sealed-segment count at which the background
         compactor merges them into one (dropping tombstones). CLI
         ``--compact-at`` / env ``TFIDF_TPU_COMPACT_AT``.
+      query_slab: the zero-allocation query hot path (round 19): a
+        donated, persistently-recycled device query block per pow2
+        bucket fed by a pinned host staging ring, so steady-state
+        serving performs zero Python-side array allocations and
+        exactly ONE H2D copy per batch (byte-stamped ``h2d`` trace
+        spans are the receipt; ``serve_bench --ab-slab`` measures
+        it). None resolves the env (``TFIDF_TPU_QUERY_SLAB``,
+        default on); False forces the legacy per-batch allocation —
+        the bit-identical fallback (one packing implementation,
+        ``models.retrieval.fill_query_matrix``). CLI
+        ``--query-slab``. Mesh-sharded serving keeps the legacy
+        packing either way (its query block replicates under
+        shard_map — a different staging contract).
       mesh_shards: serve ONE logical index doc-sharded across this
         many devices (``0`` = every visible device): the resident
         index's BCOO blocks live block-sharded over the mesh's
@@ -357,6 +370,7 @@ class ServeConfig:
     delta_docs: Optional[int] = None
     compact_at: int = 4
     mesh_shards: Optional[int] = None
+    query_slab: Optional[bool] = None
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -446,7 +460,10 @@ class ServeConfig:
                 ("slo_target", "TFIDF_TPU_SLO_TARGET", float),
                 ("delta_docs", "TFIDF_TPU_DELTA_DOCS", int),
                 ("compact_at", "TFIDF_TPU_COMPACT_AT", int),
-                ("mesh_shards", "TFIDF_TPU_MESH_SHARDS", int)):
+                ("mesh_shards", "TFIDF_TPU_MESH_SHARDS", int),
+                ("query_slab", "TFIDF_TPU_QUERY_SLAB",
+                 lambda raw: raw.strip().lower() not in
+                 ("0", "off", "false", "no"))):
             val = pick(key, env, cast)
             if val is not None:
                 kw[key] = val

@@ -1829,7 +1829,10 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
                    chunk_docs: int = 8192, doc_len: Optional[int] = None,
                    strict: bool = True, spill: str = "auto",
                    wire_vals: bool = True,
-                   plan: Optional["MeshPlan"] = None) -> IngestResult:
+                   plan: Optional["MeshPlan"] = None,
+                   shard: Optional[Tuple[int, int]] = None,
+                   df_merge=None,
+                   total_docs: Optional[int] = None) -> IngestResult:
     """Stream a directory through the overlapped two-pass pipeline.
 
     ``doc_len`` fixes the static token length L for every chunk (defaults
@@ -1860,6 +1863,20 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
     beyond it the two-pass streaming regime takes over with the same
     triple cache (``_run_overlapped_mesh_streaming``).
 
+    ``shard``/``df_merge``/``total_docs`` are the multi-process ingest
+    hooks (``parallel.multihost.run_sharded_ingest``): ``shard=(lo,
+    hi)`` ingests only that contiguous slice of the global discovery
+    order, ``df_merge`` (a callable ``[V] int32 host DF -> merged
+    DF``, typically ``MpiLiteComm.allreduce_sum``) replaces the local
+    DF with the cross-worker sum at the one DF->IDF boundary, and
+    ``total_docs`` is the GLOBAL document count the IDF must use.
+    Per-document rows depend only on the document's own tokens plus
+    the (merged) DF/IDF, so a shard's rows are bit-identical to the
+    same rows of a single-process run. The merge forces the gather DF
+    join on the pair-wire finish — the sort-join derives per-slot DF
+    from the local triples, which a merged run must not (the same rule
+    the mesh path follows).
+
     Requires HASHED vocab (fixed id space across chunks) and a top-k
     selection (full per-term output would defeat the streaming design).
     Works with or without the native loader; the native path keeps
@@ -1880,6 +1897,12 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
     if spill not in ("auto", "host", "reread"):
         raise ValueError(f"unknown spill policy {spill!r}")
     length = doc_len or cfg.max_doc_len
+    if plan is not None and (shard is not None or df_merge is not None
+                             or total_docs is not None):
+        raise ValueError("shard/df_merge/total_docs are the "
+                         "multi-PROCESS ingest hooks; a mesh plan "
+                         "shards across devices of one process — "
+                         "compose by giving each worker its own plan")
     if plan is not None:
         # Multi-chip composition: route to the docs-sharded resident
         # path. Per-shard HBM holds corpus/S, so the resident budget
@@ -1899,9 +1922,20 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
         return _run_overlapped_mesh(input_dir, cfg, plan, chunk_docs,
                                     length, mesh_names, wire_vals)
     names = discover_names(input_dir, strict)
+    if shard is not None:
+        lo, hi = shard
+        if not (0 <= lo <= hi <= len(names)):
+            raise ValueError(f"shard {shard} outside corpus "
+                             f"[0, {len(names)}]")
+        names = names[lo:hi]
     num_docs = len(names)
     if num_docs == 0:
-        raise ValueError(f"no documents in {input_dir}")
+        raise ValueError(f"no documents in {input_dir}"
+                         + (f" shard {shard}" if shard else ""))
+    # The IDF's num_docs: global under a sharded multi-process run
+    # (every worker scores against the same corpus-wide weights),
+    # local otherwise. Chunking/guards stay local either way.
+    num_docs_idf = total_docs if total_docs is not None else num_docs
 
     use_native = (cfg.tokenizer is TokenizerKind.WHITESPACE
                   and fast_tokenizer.loader_available())
@@ -2053,7 +2087,15 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
             df_dev = (_df_from_trips(tuple(trip_i), tuple(trip_h),
                                      vocab_size=cfg.vocab_size)
                       if _resident_df_mode()[1] else df_acc)
-            idf = _final_idf(df_dev, jnp.int32(num_docs),
+            if df_merge is not None:
+                # THE cross-worker rendezvous: one [V] allreduce — the
+                # reference's MPI_Reduce+Bcast of the DF table
+                # (TFIDF.c:215,220). A host round trip by design: the
+                # workers' links are the thing being divided, and the
+                # [V] vector is 256 KB against the corpus's GBs.
+                with obs.span("link_sync", bytes=int(df_dev.nbytes)):
+                    df_dev = jnp.asarray(df_merge(np.asarray(df_dev)))
+            idf = _final_idf(df_dev, jnp.int32(num_docs_idf),
                              score_dtype=score_dtype)
             # The [V] DF rides its own async copy behind the scoring
             # queue — the host read at the end finds it landed, where a
@@ -2102,10 +2144,29 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
                                 **common)
         t0 = time.perf_counter()
         wide = cfg.vocab_size > (1 << 16)
-        with obs.device_span("phase_b", finish="fused"):
-            df_dev, wire = _finish_wire((trip_i, trip_c, trip_h),
-                                        len_parts, df_acc, num_docs, k,
-                                        score_dtype, cfg, wire_vals)
+        if df_merge is not None:
+            # Merged DF cannot take the sort-join finish (its per-slot
+            # DF comes from the LOCAL triples — the mesh rule): fold
+            # the local DF, allreduce it, and score through the gather
+            # join against the merged table.
+            df_local = (_df_from_trips(tuple(trip_i), tuple(trip_h),
+                                       vocab_size=cfg.vocab_size)
+                        if _resident_df_mode()[1] else df_acc)
+            with obs.span("link_sync", bytes=int(df_local.nbytes)):
+                df_acc = jnp.asarray(df_merge(np.asarray(df_local)))
+            with obs.device_span("phase_b", finish="fused"):
+                df_dev, wire = _score_pack_wire(
+                    tuple(trip_i), tuple(trip_c), tuple(trip_h),
+                    tuple(len_parts), df_acc, jnp.int32(num_docs_idf),
+                    topk=k, score_dtype=score_dtype, wide_ids=wide,
+                    include_vals=wire_vals, join="gather",
+                    derive_df=False)
+        else:
+            with obs.device_span("phase_b", finish="fused"):
+                df_dev, wire = _finish_wire((trip_i, trip_c, trip_h),
+                                            len_parts, df_acc,
+                                            num_docs_idf, k,
+                                            score_dtype, cfg, wire_vals)
         # ONE unfenced fetch = one link round trip: drain + transfer.
         # DF stays on device (jax.Array acts array-like; np.asarray
         # fetches it on first real read — no hot-path consumer does).
@@ -2280,7 +2341,14 @@ def run_overlapped(input_dir: str, config: Optional[PipelineConfig] = None,
     ph["pass_a"] = time.perf_counter() - t_pass
     ph["triple_cached_chunks"] = float(len(trip_cache))
 
-    idf = _final_idf(df_acc, jnp.int32(num_docs), score_dtype=score_dtype)
+    if df_merge is not None:
+        # Pass-A/B boundary: the one place the streaming regime's DF
+        # is complete and its IDF not yet consumed — the cross-worker
+        # allreduce slots in exactly here (see the resident twin).
+        with obs.span("link_sync", bytes=int(df_acc.nbytes)):
+            df_acc = jnp.asarray(df_merge(np.asarray(df_acc)))
+    idf = _final_idf(df_acc, jnp.int32(num_docs_idf),
+                     score_dtype=score_dtype)
 
     # Pass B: rescore each chunk against the corpus-wide IDF. Same
     # overlap structure. On the packed result wire (the default,

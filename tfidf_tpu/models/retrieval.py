@@ -39,6 +39,7 @@ from jax.sharding import PartitionSpec as P
 
 from tfidf_tpu.config import PipelineConfig, VocabMode
 from tfidf_tpu.io.corpus import Corpus, discover_corpus, pack_corpus
+from tfidf_tpu import obs
 from tfidf_tpu.obs import devmon
 from tfidf_tpu.ops.hashing import words_to_ids
 from tfidf_tpu.ops.scoring import idf_from_df
@@ -83,7 +84,19 @@ def _finish_index(trip_i, trip_c, trip_h, len_parts, df_acc, num_docs):
 
 @functools.partial(jax.jit, static_argnames=("k",))
 def _search_bcoo(data, cols, qmat, *, k: int):
-    """[D, V] BCOO x [V, Q] dense on the MXU -> per-query top-k docs."""
+    """[D, V] BCOO x [V, Q] dense on the MXU -> per-query top-k docs.
+
+    ``qmat`` is consumed (round 19): every call site stages a fresh
+    query block (the slab's ring-buffer upload or a one-shot
+    ``jnp.asarray``), never touches it after the call, and the slab
+    path deletes it explicitly once results land — so the allocator
+    recycles ONE device block per pow2 bucket in steady-state serving.
+    An actual ``donate_argnums`` entry is the measured honest negative
+    (docs/SCALING.md round 19): XLA can only honor donation by
+    aliasing an output, and no [Q, k] output can alias the [V, Q]
+    block, so donation degrades to a per-dispatch "not usable"
+    warning with zero memory effect — explicit post-dispatch delete
+    gives the same one-recycled-block guarantee, silently."""
     d = data.shape[0]
     mat = jsparse.BCOO((data, cols), shape=(d, qmat.shape[0]))
     sims = jsparse.bcoo_dot_general(
@@ -135,6 +148,48 @@ def _make_search_sharded(plan: MeshPlan, k: int):
         check_vma=False))
 
 
+def fill_query_matrix(queries: Sequence[Union[str, bytes]],
+                      config: PipelineConfig, idf: np.ndarray,
+                      out: np.ndarray,
+                      scratch: Optional[np.ndarray] = None) -> np.ndarray:
+    """Pack queries into the [V, Q] cosine block ``out`` IN PLACE.
+
+    THE query-packing implementation — :func:`query_matrix` and the
+    slab path both run this exact float-op sequence, so the
+    zero-allocation path is bit-identical to the allocating one by
+    construction (pinned as a property in tests/test_queryslab.py).
+    Each column: float32 term counts accumulated directly into the
+    column, ``/ len(words)``, ``* idf``, L2-normalized via the reused
+    ``[V]`` ``scratch`` — no per-query temporaries at all. A zero/
+    empty column scores 0 against every document.
+    """
+    out.fill(0.0)
+    idf = np.asarray(idf)
+    if scratch is None:
+        scratch = np.empty((config.vocab_size,), np.float32)
+    one = np.float32(1.0)
+    for j, text in enumerate(queries):
+        data = text.encode() if isinstance(text, str) else text
+        words = whitespace_tokenize(data, config.truncate_tokens_at)
+        if not words:
+            continue
+        ids = words_to_ids(words, config.vocab_size, config.hash_seed)
+        col = out[:, j]
+        # Exact float32 counts (integers < 2^24 are exact), same
+        # values bincount+astype produced; then the same two
+        # elementwise ops, in place.
+        np.add.at(col, ids, one)
+        col /= len(words)
+        col *= idf
+        np.multiply(col, col, out=scratch)
+        norm = float(np.sqrt(scratch.sum()))
+        if norm > 0:
+            col /= norm
+        else:
+            col.fill(0.0)
+    return out
+
+
 def query_matrix(queries: Sequence[Union[str, bytes]],
                  config: PipelineConfig, idf: np.ndarray,
                  pad_to: Optional[int] = None) -> np.ndarray:
@@ -146,22 +201,11 @@ def query_matrix(queries: Sequence[Union[str, bytes]],
     rebuild bit-parity contract. ``pad_to`` widens the block with
     all-zero columns (query-count bucketing); a zero column scores 0
     against every document, so padded rows fall out of results via the
-    ``vals > 0`` mask.
+    ``vals > 0`` mask. Delegates to :func:`fill_query_matrix` — one
+    packing implementation for the allocating and slab paths alike.
     """
-    idf = np.asarray(idf)
-    q = np.zeros((config.vocab_size, pad_to or len(queries)), np.float32)
-    for j, text in enumerate(queries):
-        data = text.encode() if isinstance(text, str) else text
-        words = whitespace_tokenize(data, config.truncate_tokens_at)
-        if not words:
-            continue
-        ids = words_to_ids(words, config.vocab_size, config.hash_seed)
-        counts = np.bincount(ids, minlength=config.vocab_size)
-        vec = counts.astype(np.float32) / len(words) * idf
-        norm = float(np.sqrt((vec * vec).sum()))
-        if norm > 0:
-            q[:, j] = vec / norm
-    return q
+    q = np.empty((config.vocab_size, pad_to or len(queries)), np.float32)
+    return fill_query_matrix(queries, config, idf, q)
 
 
 def config_fingerprint(cfg: PipelineConfig) -> str:
@@ -212,6 +256,15 @@ class TfidfRetriever:
         self._ids = self._weights = self._head = None
         self._num_docs = 0
         self._sharded_cache: dict = {}
+        # Zero-allocation query path (round 19): tri-state knob
+        # (None = env TFIDF_TPU_QUERY_SLAB, default on; the server
+        # sets it from ServeConfig.query_slab), the lazily-built
+        # staging slab, and the cached host IDF the slab fill reads
+        # (one D2H per index install instead of one per search).
+        self.query_slab: Optional[bool] = None
+        self._slab = None
+        self._idf_np: Optional[np.ndarray] = None
+        self._idf_src = None
 
     # --- indexing ---
     def index(self, corpus: Corpus) -> "TfidfRetriever":
@@ -369,6 +422,31 @@ class TfidfRetriever:
         return query_matrix(queries, self.config, self._idf,
                             pad_to=pad_to)
 
+    def _idf_host(self) -> np.ndarray:
+        """Host copy of the IDF vector, cached per installed index —
+        the slab fill must not pay a D2H round trip per search. A
+        racing refresh is benign (both sides compute the same array)."""
+        idf = self._idf
+        if self._idf_np is None or self._idf_src is not idf:
+            self._idf_np = np.asarray(idf)
+            self._idf_src = idf
+        return self._idf_np
+
+    def _resolve_slab(self):
+        """The query slab serving this retriever, or None when the
+        path is off (mesh plans keep the legacy packing — their qmat
+        replicates under shard_map, a different staging contract)."""
+        from tfidf_tpu.ops.queryslab import QuerySlab, use_query_slab
+        if self.plan is not None or not use_query_slab(self.query_slab):
+            return None
+        if (self._slab is None
+                or self._slab.vocab_size != self.config.vocab_size):
+            block = max(1, int(os.environ.get("TFIDF_TPU_QUERY_BLOCK",
+                                              "64")))
+            self._slab = QuerySlab(self.config.vocab_size,
+                                   max_bucket=block)
+        return self._slab
+
     def search(self, queries: Sequence[Union[str, bytes]], k: int = 10
                ) -> Tuple[np.ndarray, np.ndarray]:
         """Ranked retrieval: (scores, doc_indices), each [Q, k'] with
@@ -400,8 +478,9 @@ class TfidfRetriever:
         # score 0 everywhere and their rows are dropped before return.
         nq = len(queries)
         bucket = 1 << max(0, nq - 1).bit_length()
-        qmat = jnp.asarray(self._query_matrix(queries, pad_to=bucket))
         if self.plan is not None:
+            qmat = jnp.asarray(self._query_matrix(queries,
+                                                  pad_to=bucket))
             fn = self._sharded_fn(k)
             vals, idx = fn(self._ids, self._weights, self._head, qmat)
         else:
@@ -417,13 +496,48 @@ class TfidfRetriever:
             before = (_search_bcoo._cache_size()
                       if watch is not None
                       and hasattr(_search_bcoo, "_cache_size") else None)
-            vals, idx = _search_bcoo(data, cols, qmat, k=kk)
+            slab = self._resolve_slab()
+            if slab is not None and bucket <= slab.max_bucket:
+                # Zero-allocation hot path (round 19): fill a reused
+                # staging-ring buffer in place, EXACTLY ONE H2D copy
+                # (the byte-stamped span is the trace receipt), then
+                # delete the uploaded block the moment results land —
+                # the allocator recycles one device block per bucket.
+                # The slot releases only after the result rows
+                # materialize: host rows back means the copy was
+                # consumed, so the next batch can safely refill this
+                # buffer (the reuse-safety guard the 8-thread stress
+                # pins).
+                buf, scratch, slot = slab.checkout(bucket)
+                try:
+                    fill_query_matrix(queries, self.config,
+                                      self._idf_host(), buf,
+                                      scratch=scratch)
+                    with obs.span("h2d", bytes=int(buf.nbytes)):
+                        qmat = jax.device_put(buf)
+                    slab.note_h2d(buf.nbytes)
+                    vals, idx = _search_bcoo(data, cols, qmat, k=kk)
+                    vals = np.asarray(vals)
+                    idx = np.asarray(idx)
+                    qmat.delete()
+                finally:
+                    slab.release(slot)
+            else:
+                # Oversize-batch fallback (bucket past the slab's
+                # ring shapes — a raised TFIDF_TPU_QUERY_BLOCK) or
+                # slab off: the legacy one-shot allocation. Same
+                # programs, same bytes.
+                if slab is not None:
+                    slab.note_fallback()
+                qmat = jnp.asarray(self._query_matrix(queries,
+                                                      pad_to=bucket))
+                vals, idx = _search_bcoo(data, cols, qmat, k=kk)
             if (before is not None
                     and _search_bcoo._cache_size() > before):
                 devmon.note_compile(
-                    "search_bcoo", queries=int(qmat.shape[1]), k=kk,
+                    "search_bcoo", queries=int(bucket), k=kk,
                     docs=int(self._ids.shape[0]),
-                    dtype=str(qmat.dtype))
+                    dtype="float32")
         # Both paths produce >= min(k, num_docs) sorted columns (the
         # sharded one up to min(k, local_k * n_shards)); trim to the
         # path-independent width so callers see the same shape. Rows

@@ -1,0 +1,132 @@
+"""Zero-allocation query staging: a pinned host ring feeding one
+donated H2D copy per search batch.
+
+The serve hot path used to pay three avoidable costs per dispatch: a
+fresh ``np.zeros((V, Q))`` query block (16 MB at the 64-query bucket),
+per-query ``bincount``/temporary arrays inside ``query_matrix``, and
+an untracked ``jnp.asarray`` upload. Steady state should pay none of
+them: the pow2 query-count bucketing (round 9) means there are only
+``log2(block)+1`` distinct block shapes per index, so the staging
+buffers are perfectly reusable.
+
+:class:`QuerySlab` holds, per pow2 bucket, a small FIFO ring of host
+staging buffers (plus one ``[V]`` float32 norm scratch each). A search
+checks a slot out, fills it IN PLACE through
+:func:`~tfidf_tpu.models.retrieval.fill_query_matrix` (the same
+float-op sequence ``query_matrix`` runs — bit-identical columns, one
+implementation), uploads it with EXACTLY ONE ``jax.device_put`` inside
+a byte-stamped ``h2d`` span, and releases the slot once the result has
+materialized (by which point the copy is provably consumed — the
+use-after-donate guard). The device side of the slab is the donated
+``qmat`` argument of the search program: donation recycles the same
+device allocation batch over batch, so steady-state serving holds one
+persistent device block per bucket and allocates nothing on either
+side of the link.
+
+Ring behavior: slots are reused FIFO; when every slot of a bucket is
+checked out (N concurrent searches), a fresh slot is allocated and the
+``allocs`` counter ticks — so after warm-up ``allocs`` goes flat and
+``serve_bench --ab-slab`` can print ``allocs/batch = 0`` as a measured
+receipt, not a promise. Batches wider than ``max_bucket`` fall back to
+the legacy allocating path (callers check :attr:`max_bucket`).
+
+Env knob ``TFIDF_TPU_QUERY_SLAB`` (CLI ``--query-slab``): ``0``/
+``off``/``false`` disables, anything else (and unset) enables.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+
+def use_query_slab(explicit=None) -> bool:
+    """Resolve the slab knob: explicit setting > env > on."""
+    if explicit is not None:
+        return bool(explicit)
+    raw = os.environ.get("TFIDF_TPU_QUERY_SLAB", "").strip().lower()
+    return raw not in ("0", "off", "false", "no")
+
+
+class QuerySlab:
+    """Per-bucket host staging rings + the slab counters.
+
+    Thread-safe: checkout/release take the slab lock; the fill and the
+    upload happen OUTSIDE it on the checked-out slot, so concurrent
+    searches at the same bucket stage through distinct buffers.
+    """
+
+    def __init__(self, vocab_size: int, max_bucket: int):
+        if max_bucket < 1:
+            raise ValueError("max_bucket must be >= 1")
+        self.vocab_size = int(vocab_size)
+        # Next pow2 at or above the query-block bound, so every bucket
+        # the search path can produce has a ring.
+        self.max_bucket = 1 << max(0, int(max_bucket) - 1).bit_length()
+        self._lock = threading.Lock()
+        self._free: Dict[int, collections.deque] = {}
+        self._slots: Dict[int, List[Tuple[np.ndarray, np.ndarray]]] = {}
+        # Receipts (read by serve_bench --ab-slab and the tests):
+        self.allocs = 0       # fresh staging-buffer allocations
+        self.packs = 0        # checkouts = batches staged via the slab
+        self.h2d_copies = 0   # device_put calls (must equal packs)
+        self.bytes_h2d = 0
+        self.fallbacks = 0    # oversize batches the caller routed away
+
+    def checkout(self, bucket: int):
+        """-> (buf [V, bucket] f32, scratch [V] f32, slot key).
+
+        Reuses the oldest FREE slot of the bucket's ring (FIFO — the
+        wraparound order the tests pin) or allocates a fresh one when
+        every slot is in flight."""
+        if bucket > self.max_bucket:
+            raise ValueError(f"bucket {bucket} > max_bucket "
+                             f"{self.max_bucket} — caller must take "
+                             f"the legacy path (note_fallback)")
+        with self._lock:
+            free = self._free.setdefault(bucket, collections.deque())
+            slots = self._slots.setdefault(bucket, [])
+            if free:
+                idx = free.popleft()
+            else:
+                slots.append((
+                    np.zeros((self.vocab_size, bucket), np.float32),
+                    np.zeros((self.vocab_size,), np.float32)))
+                idx = len(slots) - 1
+                self.allocs += 1
+            self.packs += 1
+            buf, scratch = slots[idx]
+        return buf, scratch, (bucket, idx)
+
+    def release(self, slot) -> None:
+        bucket, idx = slot
+        with self._lock:
+            self._free[bucket].append(idx)
+
+    def note_h2d(self, nbytes: int) -> None:
+        with self._lock:
+            self.h2d_copies += 1
+            self.bytes_h2d += int(nbytes)
+
+    def note_fallback(self) -> None:
+        with self._lock:
+            self.fallbacks += 1
+
+    def ring_depth(self, bucket: int) -> int:
+        with self._lock:
+            return len(self._slots.get(bucket, ()))
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "allocs": self.allocs,
+                "packs": self.packs,
+                "h2d_copies": self.h2d_copies,
+                "bytes_h2d": self.bytes_h2d,
+                "fallbacks": self.fallbacks,
+                "buffers": sum(len(s) for s in self._slots.values()),
+            }

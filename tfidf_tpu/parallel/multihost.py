@@ -1,19 +1,451 @@
-"""Multi-host (DCN) bring-up.
+"""Multi-host / multi-process bring-up and sharded ingest.
 
-The reference's runtime bring-up is ``MPI_Init``/``MPI_Finalize`` +
-``MPI_Comm_size/rank`` (``TFIDF.c:82-92``); launched as one process per
-rank by mpirun. The JAX equivalent for a multi-host TPU slice is
-``jax.distributed.initialize`` — one process per host, all chips of all
-hosts visible in ``jax.devices()`` afterwards, meshes spanning hosts
-transparently (collectives ride ICI within a slice, DCN across slices).
+Two process models live here, mirroring the reference's two runtimes:
+
+* ``initialize`` — the JAX-native bring-up for a multi-host TPU slice
+  (``jax.distributed.initialize``; one process per host, meshes span
+  hosts transparently). The reference's ``MPI_Init``/``MPI_Comm_rank``
+  (``TFIDF.c:82-92``) done the jax way.
+* ``MpiLiteComm`` + ``run_sharded_ingest`` — the reference's
+  rank-partitioned document loop (``TFIDF.c:130``) done over N OS
+  processes, each owning its own host→device link: the driver launches
+  workers with the SAME process model as ``native/mpirun_lite``
+  (pairwise AF_UNIX socketpairs inherited through
+  ``MPILITE_RANK/SIZE/FDS``) and each worker ingests a contiguous
+  document shard concurrently. The only cross-worker traffic is the
+  psum-shaped DF allreduce (``MPI_Reduce + MPI_Bcast`` of the DF
+  table, ``TFIDF.c:215,220``) — one [V] vector per worker per run.
+  ``MpiLiteComm`` speaks the exact mpi_lite wire protocol
+  (``native/mpi_lite/mpi_lite.cc``: framed ``[i32 tag][u64 bytes]``
+  messages, root-sequenced collectives, reserved negative tags), so a
+  Python rank launched by the native ``mpirun_lite`` binary finds the
+  same channels a C rank would.
+
+Why processes and not threads: the link tax is per-process — one
+process owns one transfer queue to its device, so N processes drive N
+links (or N slices of one link's staging bandwidth) concurrently,
+dividing the ``link_tax_s`` column of BENCH_r05 by worker count. The
+merged index is BIT-identical to a single-process ingest: per-document
+rows depend only on that document's tokens and the GLOBAL DF/IDF, DF
+is an order-independent integer sum, and shard concatenation preserves
+the global document order (docs/SCALING.md round 19).
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+import json
+import os
+import socket
+import struct
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
-import jax
+import numpy as np
+
+# Reserved collective tags — the mpi_lite runtime's values
+# (native/mpi_lite/mpi_lite.cc): point-to-point tags are >= 0, so the
+# collectives can never collide with them.
+_TAG_BCAST = -101
+_TAG_BARRIER_IN = -102
+_TAG_BARRIER_OUT = -103
+# Python-side reduce contribution tag (the C runtime sequences its
+# reductions through Send/Recv with caller tags; our allreduce uses a
+# reserved one so a concurrent p2p exchange cannot interleave).
+_TAG_REDUCE = -105
+
+_FRAME_HDR = struct.Struct("<iQ")  # [i32 tag][u64 nbytes]
+
+
+class MpiLiteError(RuntimeError):
+    """Protocol violation on an mpi_lite channel (tag mismatch, short
+    read, peer gone) — aborting loudly beats silently reordering."""
+
+
+class MpiLiteComm:
+    """The mpi_lite runtime subset in Python, over inherited fds.
+
+    Wire protocol per (src, dst) channel: framed messages
+    ``[u32 tag][u64 bytes][payload]``, strictly ordered per channel —
+    every send has exactly one program-ordered matching recv, and a
+    frame whose tag differs from the one the receiver asked for raises
+    :class:`MpiLiteError`. Collectives are root-sequenced (peers talk
+    only to rank 0), so channel buffers bound memory, not progress —
+    the same deadlock discipline as the C runtime.
+    """
+
+    def __init__(self, rank: int, size: int, fds: Sequence[int]):
+        if len(fds) != size:
+            raise MpiLiteError(f"fds length {len(fds)} != size {size}")
+        self.rank = rank
+        self.size = size
+        self._fds = list(fds)
+
+    @classmethod
+    def from_env(cls) -> "MpiLiteComm":
+        """Attach to the channels ``mpirun_lite`` (or
+        :func:`launch_ranks`) wired up: ``MPILITE_RANK``,
+        ``MPILITE_SIZE``, ``MPILITE_FDS`` (own slot -1)."""
+        try:
+            rank = int(os.environ["MPILITE_RANK"])
+            size = int(os.environ["MPILITE_SIZE"])
+            raw = os.environ["MPILITE_FDS"]
+        except KeyError as e:
+            raise MpiLiteError(f"not under an mpi_lite launcher "
+                               f"(missing {e.args[0]})")
+        fds = []
+        for part in raw.split(","):
+            try:
+                fds.append(int(part))
+            except ValueError:
+                raise MpiLiteError(
+                    f"malformed MPILITE_FDS entry {part!r} in {raw!r}")
+        return cls(rank, size, fds)
+
+    # --- framed point-to-point ---
+    def _write_all(self, fd: int, data: bytes) -> None:
+        view = memoryview(data)
+        while view:
+            n = os.write(fd, view)
+            view = view[n:]
+
+    def _read_all(self, fd: int, n: int) -> bytes:
+        parts = []
+        while n:
+            chunk = os.read(fd, min(n, 1 << 20))
+            if not chunk:
+                raise MpiLiteError("peer closed channel mid-message")
+            parts.append(chunk)
+            n -= len(chunk)
+        return b"".join(parts)
+
+    def send(self, peer: int, tag: int, payload: bytes) -> None:
+        fd = self._fds[peer]
+        if fd < 0:
+            raise MpiLiteError(f"send to self/unwired peer {peer}")
+        self._write_all(fd, _FRAME_HDR.pack(tag, len(payload)))
+        self._write_all(fd, payload)
+
+    def recv(self, peer: int, tag: int) -> bytes:
+        fd = self._fds[peer]
+        if fd < 0:
+            raise MpiLiteError(f"recv from self/unwired peer {peer}")
+        got_tag, nbytes = _FRAME_HDR.unpack(
+            self._read_all(fd, _FRAME_HDR.size))
+        if got_tag != tag:
+            raise MpiLiteError(
+                f"tag mismatch on channel {peer}->{self.rank}: "
+                f"expected {tag}, got {got_tag} — per-channel ordering "
+                f"is the protocol; this is a bug, not a race")
+        return self._read_all(fd, nbytes)
+
+    # --- root-sequenced collectives (rank 0 is root, like the C
+    # runtime's MPI_COMM_WORLD collectives) ---
+    def barrier(self) -> None:
+        if self.rank == 0:
+            for peer in range(1, self.size):
+                self.recv(peer, _TAG_BARRIER_IN)
+            for peer in range(1, self.size):
+                self.send(peer, _TAG_BARRIER_OUT, b"")
+        else:
+            self.send(0, _TAG_BARRIER_IN, b"")
+            self.recv(0, _TAG_BARRIER_OUT)
+
+    def bcast_bytes(self, payload: Optional[bytes]) -> bytes:
+        if self.rank == 0:
+            assert payload is not None
+            for peer in range(1, self.size):
+                self.send(peer, _TAG_BCAST, payload)
+            return payload
+        return self.recv(0, _TAG_BCAST)
+
+    def allreduce_sum(self, arr: np.ndarray) -> np.ndarray:
+        """Exact elementwise sum of every rank's array — the
+        psum-shaped DF reduction (integer sums are order-independent,
+        so the merged DF is bit-identical to a single-process fold).
+        Root-sequenced: peers send to rank 0, root sums in RANK ORDER
+        and broadcasts the merged vector back."""
+        arr = np.ascontiguousarray(arr)
+        if self.size == 1:
+            return arr.copy()
+        if self.rank == 0:
+            acc = arr.copy()
+            for peer in range(1, self.size):
+                part = np.frombuffer(
+                    self.recv(peer, _TAG_REDUCE),
+                    dtype=arr.dtype).reshape(arr.shape)
+                acc += part
+            self.bcast_bytes(acc.tobytes())
+            return acc
+        self.send(0, _TAG_REDUCE, arr.tobytes())
+        out = np.frombuffer(self.bcast_bytes(None),
+                            dtype=arr.dtype).reshape(arr.shape)
+        return out.copy()
+
+    def close(self) -> None:
+        for fd in self._fds:
+            if fd >= 0:
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+        self._fds = [-1] * self.size
+
+
+def launch_ranks(n: int, argv_for_rank: Callable[[int], List[str]],
+                 env: Optional[dict] = None,
+                 stderr=subprocess.PIPE) -> List[subprocess.Popen]:
+    """The ``mpirun_lite`` process model in Python: one AF_UNIX
+    socketpair per rank pair, N children each inheriting exactly its
+    own row of fds through ``MPILITE_RANK/SIZE/FDS``. Children
+    launched this way and children launched by the native binary see
+    the identical channel environment."""
+    pair_fd = [[-1] * n for _ in range(n)]
+    socks = []  # keep the python socket objects alive until spawn
+    for i in range(n):
+        for j in range(i + 1, n):
+            a, b = socket.socketpair(socket.AF_UNIX, socket.SOCK_STREAM)
+            a.setblocking(True)
+            b.setblocking(True)
+            socks += [a, b]
+            pair_fd[i][j] = a.fileno()
+            pair_fd[j][i] = b.fileno()
+    procs = []
+    base_env = dict(os.environ if env is None else env)
+    for r in range(n):
+        fds = [pair_fd[r][j] for j in range(n)]
+        child_env = dict(base_env,
+                         MPILITE_RANK=str(r), MPILITE_SIZE=str(n),
+                         MPILITE_FDS=",".join(str(f) for f in fds))
+        procs.append(subprocess.Popen(
+            argv_for_rank(r), env=child_env,
+            pass_fds=[f for f in fds if f >= 0],
+            stdout=subprocess.PIPE, stderr=stderr, text=True))
+    for s in socks:  # parent's copies: children hold their own dups
+        s.close()
+    return procs
+
+
+def shard_bounds(num_docs: int, n_workers: int) -> List[Tuple[int, int]]:
+    """Contiguous document shards in global discovery order — the
+    reference's ``rank * docs / size`` partition (``TFIDF.c:130``).
+    The last shard is ragged when ``num_docs % n_workers != 0``."""
+    if n_workers < 1:
+        raise ValueError("n_workers must be >= 1")
+    n_workers = min(n_workers, max(num_docs, 1))
+    return [(r * num_docs // n_workers, (r + 1) * num_docs // n_workers)
+            for r in range(n_workers)]
+
+
+def _config_to_spec(cfg) -> dict:
+    d = dataclasses.asdict(cfg)
+    d["vocab_mode"] = cfg.vocab_mode.value
+    d["tokenizer"] = cfg.tokenizer.value
+    d["ngram_range"] = list(cfg.ngram_range)
+    return d
+
+
+def _config_from_spec(d: dict):
+    from tfidf_tpu.config import PipelineConfig, TokenizerKind, VocabMode
+    d = dict(d)
+    d["vocab_mode"] = VocabMode(d["vocab_mode"])
+    d["tokenizer"] = TokenizerKind(d["tokenizer"])
+    d["ngram_range"] = tuple(d["ngram_range"])
+    return PipelineConfig(**d)
+
+
+def _worker_main(spec_path: str) -> int:
+    """One ingest rank: attach to the mpi_lite channels, ingest the
+    assigned contiguous shard through the SAME ``run_overlapped``
+    programs a single-process run dispatches (only the IDF's
+    ``num_docs`` and the merged DF differ — both global), and write
+    the shard's rows for the driver to concatenate."""
+    with open(spec_path) as f:
+        spec = json.load(f)
+    comm = MpiLiteComm.from_env()
+    from tfidf_tpu import obs
+    from tfidf_tpu.ingest import run_overlapped
+
+    cfg = _config_from_spec(spec["config"])
+    lo, hi = spec["shards"][comm.rank]
+
+    def df_merge(df_host: np.ndarray) -> np.ndarray:
+        return comm.allreduce_sum(np.asarray(df_host, dtype=np.int32))
+
+    walls = []
+    result = None
+    for _ in range(max(1, int(spec.get("repeat", 1)))):
+        # Align the timed windows: every rank starts its ingest at the
+        # same barrier, so per-rank walls measure concurrent work.
+        comm.barrier()
+        t0 = time.perf_counter()
+        result = run_overlapped(
+            spec["input_dir"], cfg,
+            chunk_docs=spec["chunk_docs"], doc_len=spec["doc_len"],
+            strict=spec["strict"], spill=spec["spill"],
+            shard=(lo, hi), total_docs=spec["total_docs"],
+            df_merge=df_merge if comm.size > 1 else None)
+        walls.append(time.perf_counter() - t0)
+    # One more fence so no rank tears down its channels while a peer
+    # is still mid-allreduce.
+    comm.barrier()
+    out = spec["out_paths"][comm.rank]
+    arrays = {
+        "topk_vals": np.asarray(result.topk_vals),
+        "topk_ids": np.asarray(result.topk_ids),
+        "lengths": np.asarray(result.lengths),
+    }
+    if comm.rank == 0:
+        arrays["df"] = np.asarray(result.df)
+    np.savez(out, **arrays)
+    meta = {
+        "rank": comm.rank, "lo": lo, "hi": hi,
+        "wall_s": walls[-1], "walls_s": walls,
+        "phases": result.phases or {},
+        "path": result.path, "wire": result.wire,
+        "finish": result.finish,
+        "bytes_on_wire": result.bytes_on_wire,
+        "df_occupied": result.df_occupied,
+    }
+    with open(out + ".meta.json", "w") as f:
+        json.dump(meta, f)
+    obs.export()  # no-op unless TFIDF_TPU_TRACE armed
+    comm.close()
+    print(f"OK {comm.rank}")
+    return 0
+
+
+def _upload_seconds(phases: Dict[str, float]) -> float:
+    """The worker-run seconds spent driving its link: the resident
+    path's ``put`` (device_put staging + dispatch) or the streaming
+    passes' equivalents."""
+    if "put" in phases:
+        return float(phases["put"])
+    return float(phases.get("pass_a", 0.0)) + float(
+        phases.get("pass_b", 0.0))
+
+
+@dataclasses.dataclass
+class ShardedIngestInfo:
+    """Per-worker receipts of a :func:`run_sharded_ingest` run."""
+
+    n_workers: int
+    shards: List[Tuple[int, int]]
+    wall_s: float               # max over workers (concurrent ranks)
+    worker_walls_s: List[float]
+    upload_s: float             # max over workers' link-driving time
+    worker_upload_s: List[float]
+    # Fraction of each worker's wall spent driving its own link — the
+    # per-worker link_utilization column of the bench artifact.
+    link_utilization: List[float]
+    worker_phases: List[Dict[str, float]]
+    path: str = ""
+    wire: str = ""
+
+
+def run_sharded_ingest(input_dir: str, config=None, n_workers: int = 2,
+                       chunk_docs: int = 8192,
+                       doc_len: Optional[int] = None, strict: bool = True,
+                       spill: str = "auto", repeat: int = 1,
+                       timeout_s: float = 600.0,
+                       keep_dir: Optional[str] = None):
+    """Ingest ``input_dir`` across ``n_workers`` OS processes, each
+    packing and uploading its contiguous document shard over its own
+    link concurrently; returns ``(IngestResult, ShardedIngestInfo)``.
+
+    The merged result is bit-identical to a single-process
+    :func:`~tfidf_tpu.ingest.run_overlapped` of the same corpus and
+    config (DF, IDF, scores, names, tie order — pinned by
+    tests/test_multihost.py): per-document rows depend only on the
+    document's own tokens and the global DF/IDF, the DF allreduce is
+    an exact integer sum, and shards concatenate in global discovery
+    order. ``repeat`` re-runs the timed ingest inside each (warm)
+    worker process and reports the last run's walls — the honest
+    steady-state number, with the per-process interpreter/compile
+    cold-start excluded from the measured window on every side alike.
+    """
+    from tfidf_tpu.config import PipelineConfig, VocabMode
+    from tfidf_tpu.ingest import IngestResult
+    from tfidf_tpu.io.corpus import discover_names
+
+    cfg = config or PipelineConfig(vocab_mode=VocabMode.HASHED, topk=16)
+    names = discover_names(input_dir, strict)
+    if not names:
+        raise ValueError(f"no documents in {input_dir}")
+    shards = shard_bounds(len(names), n_workers)
+    n_workers = len(shards)
+
+    tmp = keep_dir or tempfile.mkdtemp(prefix="tfidf_mh_")
+    out_paths = [os.path.join(tmp, f"shard{r}.npz")
+                 for r in range(n_workers)]
+    spec = {
+        "input_dir": input_dir,
+        "config": _config_to_spec(cfg),
+        "chunk_docs": chunk_docs,
+        "doc_len": doc_len,
+        "strict": strict,
+        "spill": spill,
+        "repeat": repeat,
+        "total_docs": len(names),
+        "shards": [list(s) for s in shards],
+        "out_paths": out_paths,
+    }
+    spec_path = os.path.join(tmp, "spec.json")
+    with open(spec_path, "w") as f:
+        json.dump(spec, f)
+
+    procs = launch_ranks(
+        n_workers,
+        lambda r: [sys.executable, "-m", "tfidf_tpu.parallel.multihost",
+                   spec_path])
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=timeout_s))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for r, (p, (out, err)) in enumerate(zip(procs, outs)):
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"ingest worker {r} failed rc={p.returncode}\n"
+                f"stdout: {out[-2000:]}\nstderr: {err[-2000:]}")
+
+    parts, metas = [], []
+    for r, path in enumerate(out_paths):
+        parts.append(np.load(path))
+        with open(path + ".meta.json") as f:
+            metas.append(json.load(f))
+    df = parts[0]["df"]
+    vals = np.concatenate([p["topk_vals"] for p in parts])
+    tids = np.concatenate([p["topk_ids"] for p in parts])
+    lengths = np.concatenate([p["lengths"] for p in parts])
+    walls = [m["wall_s"] for m in metas]
+    uploads = [_upload_seconds(m["phases"]) for m in metas]
+    info = ShardedIngestInfo(
+        n_workers=n_workers, shards=shards,
+        wall_s=max(walls), worker_walls_s=walls,
+        upload_s=max(uploads), worker_upload_s=uploads,
+        link_utilization=[round(min(1.0, u / w), 4) if w > 0 else 0.0
+                          for u, w in zip(uploads, walls)],
+        worker_phases=[m["phases"] for m in metas],
+        path=metas[0]["path"], wire=metas[0]["wire"])
+    result = IngestResult(
+        df=df, topk_vals=vals, topk_ids=tids, lengths=lengths,
+        names=names, num_docs=len(names),
+        df_occupied=int((df > 0).sum()),
+        path=f"sharded-{n_workers}proc:{metas[0]['path']}",
+        phases={"upload": info.upload_s, "wall": info.wall_s},
+        wire=metas[0]["wire"],
+        bytes_on_wire=sum(int(m["bytes_on_wire"] or 0) for m in metas))
+    if keep_dir is None:
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+    return result, info
 
 
 @dataclasses.dataclass(frozen=True)
@@ -34,7 +466,7 @@ def initialize(coordinator_address: Optional[str] = None,
     runs everywhere — unlike the reference, which cannot run without an
     MPI runtime even on one node.
     """
-    import os
+    import jax
     if coordinator_address is not None or num_processes is not None:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
@@ -52,3 +484,7 @@ def initialize(coordinator_address: Optional[str] = None,
         local_devices=jax.local_device_count(),
         global_devices=jax.device_count(),
     )
+
+
+if __name__ == "__main__":  # the ingest-worker entry launch_ranks spawns
+    sys.exit(_worker_main(sys.argv[1]))

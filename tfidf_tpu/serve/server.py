@@ -106,6 +106,7 @@ class TfidfServer:
             plan = self._mesh_plan
             self._index_transform = lambda r: shard_index(r, plan)
             retriever = self._index_transform(retriever)
+        self._apply_query_slab(retriever)
         self._retriever = retriever
         # initial_epoch: a snapshot-restored server resumes at the
         # epoch it snapshotted (cache keys and canary oracles stay
@@ -213,6 +214,15 @@ class TfidfServer:
             "batcher", busy_fn=lambda: self._batcher.queued_queries() > 0)
         if self.config.health_period_ms is not None:
             self.health.start()
+
+    def _apply_query_slab(self, retriever) -> None:
+        """Push the config's query-slab knob onto an (installable)
+        index. Duck-typed: plain retrievers and segmented IndexViews
+        that expose the attribute get it; mesh-sharded wrappers (no
+        ``query_slab`` attr) keep their own staging contract."""
+        if (self.config.query_slab is not None
+                and hasattr(retriever, "query_slab")):
+            retriever.query_slab = self.config.query_slab
 
     # --- the batch kernel the batcher drives ---
     def _run_batch(self, queries, k, group):
@@ -468,6 +478,7 @@ class TfidfServer:
         admission lock — placement is slow; the flip stays atomic)."""
         if self._index_transform is not None:
             retriever = self._index_transform(retriever)
+        self._apply_query_slab(retriever)
         with self._lock:
             if self._closed:
                 raise ServerClosed("server is closed")

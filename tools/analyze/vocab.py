@@ -27,6 +27,10 @@ SPANS = {
     "request", "queued", "batched", "device", "dispatch_retry",
     # segmented index (round 17): the compaction merge pass
     "compact",
+    # link tax (round 19): the query slab's single byte-stamped H2D
+    # copy per batch, and the sharded ingest's cross-worker DF
+    # allreduce at the pass-A/B boundary
+    "h2d", "link_sync",
 }
 
 #: Trace instants (``obs.instant``) — point events, not spans.
@@ -108,6 +112,8 @@ ENV_CLI_FLAGS = {
     "TFIDF_TPU_DELTA_DOCS": "--delta-docs",
     "TFIDF_TPU_COMPACT_AT": "--compact-at",
     "TFIDF_TPU_MESH_SHARDS": "--mesh-shards",
+    "TFIDF_TPU_INGEST_WORKERS": "--ingest-workers",
+    "TFIDF_TPU_QUERY_SLAB": "--query-slab",
 }
 
 #: Shared attributes the T001 thread lint tolerates without a lock,

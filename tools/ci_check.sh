@@ -48,10 +48,19 @@ stage "tier1" env JAX_PLATFORMS=cpu timeout -k 10 870 \
     python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider
 
-# 4. perf gate: re-gate the committed newest artifacts against the
+# 4. multi-process ingest smoke (slow-marked, round 19): a real
+#    2-process mpi_lite-rendezvous sharded ingest must stay
+#    bit-identical to single-process — this path went dark the way the
+#    mesh paths did before PR 13 exactly once; never again.
+stage "multihost_ingest_smoke" env JAX_PLATFORMS=cpu timeout -k 10 600 \
+    python -m pytest tests/test_multihost.py -q -m slow -k sharded \
+    -p no:cacheprovider
+
+# 5. perf gate: re-gate the committed newest artifacts against the
 #    ledger (unchanged artifacts must pass; a refreshed artifact that
 #    regressed fails here)
-for artifact in BENCH_r05.json SERVE_r01.json; do
+for artifact in BENCH_r05.json SERVE_r01.json SERVE_r02.json \
+                INGEST_MH_r01.json; do
     if [ -f "${artifact}" ]; then
         stage "perf_gate:${artifact}" \
             python tools/perf_gate.py "${artifact}"

@@ -1,0 +1,175 @@
+"""Multi-process sharded ingest A/B + INGEST_MH_r0x.json artifact.
+
+BENCH_r05 reduced the end-to-end story to one number: ``link_tax_s``
+~1.05 s against ~0.94 s of everything else. This tool measures the fix
+— the reference's rank-partitioned document loop (``TFIDF.c:130``)
+done over N OS processes each owning its own link
+(``tfidf_tpu/parallel/multihost.run_sharded_ingest``) — as a PAIRED
+A/B against the identical single-process protocol, and emits the
+ledger artifact ``tools/perf_ledger.py`` files as ``kind=ingest_mh``.
+
+Protocol fairness: BOTH sides run through the same worker machinery
+(fresh OS processes, mpi_lite-style rendezvous, barrier-aligned timed
+windows, ``--repeat`` in-process repeats with the LAST — warm — run
+reported), so interpreter start and XLA compile cold-starts cancel
+out. The verdict fields:
+
+* ``parity_ok`` — the N-worker merged index bit-identical to the
+  1-process result (DF, IDF-scored top-k values, ids, lengths, names
+  — zero-tolerance in the perf gate);
+* ``upload_s`` vs ``upload_s_1p`` — wall of the slowest worker's
+  link-driving phase (``put``), THE attacked column;
+* ``speedup_vs_1p`` = ``upload_s_1p / upload_s``;
+* per-worker ``link_utilization`` — fraction of each worker's wall
+  spent driving its link.
+
+Usage::
+
+    python tools/ingest_mh_bench.py --docs 32768 --workers 2 \
+        --out INGEST_MH_r01.json
+
+Exit codes: 0 = parity holds (and ratio bound met when given),
+1 = parity/bound failure, 2 = setup error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import sys
+import tempfile
+import time
+
+import _common  # noqa: E402,F401  repo-root sys.path bootstrap
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.split("\n")[0],
+        epilog="artifact keys: upload_s[_1p], wall_s[_1p], "
+               "speedup_vs_1p, parity_ok, link_utilization")
+    ap.add_argument("--docs", type=int, default=32768,
+                    help="synthetic corpus size (ignored with --input)")
+    ap.add_argument("--doc-len", type=int, default=256)
+    ap.add_argument("--workers", type=int, default=2,
+                    help="ingest worker processes for the sharded side")
+    ap.add_argument("--chunk-docs", type=int, default=8192)
+    ap.add_argument("--repeat", type=int, default=2,
+                    help="in-process timed repeats per worker; the "
+                         "LAST (warm) run is reported — compile "
+                         "cold-start excluded on both sides alike")
+    ap.add_argument("--input", default=None,
+                    help="ingest an existing corpus dir instead")
+    ap.add_argument("--max-upload-ratio", type=float, default=None,
+                    help="fail (exit 1) when upload_s exceeds this "
+                         "fraction of upload_s_1p (the round-19 "
+                         "acceptance bound is 0.6)")
+    ap.add_argument("--out", default="INGEST_MH_r01.json")
+    args = ap.parse_args()
+
+    import bench as benchmod
+    benchmod.N_DOCS = args.docs
+    benchmod.DOC_LEN = args.doc_len
+
+    import jax
+
+    from tfidf_tpu.config import PipelineConfig, VocabMode
+    from tfidf_tpu.obs import log as obs_log
+    from tfidf_tpu.parallel.multihost import run_sharded_ingest
+
+    log = obs_log.get_log()
+    tmp = None
+    if args.input is None:
+        tmp = tempfile.mkdtemp(prefix="ingest_mh_")
+        log.info("ingest_mh_bench",
+                 msg=f"generating {args.docs}-doc corpus...")
+        input_dir = benchmod.make_corpus(tmp)
+    else:
+        input_dir = args.input
+    try:
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED,
+                             vocab_size=benchmod.VOCAB,
+                             max_doc_len=args.doc_len,
+                             topk=benchmod.TOPK, engine="sparse")
+
+        def run(n):
+            t0 = time.perf_counter()
+            result, info = run_sharded_ingest(
+                input_dir, cfg, n_workers=n,
+                chunk_docs=args.chunk_docs, doc_len=args.doc_len,
+                strict=False, repeat=args.repeat)
+            return result, info, time.perf_counter() - t0
+
+        log.info("ingest_mh_bench", msg="1-process reference side...")
+        ref, info1, e2e1 = run(1)
+        log.info("ingest_mh_bench",
+                 msg=f"{args.workers}-process sharded side...")
+        mh, infoN, e2eN = run(args.workers)
+
+        parity_ok = int(
+            np.array_equal(np.asarray(ref.df), np.asarray(mh.df))
+            and np.array_equal(ref.topk_vals, mh.topk_vals)
+            and np.array_equal(ref.topk_ids, mh.topk_ids)
+            and np.array_equal(ref.lengths, mh.lengths)
+            and ref.names == mh.names)
+
+        upload_ratio = (infoN.upload_s / info1.upload_s
+                        if info1.upload_s > 0 else 0.0)
+        artifact = {
+            "metric": "ingest_mh",
+            "backend": jax.default_backend(),
+            "n_docs": ref.num_docs,
+            "doc_len": args.doc_len,
+            "chunk_docs": args.chunk_docs,
+            "n_workers": infoN.n_workers,
+            "repeat": args.repeat,
+            "wire": infoN.wire,
+            "ingest_path": infoN.path,
+            "parity_ok": parity_ok,
+            # The attacked column: wall of the slowest worker's
+            # link-driving phase, measured in barrier-aligned windows.
+            "upload_s": round(infoN.upload_s, 4),
+            "upload_s_1p": round(info1.upload_s, 4),
+            "upload_ratio": round(upload_ratio, 4),
+            "speedup_vs_1p": round(1.0 / upload_ratio, 4)
+            if upload_ratio > 0 else 0.0,
+            "wall_s": round(infoN.wall_s, 4),
+            "wall_s_1p": round(info1.wall_s, 4),
+            "worker_walls_s": [round(w, 4)
+                               for w in infoN.worker_walls_s],
+            "worker_upload_s": [round(u, 4)
+                                for u in infoN.worker_upload_s],
+            "link_utilization": infoN.link_utilization,
+            "shards": [list(s) for s in infoN.shards],
+            # Driver-side end-to-end including process spawn/teardown:
+            # context, not a gated column (interpreter+jax start is
+            # ~constant per process, amortized at real corpus sizes).
+            "e2e_s": round(e2eN, 4),
+            "e2e_s_1p": round(e2e1, 4),
+        }
+        with open(args.out, "w") as f:
+            json.dump(artifact, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(json.dumps(artifact, sort_keys=True))
+        if not parity_ok:
+            log.error("ingest_mh_parity",
+                      msg="parity FAILED: the sharded merge diverged "
+                          "from the single-process index")
+            return 1
+        if (args.max_upload_ratio is not None
+                and upload_ratio > args.max_upload_ratio):
+            log.error("ingest_mh_ratio",
+                      msg=f"upload ratio {upload_ratio:.3f} exceeds "
+                          f"bound {args.max_upload_ratio}")
+            return 1
+        return 0
+    finally:
+        if tmp is not None:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -69,6 +69,12 @@ _GATES = {
         # the wire cannot hide inside run-to-run noise.
         "wire_ratio": ("lower", 0.05),
         "link_tax_s": ("lower", 0.40),
+        # Round 19 attributed link columns (bench `link` object): the
+        # exact columns the multi-process ingest and the query slab
+        # attack, held separately so a regression in one cannot hide
+        # inside the other's noise band.
+        "upload_s": ("lower", 0.40),
+        "sync_s": ("lower", 0.40),
         "recall_at_k": ("higher", 0.02),
         # Round 12: memory/compile regressions gate like latency ones.
         # Peak HBM at a fixed corpus shape is allocator-deterministic
@@ -92,6 +98,27 @@ _GATES = {
         # the band vs the rolling baseline) fails CI even when raw
         # p50/p99 stay inside their (wide) noise tolerances.
         "slo_compliance": ("higher", 0.10),
+        # Round 19 query-slab receipts (--ab-slab): parity vs the
+        # slab-off pass is the contract (zero-tolerance), and the
+        # structural invariants gate absolutely — steady state must
+        # allocate NOTHING (0 allocs/batch) and copy ONCE (the
+        # absolute zero-baseline rule fires on any nonzero allocs;
+        # h2d/batch above 1 fails the 1.0 baseline's 0% band).
+        "slab_parity_ok": ("higher", 0.0),
+        "slab_allocs_per_batch": ("lower", 0.0),
+        "slab_h2d_per_batch": ("lower", 0.0),
+    },
+    # Multi-process sharded ingest (tools/ingest_mh_bench.py): parity
+    # is zero-tolerance — the N-worker merged index must stay
+    # bit-identical to single-process (DF, IDF, scores, names, tie
+    # order); upload_s is THE attacked column (wall of the slowest
+    # link-owning worker, lower); speedup_vs_1p gates higher so the
+    # protocol cannot quietly decay back toward serial ingest.
+    "ingest_mh": {
+        "parity_ok": ("higher", 0.0),
+        "upload_s": ("lower", 0.40),
+        "wall_s": ("lower", 0.40),
+        "speedup_vs_1p": ("higher", 0.25),
     },
     # Mutation workloads (serve_bench --mutate): parity under a live
     # add/update/delete stream is zero-tolerance (served bytes must
@@ -154,6 +181,8 @@ _MATCH_KEYS = {"bench": ("backend", "n_docs", "wire"),
                           "delta_docs", "compact_at", "chaos_plan"),
                "mesh_serve": ("backend", "docs", "k", "max_batch",
                               "n_shards"),
+               "ingest_mh": ("backend", "n_docs", "doc_len",
+                             "n_workers", "wire"),
                "multichip": ("n_devices",)}
 # Defaults applied to BOTH sides of a match when the key is absent —
 # how records that predate a context key stay comparable to their

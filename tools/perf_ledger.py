@@ -70,6 +70,12 @@ _BENCH_METRICS = {
     # that silently re-fattens the wire fails the gate (round 14).
     "wire_ratio": "wire_ratio",
     "link_tax_s": "link_tax_s",
+    # Round 19 attributed link columns: the aggregate splits into the
+    # H2D staging wall and the synchronizing D2H round trip, so the
+    # gate can hold the exact column the multi-process sharded ingest
+    # attacks. Absent on pre-round-19 records (gate skips them there).
+    "upload_s": "link.upload_s",
+    "sync_s": "link.sync_s",
     "tpu_s": "tpu_s",
     "cpu_s": "cpu_s",
     "recall_at_k": "recall_at_k",
@@ -95,6 +101,13 @@ _SERVE_METRICS = {
     "slo_compliance": "slo.compliance",
     "slow_queries": "slow_queries",
     "reqtrace_p50_regression": "reqtrace.p50_regression",
+    # Round 19 query-slab receipts (--ab-slab runs): steady-state
+    # allocations and H2D copies per batch are structural invariants
+    # (0 and 1), parity is the bit-identity verdict vs the slab-off
+    # pass; p50 delta is trend context at device-bound latencies.
+    "slab_parity_ok": "slab.parity_ok",
+    "slab_allocs_per_batch": "slab.allocs_per_batch",
+    "slab_h2d_per_batch": "slab.h2d_copies_per_batch",
 }
 # Chaos artifacts (serve_bench --chaos): the fault-plan receipts. The
 # gated metric is parity_ok — every non-shed non-poisoned response
@@ -161,6 +174,24 @@ _MESH_SERVE_CONTEXT = {"backend": "backend", "docs": "docs", "k": "k",
                        "requests": "requests", "max_batch": "max_batch",
                        "concurrency": "concurrency", "mode": "mode",
                        "n_shards": "mesh.n_shards"}
+# Multi-process sharded ingest (tools/ingest_mh_bench.py): the link
+# receipts. parity_ok is zero-tolerance (the N-worker merge must stay
+# bit-identical to single-process); upload_s gates lower-is-better —
+# the wall-clock of the slowest link-owning worker, THE column this
+# protocol divides; speedup_vs_1p gates higher so a regression back
+# toward serial ingest fails CI. n_workers is comparability context —
+# a 2-worker and a 4-worker run are different protocols.
+_INGEST_MH_METRICS = {
+    "parity_ok": "parity_ok",
+    "upload_s": "upload_s",
+    "upload_s_1p": "upload_s_1p",
+    "wall_s": "wall_s",
+    "wall_s_1p": "wall_s_1p",
+    "speedup_vs_1p": "speedup_vs_1p",
+}
+_INGEST_MH_CONTEXT = {"backend": "backend", "n_docs": "n_docs",
+                      "doc_len": "doc_len", "chunk_docs": "chunk_docs",
+                      "n_workers": "n_workers", "wire": "wire"}
 # Multi-chip dryrun artifacts (MULTICHIP_r0X.json): a driver wrapper
 # with no parsed payload — just the mesh smoke's verdict. "ok" is the
 # gated metric (1 must stay 1); n_devices is comparability context.
@@ -206,6 +237,8 @@ def unwrap(doc: dict) -> Optional[dict]:
 
 
 def classify(payload: dict) -> Optional[str]:
+    if payload.get("metric") == "ingest_mh":
+        return "ingest_mh"
     if payload.get("metric") == "serve_bench":
         # A serve_bench run under an armed fault plan (or a mutation
         # stream) is its own kind: chaos/mutate runs are only
@@ -242,12 +275,14 @@ def normalize(path: str) -> Tuple[Optional[dict], Optional[str]]:
                     "chaos": _CHAOS_METRICS,
                     "mutate": _MUTATE_METRICS,
                     "mesh_serve": _MESH_SERVE_METRICS,
+                    "ingest_mh": _INGEST_MH_METRICS,
                     "multichip": _MULTICHIP_METRICS}[kind]
     ctx_paths = {"serve_bench": _SERVE_CONTEXT,
                  "bench": _BENCH_CONTEXT,
                  "chaos": _CHAOS_CONTEXT,
                  "mutate": _MUTATE_CONTEXT,
                  "mesh_serve": _MESH_SERVE_CONTEXT,
+                 "ingest_mh": _INGEST_MH_CONTEXT,
                  "multichip": _MULTICHIP_CONTEXT}[kind]
     metrics = {name: (int(v) if isinstance(v, bool) else v)
                for name, p in metric_paths.items()
@@ -339,7 +374,9 @@ def backfill_paths() -> List[str]:
             + sorted(glob.glob(os.path.join(_common.REPO,
                                             "MUTATE_r*.json")))
             + sorted(glob.glob(os.path.join(_common.REPO,
-                                            "MESH_SERVE_r*.json"))))
+                                            "MESH_SERVE_r*.json")))
+            + sorted(glob.glob(os.path.join(_common.REPO,
+                                            "INGEST_MH_r*.json"))))
 
 
 def main() -> int:

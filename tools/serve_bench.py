@@ -361,6 +361,18 @@ def main() -> int:
                          "a reqtrace object {p50_ms_on, p50_ms_off, "
                          "p50_regression} in the artifact — the "
                          "<2%% steady-state p50 bound receipt")
+    ap.add_argument("--ab-slab", action="store_true",
+                    help="measure the zero-allocation query slab: run "
+                         "the same load twice through throwaway "
+                         "cache-off servers (slab off, then on) and "
+                         "embed a 'slab' artifact object — steady-"
+                         "state allocs/batch (must be 0) and H2D "
+                         "copies/batch (must be 1) from the slab's "
+                         "own counters, p50 on/off delta, and a "
+                         "parity verdict (slab-on served rows "
+                         "bit-identical to slab-off direct search). "
+                         "perf_gate zero-tolerates the parity and "
+                         "the structural invariants")
     ap.add_argument("--chaos", metavar="PLAN", default=None,
                     help="arm this fault-injection plan for the whole "
                          "load (grammar in tfidf_tpu/faults.py, e.g. "
@@ -628,6 +640,83 @@ def main() -> int:
                                    if off_p50 else 0.0),
             }
 
+        # Query-slab receipt (--ab-slab): the SAME load driven twice
+        # through throwaway cache-off servers — slab OFF then ON —
+        # BEFORE the main run. Cache off for the same reason as
+        # --ab-reqtrace: the bounded path is the batched device path.
+        # The ON pass reads the slab's own counters over a post-warm
+        # window (the batcher serializes device dispatch, so the ring
+        # holds one buffer per bucket and steady-state allocs must be
+        # ZERO with exactly ONE H2D copy per batch), and pins parity:
+        # slab-served rows bit-identical to slab-off direct search.
+        slab_ab = None
+        if args.ab_slab and not args.chaos and args.mesh_shards is None:
+            pinned_slab = [draw() for _ in range(8)]
+
+            def slab_pass(slab_on):
+                prior = retriever.query_slab
+                retriever.query_slab = slab_on
+                try:
+                    ab_server = TfidfServer(retriever, ServeConfig(
+                        max_batch=args.max_batch,
+                        max_wait_ms=args.max_wait_ms,
+                        queue_depth=args.queue_depth,
+                        cache_entries=0,
+                        default_deadline_ms=args.deadline_ms,
+                        query_slab=slab_on))
+                    ab_server.mark_warm()
+                    for nb in sorted(buckets):  # ring slots allocate
+                        ab_server.submit(
+                            [draw() for _ in range(nb)], args.k,
+                            use_cache=False).result(timeout=120)
+                    stats0 = (retriever._slab.stats() if slab_on
+                              else None)
+                    drive(ab_server, args.requests)
+                    served = ab_server.submit(
+                        pinned_slab, args.k,
+                        use_cache=False).result(timeout=60)
+                    p50 = ab_server.metrics_snapshot()[
+                        "latency_s"]["p50"]
+                    stats1 = (retriever._slab.stats() if slab_on
+                              else None)
+                    ab_server.close(drain=True)
+                finally:
+                    retriever.query_slab = prior
+                return p50, served, stats0, stats1
+
+            off_p50, off_rows, _, _ = slab_pass(False)
+            on_p50, on_rows, s0, s1 = slab_pass(True)
+            batches = s1["packs"] - s0["packs"]
+            parity = int(
+                np.array_equal(on_rows[0], off_rows[0])
+                and np.array_equal(on_rows[1], off_rows[1]))
+            slab_ab = {
+                "parity_ok": parity,
+                "batches": batches,
+                "allocs_per_batch": round(
+                    (s1["allocs"] - s0["allocs"]) / batches, 4)
+                if batches else None,
+                "h2d_copies_per_batch": round(
+                    (s1["h2d_copies"] - s0["h2d_copies"]) / batches, 4)
+                if batches else None,
+                "staging_buffers": s1["buffers"],
+                "bytes_h2d": s1["bytes_h2d"] - s0["bytes_h2d"],
+                "fallbacks": s1["fallbacks"] - s0["fallbacks"],
+                "p50_ms_off": round(off_p50 * 1e3, 3),
+                "p50_ms_on": round(on_p50 * 1e3, 3),
+                "p50_delta": (round(on_p50 / off_p50 - 1.0, 4)
+                              if off_p50 else 0.0),
+            }
+            from tfidf_tpu.obs import devmon as obs_devmon2
+            obs_devmon2.set_watch(server.compile_watch)
+            log.info("serve_bench",
+                     msg=f"slab A/B: allocs/batch "
+                         f"{slab_ab['allocs_per_batch']}, h2d/batch "
+                         f"{slab_ab['h2d_copies_per_batch']}, p50 "
+                         f"{slab_ab['p50_ms_off']:.3f} ms off -> "
+                         f"{slab_ab['p50_ms_on']:.3f} ms on "
+                         f"({slab_ab['p50_delta']:+.1%})")
+
         wall, n_shed, n_poisoned, n_failed, completed = drive(
             server, args.requests)
         shed = [n_shed]
@@ -743,6 +832,8 @@ def main() -> int:
                          f"{reqtrace_ab['p50_ms_off']:.3f} ms off -> "
                          f"{reqtrace_ab['p50_ms_on']:.3f} ms on "
                          f"({reqtrace_ab['p50_regression']:+.1%})")
+        if slab_ab is not None:
+            artifact["slab"] = slab_ab
         if chaos is not None:
             artifact["chaos"] = chaos
         if mesh is not None:
@@ -764,6 +855,11 @@ def main() -> int:
                         msg=f"warning: {recompiles} recompiles after "
                             f"warmup (expected 0)",
                         recompiles=recompiles)
+            return 1
+        if slab_ab is not None and not slab_ab["parity_ok"]:
+            log.error("serve_bench_slab_parity",
+                      msg="slab parity FAILED: slab-on served rows "
+                          "diverge from slab-off direct search")
             return 1
         if chaos is not None and not chaos["parity_ok"]:
             log.error("serve_bench_chaos_parity",
