@@ -1,0 +1,330 @@
+"""Replicated serving tier: routing, the launcher, merged metrics,
+the ledger/gate wiring, and (slow) a real 2-replica front with the
+chaos rehearsal.
+
+The reference serves one rank-partitioned corpus per MPI process
+(``TFIDF.c:130``); the tier here is N full replica processes behind
+one front — same process model (``launch_rank``), but every replica
+holds the WHOLE index and visibility moves by two-phase epoch bumps.
+The pinned invariants (docs/SERVING.md "Replicated tier"):
+
+* no client observes a mixed epoch — in-flight queries drain onto the
+  admitted epoch before any replica flips;
+* a replica SIGKILLed between its prepare-ack and the commit leaves
+  the tier on the OLD epoch everywhere (the swap aborts).
+"""
+
+import json
+import os
+import signal
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from tfidf_tpu.config import PipelineConfig, ServeConfig, VocabMode
+from tfidf_tpu.parallel.multihost import MpiLiteComm, launch_rank
+from tfidf_tpu.serve.front import (FrontError, ReplicatedFront,
+                                   SwapAborted)
+
+
+def _write_corpus(path, n_docs, seed, n_words=200, doc_len=30):
+    """Strict-discovery corpus: doc1..docN, space-joined words."""
+    rng = np.random.default_rng(seed)
+    path.mkdir(parents=True, exist_ok=True)
+    for i in range(1, n_docs + 1):
+        words = [f"w{rng.integers(0, n_words)}"
+                 for _ in range(doc_len)]
+        (path / f"doc{i}").write_text(" ".join(words))
+    return str(path)
+
+
+def _cfg():
+    return PipelineConfig(vocab_mode=VocabMode.HASHED,
+                          vocab_size=4096, max_doc_len=64)
+
+
+# ---------------------------------------------------------------------
+# fast: config validation
+
+
+def test_replicas_requires_snapshot_dir():
+    with pytest.raises(ValueError, match="snapshot"):
+        ServeConfig(replicas=2)
+
+
+def test_replicas_env_roundtrip(monkeypatch):
+    monkeypatch.setenv("TFIDF_TPU_REPLICAS", "3")
+    monkeypatch.setenv("TFIDF_TPU_SNAPSHOT_DIR", "/tmp/x")
+    cfg = ServeConfig.from_env()
+    assert cfg.replicas == 3
+    # The flag wins over the env, the ServeConfig pick contract.
+    cfg = ServeConfig.from_env(replicas=2)
+    assert cfg.replicas == 2
+
+
+def test_front_rejects_no_replicas(tmp_path):
+    with pytest.raises(ValueError, match="replicas"):
+        ReplicatedFront(str(tmp_path), _cfg(),
+                        ServeConfig(snapshot_dir=str(tmp_path / "s")))
+
+
+# ---------------------------------------------------------------------
+# fast: routing policy (no processes — the front's handle table is
+# populated by hand)
+
+
+def _unstarted_front(tmp_path, n=4):
+    serve_cfg = ServeConfig(snapshot_dir=str(tmp_path / "snap"),
+                            replicas=n)
+    return ReplicatedFront(str(tmp_path), _cfg(), serve_cfg)
+
+
+def test_pick_is_deterministic_and_cache_affine(tmp_path):
+    front = _unstarted_front(tmp_path)
+    try:
+        for rep in front._replicas.values():
+            rep.state = "live"
+        picks = {q: front._pick(front._norm_for({"queries": [q]}))
+                 for q in ("alpha beta", "gamma", "delta epsilon")}
+        # Same query -> same replica, every time (cache affinity).
+        for q, first in picks.items():
+            for _ in range(5):
+                assert front._pick(
+                    front._norm_for({"queries": [q]})) == first
+        # Normalization IS the routing key: whitespace variants of
+        # one query land on one replica (one cache, one entry).
+        assert front._pick(front._norm_for(
+            {"queries": ["  alpha   beta "]})) == picks["alpha beta"]
+    finally:
+        front.close()
+
+
+def test_pick_falls_back_off_dead_replica(tmp_path):
+    front = _unstarted_front(tmp_path)
+    try:
+        for rep in front._replicas.values():
+            rep.state = "live"
+        q = {"queries": ["alpha beta"]}
+        preferred = front._pick(front._norm_for(q))
+        front._replicas[preferred].state = "dead"
+        # Load the survivors unevenly; the fallback is least-loaded.
+        live = [r for r, rp in front._replicas.items()
+                if rp.state == "live"]
+        for r in live:
+            front._replicas[r].inflight = 5
+        front._replicas[live[-1]].inflight = 0
+        assert front._pick(front._norm_for(q)) == live[-1]
+        # Degraded (failing healthz) is routed around the same way.
+        front._replicas[preferred].state = "live"
+        front._replicas[preferred].health = "failing"
+        assert front._pick(front._norm_for(q)) != preferred
+    finally:
+        front.close()
+
+
+def test_pick_no_live_replicas_raises(tmp_path):
+    front = _unstarted_front(tmp_path)
+    try:
+        with pytest.raises(FrontError, match="no live"):
+            front._pick(b"anything")
+    finally:
+        front.close()
+
+
+# ---------------------------------------------------------------------
+# fast: launch_rank — the process model the tier rides
+
+
+def test_launch_rank_wires_mpi_lite_child():
+    child_src = (
+        "import json\n"
+        "from tfidf_tpu.parallel.multihost import MpiLiteComm\n"
+        "comm = MpiLiteComm.from_env()\n"
+        "obj = json.loads(comm.recv(0, 7))\n"
+        "comm.send(0, 8, json.dumps(\n"
+        "    {'echo': obj, 'rank': comm.rank}).encode())\n"
+        "comm.close()\n")
+    fd, proc = launch_rank(1, 2, [sys.executable, "-c", child_src])
+    comm = MpiLiteComm(0, 2, [-1, fd])
+    try:
+        comm.send(1, 7, json.dumps({"ping": 42}).encode())
+        ack = json.loads(comm.recv(1, 8))
+        assert ack == {"echo": {"ping": 42}, "rank": 1}
+        assert proc.wait(timeout=30) == 0
+    finally:
+        comm.close()
+        if proc.poll() is None:
+            proc.kill()
+
+
+# ---------------------------------------------------------------------
+# fast: ledger + gate wiring for the replica artifact
+
+
+def _replica_artifact(tmp_path, mixed=0):
+    art = {
+        "metric": "replica_bench", "backend": "cpu", "docs": 256,
+        "k": 10, "requests": 16, "concurrency": 4, "host_cores": 1,
+        "cpu_bound": 1, "n_replicas": 2,
+        "replica": {"sweep": []},
+        "throughput_qps": 400.0, "qps_1": 410.0,
+        "qps_scaling_x": 0.97, "scaling_efficiency": 0.49,
+        "latency_ms": {"p50": 20.0, "p99": 50.0, "max": 50.0},
+        "parity_checked": 48, "parity_mismatches": 0, "parity_ok": 1,
+        "mixed_epoch_responses": mixed,
+        "recompiles_after_warmup": 0,
+        "chaos": {"plan": "replica_prepare:fatal:n=1",
+                  "swap_aborted": 1,
+                  "old_epoch_everywhere_after_abort": 1,
+                  "restarts": 1, "second_swap_epoch": 1,
+                  "mixed_epoch_responses": mixed,
+                  "parity_mismatches": 0},
+    }
+    p = tmp_path / "REPLICA_rX.json"
+    p.write_text(json.dumps(art))
+    return str(p)
+
+
+def _tools():
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    os.pardir, "tools"))
+    import perf_gate
+    import perf_ledger
+    return perf_ledger, perf_gate
+
+
+def test_ledger_classifies_replica_artifact(tmp_path):
+    perf_ledger, _ = _tools()
+    rec, reason = perf_ledger.normalize(_replica_artifact(tmp_path))
+    assert reason is None
+    # The chaos block must NOT misfile it as a single-process chaos
+    # run — replica_serve has its own comparability context.
+    assert rec["kind"] == "replica_serve"
+    assert rec["context"]["n_replicas"] == 2
+    assert rec["context"]["host_cores"] == 1
+    assert rec["metrics"]["mixed_epoch_responses"] == 0
+    assert rec["metrics"]["chaos_old_epoch_everywhere"] == 1
+
+
+def test_gate_zero_tolerates_mixed_epoch(tmp_path):
+    perf_ledger, perf_gate = _tools()
+    clean, _ = perf_ledger.normalize(_replica_artifact(tmp_path))
+    leaked, _ = perf_ledger.normalize(
+        _replica_artifact(tmp_path, mixed=1))
+    verdict = perf_gate.gate(leaked, [clean])
+    bad = {c["metric"] for c in verdict["checks"]
+           if c["verdict"] == "REGRESSED"}
+    assert "mixed_epoch_responses" in bad and not verdict["ok"]
+    assert perf_gate.gate(clean, [clean])["ok"]
+
+
+# ---------------------------------------------------------------------
+# slow: the real tier — 2 replica processes, parity, merged metrics,
+# and the kill-mid-swap chaos rehearsal (the ci_check.sh stage)
+
+
+@pytest.mark.slow
+def test_two_replica_front_end_to_end(tmp_path):
+    input_dir = _write_corpus(tmp_path / "input", 12, seed=7)
+    serve_cfg = ServeConfig(
+        max_batch=8, cache_entries=256,
+        snapshot_dir=str(tmp_path / "snap"), replicas=2,
+        replica_timeout_s=240.0,
+        faults="replica_prepare:fatal:n=1:match=replica=2 boot=0")
+    front = ReplicatedFront(input_dir, _cfg(), serve_cfg, k=5)
+    try:
+        front.start()
+        desc = front.describe()
+        assert desc["live"] == 2 and front.epoch == 0
+
+        # Parity: front-routed responses must match direct search.
+        from tfidf_tpu.models.retrieval import TfidfRetriever
+        oracle = TfidfRetriever(_cfg())
+        oracle.index_dir(input_dir, strict=False)
+        names = oracle.names
+
+        def expect(qs, k=5):
+            vals, ids = oracle.search(qs, k=k)
+            return [[[names[int(d)], float(np.float32(v))]
+                     for v, d in zip(vrow, irow) if d >= 0]
+                    for vrow, irow in zip(vals, ids)]
+
+        queries = ["w1 w2 w3", "w7", "w11 w5", "w2 w2 w9"]
+        for q in queries:
+            resp = front.query([q], k=5, use_cache=False)
+            got = [[nm, float(np.float32(v))]
+                   for nm, v in resp["results"][0]]
+            assert got == expect([q])[0]
+            assert resp["epoch"] == 0
+
+        # Merged metrics: the two-live-replicas pin. The merged view
+        # carries both replicas' registries under {process=...}
+        # labels, and the merged counter is the SUM.
+        snap = front.metrics_snapshot()
+        assert set(snap["per_replica"]) == {"r1", "r2"}
+        merged_reqs = snap["merged"]["serve_requests_total"]
+        per = [s["registry"]["serve_requests_total"]
+               for s in snap["per_replica"].values()]
+        assert merged_reqs == sum(per) and merged_reqs >= len(queries)
+        prom = front.metrics_prom()
+        assert 'process="r1"' in prom and 'process="r2"' in prom
+        assert "serve_front_routed_total" in prom
+
+        # Chaos: replica 2's armed fault SIGKILLs it between its
+        # prepare-ack and the commit. The swap must abort with every
+        # surviving replica still on the OLD epoch.
+        with pytest.raises(SwapAborted):
+            front.swap_index(input_dir)
+        assert front.epoch == 0
+        for rep in front.describe()["replicas"].values():
+            assert rep["epoch"] == 0
+
+        # Queries keep flowing (re-routed off the dead replica) and
+        # never observe an epoch the front has not committed.
+        for q in queries:
+            resp = front.query([q], k=5)
+            assert "error" not in resp and resp["epoch"] == 0
+
+        # Supervised restart: replica 2 comes back at boot 1 from the
+        # shared snapshot; the retried swap then commits tier-wide.
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            d = front.describe()["replicas"]
+            if all(r["state"] == "live" for r in d.values()) \
+                    and d["2"]["boot"] >= 1:
+                break
+            time.sleep(0.25)
+        d = front.describe()["replicas"]
+        assert d["2"]["state"] == "live" and d["2"]["boot"] >= 1
+
+        second = None
+        for _ in range(5):
+            try:
+                second = front.swap_index(input_dir)
+                break
+            except SwapAborted:
+                time.sleep(1.0)
+        assert second == 1 and front.epoch == 1
+        for rep in front.describe()["replicas"].values():
+            assert rep["epoch"] == 1
+
+        # Post-swap parity + epoch echo on the served responses.
+        resp = front.query(queries[:2], k=5, use_cache=False)
+        assert resp["epoch"] == 1
+        want = expect(queries[:2])
+        got = [[[nm, float(np.float32(v))] for nm, v in row]
+               for row in resp["results"]]
+        assert got == want
+
+        # Zero steady-state recompiles, per replica.
+        info = front.replica_info()
+        assert all(v.get("recompiles_after_warm") == 0
+                   for v in info.values())
+    finally:
+        front.close()
+    # Idempotent close, and the tier really is gone.
+    front.close()
+    assert all(r.proc is None or r.proc.poll() is not None
+               for r in front._replicas.values())

@@ -466,6 +466,27 @@ def _build_parser() -> argparse.ArgumentParser:
                          "them into one, dropping tombstones "
                          "(default 4; env TFIDF_TPU_COMPACT_AT; "
                          "needs --delta-docs)")
+    sv.add_argument("--replicas", type=int, default=None,
+                    metavar="N",
+                    help="replicated serving tier: run N full server "
+                         "processes behind a lightweight front that "
+                         "owns this JSONL protocol — queries route "
+                         "by hash of their normalized form (cache "
+                         "affinity) with least-loaded fallback; index "
+                         "changes commit tier-wide via a two-phase "
+                         "epoch bump; dead replicas restart from "
+                         "--snapshot-dir (REQUIRED with --replicas) "
+                         "under the --restart budget (env "
+                         "TFIDF_TPU_REPLICAS; docs/SERVING.md "
+                         "'Replicated tier')")
+    sv.add_argument("--replica-timeout-s", type=float, default=None,
+                    metavar="S",
+                    help="front-side patience per replica: boot-to-"
+                         "ready wait, per-request response wait, and "
+                         "the two-phase control round-trip bound — "
+                         "past it the replica is declared dead and "
+                         "restarted (default 120; env "
+                         "TFIDF_TPU_REPLICA_TIMEOUT_S)")
     sv.add_argument("--faults", metavar="PLAN", default=None,
                     help="arm a deterministic fault-injection plan "
                          "(chaos testing; also env TFIDF_TPU_FAULTS; "
@@ -1090,6 +1111,11 @@ def _serve_handle_line(server, line, write, default_k, build_retriever,
         # slow_query event.
         extra = ({"rid": f.rid}
                  if getattr(f, "rid", None) is not None else {})
+        if getattr(f, "epoch", None) is not None:
+            # The admitted epoch on every response line: the
+            # replicated front's mixed-epoch audit (and any client's
+            # consistency check) reads it straight off the protocol.
+            extra["epoch"] = f.epoch
         err = f.exception()
         if isinstance(err, Overloaded):
             write({"id": line_id, "error": "overloaded", **extra})
@@ -1109,7 +1135,8 @@ def _serve_handle_line(server, line, write, default_k, build_retriever,
 
     try:
         server.submit(queries, k,
-                      deadline_ms=req.get("deadline_ms")
+                      deadline_ms=req.get("deadline_ms"),
+                      use_cache=bool(req.get("use_cache", True))
                       ).add_done_callback(on_done)
     except PoisonQuery as e:     # quarantined: the protocol's 4xx
         write({"id": line_id, "error": "poison_query", "detail": str(e),
@@ -1155,7 +1182,15 @@ def _run_serve(args) -> int:
         delta_docs=args.delta_docs, compact_at=args.compact_at,
         mesh_shards=args.mesh_shards,
         query_slab=(None if args.query_slab is None
-                    else args.query_slab == "on"))
+                    else args.query_slab == "on"),
+        replicas=args.replicas,
+        replica_timeout_s=args.replica_timeout_s)
+
+    if serve_cfg.replicas:
+        # Replicated tier: this process becomes the FRONT — it owns
+        # the protocol and the replicas own the indexes; nothing
+        # below (restore, warm, canary, compactor) happens here.
+        return _run_serve_front(args, cfg, serve_cfg)
 
     # Crash-fast start: a committed snapshot with a matching config
     # fingerprint restores the resident index from disk — seconds, no
@@ -1299,7 +1334,15 @@ def _run_serve(args) -> int:
     prev_term = _install_sigterm_dump()
     try:
         if args.port is not None:
-            return _serve_tcp(server, args, build_retriever, canary)
+            def handle(line, write):
+                return _serve_handle_line(server, line, write, args.k,
+                                          build_retriever, canary)
+
+            def on_close():
+                if canary is not None:
+                    canary.close()
+                server.close(drain=True)
+            return _serve_tcp(handle, args.port, on_close)
         # Responses may be written from batcher callback threads while
         # the main thread blocks on the next stdin line — one lock
         # keeps the JSONL stream line-atomic.
@@ -1365,10 +1408,13 @@ def _restore_sigterm(prev) -> None:
         pass
 
 
-def _serve_tcp(server, args, build_retriever, canary=None) -> int:
+def _serve_tcp(handle_line, port, on_close) -> int:
     """--port mode: the same JSONL protocol over TCP, one thread per
-    connection (socketserver), all feeding the one shared server —
-    which is the point: their queries coalesce into shared batches."""
+    connection (socketserver), all feeding one shared backend —
+    which is the point: their queries coalesce into shared batches
+    (single server) or fan out across the replica tier (front).
+    ``handle_line(line, write) -> bool`` is the protocol handler;
+    ``on_close()`` tears the backend down after the listener stops."""
     import json
     import socketserver
     import threading
@@ -1386,10 +1432,8 @@ def _serve_tcp(server, args, build_retriever, canary=None) -> int:
                         pass  # client went away; drop the response
 
             for raw in self.rfile:
-                if not _serve_handle_line(server, raw.decode("utf-8",
-                                                             "replace"),
-                                          write, args.k, build_retriever,
-                                          canary):
+                if not handle_line(raw.decode("utf-8", "replace"),
+                                   write):
                     threading.Thread(target=srv.shutdown,
                                      daemon=True).start()
                     return
@@ -1398,17 +1442,64 @@ def _serve_tcp(server, args, build_retriever, canary=None) -> int:
         allow_reuse_address = True
         daemon_threads = True
 
-    with Srv(("127.0.0.1", args.port), Handler) as srv:
+    with Srv(("127.0.0.1", port), Handler) as srv:
         sys.stderr.write(f"listening on 127.0.0.1:{srv.server_address[1]}\n")
         try:
             srv.serve_forever()
         except KeyboardInterrupt:
             pass
         finally:
-            if canary is not None:
-                canary.close()
-            server.close(drain=True)
+            on_close()
     return 0
+
+
+def _run_serve_front(args, cfg, serve_cfg) -> int:
+    """--replicas mode: this process is the replicated tier's FRONT.
+    It holds no index and no device link — it spawns N replica
+    processes off --snapshot-dir, routes the JSONL protocol across
+    them, and supervises restarts (docs/SERVING.md 'Replicated
+    tier')."""
+    import json
+    import threading
+
+    from tfidf_tpu.serve import FrontError, ReplicatedFront
+
+    front = ReplicatedFront(args.input, cfg, serve_cfg, k=args.k,
+                            no_strict=args.no_strict,
+                            doc_len=args.doc_len)
+    prev_term = _install_sigterm_dump()
+    try:
+        try:
+            front.start()
+        except FrontError as e:
+            sys.stderr.write(f"front failed to start: {e}\n")
+            front.close()
+            return 3
+        sys.stderr.write(
+            f"front serving {front.n_replicas} replica(s) "
+            f"(epoch={front.epoch}, "
+            f"snapshot={serve_cfg.snapshot_dir}, "
+            f"restart_budget={serve_cfg.restart_budget}, "
+            f"timeout_s={serve_cfg.replica_timeout_s})\n")
+        if args.port is not None:
+            return _serve_tcp(front.handle_line, args.port,
+                              front.close)
+        wlock = threading.Lock()
+
+        def write(obj) -> None:
+            with wlock:
+                sys.stdout.write(json.dumps(obj) + "\n")
+                sys.stdout.flush()
+
+        try:
+            for line in sys.stdin:
+                if not front.handle_line(line, write):
+                    break
+        finally:
+            front.close()
+        return 0
+    finally:
+        _restore_sigterm(prev_term)
 
 
 def main(argv: Optional[List[str]] = None) -> int:

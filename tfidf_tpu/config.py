@@ -344,6 +344,18 @@ class ServeConfig:
         (swap, mutation, restore) re-shards through the same
         transform. None = classic single-device serving. CLI
         ``--mesh-shards`` / env ``TFIDF_TPU_MESH_SHARDS``.
+      replicas: run the REPLICATED serving tier: N worker processes
+        each owning a full :class:`TfidfServer`, behind one in-process
+        front that hash-routes queries (cache affinity) and drives
+        index visibility changes through a two-phase epoch bump
+        (``tfidf_tpu/serve/front.py``; docs/SERVING.md "Replicated
+        tier"). Requires ``snapshot_dir`` — replicas boot and restart
+        from the shared snapshot. None = classic single-process
+        serving. CLI ``--replicas`` / env ``TFIDF_TPU_REPLICAS``.
+      replica_timeout_s: how long the front waits for one replica to
+        boot to ready (jax import + snapshot restore + warm) or to
+        ack a control op before declaring it dead. CLI
+        ``--replica-timeout-s`` / env ``TFIDF_TPU_REPLICA_TIMEOUT_S``.
     """
 
     max_batch: int = 64
@@ -371,6 +383,8 @@ class ServeConfig:
     compact_at: int = 4
     mesh_shards: Optional[int] = None
     query_slab: Optional[bool] = None
+    replicas: Optional[int] = None
+    replica_timeout_s: float = 120.0
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -423,6 +437,15 @@ class ServeConfig:
         if self.mesh_shards is not None and self.mesh_shards < 0:
             raise ValueError("mesh_shards must be >= 0 (0 = all "
                              "devices; None disables mesh serving)")
+        if self.replicas is not None and self.replicas < 1:
+            raise ValueError("replicas must be >= 1 "
+                             "(None disables the replicated front)")
+        if self.replica_timeout_s <= 0:
+            raise ValueError("replica_timeout_s must be positive")
+        if self.replicas is not None and not self.snapshot_dir:
+            raise ValueError("replicas requires snapshot_dir — the "
+                             "replicas spin up from (and restart "
+                             "from) the shared snapshot")
 
     @staticmethod
     def from_env(**overrides) -> "ServeConfig":
@@ -461,6 +484,9 @@ class ServeConfig:
                 ("delta_docs", "TFIDF_TPU_DELTA_DOCS", int),
                 ("compact_at", "TFIDF_TPU_COMPACT_AT", int),
                 ("mesh_shards", "TFIDF_TPU_MESH_SHARDS", int),
+                ("replicas", "TFIDF_TPU_REPLICAS", int),
+                ("replica_timeout_s", "TFIDF_TPU_REPLICA_TIMEOUT_S",
+                 float),
                 ("query_slab", "TFIDF_TPU_QUERY_SLAB",
                  lambda raw: raw.strip().lower() not in
                  ("0", "off", "false", "no"))):

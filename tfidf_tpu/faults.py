@@ -66,7 +66,7 @@ __all__ = [
 ]
 
 SEAMS = ("device_dispatch", "drain", "pack_worker", "batcher_loop",
-         "swap")
+         "swap", "replica_prepare")
 _KINDS = ("transient", "fatal", "sleep")
 
 

@@ -36,6 +36,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import select
 import socket
 import struct
 import subprocess
@@ -185,6 +186,35 @@ class MpiLiteComm:
                             dtype=arr.dtype).reshape(arr.shape)
         return out.copy()
 
+    def poll(self, peer: int, timeout_s: Optional[float] = None) -> bool:
+        """True when a frame from ``peer`` is readable within
+        ``timeout_s`` (None = block) — the supervisor's bounded wait:
+        a wedged child is distinguishable from a slow one without
+        committing this process to an unbounded ``recv``."""
+        fd = self._fds[peer]
+        if fd < 0:
+            raise MpiLiteError(f"poll on self/unwired peer {peer}")
+        readable, _, _ = select.select([fd], [], [], timeout_s)
+        return bool(readable)
+
+    def wire(self, peer: int, fd: int) -> None:
+        """Install (or replace) the channel to ``peer`` — the star-
+        supervisor hook: when a dead child is respawned with a fresh
+        socketpair (:func:`launch_rank`), the stale fd is closed and
+        the new one takes its slot, so the same comm object keeps
+        speaking to the replacement."""
+        old = self._fds[peer]
+        if old >= 0 and old != fd:
+            try:
+                os.close(old)
+            except OSError:
+                pass
+        self._fds[peer] = fd
+
+    def unwire(self, peer: int) -> None:
+        """Close and forget the channel to ``peer`` (dead child)."""
+        self.wire(peer, -1)
+
     def close(self) -> None:
         for fd in self._fds:
             if fd >= 0:
@@ -227,6 +257,43 @@ def launch_ranks(n: int, argv_for_rank: Callable[[int], List[str]],
     for s in socks:  # parent's copies: children hold their own dups
         s.close()
     return procs
+
+
+def launch_rank(rank: int, size: int, argv: List[str],
+                env: Optional[dict] = None,
+                stderr=None,
+                stdin=subprocess.PIPE) -> Tuple[int, subprocess.Popen]:
+    """Spawn ONE child wired to the caller over a fresh socketpair —
+    the star-topology complement to :func:`launch_ranks`. The caller
+    plays rank 0; the child attaches as ``rank`` of ``size`` with only
+    its rank-0 channel wired (``MPILITE_FDS`` carries -1 everywhere
+    else), so child<->child traffic is impossible by construction and
+    every control exchange funnels through the supervisor — the
+    replicated serving front's process model, where a dead replica is
+    respawned with a FRESH channel instead of rebuilding the whole
+    all-pairs mesh. Returns ``(parent_fd, Popen)``; install the fd
+    with :meth:`MpiLiteComm.wire`. ``stderr=None`` inherits the
+    caller's (a supervisor that never drains a stderr pipe would
+    deadlock its children on the 64 KiB pipe buffer)."""
+    if not 1 <= rank < size:
+        raise ValueError(f"rank {rank} out of range for size {size}")
+    a, b = socket.socketpair(socket.AF_UNIX, socket.SOCK_STREAM)
+    a.setblocking(True)
+    b.setblocking(True)
+    fds = [-1] * size
+    fds[0] = b.fileno()
+    base_env = dict(os.environ if env is None else env)
+    child_env = dict(base_env,
+                     MPILITE_RANK=str(rank), MPILITE_SIZE=str(size),
+                     MPILITE_FDS=",".join(str(f) for f in fds))
+    proc = subprocess.Popen(argv, env=child_env,
+                            pass_fds=[b.fileno()],
+                            stdin=stdin, stdout=subprocess.PIPE,
+                            stderr=stderr, text=True)
+    parent_fd = os.dup(a.fileno())
+    a.close()
+    b.close()
+    return parent_fd, proc
 
 
 def shard_bounds(num_docs: int, n_workers: int) -> List[Tuple[int, int]]:

@@ -16,6 +16,10 @@ the ROADMAP's serve-heavy-traffic leg. Four parts:
 * :mod:`~tfidf_tpu.serve.canary` — background parity probes replaying
   pinned golden queries against the swap-time oracle, the live
   index-corruption detector (``serve_canary_parity`` gauge);
+* :mod:`~tfidf_tpu.serve.front` — the replicated tier:
+  :class:`ReplicatedFront` runs N full servers as worker processes
+  behind one lightweight front (hash-affinity routing, two-phase
+  epoch swaps, restart supervision, merged metrics);
 * :mod:`~tfidf_tpu.serve.supervisor` — the recovery half: bounded
   retry with backoff for transient dispatch faults, a circuit breaker
   tripping into degraded admission, poison-query bisection +
@@ -43,9 +47,16 @@ from tfidf_tpu.serve.metrics import ServeMetrics
 from tfidf_tpu.serve.server import TfidfServer
 from tfidf_tpu.serve.supervisor import (CircuitBreaker, QuarantineList,
                                         RetryPolicy, SupervisedDispatch)
+# front imports the submodules above; keep it LAST so the package
+# namespace is fully populated before it loads.
+from tfidf_tpu.serve.front import (FrontError, ReplicatedFront,
+                                   SwapAborted)
 
 __all__ = [
     "TfidfServer",
+    "ReplicatedFront",
+    "FrontError",
+    "SwapAborted",
     "MicroBatcher",
     "ResultCache",
     "ServeMetrics",

@@ -322,6 +322,11 @@ class TfidfServer:
 
         out: Future = Future()
         out.rid = rid
+        # The ADMITTED epoch rides the future: a response's epoch is
+        # decided here, never by a swap that lands mid-flight — the
+        # per-request half of the replicated tier's no-mixed-epochs
+        # contract (the JSONL protocol echoes it on every response).
+        out.epoch = epoch
         if n == 0:
             width = min(k, retriever._num_docs)
             out.set_result((np.zeros((0, width), np.float32),

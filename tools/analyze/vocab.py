@@ -31,6 +31,9 @@ SPANS = {
     # copy per batch, and the sharded ingest's cross-worker DF
     # allreduce at the pass-A/B boundary
     "h2d", "link_sync",
+    # replicated tier (round 20): the front's routing decision and a
+    # two-phase epoch transaction end to end (prepare..commit/abort)
+    "route", "epoch_swap",
 }
 
 #: Trace instants (``obs.instant``) — point events, not spans.
@@ -83,6 +86,11 @@ FLIGHT_EVENTS = {
     "shard_balance",
     # engine/bench diagnostics (round 11 structured-logger migration)
     "exact_engine_fallback", "margin_pressure", "bench_progress",
+    # replicated tier (round 20): replica lifecycle + the two-phase
+    # epoch protocol's receipts — tools/doctor.py's replicas section
+    # reads liveness/routed-share/restarts/commits from exactly these
+    "replica_up", "replica_down",
+    "epoch_prepare", "epoch_commit", "epoch_abort",
 }
 
 #: ``TFIDF_TPU_*`` env knobs mirrored by a CLI flag: the C004 gate
@@ -114,6 +122,8 @@ ENV_CLI_FLAGS = {
     "TFIDF_TPU_MESH_SHARDS": "--mesh-shards",
     "TFIDF_TPU_INGEST_WORKERS": "--ingest-workers",
     "TFIDF_TPU_QUERY_SLAB": "--query-slab",
+    "TFIDF_TPU_REPLICAS": "--replicas",
+    "TFIDF_TPU_REPLICA_TIMEOUT_S": "--replica-timeout-s",
 }
 
 #: Shared attributes the T001 thread lint tolerates without a lock,

@@ -56,10 +56,21 @@ stage "multihost_ingest_smoke" env JAX_PLATFORMS=cpu timeout -k 10 600 \
     python -m pytest tests/test_multihost.py -q -m slow -k sharded \
     -p no:cacheprovider
 
-# 5. perf gate: re-gate the committed newest artifacts against the
+# 5. replicated-tier smoke (slow-marked, round 20): a real 2-replica
+#    front — routed queries parity-checked against direct search, a
+#    hot swap, and the chaos rehearsal: SIGKILL a replica between its
+#    prepare-ack and the commit; the swap must abort with EVERY
+#    surviving replica still on the OLD epoch (zero mixed-epoch
+#    responses), then the supervised restart + retried swap commit.
+stage "replica_front_smoke" env JAX_PLATFORMS=cpu timeout -k 10 600 \
+    python -m pytest tests/test_replica.py -q -m slow \
+    -p no:cacheprovider
+
+# 6. perf gate: re-gate the committed newest artifacts against the
 #    ledger (unchanged artifacts must pass; a refreshed artifact that
 #    regressed fails here)
 for artifact in BENCH_r05.json SERVE_r01.json SERVE_r02.json \
+                SERVE_r03.json REPLICA_r01.json \
                 INGEST_MH_r01.json; do
     if [ -f "${artifact}" ]; then
         stage "perf_gate:${artifact}" \

@@ -230,6 +230,49 @@ def analyze_flight(path: str) -> dict:
             "imbalance": latest.get("imbalance"),
             "installs_seen": len(shard_events),
         }
+    # Replicated-tier receipts (round 20): the front logs replica
+    # lifecycle (replica_up / replica_down with reason + routed
+    # counts) and the two-phase epoch protocol's receipts
+    # (epoch_prepare / epoch_commit / epoch_abort). The doctor folds
+    # them into per-rank liveness + routed share plus the tier's
+    # commit/abort tally — the first thing to read when a replicated
+    # run misbehaves is whether an abort left the tier on the old
+    # epoch (by design) or a rank burned its restart budget.
+    rep_up = [e for e in events if e.get("event") == "replica_up"]
+    rep_down = [e for e in events if e.get("event") == "replica_down"]
+    prepares = [e for e in events if e.get("event") == "epoch_prepare"]
+    commits = [e for e in events if e.get("event") == "epoch_commit"]
+    aborts = [e for e in events if e.get("event") == "epoch_abort"]
+    replicas_out = None
+    if rep_up or rep_down:
+        per_rank: dict = {}
+        for e in rep_up + rep_down:
+            r = per_rank.setdefault(str(e.get("replica", "?")), {
+                "state": "down", "boot": 0, "routed": 0,
+                "deaths": 0, "budget_exhausted": False})
+            r["boot"] = max(r["boot"], e.get("boot", 0) or 0)
+            if e.get("event") == "replica_up":
+                r["state"] = "up"
+            else:
+                r["state"] = "down"
+                r["routed"] = max(r["routed"], e.get("routed", 0) or 0)
+                if e.get("reason") == "died":
+                    r["deaths"] += 1
+                elif e.get("reason") == "budget_exhausted":
+                    r["budget_exhausted"] = True
+        total_routed = sum(r["routed"] for r in per_rank.values()) or 1
+        for r in per_rank.values():
+            r["routed_share"] = round(r["routed"] / total_routed, 4)
+        replicas_out = {
+            "ranks": dict(sorted(per_rank.items())),
+            "epoch_prepares": len(prepares),
+            "epoch_commits": len(commits),
+            "epoch_aborts": len(aborts),
+            "last_epoch": (commits[-1].get("epoch")
+                           if commits else None),
+            "partial_commits": sum(1 for e in commits
+                                   if e.get("partial")),
+        }
     out = {
         "events": len(events),
         "digests": len(digests),
@@ -237,6 +280,7 @@ def analyze_flight(path: str) -> dict:
         "faults": faults_out,
         "segments": segments_out,
         "shards": shards_out,
+        "replicas": replicas_out,
         "recompiles": [
             {k: v for k, v in e.items()
              if k not in ("t", "kind", "level", "msg")}
@@ -480,6 +524,25 @@ def render(report: dict) -> str:
                 f"  shards: {sh['n_shards']} docs-shards ({per}), "
                 f"imbalance {sh['imbalance']:.3f} "
                 f"({sh['installs_seen']} install(s) seen)")
+        rp = fl.get("replicas")
+        if rp:
+            per = ", ".join(
+                f"r{rank} {info['state']}"
+                f" boot={info['boot']}"
+                f" share={info['routed_share']:.0%}"
+                + (f" deaths={info['deaths']}" if info["deaths"]
+                   else "")
+                + (" BUDGET-EXHAUSTED" if info["budget_exhausted"]
+                   else "")
+                for rank, info in rp["ranks"].items())
+            lines.append(
+                f"  replicas: {per}; epochs: {rp['epoch_prepares']} "
+                f"prepare(s), {rp['epoch_commits']} commit(s), "
+                f"{rp['epoch_aborts']} abort(s)"
+                + (f", {rp['partial_commits']} PARTIAL"
+                   if rp["partial_commits"] else "")
+                + (f", last epoch {rp['last_epoch']}"
+                   if rp["last_epoch"] is not None else ""))
         if "hbm_owners" in fl:
             owners = ", ".join(
                 f"{name} {info.get('bytes', 0) / 1e6:.1f} MB"

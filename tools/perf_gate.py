@@ -107,6 +107,12 @@ _GATES = {
         "slab_parity_ok": ("higher", 0.0),
         "slab_allocs_per_batch": ("lower", 0.0),
         "slab_h2d_per_batch": ("lower", 0.0),
+        # Round 20 bench honesty: the cache-bypassed latency columns.
+        # Wider bands than the warm ones — every request pays the full
+        # device path — but gated, so the headline p50/p99 can never
+        # again improve purely by riding a fatter cache.
+        "p50_ms_cache_off": ("lower", 0.60),
+        "p99_ms_cache_off": ("lower", 0.80),
     },
     # Multi-process sharded ingest (tools/ingest_mh_bench.py): parity
     # is zero-tolerance — the N-worker merged index must stay
@@ -153,6 +159,28 @@ _GATES = {
         "shard_imbalance": ("lower", 0.10),
         "slo_compliance": ("higher", 0.10),
     },
+    # Replicated serving tier (serve_bench --replicas): the pins are
+    # zero-tolerance — parity_ok must stay 1 (front-routed responses
+    # float32-identical to direct search at every sweep width AND
+    # under the chaos plan), mixed_epoch_responses must stay 0 (no
+    # client observes an epoch the front has not committed; the
+    # absolute zero-baseline rule fires on any nonzero candidate),
+    # recompiles_after_warmup must stay 0 per replica, and the chaos
+    # rehearsal receipts must stay 1 (kill-mid-swap aborted AND left
+    # every replica on the old epoch). Throughput gates directionally
+    # with a wide band: host_cores is a match key, but even at a fixed
+    # core count a 1-core box times scheduler fairness, not replicas.
+    "replica_serve": {
+        "throughput_qps": ("higher", 0.50),
+        "qps_1": ("higher", 0.50),
+        "qps_scaling_x": ("higher", 0.30),
+        "p99_ms": ("lower", 0.80),
+        "parity_ok": ("higher", 0.0),
+        "mixed_epoch_responses": ("lower", 0.0),
+        "recompiles_after_warmup": ("lower", 0.0),
+        "chaos_swap_aborted": ("higher", 0.0),
+        "chaos_old_epoch_everywhere": ("higher", 0.0),
+    },
     # The mesh dryrun verdict: ok must STAY 1 (zero-tolerance, the
     # absolute zero-baseline rule below never fires because ok is the
     # higher-is-better direction with a nonzero baseline).
@@ -183,6 +211,8 @@ _MATCH_KEYS = {"bench": ("backend", "n_docs", "wire"),
                               "n_shards"),
                "ingest_mh": ("backend", "n_docs", "doc_len",
                              "n_workers", "wire"),
+               "replica_serve": ("backend", "docs", "k",
+                                 "n_replicas", "host_cores"),
                "multichip": ("n_devices",)}
 # Defaults applied to BOTH sides of a match when the key is absent —
 # how records that predate a context key stay comparable to their
