@@ -364,7 +364,9 @@ class TestCompileWatch:
         r.search(["alpha beta", "gamma"], k=3)   # bucket 2: fresh
         assert watch.recompile_count >= 1
         fp = watch.recompiles_after_warm()[0]
-        assert fp["program"] == "search_bcoo"
+        # Round 21: tiled scoring is the default search program; the
+        # fingerprint must name the path that actually compiled.
+        assert fp["program"] == "search_tiled"
         assert fp["queries"] == 2 and fp["k"] == 3
         # warmed shape again: no new note
         before = watch.recompile_count

@@ -561,9 +561,27 @@ class TestSearchBucketing:
     count — Q pads to power-of-two buckets inside search."""
 
     def test_compile_count_pinned_across_counts(self):
-        from tfidf_tpu.models.retrieval import _search_bcoo
+        from tfidf_tpu.models.retrieval import _search_tiled
         # Fresh shape signature (unique vocab+k) so other tests' cache
-        # entries can't mask or inflate the delta.
+        # entries can't mask or inflate the delta. Round 21: the tiled
+        # scorer is the default dispatch, so the pin moves to it.
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=1024,
+                             max_doc_len=16, doc_chunk=16)
+        r = TfidfRetriever(cfg).index(CORPUS)
+        base = _search_tiled._cache_size()
+        for n in (3, 4):           # same bucket (4)
+            r.search(["apple"] * n, k=5)
+        assert _search_tiled._cache_size() == base + 1
+        for n in (5, 7, 6, 8):     # all bucket 8
+            r.search(["banana"] * n, k=5)
+        assert _search_tiled._cache_size() == base + 2
+        for n in (1, 2, 3, 4, 5, 6, 7, 8):  # buckets 1,2 are new
+            r.search(["fig"] * n, k=5)
+        assert _search_tiled._cache_size() == base + 4
+
+    def test_compile_count_pinned_untiled_fallback(self, monkeypatch):
+        from tfidf_tpu.models.retrieval import _search_bcoo
+        monkeypatch.setenv("TFIDF_TPU_SCORE_TILING", "off")
         cfg = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=1024,
                              max_doc_len=16, doc_chunk=16)
         r = TfidfRetriever(cfg).index(CORPUS)
@@ -574,9 +592,6 @@ class TestSearchBucketing:
         for n in (5, 7, 6, 8):     # all bucket 8
             r.search(["banana"] * n, k=5)
         assert _search_bcoo._cache_size() == base + 2
-        for n in (1, 2, 3, 4, 5, 6, 7, 8):  # buckets 1,2 are new
-            r.search(["fig"] * n, k=5)
-        assert _search_bcoo._cache_size() == base + 4
 
     def test_bucketed_results_match_per_count(self, retriever):
         # Padded zero columns must stay inert: each query's row is the

@@ -342,7 +342,8 @@ def _build_parser() -> argparse.ArgumentParser:
                          "override per line)")
     sv.add_argument("--max-batch", type=int, default=None,
                     help="most queries one coalesced device batch "
-                         "carries (default 64; env TFIDF_TPU_MAX_BATCH)")
+                         "carries (default 256; env "
+                         "TFIDF_TPU_MAX_BATCH)")
     sv.add_argument("--max-wait-ms", type=float, default=None,
                     help="micro-batching window: the oldest queued "
                          "request never waits longer than this for the "
@@ -449,6 +450,19 @@ def _build_parser() -> argparse.ArgumentParser:
                          "--ab-slab measures it). 'off' forces the "
                          "legacy per-batch allocation, bit-identical "
                          "(default on; env TFIDF_TPU_QUERY_SLAB)")
+    sv.add_argument("--score-tiling", choices=["on", "off"], default=None,
+                    help="tiled sparse scoring: the document axis is "
+                         "chunked into fixed tiles scored against the "
+                         "full query block inside ONE lax.scan "
+                         "dispatch, streaming top-k folded across "
+                         "tiles on device — per-tile intermediates "
+                         "stay bounded however wide the batch grows "
+                         "(tile width: env TFIDF_TPU_QUERY_BLOCK, "
+                         "default 4096 doc rows; serve_bench "
+                         "--ab-tiled measures it). 'off' forces the "
+                         "legacy whole-corpus dot with serial 64-"
+                         "query block splitting, bit-identical "
+                         "(default on; env TFIDF_TPU_SCORE_TILING)")
     sv.add_argument("--delta-docs", type=int, default=None,
                     help="serve an LSM-style SEGMENTED index with a "
                          "delta segment of this capacity: the "
@@ -1162,6 +1176,11 @@ def _run_serve(args) -> int:
     from tfidf_tpu.serve import TfidfServer
 
     apply_compile_cache(args.compile_cache)
+    if args.score_tiling is not None:
+        # CLI mirror of TFIDF_TPU_SCORE_TILING: the knob is read at
+        # dispatch time, so the env var is the single source of truth
+        # for every consumer (flat, segmented, mesh, serve).
+        os.environ["TFIDF_TPU_SCORE_TILING"] = args.score_tiling
     cfg = PipelineConfig(vocab_mode=VocabMode.HASHED,
                          vocab_size=args.vocab_size,
                          compile_cache=args.compile_cache)

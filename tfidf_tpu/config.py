@@ -221,9 +221,13 @@ class ServeConfig:
 
     Attributes:
       max_batch: most queries one coalesced device batch carries; a
-        single request larger than this stays atomic (one batch) —
-        :meth:`~tfidf_tpu.models.TfidfRetriever.search` already blocks
-        internally. CLI ``--max-batch`` / env ``TFIDF_TPU_MAX_BATCH``.
+        single request larger than this stays atomic (one batch).
+        Default 256 (round 21): tiled scoring made one wide dispatch
+        cheaper per query than many narrow ones, so the batcher's
+        coalescing is now a throughput lever, not a memory liability
+        (under ``--score-tiling=off`` the search path re-splits
+        internally at the legacy 64 block). CLI ``--max-batch`` / env
+        ``TFIDF_TPU_MAX_BATCH``.
       max_wait_ms: deadline-bounded coalescing window — the oldest
         queued request never waits longer than this for the batch to
         fill before it is flushed. CLI ``--max-wait-ms`` / env
@@ -358,7 +362,7 @@ class ServeConfig:
         ``--replica-timeout-s`` / env ``TFIDF_TPU_REPLICA_TIMEOUT_S``.
     """
 
-    max_batch: int = 64
+    max_batch: int = 256
     max_wait_ms: float = 2.0
     queue_depth: int = 256
     cache_entries: int = 4096

@@ -37,7 +37,6 @@ sha256, typed ``SnapshotMismatch``): a sealed segment *is* a
 from __future__ import annotations
 
 import functools
-import os
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple, Union
@@ -48,10 +47,14 @@ from tfidf_tpu import faults, obs
 from tfidf_tpu.config import PipelineConfig, VocabMode
 from tfidf_tpu.index.segment import Segment
 from tfidf_tpu.io.corpus import Corpus, discover_corpus
-from tfidf_tpu.models.retrieval import (TfidfRetriever, _build_index,
+from tfidf_tpu.models.retrieval import (_LEGACY_QUERY_BLOCK,
+                                        TfidfRetriever, _build_index,
                                         config_fingerprint, query_matrix)
 from tfidf_tpu.obs import log as obs_log
-from tfidf_tpu.ops.sparse import sorted_term_counts_host, sparse_scores
+from tfidf_tpu.ops.sparse import (score_tile_rows, score_tiling,
+                                  score_topk_tiled,
+                                  score_topk_tiled_cache_size,
+                                  sorted_term_counts_host, sparse_scores)
 from tfidf_tpu.ops.scoring import idf_from_df
 from tfidf_tpu.ops.topk import merge_topk, segment_score_topk
 from tfidf_tpu.streaming import StreamingTfidf
@@ -98,7 +101,8 @@ def index_compile_cache_size() -> int:
     window; must be flat after warm-up)."""
     idf_fn, refresh_weights = _jitted()
     return sum(f._cache_size() for f in
-               (idf_fn, refresh_weights, segment_score_topk, merge_topk))
+               (idf_fn, refresh_weights, segment_score_topk,
+                merge_topk)) + score_topk_tiled_cache_size()
 
 
 class _ViewPart:
@@ -140,6 +144,9 @@ class IndexView:
         self._idf = idf
         self._idf_np = idf_np
         self._num_docs = num_live
+        # Lazily-built stacked face of every part (round 21): the
+        # one-dispatch tiled search scans segments as ONE row block.
+        self._stack: Optional[tuple] = None
 
     @property
     def indexed(self) -> bool:
@@ -154,7 +161,42 @@ class IndexView:
         out = [self._idf]
         for p in self._parts:
             out += [p.data, p.cols, p.live]
+        if self._stack is not None:
+            out += list(self._stack)
         return out
+
+    def _stacked(self):
+        """The parts stacked into ONE row block (data, cols, live),
+        built lazily per view and cached: views are immutable, so the
+        concatenation cost is paid once per visibility change, not per
+        search (a racing double-build is benign — same values). Rows
+        pad to the next power of two with dead rows so the stacked
+        shape — and therefore the tiled search program — cycles within
+        a log-small warmable set as segments seal and compact (the
+        zero-recompiles-under-mutation contract, same discipline as
+        pow2 segment capacities). Base offsets are cumulative part
+        capacities (``view()``), so stacked row order IS the global
+        positional row space ``names`` indexes; the lowest-index
+        tie-break therefore reproduces the per-part merge exactly."""
+        st = self._stack
+        if st is None:
+            _, jnp = _jax()
+            parts = self._parts
+            if len(parts) == 1:
+                data, cols, live = (parts[0].data, parts[0].cols,
+                                    parts[0].live)
+            else:
+                data = jnp.concatenate([p.data for p in parts], axis=0)
+                cols = jnp.concatenate([p.cols for p in parts], axis=0)
+                live = jnp.concatenate([p.live for p in parts], axis=0)
+            total = data.shape[0]
+            pad = _next_pow2(total) - total
+            if pad:
+                data = jnp.pad(data, ((0, pad), (0, 0)))
+                cols = jnp.pad(cols, ((0, pad), (0, 0)))
+                live = jnp.pad(live, (0, pad))
+            self._stack = st = (data, cols, live)
+        return st
 
     def snapshot(self, path: str, epoch: int = 0,
                  extra_meta: Optional[dict] = None) -> str:
@@ -168,14 +210,22 @@ class IndexView:
         """Ranked retrieval over the live segments: (scores, doc
         positions), each [Q, k'] with k' = min(k, live docs).
         ``doc positions`` index :attr:`names`; -1 marks padding. Same
-        blocking/bucketing discipline as ``TfidfRetriever.search``, so
-        the compiled-program budget is shared."""
+        bucketing discipline as ``TfidfRetriever.search``, so the
+        compiled-program budget is shared.
+
+        Tiled (round 21, default ON): every segment stacks into ONE
+        doc-tiled scan — K segments cost one device dispatch plus the
+        in-scan merge, not K dispatches. ``--score-tiling=off``
+        restores the per-segment dispatch loop + host-side 64-wide
+        query split; results are bit-identical either way (stacked row
+        order is the per-part base order, so the tie discipline
+        matches — see ``ops.sparse``'s parity argument)."""
         _, jnp = _jax()
-        block = max(1, int(os.environ.get("TFIDF_TPU_QUERY_BLOCK",
-                                          "64")))
-        if len(queries) > block:
-            parts = [self.search(queries[s:s + block], k)
-                     for s in range(0, len(queries), block)]
+        tiled = score_tiling()
+        if not tiled and len(queries) > _LEGACY_QUERY_BLOCK:
+            blk = _LEGACY_QUERY_BLOCK
+            parts = [self.search(queries[s:s + blk], k)
+                     for s in range(0, len(queries), blk)]
             return (np.concatenate([p[0] for p in parts]),
                     np.concatenate([p[1] for p in parts]))
         nq = len(queries)
@@ -186,20 +236,30 @@ class IndexView:
         bucket = 1 << max(0, nq - 1).bit_length()
         qmat = jnp.asarray(query_matrix(queries, self.config,
                                         self._idf_np, pad_to=bucket))
-        vals_parts, ids_parts = [], []
-        for part in self._parts:
-            kk = min(k, part.rows)
-            vals, idx = segment_score_topk(part.data, part.cols,
-                                           part.live, qmat, k=kk)
-            vals_parts.append(vals)
-            ids_parts.append(idx + part.base)
-        if len(vals_parts) == 1:
-            vals_cat, ids_cat = vals_parts[0], ids_parts[0]
+        if tiled:
+            data, cols, live = self._stacked()
+            rows = int(data.shape[0])
+            tile = score_tile_rows(rows)
+            with obs.span("score_tile", tiles=-(-rows // tile),
+                          rows=rows, segments=len(self._parts),
+                          queries=int(bucket)):
+                vals, idx = score_topk_tiled(data, cols, live, qmat,
+                                             k, tile=tile)
         else:
-            vals_cat = jnp.concatenate(vals_parts, axis=1)
-            ids_cat = jnp.concatenate(ids_parts, axis=1)
-        ksel = min(k, vals_cat.shape[1])
-        vals, idx = merge_topk(vals_cat, ids_cat, k=ksel)
+            vals_parts, ids_parts = [], []
+            for part in self._parts:
+                kk = min(k, part.rows)
+                vals, idx = segment_score_topk(part.data, part.cols,
+                                               part.live, qmat, k=kk)
+                vals_parts.append(vals)
+                ids_parts.append(idx + part.base)
+            if len(vals_parts) == 1:
+                vals_cat, ids_cat = vals_parts[0], ids_parts[0]
+            else:
+                vals_cat = jnp.concatenate(vals_parts, axis=1)
+                ids_cat = jnp.concatenate(ids_parts, axis=1)
+            ksel = min(k, vals_cat.shape[1])
+            vals, idx = merge_topk(vals_cat, ids_cat, k=ksel)
         vals = np.asarray(vals)[:nq, :width]
         idx = np.asarray(idx)[:nq, :width]
         ok = vals > 0

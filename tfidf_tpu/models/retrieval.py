@@ -43,7 +43,9 @@ from tfidf_tpu import obs
 from tfidf_tpu.obs import devmon
 from tfidf_tpu.ops.hashing import words_to_ids
 from tfidf_tpu.ops.scoring import idf_from_df
-from tfidf_tpu.ops.sparse import (sorted_term_counts, sparse_df,
+from tfidf_tpu.ops.sparse import (score_method, score_tile_rows,
+                                  score_tiling, score_topk_tiled_trace,
+                                  sorted_term_counts, sparse_df,
                                   sparse_scores)
 from tfidf_tpu.ops.tokenize import whitespace_tokenize
 from tfidf_tpu.parallel.mesh import DOCS_AXIS, MeshPlan
@@ -103,6 +105,31 @@ def _search_bcoo(data, cols, qmat, *, k: int):
         mat, qmat, dimension_numbers=(((1,), (0,)), ((), ())))  # [D, Q]
     vals, idx = lax.top_k(sims.T, k)                            # [Q, k]
     return vals, idx
+
+
+# The --score-tiling=off fallback splits query batches at this fixed
+# width — the measured-safe 64-query block the untiled [nse, Qb]
+# intermediate demands at the 100k bench shape. No longer a knob:
+# TFIDF_TPU_QUERY_BLOCK now names the tiled path's DOC tile width
+# (ops.sparse.score_tile_rows), which is what bounds memory instead.
+_LEGACY_QUERY_BLOCK = 64
+
+
+@functools.partial(jax.jit, static_argnames=("k", "tile", "method"))
+def _search_tiled(ids, weights, head, qmat, *, k: int, tile: int,
+                  method: str):
+    """The round-21 flat-index search program: doc-tiled scan + on-
+    device streaming top-k (``ops.sparse.score_topk_tiled_trace``),
+    ONE dispatch for any Q. Takes the raw index triple so the
+    data/cols masking that ``_search_bcoo`` callers staged eagerly
+    (two extra device ops per search) fuses into the same program.
+    ``qmat`` is consumed by convention, exactly like ``_search_bcoo``
+    (same slab delete discipline, same donation honest negative)."""
+    data = jnp.where(head, weights, 0.0)
+    cols = jnp.where(head, ids, 0)
+    return score_topk_tiled_trace(data, cols, None, qmat, k=k,
+                                  tile=tile, masked=False,
+                                  method=method)
 
 
 def _make_search_sharded(plan: MeshPlan, k: int):
@@ -441,10 +468,15 @@ class TfidfRetriever:
             return None
         if (self._slab is None
                 or self._slab.vocab_size != self.config.vocab_size):
-            block = max(1, int(os.environ.get("TFIDF_TPU_QUERY_BLOCK",
-                                              "64")))
+            # Ring ceiling = the serve batch ceiling (round 21): with
+            # tiled scoring the batcher coalesces past 64, and every
+            # bucket it can produce must have a staging ring. Rings
+            # allocate lazily per bucket actually seen, so an oversize
+            # ceiling costs nothing until a batch that wide arrives.
+            cap = max(1, int(os.environ.get("TFIDF_TPU_MAX_BATCH",
+                                            "256") or "256"))
             self._slab = QuerySlab(self.config.vocab_size,
-                                   max_bucket=block)
+                                   max_bucket=cap)
         return self._slab
 
     def search(self, queries: Sequence[Union[str, bytes]], k: int = 10
@@ -458,22 +490,27 @@ class TfidfRetriever:
         """
         if not self.indexed:
             raise RuntimeError("index() a corpus before search()")
-        # Query blocks bound device memory: the BCOO dot materializes an
-        # [nse, Qb] intermediate (measured: Q=256 over 100k x 256 docs
-        # asks for 28 GB and OOMs a v5e), so large batches run as
-        # independent per-block top-k searches. 64 is the measured-safe
-        # block at the 100k bench shape; per-query results are
-        # independent, so concatenation is exact.
-        block = max(1, int(os.environ.get("TFIDF_TPU_QUERY_BLOCK", "64")))
-        if len(queries) > block:
-            parts = [self.search(queries[s:s + block], k)
-                     for s in range(0, len(queries), block)]
+        # Tiled scoring (round 21, default ON): the doc axis scans in
+        # fixed tiles against the FULL query block, so the per-dispatch
+        # intermediate is [tile * L, Q] — bounded regardless of Q — and
+        # one batch is ONE dispatch at any width. OFF restores the
+        # legacy untiled dot, whose [nse, Qb] intermediate (measured:
+        # Q=256 over 100k x 256 docs asks 28 GB and OOMs a v5e) forces
+        # the serial 64-wide query-block split below; per-query results
+        # are independent, so that concatenation is exact — and tiled
+        # results are bit-identical to it (scores, ids, tie order).
+        tiled = self.plan is None and score_tiling()
+        if (not tiled and self.plan is None
+                and len(queries) > _LEGACY_QUERY_BLOCK):
+            parts = [self.search(queries[s:s + _LEGACY_QUERY_BLOCK], k)
+                     for s in range(0, len(queries),
+                                    _LEGACY_QUERY_BLOCK)]
             return (np.concatenate([p[0] for p in parts]),
                     np.concatenate([p[1] for p in parts]))
         # Query-count bucketing: the compiled search program is shaped
         # by Q, so ad-hoc repeated searches at arbitrary query counts
         # would re-jit per count. Padding Q to the next power of two
-        # caps steady-state serving at log2(block)+1 programs per k
+        # caps steady-state serving at log2(bucket)+1 programs per k
         # (pinned by tests/test_serve.py); the zero padding columns
         # score 0 everywhere and their rows are dropped before return.
         nq = len(queries)
@@ -484,18 +521,36 @@ class TfidfRetriever:
             fn = self._sharded_fn(k)
             vals, idx = fn(self._ids, self._weights, self._head, qmat)
         else:
-            data = jnp.where(self._head, self._weights, 0.0)
-            cols = jnp.where(self._head, self._ids, 0)[..., None]
-            kk = min(k, self._ids.shape[0])
+            rows = int(self._ids.shape[0])
+            kk = min(k, rows)
+            if tiled:
+                tile = score_tile_rows(rows)
+                method = score_method()
+                n_tiles = -(-rows // tile)
+
+                def dispatch(qmat):
+                    with obs.span("score_tile", tiles=n_tiles,
+                                  rows=rows, queries=int(bucket)):
+                        return _search_tiled(
+                            self._ids, self._weights, self._head,
+                            qmat, k=kk, tile=tile, method=method)
+            else:
+                data = jnp.where(self._head, self._weights, 0.0)
+                cols = jnp.where(self._head, self._ids, 0)[..., None]
+
+                def dispatch(qmat):
+                    return _search_bcoo(data, cols, qmat, k=kk)
+
             # Compile fingerprinting (round 12): with a CompileWatch
             # armed, a cache-size delta across this call means a fresh
             # search program — note it with the shape identity the
             # watch's flight event needs. Disabled cost: one global
             # load + None test (the hot-path discipline of obs).
+            fn = _search_tiled if tiled else _search_bcoo
             watch = devmon.get_watch()
-            before = (_search_bcoo._cache_size()
+            before = (fn._cache_size()
                       if watch is not None
-                      and hasattr(_search_bcoo, "_cache_size") else None)
+                      and hasattr(fn, "_cache_size") else None)
             slab = self._resolve_slab()
             if slab is not None and bucket <= slab.max_bucket:
                 # Zero-allocation hot path (round 19): fill a reused
@@ -516,7 +571,7 @@ class TfidfRetriever:
                     with obs.span("h2d", bytes=int(buf.nbytes)):
                         qmat = jax.device_put(buf)
                     slab.note_h2d(buf.nbytes)
-                    vals, idx = _search_bcoo(data, cols, qmat, k=kk)
+                    vals, idx = dispatch(qmat)
                     vals = np.asarray(vals)
                     idx = np.asarray(idx)
                     qmat.delete()
@@ -524,19 +579,19 @@ class TfidfRetriever:
                     slab.release(slot)
             else:
                 # Oversize-batch fallback (bucket past the slab's
-                # ring shapes — a raised TFIDF_TPU_QUERY_BLOCK) or
+                # ring shapes — a raised TFIDF_TPU_MAX_BATCH) or
                 # slab off: the legacy one-shot allocation. Same
                 # programs, same bytes.
                 if slab is not None:
                     slab.note_fallback()
                 qmat = jnp.asarray(self._query_matrix(queries,
                                                       pad_to=bucket))
-                vals, idx = _search_bcoo(data, cols, qmat, k=kk)
+                vals, idx = dispatch(qmat)
             if (before is not None
-                    and _search_bcoo._cache_size() > before):
+                    and fn._cache_size() > before):
                 devmon.note_compile(
-                    "search_bcoo", queries=int(bucket), k=kk,
-                    docs=int(self._ids.shape[0]),
+                    "search_tiled" if tiled else "search_bcoo",
+                    queries=int(bucket), k=kk, docs=rows,
                     dtype="float32")
         # Both paths produce >= min(k, num_docs) sorted columns (the
         # sharded one up to min(k, local_k * n_shards)); trim to the

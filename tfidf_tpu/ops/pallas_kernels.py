@@ -500,3 +500,58 @@ def fused_score_topk_pallas(ids: jax.Array, counts: jax.Array,
         interpret=interpret,
     )(ids_p, cnt_p, head_p, lens_p, idf2)
     return vals[:d], tids[:d]
+
+
+def _tile_scores_kernel(data_ref, cols_ref, q_ref, out_ref):
+    """One doc-subtile's retrieval similarities:
+    ``sims[r, q] = sum_l data[r, l] * qmat[cols[r, l], q]`` — the BCOO
+    sparse x dense dot as an in-kernel gather-accumulate, the same
+    VMEM-resident-table idiom as ``_fused_score_topk_kernel`` with the
+    [V, Q] query block in place of the [V] IDF table. Dead slots carry
+    ``data == 0`` (``to_bcoo``'s explicit-zero convention), so no head
+    mask is needed: they gather column 0 and add nothing."""
+    qtab = q_ref[...]                            # [V, Q] resident
+    length = data_ref.shape[1]
+
+    def body(sl, acc):
+        c = cols_ref[:, sl]                      # [TILE_D] int32
+        w = data_ref[:, sl]                      # [TILE_D]
+        return acc + w[:, None] * jnp.take(qtab, c, axis=0)
+
+    out_ref[...] = jax.lax.fori_loop(
+        0, length, body, jnp.zeros(out_ref.shape, out_ref.dtype))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def tile_scores_pallas(data: jax.Array, cols: jax.Array,
+                       qmat: jax.Array, *, interpret: bool = False
+                       ) -> jax.Array:
+    """[tile, L] row-sparse weights x [V, Q] query block -> [tile, Q]
+    similarities via the Mosaic gather-accumulate kernel — the
+    ``TFIDF_TPU_SCORE=pallas`` lowering of one score tile inside
+    ``ops.sparse.score_topk_tiled`` (scope extended, round 21). Same
+    contract as the phase-B probe: selections bit-identical, scores
+    the same float formula (allclose; reassociation headroom only).
+    In-tree A/B probe scope note: the whole [V, Q] block must sit in
+    VMEM, which bounds Q on real hardware — interpret mode (CPU) has
+    no such ceiling."""
+    d, length = data.shape
+    dp = _pad_to(d, TILE_D)
+    # Padding rows are all-zero: they gather column 0 with weight 0
+    # and score exactly 0, then slice off below.
+    data_p = jnp.zeros((dp, length), data.dtype).at[:d].set(data)
+    cols_p = jnp.zeros((dp, length), jnp.int32).at[:d].set(
+        cols.astype(jnp.int32))
+    out = pl.pallas_call(
+        _tile_scores_kernel,
+        grid=(dp // TILE_D,),
+        in_specs=[pl.BlockSpec((TILE_D, length), lambda i: (i, 0)),
+                  pl.BlockSpec((TILE_D, length), lambda i: (i, 0)),
+                  pl.BlockSpec(qmat.shape, lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((TILE_D, qmat.shape[1]),
+                               lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((dp, qmat.shape[1]),
+                                       qmat.dtype),
+        interpret=interpret,
+    )(data_p, cols_p, qmat)
+    return out[:d]

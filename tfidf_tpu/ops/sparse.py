@@ -21,6 +21,7 @@ document axis shards exactly like the dense path (``parallel``).
 
 from __future__ import annotations
 
+import functools
 import os
 from typing import Optional, Tuple
 
@@ -343,6 +344,176 @@ def to_bcoo(ids: jax.Array, counts: jax.Array, head: jax.Array,
     cols = jnp.where(head, ids, 0)[..., None]
     data = jnp.where(head, counts, 0)
     return jsparse.BCOO((data, cols), shape=(d, vocab_size))
+
+
+# --- tiled retrieval scoring (round 21) ------------------------------
+#
+# The retrieval score path used to materialize the full [nse, Q] BCOO
+# dot intermediate, forcing callers to split query batches host-side
+# (the serial 64-wide block loop) — so measured QPS DROPPED as Q grew,
+# exactly backwards for a batcher built to coalesce. The tiled lowering
+# below scans fixed-size DOC tiles against the full [V, Q] query block:
+# the peak intermediate is [tile * L, Q] (bounded regardless of D or
+# Q), the whole sweep is ONE compiled dispatch (a lax.scan), and a
+# streaming top-k folds across tiles via ops.topk.merge_topk.
+#
+# Bit-parity with the untiled path is by construction, not luck:
+# * rows never split across tiles, so each row's float dot is the
+#   same reduction over the same L slots;
+# * lax.top_k breaks equal scores by LOWEST index; tiles scan in
+#   ascending global-row order and every fold concatenates the carry
+#   (lower rows) BEFORE the new tile's candidates (ids ascending
+#   within), so lowest-position == lowest-global-row at every step;
+# * per-tile retention min(k, tile) keeps every row that could reach
+#   the global top-k (a global winner is a winner of its own tile);
+# * tail-padding rows score 0 (unmasked; weights and query columns are
+#   both >= 0) or the tombstone sentinel (masked) AND sit at the
+#   highest global positions, so with >= k real rows they can never
+#   displace one.
+
+_TILE_DEFAULT = 4096
+
+
+def score_tiling(explicit: Optional[str] = None) -> bool:
+    """Resolve the tiled-scoring knob: ``TFIDF_TPU_SCORE_TILING``
+    (CLI ``--score-tiling``), default ON. ``off`` restores the legacy
+    untiled dot + host-side serial query-block split — kept as the
+    bit-identical fallback and the A/B baseline (serve_bench
+    ``--ab-tiled``). Resolved at CALL time, deliberately NOT trace
+    time: the knob selects between two distinct jitted programs, so an
+    env toggle flips paths even for already-compiled shapes."""
+    raw = (explicit if explicit is not None
+           else os.environ.get("TFIDF_TPU_SCORE_TILING", "on"))
+    val = str(raw).strip().lower()
+    if val in ("on", "1", "true", "yes", ""):
+        return True
+    if val in ("off", "0", "false", "no"):
+        return False
+    raise ValueError(
+        f"unknown TFIDF_TPU_SCORE_TILING value {raw!r} (on|off)")
+
+
+def score_tile_rows(d: int, explicit: Optional[int] = None) -> int:
+    """Resolve the document-axis tile width (rows per scan step):
+    ``TFIDF_TPU_QUERY_BLOCK``, repurposed (round 21) — it used to
+    split QUERIES host-side, now it tiles DOCS on device — clamped to
+    [1, d]. Default 4096 rows: at the 100k x 256 bench shape the
+    per-tile [tile * L, Q] intermediate is ~1 GB at Q=256, inside the
+    budget the old 64-query block was chosen for."""
+    if explicit is None:
+        raw = os.environ.get("TFIDF_TPU_QUERY_BLOCK", "")
+        explicit = int(raw) if raw.strip() else _TILE_DEFAULT
+    return max(1, min(int(explicit), max(1, int(d))))
+
+
+def _tile_scores(data_t: jax.Array, cols_t: jax.Array, qmat: jax.Array,
+                 method: str) -> jax.Array:
+    """One tile's [tile, Q] similarity block: the BCOO sparse x dense
+    MXU dot (``"xla"``, bit-identical to the untiled kernel) or the
+    fused Mosaic gather-accumulate (``"pallas"`` — the
+    ``TFIDF_TPU_SCORE`` probe's scope extended to retrieval; ids
+    bit-identical, scores allclose, same contract as phase B)."""
+    if method == "pallas":
+        from tfidf_tpu.ops.pallas_kernels import (default_interpret,
+                                                  tile_scores_pallas)
+        return tile_scores_pallas(data_t, cols_t, qmat,
+                                  interpret=default_interpret())
+    mat = jsparse.BCOO((data_t, cols_t[..., None]),
+                       shape=(data_t.shape[0], qmat.shape[0]))
+    return jsparse.bcoo_dot_general(
+        mat, qmat, dimension_numbers=(((1,), (0,)), ((), ())))
+
+
+def score_topk_tiled_trace(data: jax.Array, cols: jax.Array,
+                           live: Optional[jax.Array], qmat: jax.Array,
+                           *, k: int, tile: int, masked: bool,
+                           method: str) -> Tuple[jax.Array, jax.Array]:
+    """The traceable tiled score+top-k body (see the section comment
+    for the parity argument) — embedded by :func:`score_topk_tiled`,
+    the retriever's flat-path jit and the mesh shard_map body, so all
+    four consumers run ONE definition.
+
+    [D, L] triple x [V, Q] queries -> ([Q, k'], [Q, k']) with
+    k' = min(k, D), ids int32 global row indices, columns sorted by
+    (score desc, row asc). ``live`` ([D] bool, ``masked=True``) applies
+    the tombstone sentinel before selection; padding the caller did NOT
+    provide is added here (ragged last tile)."""
+    from tfidf_tpu.ops.topk import _DEAD, merge_topk
+
+    d, length = data.shape
+    k = min(k, d)
+    tile = max(1, min(tile, d))
+    n_tiles = -(-d // tile)
+    pad = n_tiles * tile - d
+    if pad:
+        data = jnp.pad(data, ((0, pad), (0, 0)))
+        cols = jnp.pad(cols, ((0, pad), (0, 0)))
+        if masked:
+            live = jnp.pad(live, (0, pad))
+    data3 = data.reshape(n_tiles, tile, length)
+    cols3 = cols.reshape(n_tiles, tile, length)
+    bases = jnp.arange(n_tiles, dtype=jnp.int32) * tile
+    kt = min(k, tile)
+
+    def step(carry, xs):
+        cvals, cids = carry
+        if masked:
+            data_t, cols_t, live_t, base = xs
+        else:
+            data_t, cols_t, base = xs
+        sims = _tile_scores(data_t, cols_t, qmat, method).T  # [Q, tile]
+        if masked:
+            sims = jnp.where(live_t[None, :], sims, _DEAD)
+        v, i = lax.top_k(sims, kt)
+        # Carry first: its rows precede this tile's globally, so the
+        # merge's lowest-position tie-break IS lowest-global-row.
+        nv, ni = merge_topk(jnp.concatenate([cvals, v], axis=1),
+                            jnp.concatenate([cids, i + base], axis=1),
+                            k=k)
+        return (nv, ni), None
+
+    q = qmat.shape[1]
+    init = (jnp.full((q, k), -jnp.inf, qmat.dtype),
+            jnp.zeros((q, k), jnp.int32))
+    xs = ((data3, cols3, live.reshape(n_tiles, tile), bases) if masked
+          else (data3, cols3, bases))
+    (vals, ids), _ = lax.scan(step, init, xs)
+    return vals, ids
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("k", "tile", "masked", "method"))
+def _score_topk_tiled(data, cols, live, qmat, *, k: int, tile: int,
+                      masked: bool, method: str):
+    return score_topk_tiled_trace(data, cols, live, qmat, k=k,
+                                  tile=tile, masked=masked,
+                                  method=method)
+
+
+def score_topk_tiled(data: jax.Array, cols: jax.Array,
+                     live: Optional[jax.Array], qmat: jax.Array,
+                     k: int, tile: Optional[int] = None,
+                     method: Optional[str] = None
+                     ) -> Tuple[jax.Array, jax.Array]:
+    """ONE-dispatch tiled score+top-k over a row-sparse block — the
+    round-21 retrieval kernel (segmented views stack every sealed
+    segment into this single scan: K segments = one dispatch + merge,
+    not K). Resolves the tile width (:func:`score_tile_rows`) and the
+    score lowering (:func:`score_method`) at call time, then runs the
+    jitted :func:`score_topk_tiled_trace`."""
+    d = data.shape[0]
+    return _score_topk_tiled(data, cols, live, qmat,
+                             k=min(int(k), d),
+                             tile=score_tile_rows(d, tile),
+                             masked=live is not None,
+                             method=score_method(method))
+
+
+def score_topk_tiled_cache_size() -> int:
+    """Compiled-program count of the shared tiled search jit — summed
+    into ``index_compile_cache_size`` (the mutate bench's recompile
+    receipt) and read by the retrieval bench's zero-recompile pin."""
+    return _score_topk_tiled._cache_size()
 
 
 def sparse_forward(token_ids, lengths, num_docs, *, vocab_size: int,

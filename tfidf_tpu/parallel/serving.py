@@ -37,7 +37,6 @@ compaction, snapshot-restore) re-shard through one transform.
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
@@ -97,23 +96,37 @@ _MESH_SEARCH_FNS: Dict[Tuple, object] = {}
 _FNS_LOCK = threading.Lock()
 
 
-def _make_mesh_search(plan: MeshPlan, k: int):
+def _make_mesh_search(plan: MeshPlan, k: int, tiled: bool,
+                      method: str, tile: int):
     """The sharded serving program: per-shard fused score/top-k, one
     all_gather, device-side merge. Blocks: data/cols [D/s, L] + live
-    [D/s] local rows; qmat [V, Q] replicated."""
+    [D/s] local rows; qmat [V, Q] replicated.
+
+    ``tiled`` (round 21, default on): each shard scans ITS rows in doc
+    tiles via ``ops.sparse.score_topk_tiled_trace`` — same per-tile
+    memory bound as the flat path, unchanged gather/merge, per-shard
+    results bit-identical to the untiled body (the tiled parity
+    argument applies shard-locally, so the merged output is too)."""
     jax, jnp = _jax()
     from jax import lax
     from jax.sharding import PartitionSpec as P
 
+    from tfidf_tpu.ops.sparse import score_topk_tiled_trace
     from tfidf_tpu.ops.topk import merge_topk, segment_score_topk
 
     def body(data, cols, live, qmat):
         d = data.shape[0]
         kk = min(k, d)
-        # PR 3's fused BCOO score + tombstone mask + top-k, unchanged:
-        # this shard scores only its own rows. Ids come back shard-
-        # local; the axis index globalizes them.
-        vals, ids = segment_score_topk(data, cols, live, qmat, k=kk)
+        # PR 3's fused BCOO score + tombstone mask + top-k (tiled or
+        # not): this shard scores only its own rows. Ids come back
+        # shard-local; the axis index globalizes them.
+        if tiled:
+            vals, ids = score_topk_tiled_trace(
+                data, cols, live, qmat, k=kk,
+                tile=max(1, min(tile, d)), masked=True, method=method)
+        else:
+            vals, ids = segment_score_topk(data, cols, live, qmat,
+                                           k=kk)
         ids = ids + lax.axis_index(DOCS_AXIS) * d
         # The ONE collective of the query path: k-sized candidate
         # lists (never [D, Q] score rows) gather in shard order...
@@ -137,10 +150,20 @@ def _make_mesh_search(plan: MeshPlan, k: int):
 
 
 def _mesh_search_fn(plan: MeshPlan, k: int):
+    # The tiling/lowering knobs resolve at LOOKUP time and ride the
+    # cache key, so an env toggle (serve_bench A/B) selects a distinct
+    # program instead of silently reusing the other path's jit.
+    from tfidf_tpu.ops.sparse import (score_method, score_tile_rows,
+                                      score_tiling)
+    tiled = score_tiling()
+    method = score_method() if tiled else "xla"
+    tile = score_tile_rows(1 << 30) if tiled else 0
+    key = (plan, k, tiled, method, tile)
     with _FNS_LOCK:
-        fn = _MESH_SEARCH_FNS.get((plan, k))
+        fn = _MESH_SEARCH_FNS.get(key)
         if fn is None:
-            fn = _MESH_SEARCH_FNS[(plan, k)] = _make_mesh_search(plan, k)
+            fn = _MESH_SEARCH_FNS[key] = _make_mesh_search(
+                plan, k, tiled, method, tile)
         return fn
 
 
@@ -300,14 +323,19 @@ class MeshShardedRetriever:
         single-device ``search`` (same blocking, same query bucketing,
         same compiled-program budget discipline)."""
         _, jnp = _jax()
-        from tfidf_tpu.models.retrieval import query_matrix
+        from tfidf_tpu.models.retrieval import (_LEGACY_QUERY_BLOCK,
+                                                query_matrix)
         from tfidf_tpu.obs import devmon
+        from tfidf_tpu.ops.sparse import score_tiling
 
-        block = max(1, int(os.environ.get("TFIDF_TPU_QUERY_BLOCK",
-                                          "64")))
-        if len(queries) > block:
-            parts = [self.search(queries[s:s + block], k)
-                     for s in range(0, len(queries), block)]
+        # Tiled (round 21): one dispatch at any Q — the per-shard doc
+        # scan bounds memory, so the legacy host-side query split only
+        # applies on the --score-tiling=off fallback.
+        if (not score_tiling()
+                and len(queries) > _LEGACY_QUERY_BLOCK):
+            parts = [self.search(queries[s:s + _LEGACY_QUERY_BLOCK], k)
+                     for s in range(0, len(queries),
+                                    _LEGACY_QUERY_BLOCK)]
             return (np.concatenate([p[0] for p in parts]),
                     np.concatenate([p[1] for p in parts]))
         nq = len(queries)

@@ -34,6 +34,10 @@ SPANS = {
     # replicated tier (round 20): the front's routing decision and a
     # two-phase epoch transaction end to end (prepare..commit/abort)
     "route", "epoch_swap",
+    # tiled scoring (round 21): one lax.scan dispatch folding a
+    # streaming top-k across document tiles — carries tiles/rows/
+    # queries (and segments on the stacked segmented path)
+    "score_tile",
 }
 
 #: Trace instants (``obs.instant``) — point events, not spans.
@@ -122,6 +126,7 @@ ENV_CLI_FLAGS = {
     "TFIDF_TPU_MESH_SHARDS": "--mesh-shards",
     "TFIDF_TPU_INGEST_WORKERS": "--ingest-workers",
     "TFIDF_TPU_QUERY_SLAB": "--query-slab",
+    "TFIDF_TPU_SCORE_TILING": "--score-tiling",
     "TFIDF_TPU_REPLICAS": "--replicas",
     "TFIDF_TPU_REPLICA_TIMEOUT_S": "--replica-timeout-s",
 }

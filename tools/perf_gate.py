@@ -113,6 +113,10 @@ _GATES = {
         # again improve purely by riding a fatter cache.
         "p50_ms_cache_off": ("lower", 0.60),
         "p99_ms_cache_off": ("lower", 0.80),
+        # Round 21 tiled-scoring A/B (--ab-tiled): parity vs the
+        # tiling-off pass is the contract — any byte divergence at
+        # any probed width fails absolutely.
+        "tiled_parity_ok": ("higher", 0.0),
     },
     # Multi-process sharded ingest (tools/ingest_mh_bench.py): parity
     # is zero-tolerance — the N-worker merged index must stay
@@ -181,6 +185,23 @@ _GATES = {
         "chaos_swap_aborted": ("higher", 0.0),
         "chaos_old_epoch_everywhere": ("higher", 0.0),
     },
+    # Retrieval batch-scaling sweep (tools/retrieval_bench.py): the
+    # round-21 tiled-scorer receipts. parity_ok must stay 1 (tiled
+    # bit-identical to --score-tiling=off — scores, ids, tie order),
+    # qps_monotonic_through_256 must stay 1 (the weak-5 "throughput
+    # goes DOWN with batch size" regression can never return), and
+    # recompiles_after_warmup must stay 0 (one program per pow2
+    # bucket, full stop). The QPS columns gate directionally so the
+    # scan lowering cannot quietly slow down.
+    "retrieval": {
+        "parity_ok": ("higher", 0.0),
+        "qps_monotonic_through_256": ("higher", 0.0),
+        "recompiles_after_warmup": ("lower", 0.0),
+        "qps_q64": ("higher", 0.30),
+        "qps_q256": ("higher", 0.30),
+        "qps_q512": ("higher", 0.30),
+        "index_docs_per_sec": ("higher", 0.30),
+    },
     # The mesh dryrun verdict: ok must STAY 1 (zero-tolerance, the
     # absolute zero-baseline rule below never fires because ok is the
     # higher-is-better direction with a nonzero baseline).
@@ -213,6 +234,8 @@ _MATCH_KEYS = {"bench": ("backend", "n_docs", "wire"),
                              "n_workers", "wire"),
                "replica_serve": ("backend", "docs", "k",
                                  "n_replicas", "host_cores"),
+               "retrieval": ("backend", "docs", "doc_len", "k",
+                             "tiling"),
                "multichip": ("n_devices",)}
 # Defaults applied to BOTH sides of a match when the key is absent —
 # how records that predate a context key stay comparable to their

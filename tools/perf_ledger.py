@@ -114,6 +114,12 @@ _SERVE_METRICS = {
     # growing hit rate. Gated directionally by perf_gate.
     "p50_ms_cache_off": "cache_off.p50_ms",
     "p99_ms_cache_off": "cache_off.p99_ms",
+    # Round 21 tiled-scoring receipts (--ab-tiled runs): parity is
+    # the bit-identity verdict vs the tiling-off pass at every probed
+    # width (zero-tolerance); the speedup column is the measured
+    # tiled-over-block-split ratio at the widest width (trend).
+    "tiled_parity_ok": "tiling.parity_ok",
+    "tiled_speedup_widest": "tiling.speedup_widest",
 }
 # Chaos artifacts (serve_bench --chaos): the fault-plan receipts. The
 # gated metric is parity_ok — every non-shed non-poisoned response
@@ -230,6 +236,25 @@ _REPLICA_CONTEXT = {"backend": "backend", "docs": "docs", "k": "k",
                     "host_cores": "host_cores",
                     "cpu_bound": "cpu_bound",
                     "chaos_plan": "chaos.plan"}
+# Retrieval batch-scaling sweep (tools/retrieval_bench.py, round 21):
+# the tiled scorer's artifact of record. parity_ok (tiled results
+# bit-identical to --score-tiling=off at probe widths) and
+# qps_monotonic_through_256 (QPS non-decreasing Q=64 -> 256 — the
+# exact weak-5 regression) are zero-tolerance 0/1 pins;
+# recompiles_after_warmup pins 0; the per-width QPS columns and the
+# index build rate gate directionally.
+_RETRIEVAL_METRICS = {
+    "parity_ok": "parity_ok",
+    "qps_monotonic_through_256": "qps_monotonic_through_256",
+    "recompiles_after_warmup": "recompiles_after_warmup",
+    "qps_q64": "qps_q64",
+    "qps_q256": "qps_q256",
+    "qps_q512": "qps_q512",
+    "index_docs_per_sec": "index_docs_per_sec",
+}
+_RETRIEVAL_CONTEXT = {"backend": "backend", "docs": "docs",
+                      "doc_len": "doc_len", "k": "k",
+                      "tiling": "tiling", "tile_rows": "tile_rows"}
 # Multi-chip dryrun artifacts (MULTICHIP_r0X.json): a driver wrapper
 # with no parsed payload — just the mesh smoke's verdict. "ok" is the
 # gated metric (1 must stay 1); n_devices is comparability context.
@@ -277,6 +302,8 @@ def unwrap(doc: dict) -> Optional[dict]:
 def classify(payload: dict) -> Optional[str]:
     if payload.get("metric") == "ingest_mh":
         return "ingest_mh"
+    if payload.get("metric") == "retrieval_bench":
+        return "retrieval"
     if payload.get("metric") == "replica_bench":
         # Checked before the serve_bench branches: a replica artifact
         # also carries a "chaos" rehearsal block, which must not
@@ -320,6 +347,7 @@ def normalize(path: str) -> Tuple[Optional[dict], Optional[str]]:
                     "mesh_serve": _MESH_SERVE_METRICS,
                     "ingest_mh": _INGEST_MH_METRICS,
                     "replica_serve": _REPLICA_METRICS,
+                    "retrieval": _RETRIEVAL_METRICS,
                     "multichip": _MULTICHIP_METRICS}[kind]
     ctx_paths = {"serve_bench": _SERVE_CONTEXT,
                  "bench": _BENCH_CONTEXT,
@@ -328,6 +356,7 @@ def normalize(path: str) -> Tuple[Optional[dict], Optional[str]]:
                  "mesh_serve": _MESH_SERVE_CONTEXT,
                  "ingest_mh": _INGEST_MH_CONTEXT,
                  "replica_serve": _REPLICA_CONTEXT,
+                 "retrieval": _RETRIEVAL_CONTEXT,
                  "multichip": _MULTICHIP_CONTEXT}[kind]
     metrics = {name: (int(v) if isinstance(v, bool) else v)
                for name, p in metric_paths.items()
@@ -423,7 +452,9 @@ def backfill_paths() -> List[str]:
             + sorted(glob.glob(os.path.join(_common.REPO,
                                             "INGEST_MH_r*.json")))
             + sorted(glob.glob(os.path.join(_common.REPO,
-                                            "REPLICA_r*.json"))))
+                                            "REPLICA_r*.json")))
+            + sorted(glob.glob(os.path.join(_common.REPO,
+                                            "RETR_r*.json"))))
 
 
 def main() -> int:

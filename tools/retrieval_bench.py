@@ -1,18 +1,38 @@
-"""Retrieval throughput artifact (VERDICT r4 item 9).
+"""Retrieval throughput artifact: the batch-scaling receipt.
 
 Indexes a Zipf corpus with models/retrieval.TfidfRetriever (the
-overlapped chunked ingest) and measures batched-query search QPS on
-the live backend — the config-3 BCOO north-star use. Prints one JSON
-line per query-batch size plus an index-build row; paste into
-BASELINE.md.
+overlapped chunked ingest) and measures batched-query search QPS over
+a QUERY-COUNT SWEEP on the live backend. Round 21 made this the tiled
+scorer's artifact of record (``RETR_r01.json``): the legacy untiled
+path's QPS went DOWN as Q grew (the serial 64-query block split —
+VERDICT weak-5); the tiled scan's one-dispatch-at-any-width claim is
+only real if this sweep shows it, so the artifact carries:
 
-Usage: python tools/retrieval_bench.py [--docs 100000] [--batches 16,64,256]
+* ``sweep``: per-Q QPS rows, Q = 16 .. 512 by powers of two;
+* ``qps_monotonic_through_256``: 1 iff QPS is non-decreasing from
+  Q=64 through Q=256 (the exact regression weak-5 documents, within
+  a small timing-noise band);
+* ``parity_ok``: tiled results bit-identical (scores, ids, tie
+  order) to the ``TFIDF_TPU_SCORE_TILING=off`` fallback at probe
+  widths on BOTH sides of the legacy 64 split;
+* ``recompiles_after_warmup``: compiled-program delta across every
+  measured repeat AFTER each bucket's warm pass — must be 0.
+
+``tools/perf_ledger.py`` ingests the artifact as kind ``retrieval``;
+``tools/perf_gate.py`` zero-tolerates parity/monotonic/recompiles and
+gates the QPS columns directionally. Exit 1 when parity or the
+recompile pin fails — the bench IS the regression test.
+
+Usage::
+
+    python tools/retrieval_bench.py [--docs 100000] [--out RETR_r01.json]
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import shutil
 import sys
 import tempfile
@@ -23,13 +43,29 @@ import _common  # noqa: E402,F401  repo-root sys.path bootstrap
 import numpy as np  # noqa: E402
 
 
-def main() -> None:
+def _measure(r, queries, k, repeats):
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        vals, idx = r.search(queries, k=k)
+        best = min(best, time.perf_counter() - t0)
+    return best, vals, idx
+
+
+def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--docs", type=int, default=100000)
     ap.add_argument("--len", type=int, dest="length", default=256)
-    ap.add_argument("--batches", default="16,64,256")
+    ap.add_argument("--batches", default="16,32,64,128,256,512",
+                    help="query-count sweep (pow2 keeps one bucket "
+                         "per width)")
     ap.add_argument("--k", type=int, default=10)
     ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--parity-batches", default="16,64,256",
+                    help="widths A/B'd against --score-tiling=off "
+                         "(either side of the legacy 64 split)")
+    ap.add_argument("--out", default=None,
+                    help="write the JSON artifact here (RETR_r0X.json)")
     args = ap.parse_args()
 
     import bench as benchmod
@@ -38,9 +74,11 @@ def main() -> None:
 
     import jax
     from tfidf_tpu.config import PipelineConfig, VocabMode
-    from tfidf_tpu.models.retrieval import TfidfRetriever
+    from tfidf_tpu.models.retrieval import TfidfRetriever, _search_tiled
+    from tfidf_tpu.ops.sparse import score_tile_rows, score_tiling
 
-    print(f"backend={jax.default_backend()}", file=sys.stderr)
+    backend = jax.default_backend()
+    print(f"backend={backend}", file=sys.stderr)
     tmp = tempfile.mkdtemp(prefix="retr_bench_")
     try:
         print(f"generating {args.docs}-doc corpus...", file=sys.stderr)
@@ -60,24 +98,84 @@ def main() -> None:
                           "value": round(args.docs / t_index, 1)}))
 
         rng = np.random.default_rng(7)
-        for q in (int(b) for b in args.batches.split(",")):
-            queries = [" ".join(f"w{rng.integers(0, benchmod.N_WORDS)}"
-                                for _ in range(5)) for _ in range(q)]
-            r.search(queries[:2], k=args.k)  # warm/compile
-            best = float("inf")
-            for _ in range(args.repeats):
-                t0 = time.perf_counter()
-                vals, idx = r.search(queries, k=args.k)
-                best = min(best, time.perf_counter() - t0)
+        widths = [int(b) for b in args.batches.split(",")]
+        pool = [" ".join(f"w{rng.integers(0, benchmod.N_WORDS)}"
+                         for _ in range(5)) for _ in range(max(widths))]
+
+        # --- QPS sweep (tiled path, the default) --------------------
+        sweep = []
+        recompiles = 0
+        for q in widths:
+            queries = pool[:q]
+            r.search(queries, k=args.k)        # warm this bucket
+            warm = _search_tiled._cache_size()
+            best, vals, idx = _measure(r, queries, args.k,
+                                       args.repeats)
+            recompiles += _search_tiled._cache_size() - warm
             assert vals.shape[0] == q
-            print(json.dumps({
-                "metric": "retrieval_qps", "batch": q,
-                "k": args.k, "search_s": round(best, 4),
-                "value": round(q / best, 1),
-                "docs": args.docs}), flush=True)
+            row = {"q": q, "search_s": round(best, 4),
+                   "qps": round(q / best, 1)}
+            sweep.append(row)
+            print(json.dumps({"metric": "retrieval_qps", "batch": q,
+                              "k": args.k, "search_s": row["search_s"],
+                              "value": row["qps"],
+                              "docs": args.docs}), flush=True)
+
+        qps = {row["q"]: row["qps"] for row in sweep}
+        # Non-decreasing through Q=256 within a 5% timing-noise band:
+        # the weak-5 regression was -18% over that range, an order of
+        # magnitude outside it.
+        mono_widths = [q for q in widths if 64 <= q <= 256]
+        monotonic = all(
+            qps[b] >= qps[a] * 0.95
+            for a, b in zip(mono_widths, mono_widths[1:]))
+
+        # --- bit-parity A/B vs --score-tiling=off -------------------
+        parity_ok = True
+        for q in (int(b) for b in args.parity_batches.split(",")):
+            queries = pool[:q]
+            os.environ["TFIDF_TPU_SCORE_TILING"] = "off"
+            try:
+                off_v, off_i = r.search(queries, k=args.k)
+            finally:
+                os.environ["TFIDF_TPU_SCORE_TILING"] = "on"
+            on_v, on_i = r.search(queries, k=args.k)
+            same = (np.array_equal(np.asarray(on_v), np.asarray(off_v))
+                    and np.array_equal(np.asarray(on_i),
+                                       np.asarray(off_i)))
+            parity_ok &= same
+            print(f"parity q={q}: {'ok' if same else 'MISMATCH'}",
+                  file=sys.stderr)
+
+        artifact = {
+            "metric": "retrieval_bench",
+            "backend": backend,
+            "docs": args.docs, "doc_len": args.length, "k": args.k,
+            "tiling": "on" if score_tiling() else "off",
+            "tile_rows": score_tile_rows(args.docs),
+            "index_s": round(t_index, 3),
+            "index_docs_per_sec": round(args.docs / t_index, 1),
+            "sweep": sweep,
+            "qps_q64": qps.get(64),
+            "qps_q256": qps.get(256),
+            "qps_q512": qps.get(512),
+            "qps_monotonic_through_256": int(monotonic),
+            "parity_ok": int(parity_ok),
+            "recompiles_after_warmup": int(recompiles),
+        }
+        print(json.dumps(artifact, indent=1))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(artifact, f, indent=1)
+            print(f"wrote {args.out}", file=sys.stderr)
+        if not parity_ok or recompiles:
+            print("retrieval_bench: FAIL (parity or recompile pin)",
+                  file=sys.stderr)
+            return 1
+        return 0
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -710,6 +710,20 @@ def main() -> int:
                          "bit-identical to slab-off direct search). "
                          "perf_gate zero-tolerates the parity and "
                          "the structural invariants")
+    ap.add_argument("--ab-tiled", action="store_true",
+                    help="measure the round-21 tiled scorer: drive "
+                         "wide single-request batches (64/128/256 "
+                         "queries, each atomic -> one coalesced "
+                         "device batch) through throwaway cache-off "
+                         "servers with TFIDF_TPU_SCORE_TILING off "
+                         "(the legacy serial 64-query block split) "
+                         "then on, and embed a 'tiling' artifact "
+                         "object — per-width latency both ways, the "
+                         "widest-width speedup, and a parity verdict "
+                         "(tiled served rows bit-identical to the "
+                         "block-split pass at EVERY width). perf_gate "
+                         "zero-tolerates the parity; exit 1 on any "
+                         "divergence")
     ap.add_argument("--chaos", metavar="PLAN", default=None,
                     help="arm this fault-injection plan for the whole "
                          "load (grammar in tfidf_tpu/faults.py, e.g. "
@@ -778,7 +792,8 @@ def main() -> int:
     from tfidf_tpu import obs
     from tfidf_tpu.config import PipelineConfig, ServeConfig, VocabMode
     from tfidf_tpu.models import TfidfRetriever
-    from tfidf_tpu.models.retrieval import _search_bcoo
+    from tfidf_tpu.models.retrieval import _search_bcoo, _search_tiled
+    from tfidf_tpu.ops.sparse import score_tiling
     from tfidf_tpu.serve import (Overloaded, PoisonQuery, ServeError,
                                  TfidfServer)
 
@@ -828,6 +843,11 @@ def main() -> int:
         if args.mesh_shards is not None:
             from tfidf_tpu.parallel.serving import mesh_search_cache_size
             compiled_programs = mesh_search_cache_size
+        elif score_tiling():
+            # Round 21: the tiled scan is the default search program —
+            # the recompile receipt must watch ITS cache, not the
+            # legacy untiled one's.
+            compiled_programs = _search_tiled._cache_size
         else:
             compiled_programs = _search_bcoo._cache_size
 
@@ -1067,6 +1087,87 @@ def main() -> int:
                          f"{slab_ab['p50_ms_on']:.3f} ms on "
                          f"({slab_ab['p50_delta']:+.1%})")
 
+        # Tiled-scoring receipt (--ab-tiled): wide SINGLE-request
+        # batches (each atomic, so the batcher coalesces exactly that
+        # width) through throwaway cache-off servers — tiling OFF
+        # (the legacy serial 64-query block split) then ON — BEFORE
+        # the main run. Cache off for the same reason as --ab-slab:
+        # the column being measured is the batched device path. The
+        # SAME pinned queries feed both passes at every width, so the
+        # parity verdict is a bit-compare of identical workloads.
+        tiled_ab = None
+        if args.ab_tiled and not args.chaos and args.mesh_shards is None:
+            ab_widths = [w for w in (64, 128, 256)
+                         if w <= max(args.max_batch, 256)]
+            pinned_tiled = {w: [draw() for _ in range(w)]
+                            for w in ab_widths}
+
+            def tiled_pass(tiling_on):
+                prior = os.environ.get("TFIDF_TPU_SCORE_TILING")
+                os.environ["TFIDF_TPU_SCORE_TILING"] = (
+                    "on" if tiling_on else "off")
+                try:
+                    ab_server = TfidfServer(retriever, ServeConfig(
+                        max_batch=max(args.max_batch, max(ab_widths)),
+                        max_wait_ms=args.max_wait_ms,
+                        queue_depth=max(args.queue_depth,
+                                        2 * max(ab_widths)),
+                        cache_entries=0,
+                        default_deadline_ms=args.deadline_ms))
+                    ab_server.mark_warm()
+                    lat_ms, rows = {}, {}
+                    for w in ab_widths:
+                        ab_server.submit(pinned_tiled[w], args.k,
+                                         use_cache=False
+                                         ).result(timeout=300)  # warm
+                        best = float("inf")
+                        for _ in range(3):
+                            t1 = time.perf_counter()
+                            got = ab_server.submit(
+                                pinned_tiled[w], args.k,
+                                use_cache=False).result(timeout=300)
+                            best = min(best,
+                                       time.perf_counter() - t1)
+                        lat_ms[w] = round(best * 1e3, 3)
+                        rows[w] = got
+                    ab_server.close(drain=True)
+                finally:
+                    if prior is None:
+                        os.environ.pop("TFIDF_TPU_SCORE_TILING", None)
+                    else:
+                        os.environ["TFIDF_TPU_SCORE_TILING"] = prior
+                return lat_ms, rows
+
+            off_lat, off_rows = tiled_pass(False)
+            on_lat, on_rows = tiled_pass(True)
+            parity = all(
+                np.array_equal(on_rows[w][0], off_rows[w][0])
+                and np.array_equal(on_rows[w][1], off_rows[w][1])
+                for w in ab_widths)
+            widest = ab_widths[-1]
+            tiled_ab = {
+                "parity_ok": int(parity),
+                "widths": ab_widths,
+                "lat_ms_off": {str(w): off_lat[w] for w in ab_widths},
+                "lat_ms_on": {str(w): on_lat[w] for w in ab_widths},
+                "speedup_widest": (round(off_lat[widest]
+                                         / on_lat[widest], 3)
+                                   if on_lat[widest] else None),
+            }
+            from tfidf_tpu.obs import devmon as obs_devmon3
+            obs_devmon3.set_watch(server.compile_watch)
+            log.info("serve_bench",
+                     msg=f"tiled A/B: parity "
+                         f"{'ok' if parity else 'MISMATCH'}; width "
+                         f"{widest}: {off_lat[widest]:.1f} ms block-"
+                         f"split -> {on_lat[widest]:.1f} ms tiled "
+                         f"({tiled_ab['speedup_widest']}x)")
+            # The throwaway passes compiled wide buckets and the
+            # off-path's legacy programs AFTER the main warm line —
+            # re-draw it so recompiles_after_warmup measures the main
+            # load only, as it does without --ab-tiled.
+            compiles_warm = compiled_programs()
+
         wall, n_shed, n_poisoned, n_failed, completed = drive(
             server, args.requests)
         shed = [n_shed]
@@ -1220,6 +1321,8 @@ def main() -> int:
                          f"({reqtrace_ab['p50_regression']:+.1%})")
         if slab_ab is not None:
             artifact["slab"] = slab_ab
+        if tiled_ab is not None:
+            artifact["tiling"] = tiled_ab
         if chaos is not None:
             artifact["chaos"] = chaos
         if mesh is not None:
@@ -1246,6 +1349,11 @@ def main() -> int:
             log.error("serve_bench_slab_parity",
                       msg="slab parity FAILED: slab-on served rows "
                           "diverge from slab-off direct search")
+            return 1
+        if tiled_ab is not None and not tiled_ab["parity_ok"]:
+            log.error("serve_bench_tiled_parity",
+                      msg="tiled parity FAILED: tiled served rows "
+                          "diverge from the block-split pass")
             return 1
         if chaos is not None and not chaos["parity_ok"]:
             log.error("serve_bench_chaos_parity",
