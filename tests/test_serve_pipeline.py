@@ -1,0 +1,425 @@
+"""Pipelined serve execution (round 22): the depth-D in-flight window.
+
+The pins, in the order the docstring of ``serve/batcher.py`` promises
+them:
+
+* one drain worker == batch-major resolution — batches resolve in
+  dispatch order no matter how the per-batch device latencies land;
+* responses are BIT-IDENTICAL to direct search at every depth (1, 2,
+  4), including when the supervisor's poison bisection runs at drain
+  time;
+* swap/close drain the window to zero — a batch admitted at epoch E
+  resolves against E, and ``close(drain=True)`` returns with nothing
+  in flight;
+* the slab ring pre-provisions ``pipeline_depth`` slots per bucket so
+  a full window never forces a mid-stream allocation;
+* the replica front's two-phase commit still waits out a non-empty
+  window before any replica flips;
+* heartbeat liveness (the satellite-3 fix): a dispatch worker parked
+  on a full window keeps beating, so a busy pipeline is never falsely
+  stalled — while a device silently wedged past ``stall_after_s``
+  still flips the monitor.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tfidf_tpu import PipelineConfig, ServeConfig, faults, obs
+from tfidf_tpu.config import VocabMode
+from tfidf_tpu.io.corpus import Corpus
+from tfidf_tpu.models import TfidfRetriever
+from tfidf_tpu.obs.health import (OK, UNHEALTHY, HealthMonitor,
+                                  HealthThresholds, set_monitor)
+from tfidf_tpu.obs.log import EventLog
+from tfidf_tpu.ops.queryslab import QuerySlab
+from tfidf_tpu.serve import MicroBatcher, PoisonQuery, TfidfServer
+
+CFG = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=512,
+                     max_doc_len=16, doc_chunk=16)
+CORPUS = Corpus(
+    names=["doc1", "doc2", "doc3", "doc4", "doc5"],
+    docs=[b"apple banana apple cherry",
+          b"banana banana date",
+          b"cherry date elder fig",
+          b"apple fig fig fig",
+          b"grape grape grape grape"])
+CORPUS_B = Corpus(
+    names=["doc1", "doc2", "doc3"],
+    docs=[b"zebra yak apple",
+          b"yak yak quokka",
+          b"quokka zebra grape"])
+QUERIES = ["apple cherry", "banana", "grape date", "fig", "elder",
+           "apple fig", "date banana cherry"]
+
+
+@pytest.fixture(scope="module")
+def retriever():
+    return TfidfRetriever(CFG).index(CORPUS)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults_and_obs():
+    obs.set_log(EventLog(echo="off"))
+    faults.disarm()
+    set_monitor(None)
+    yield
+    faults.disarm()
+    set_monitor(None)
+    obs.set_log(None)
+
+
+def _cfg(**kw):
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("max_wait_ms", 5)
+    kw.setdefault("queue_depth", 64)
+    kw.setdefault("cache_entries", 0)
+    return ServeConfig(**kw)
+
+
+def assert_identical(got, want):
+    gv, gi = got
+    wv, wi = want
+    np.testing.assert_array_equal(np.asarray(gv), np.asarray(wv))
+    np.testing.assert_array_equal(np.asarray(gi), np.asarray(wi))
+
+
+# ---------------------------------------------------------------------
+# A fake device with a controllable materialize: dispatch returns
+# instantly (the async-issue contract), the drain's materialize blocks
+# on the delay/gate — the timing envelope real jax dispatch has.
+def _rows(queries, k=2):
+    h = [sum(q.encode()) % 251 for q in queries]
+    vals = np.stack([np.arange(k, dtype=np.float32) + x for x in h])
+    ids = np.stack([(np.arange(k) + x) % 5 for x in h])
+    return vals, ids
+
+
+class _FakePending:
+    def __init__(self, queries, k, delay=0.0, gate=None):
+        self._queries, self._k = list(queries), k
+        self._delay, self._gate = delay, gate
+
+    def materialize(self):
+        if self._gate is not None:
+            assert self._gate.wait(timeout=30), "gate never opened"
+        if self._delay:
+            time.sleep(self._delay)
+        return _rows(self._queries, self._k)
+
+
+def _fake_batcher(depth, delays=None, gates=None, **kw):
+    """MicroBatcher over the fake device: per-dispatch delay/gate are
+    consumed in dispatch order."""
+    seq = []
+
+    def dispatch(queries, k, group):
+        i = len(seq)
+        seq.append(list(queries))
+        delay = delays[i % len(delays)] if delays else 0.0
+        gate = gates[i] if gates is not None else None
+        return _FakePending(queries, k, delay=delay, gate=gate)
+
+    def search(queries, k, group):
+        return _rows(queries, k)
+
+    b = MicroBatcher(search, pipeline_depth=depth, dispatch_fn=dispatch,
+                     **kw)
+    b.dispatched = seq
+    return b
+
+
+class TestDrainOrder:
+    def test_batch_major_resolution_under_jittered_device(self):
+        """Property: whatever per-batch device latencies the fake
+        draws, futures resolve strictly in dispatch order — one drain
+        worker IS the ordering proof."""
+        rng = np.random.default_rng(22)
+        delays = [float(d) for d in rng.uniform(0, 0.02, size=16)]
+        b = _fake_batcher(4, delays=delays, max_batch=4, max_wait_ms=1)
+        done = []
+        try:
+            futs = []
+            for i in range(16):
+                # Distinct groups: one request == one batch == one
+                # pipeline slot, so submit order is dispatch order.
+                f = b.submit([QUERIES[i % len(QUERIES)]], k=2, group=i)
+                f.add_done_callback(
+                    lambda fut, i=i: done.append(i))
+                futs.append(f)
+            for i, f in enumerate(futs):
+                assert_identical(f.result(timeout=30),
+                                 _rows([QUERIES[i % len(QUERIES)]], 2))
+        finally:
+            b.close()
+        assert done == sorted(done), done
+        assert len(done) == 16
+
+
+class TestDepthParity:
+    @pytest.mark.parametrize("depth", [1, 2, 4])
+    def test_served_equals_direct_search(self, retriever, depth):
+        with TfidfServer(retriever, _cfg(pipeline_depth=depth)) as srv:
+            for size in (1, 2, 3, 5, 7):
+                qs = QUERIES[:size]
+                assert_identical(srv.search(qs, k=4),
+                                 retriever.search(qs, k=4))
+            # A concurrent burst keeps the window genuinely full.
+            futs = [srv.submit([q], k=3) for q in QUERIES]
+            for f, q in zip(futs, QUERIES):
+                assert_identical(f.result(timeout=30),
+                                 retriever.search([q], k=3))
+
+    @pytest.mark.parametrize("depth", [1, 2, 4])
+    def test_poison_bisection_at_drain(self, retriever, depth):
+        """The supervisor story is depth-invariant: a poison query is
+        isolated by the drain-time bisection, its co-batched innocents
+        still resolve bit-identically, and the quarantine gate fails
+        the resubmit fast."""
+        faults.arm(faults.FaultPlan.parse(
+            "device_dispatch:fatal:match=zzpoison"))
+        srv = TfidfServer(retriever, _cfg(pipeline_depth=depth,
+                                          max_wait_ms=40))
+        try:
+            futs = {q: srv.submit([q], k=3) for q in
+                    [QUERIES[0], "zzpoison attack", QUERIES[1]]}
+            with pytest.raises(PoisonQuery) as ei:
+                futs["zzpoison attack"].result(timeout=30)
+            assert ei.value.queries == ["zzpoison attack"]
+            for q in (QUERIES[0], QUERIES[1]):
+                assert_identical(futs[q].result(timeout=30),
+                                 retriever.search([q], k=3))
+            with pytest.raises(PoisonQuery):
+                srv.submit(["zzpoison attack"], k=3)
+        finally:
+            srv.close()
+
+
+class TestWindowLifecycle:
+    def test_close_drains_window_to_zero(self):
+        """close(drain=True) with dispatched-but-unmaterialized
+        batches: every future resolves, nothing is left in flight."""
+        gates = [threading.Event() for _ in range(3)]
+        b = _fake_batcher(2, gates=gates, max_batch=4, max_wait_ms=1)
+        futs = [b.submit([QUERIES[i]], k=2, group=i) for i in range(3)]
+        deadline = time.monotonic() + 10
+        while (b.inflight_batches() < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        assert b.inflight_batches() == 2  # window capped at depth
+
+        def open_gates():
+            time.sleep(0.05)
+            for g in gates:
+                g.set()
+
+        threading.Thread(target=open_gates, daemon=True).start()
+        b.close(drain=True)  # blocks through the gated materializes
+        assert b.inflight_batches() == 0
+        for i, f in enumerate(futs):
+            assert_identical(f.result(timeout=0), _rows([QUERIES[i]], 2))
+
+    def test_swap_pins_admitted_epoch(self, retriever):
+        """Queries admitted before a hot swap resolve against the OLD
+        index even when they execute after it — the group snapshot
+        rides the in-flight entry."""
+        new = TfidfRetriever(CFG).index(CORPUS_B)
+        srv = TfidfServer(retriever, _cfg(pipeline_depth=2,
+                                          max_wait_ms=100))
+        try:
+            futs = [srv.submit([q], k=2) for q in QUERIES[:4]]
+            assert srv.swap_index(new) == 1  # races the queued burst
+            for f, q in zip(futs, QUERIES[:4]):
+                assert_identical(f.result(timeout=30),
+                                 retriever.search([q], k=2))
+            assert_identical(srv.search(["zebra yak"], k=2),
+                             new.search(["zebra yak"], k=2))
+        finally:
+            srv.close()
+
+
+class TestSlabDepthGuard:
+    def test_min_depth_preprovisions_ring(self):
+        slab = QuerySlab(64, max_bucket=8, min_depth=2)
+        b0, _, s0 = slab.checkout(4)
+        assert slab.ring_depth(4) == 2      # first touch: DEPTH slots
+        assert slab.stats()["allocs"] == 2
+        b1, _, s1 = slab.checkout(4)        # window full: no growth
+        assert b1 is not b0
+        assert slab.stats()["allocs"] == 2
+        slab.checkout(4)                    # beyond depth: grows by 1
+        assert slab.stats()["allocs"] == 3
+        slab.release(s0)
+        slab.release(s1)
+
+    def test_reserve_raises_depth_on_touched_rings(self):
+        slab = QuerySlab(64, max_bucket=8)
+        _, _, s = slab.checkout(4)
+        slab.release(s)
+        assert slab.ring_depth(4) == 1      # legacy single-slot start
+        slab.reserve(3)
+        assert slab.min_depth == 3
+        assert slab.ring_depth(4) == 3      # touched ring topped up
+        slab.checkout(8)
+        assert slab.ring_depth(8) == 3      # new rings born at depth
+        with pytest.raises(ValueError):
+            slab.reserve(0)
+        with pytest.raises(ValueError):
+            QuerySlab(64, max_bucket=8, min_depth=0)
+
+    def test_server_wires_pipeline_depth_into_slab(self):
+        r = TfidfRetriever(CFG).index(CORPUS)
+        srv = TfidfServer(r, _cfg(pipeline_depth=3))
+        try:
+            assert r.slab_depth == 3
+            srv.search(QUERIES[:2], k=3)    # touches the 2-bucket ring
+            assert r._slab is not None
+            assert r._slab.min_depth >= 3
+            assert r._slab.ring_depth(2) >= 3
+        finally:
+            srv.close()
+
+    def test_full_window_steady_state_allocs_zero(self):
+        """The acceptance receipt at unit scale: with the ring
+        pre-provisioned to the pipeline depth, a full window of
+        batches allocates nothing after warm-up."""
+        r = TfidfRetriever(CFG).index(CORPUS)
+        srv = TfidfServer(r, _cfg(pipeline_depth=2, max_wait_ms=1))
+        try:
+            for n in (1, 2, 4):             # warm every bucket the
+                srv.search(QUERIES[:n], k=3)  # burst below can land in
+            a0 = r._slab.stats()["allocs"]
+            for _ in range(4):
+                futs = [srv.submit([q], k=3) for q in QUERIES[:4]]
+                for f in futs:
+                    f.result(timeout=30)
+            assert r._slab.stats()["allocs"] == a0
+        finally:
+            srv.close()
+
+
+class TestFrontTwoPhaseWindow:
+    def test_commit_waits_out_nonempty_window(self, tmp_path):
+        """The mixed-epoch pin with the pipeline window live: the
+        front's commit round must not start while any prepared replica
+        still has in-flight work (futures resolve at drain, so the
+        per-replica inflight count covers dispatched batches too)."""
+        from tfidf_tpu.serve.front import ReplicatedFront
+        serve_cfg = ServeConfig(snapshot_dir=str(tmp_path / "snap"),
+                                replicas=3)
+        pipe_cfg = PipelineConfig(vocab_mode=VocabMode.HASHED,
+                                  vocab_size=4096, max_doc_len=64)
+        front = ReplicatedFront(str(tmp_path), pipe_cfg, serve_cfg)
+        ops = []
+
+        def fake_rpc(rank, msg, **kw):
+            ops.append((msg["op"], rank))
+            # Commit acks carry the installed epoch (prepare/ping
+            # messages name the target; commit must answer with it).
+            return {"ok": True,
+                    "epoch": msg.get("epoch", front._epoch + 1)}
+
+        try:
+            for rep in front._replicas.values():
+                rep.state = "live"
+            front._ctrl_rpc = fake_rpc
+            front._replicas[1].inflight = 2   # a non-empty window
+            result = {}
+            t = threading.Thread(
+                target=lambda: result.update(
+                    front._two_phase("compact", {})), daemon=True)
+            t.start()
+            deadline = time.monotonic() + 10
+            while (sum(1 for op, _ in ops if op == "ping") < 3
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            # Prepared + pinged everywhere; the gate is closed and the
+            # commit round is parked behind the in-flight drain.
+            time.sleep(0.1)
+            assert not any(op == "commit" for op, _ in ops)
+            assert not front._admission.is_set()
+            with front._lock:
+                front._replicas[1].inflight = 0
+            t.join(timeout=10)
+            assert not t.is_alive()
+            assert sum(1 for op, _ in ops if op == "commit") == 3
+            assert result["epoch"] == 1 and front._epoch == 1
+            assert front._admission.is_set()  # gate reopened
+            # Strict phase ordering: every prepare and ping precedes
+            # every commit.
+            last_ping = max(i for i, (op, _) in enumerate(ops)
+                            if op in ("prepare", "ping"))
+            first_commit = min(i for i, (op, _) in enumerate(ops)
+                               if op == "commit")
+            assert last_ping < first_commit
+        finally:
+            for rep in front._replicas.values():
+                rep.state = "dead"  # close() must not RPC the fakes
+            front.close()
+
+
+class TestHeartbeatLiveness:
+    def _batcher_with_monitor(self, gates, stall_s):
+        # Monitor first: the batcher's threads beat the moment they
+        # start (heartbeat auto-registers; register() then installs
+        # the real busy_fn idempotently — the server wiring order).
+        m = HealthMonitor(thresholds=HealthThresholds(
+            stall_after_s=stall_s))
+        b = _fake_batcher(2, gates=gates, max_batch=4, max_wait_ms=1,
+                          heartbeat=lambda: m.heartbeat("batcher"))
+        m.register("batcher", busy_fn=lambda: (
+            b.queued_queries() > 0 or b.inflight_batches() > 0))
+        m.heartbeat("batcher")
+        return b, m
+
+    def test_full_window_wait_keeps_beating(self):
+        """Satellite 3: a dispatch worker parked on a FULL window with
+        work queued behind it keeps heartbeating — a healthy pipeline
+        crunching a slow device is busy, not stalled."""
+        gates = [threading.Event() for _ in range(4)]
+        b, m = self._batcher_with_monitor(gates, stall_s=0.25)
+        try:
+            futs = [b.submit([QUERIES[i]], k=2, group=i)
+                    for i in range(4)]
+            time.sleep(0.6)  # > 2 stall windows, gates still shut
+            assert b.inflight_batches() == 2
+            assert b.queued_queries() > 0    # genuinely busy
+            assert m.evaluate().state == OK  # ... and genuinely live
+            for g in gates:
+                g.set()
+            for i, f in enumerate(futs):
+                assert_identical(f.result(timeout=30),
+                                 _rows([QUERIES[i]], 2))
+            assert m.evaluate().state == OK
+        finally:
+            b.close()
+
+    def test_wedged_device_still_flags_after_threshold(self):
+        """The other half of the pin: liveness is not unconditional.
+        A drain blocked in materialize past ``stall_after_s`` with no
+        dispatch activity left to beat flips the monitor — and the
+        first drained batch recovers it."""
+        gates = [threading.Event()]
+        b, m = self._batcher_with_monitor(gates, stall_s=0.15)
+        try:
+            f = b.submit([QUERIES[0]], k=2)
+            deadline = time.monotonic() + 5
+            state = None
+            while time.monotonic() < deadline:
+                state = m.evaluate().state
+                if state == UNHEALTHY:
+                    break
+                time.sleep(0.02)
+            assert state == UNHEALTHY
+            gates[0].set()
+            assert_identical(f.result(timeout=30),
+                             _rows([QUERIES[0]], 2))
+            deadline = time.monotonic() + 5
+            while (m.evaluate().state != OK
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            assert m.evaluate().state == OK
+        finally:
+            b.close()

@@ -450,6 +450,18 @@ def _build_parser() -> argparse.ArgumentParser:
                          "--ab-slab measures it). 'off' forces the "
                          "legacy per-batch allocation, bit-identical "
                          "(default on; env TFIDF_TPU_QUERY_SLAB)")
+    sv.add_argument("--serve-pipeline-depth", type=int, default=None,
+                    metavar="D",
+                    help="pipelined serve execution: up to D dispatched "
+                         "batches stay in flight while the batcher "
+                         "coalesces the next — a dispatch stage issues "
+                         "the async search + D2H copy and an ordered "
+                         "drain worker materializes results batch-"
+                         "major, so the device never idles between "
+                         "dispatches. 1 = unpipelined legacy path, "
+                         "bit-identical responses at every depth "
+                         "(default 2; env TFIDF_TPU_SERVE_PIPELINE; "
+                         "docs/SERVING.md 'Pipelined execution')")
     sv.add_argument("--score-tiling", choices=["on", "off"], default=None,
                     help="tiled sparse scoring: the document axis is "
                          "chunked into fixed tiles scored against the "
@@ -1202,6 +1214,7 @@ def _run_serve(args) -> int:
         mesh_shards=args.mesh_shards,
         query_slab=(None if args.query_slab is None
                     else args.query_slab == "on"),
+        pipeline_depth=args.serve_pipeline_depth,
         replicas=args.replicas,
         replica_timeout_s=args.replica_timeout_s)
 

@@ -348,6 +348,17 @@ class ServeConfig:
         (swap, mutation, restore) re-shards through the same
         transform. None = classic single-device serving. CLI
         ``--mesh-shards`` / env ``TFIDF_TPU_MESH_SHARDS``.
+      pipeline_depth: pipelined serve execution (round 22): the
+        batcher's bounded in-flight window — up to this many
+        dispatched batches overlap with coalescing and with each
+        other's drains (one ordered drain worker materializes results
+        batch-major), so the device never idles between dispatches.
+        1 = the bit-identical legacy path (dispatch and materialize
+        one batch at a time, no drain worker). Default 2: one batch
+        in flight while the next forms closes the pipeline bubble
+        tiling/slab left, and responses stay bit-identical at every
+        depth (docs/SERVING.md "Pipelined execution"). CLI
+        ``--serve-pipeline-depth`` / env ``TFIDF_TPU_SERVE_PIPELINE``.
       replicas: run the REPLICATED serving tier: N worker processes
         each owning a full :class:`TfidfServer`, behind one in-process
         front that hash-routes queries (cache affinity) and drives
@@ -387,6 +398,7 @@ class ServeConfig:
     compact_at: int = 4
     mesh_shards: Optional[int] = None
     query_slab: Optional[bool] = None
+    pipeline_depth: int = 2
     replicas: Optional[int] = None
     replica_timeout_s: float = 120.0
 
@@ -441,6 +453,9 @@ class ServeConfig:
         if self.mesh_shards is not None and self.mesh_shards < 0:
             raise ValueError("mesh_shards must be >= 0 (0 = all "
                              "devices; None disables mesh serving)")
+        if self.pipeline_depth < 1:
+            raise ValueError("pipeline_depth must be >= 1 "
+                             "(1 = unpipelined legacy execution)")
         if self.replicas is not None and self.replicas < 1:
             raise ValueError("replicas must be >= 1 "
                              "(None disables the replicated front)")
@@ -488,6 +503,7 @@ class ServeConfig:
                 ("delta_docs", "TFIDF_TPU_DELTA_DOCS", int),
                 ("compact_at", "TFIDF_TPU_COMPACT_AT", int),
                 ("mesh_shards", "TFIDF_TPU_MESH_SHARDS", int),
+                ("pipeline_depth", "TFIDF_TPU_SERVE_PIPELINE", int),
                 ("replicas", "TFIDF_TPU_REPLICAS", int),
                 ("replica_timeout_s", "TFIDF_TPU_REPLICA_TIMEOUT_S",
                  float),

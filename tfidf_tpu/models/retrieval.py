@@ -115,6 +115,67 @@ def _search_bcoo(data, cols, qmat, *, k: int):
 _LEGACY_QUERY_BLOCK = 64
 
 
+def _start_d2h(*arrays) -> None:
+    """Kick off the device-to-host copy of each result array without
+    blocking (``jax.Array.copy_to_host_async``). Values that are
+    already host arrays (the resolved-fallback paths) simply lack the
+    method and are skipped; a backend that cannot start the copy early
+    still materializes correctly at the blocking read."""
+    for a in arrays:
+        start = getattr(a, "copy_to_host_async", None)
+        if start is not None:
+            try:
+                start()
+            except RuntimeError:
+                pass
+
+
+class PendingSearch:
+    """A dispatched-but-unmaterialized search (round 22).
+
+    The handle the pipelined serve path overlaps on: the dispatch
+    stage (:meth:`TfidfRetriever.search_async`) has already staged the
+    query block, issued the jitted program, and started the D2H copy;
+    :meth:`materialize` blocks on the result words, releases the slab
+    slot, and applies the same trim/mask tail ``search`` always
+    applied — so ``search_async(q, k).materialize()`` is bit-identical
+    to the synchronous path by construction (it IS the synchronous
+    path).
+
+    Device failures (a poisoned dispatch, an injected fault, a real
+    XLA error) surface at ``materialize()`` — the drain-time seam the
+    batcher's supervisor hooks. A handle materializes at most once;
+    callers that need the rows twice keep the returned pair.
+    """
+
+    __slots__ = ("_materialize", "_result")
+
+    def __init__(self, materialize=None, result=None):
+        self._materialize = materialize
+        self._result = result
+
+    @classmethod
+    def resolved(cls, vals, ids) -> "PendingSearch":
+        """An already-materialized handle — the eager fallback for
+        paths that cannot defer (legacy block split, duck-typed
+        retrievers without a dispatch stage)."""
+        return cls(result=(vals, ids))
+
+    @property
+    def done(self) -> bool:
+        return self._result is not None
+
+    def materialize(self) -> Tuple[np.ndarray, np.ndarray]:
+        if self._result is None:
+            fn, self._materialize = self._materialize, None
+            if fn is None:
+                raise RuntimeError(
+                    "PendingSearch already failed to materialize — "
+                    "re-dispatch instead of re-reading")
+            self._result = fn()
+        return self._result
+
+
 @functools.partial(jax.jit, static_argnames=("k", "tile", "method"))
 def _search_tiled(ids, weights, head, qmat, *, k: int, tile: int,
                   method: str):
@@ -289,6 +350,11 @@ class TfidfRetriever:
         # staging slab, and the cached host IDF the slab fill reads
         # (one D2H per index install instead of one per search).
         self.query_slab: Optional[bool] = None
+        # Pipelined serving (round 22): the server pushes its
+        # pipeline depth here so the slab pre-provisions that many
+        # slots per ring — ``depth`` batches can be staged-and-in-
+        # flight at once without a mid-stream allocation.
+        self.slab_depth: int = 1
         self._slab = None
         self._idf_np: Optional[np.ndarray] = None
         self._idf_src = None
@@ -476,7 +542,10 @@ class TfidfRetriever:
             cap = max(1, int(os.environ.get("TFIDF_TPU_MAX_BATCH",
                                             "256") or "256"))
             self._slab = QuerySlab(self.config.vocab_size,
-                                   max_bucket=cap)
+                                   max_bucket=cap,
+                                   min_depth=max(1, self.slab_depth))
+        elif self._slab.min_depth < self.slab_depth:
+            self._slab.reserve(self.slab_depth)
         return self._slab
 
     def search(self, queries: Sequence[Union[str, bytes]], k: int = 10
@@ -487,6 +556,30 @@ class TfidfRetriever:
         ``doc_indices`` index into :attr:`names`; -1 marks padding when
         fewer than k documents score. Scores are cosine similarities;
         padded/empty matches score 0.
+
+        One implementation with :meth:`search_async` — this is the
+        dispatch stage plus an immediate materialization, so the
+        pipelined serve path and the synchronous path can never
+        diverge by a byte.
+        """
+        return self.search_async(queries, k).materialize()
+
+    def search_async(self, queries: Sequence[Union[str, bytes]],
+                     k: int = 10) -> "PendingSearch":
+        """Dispatch stage of :meth:`search` (round 22): stage the
+        query block, issue the (async) jitted search, start the D2H
+        copy of the result words, and return WITHOUT blocking. The
+        returned :class:`PendingSearch`'s ``materialize()`` blocks on
+        the transfer, releases the slab slot (slot release stays keyed
+        to result materialization — the reuse-safety guard), and
+        applies the same trim/mask tail as ``search``.
+
+        Device errors surface at ``materialize()`` — jax defers them
+        to the first host read — which is exactly where the pipelined
+        batcher's drain-time supervision catches them. Paths that
+        cannot defer (the legacy >64-query block split, which recurses
+        through synchronous searches) return an already-resolved
+        handle; callers need no special case.
         """
         if not self.indexed:
             raise RuntimeError("index() a corpus before search()")
@@ -505,8 +598,9 @@ class TfidfRetriever:
             parts = [self.search(queries[s:s + _LEGACY_QUERY_BLOCK], k)
                      for s in range(0, len(queries),
                                     _LEGACY_QUERY_BLOCK)]
-            return (np.concatenate([p[0] for p in parts]),
-                    np.concatenate([p[1] for p in parts]))
+            return PendingSearch.resolved(
+                np.concatenate([p[0] for p in parts]),
+                np.concatenate([p[1] for p in parts]))
         # Query-count bucketing: the compiled search program is shaped
         # by Q, so ad-hoc repeated searches at arbitrary query counts
         # would re-jit per count. Padding Q to the next power of two
@@ -515,6 +609,12 @@ class TfidfRetriever:
         # score 0 everywhere and their rows are dropped before return.
         nq = len(queries)
         bucket = 1 << max(0, nq - 1).bit_length()
+        # Deferred cleanup for the materialization stage: the donated
+        # device block to delete and the slab slot to release once the
+        # result rows are back on the host.
+        qmat_live = None
+        slab_slot = None
+        slab_ref = None
         if self.plan is not None:
             qmat = jnp.asarray(self._query_matrix(queries,
                                                   pad_to=bucket))
@@ -572,11 +672,16 @@ class TfidfRetriever:
                         qmat = jax.device_put(buf)
                     slab.note_h2d(buf.nbytes)
                     vals, idx = dispatch(qmat)
-                    vals = np.asarray(vals)
-                    idx = np.asarray(idx)
-                    qmat.delete()
-                finally:
+                except BaseException:
+                    # Dispatch-stage failure: nothing in flight, so
+                    # the slot frees immediately instead of leaking.
                     slab.release(slot)
+                    raise
+                # Slot release stays keyed to RESULT materialization
+                # (host rows back == the H2D copy provably consumed),
+                # now deferred into the PendingSearch below.
+                qmat_live = qmat
+                slab_ref, slab_slot = slab, slot
             else:
                 # Oversize-batch fallback (bucket past the slab's
                 # ring shapes — a raised TFIDF_TPU_MAX_BATCH) or
@@ -593,15 +698,36 @@ class TfidfRetriever:
                     "search_tiled" if tiled else "search_bcoo",
                     queries=int(bucket), k=kk, docs=rows,
                     dtype="float32")
-        # Both paths produce >= min(k, num_docs) sorted columns (the
-        # sharded one up to min(k, local_k * n_shards)); trim to the
-        # path-independent width so callers see the same shape. Rows
-        # past nq are the bucketing pad — dropped first.
-        width = min(k, self._num_docs)
-        vals = np.asarray(vals)[:nq, :width]
-        idx = np.asarray(idx)[:nq, :width]
-        ok = (vals > 0) & (idx < self._num_docs)
-        return np.where(ok, vals, 0.0), np.where(ok, idx, -1)
+        # Start the D2H transfer NOW (jax runs it concurrently with
+        # whatever the host does next); the blocking np.asarray moves
+        # into materialize(). Snapshot num_docs at dispatch time so a
+        # racing index install cannot skew the trim/mask of a batch
+        # already in flight.
+        _start_d2h(vals, idx)
+        num_docs = self._num_docs
+        width = min(k, num_docs)
+
+        def materialize(vals=vals, idx=idx):
+            # Both paths produce >= min(k, num_docs) sorted columns
+            # (the sharded one up to min(k, local_k * n_shards)); trim
+            # to the path-independent width so callers see the same
+            # shape. Rows past nq are the bucketing pad — dropped
+            # first.
+            try:
+                v = np.asarray(vals)[:nq, :width]
+                i = np.asarray(idx)[:nq, :width]
+            finally:
+                if qmat_live is not None:
+                    try:
+                        qmat_live.delete()
+                    except RuntimeError:
+                        pass  # already deleted with a failed dispatch
+                if slab_ref is not None:
+                    slab_ref.release(slab_slot)
+            ok = (v > 0) & (i < num_docs)
+            return np.where(ok, v, 0.0), np.where(ok, i, -1)
+
+        return PendingSearch(materialize)
 
     def _sharded_fn(self, k: int):
         if k not in self._sharded_cache:

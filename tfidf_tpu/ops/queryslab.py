@@ -60,13 +60,22 @@ class QuerySlab:
     searches at the same bucket stage through distinct buffers.
     """
 
-    def __init__(self, vocab_size: int, max_bucket: int):
+    def __init__(self, vocab_size: int, max_bucket: int,
+                 min_depth: int = 1):
         if max_bucket < 1:
             raise ValueError("max_bucket must be >= 1")
+        if min_depth < 1:
+            raise ValueError("min_depth must be >= 1")
         self.vocab_size = int(vocab_size)
         # Next pow2 at or above the query-block bound, so every bucket
         # the search path can produce has a ring.
         self.max_bucket = 1 << max(0, int(max_bucket) - 1).bit_length()
+        # Pipelined serving (round 22) keeps up to ``pipeline_depth``
+        # batches checked out at once; a ring provisioned to that
+        # depth on FIRST touch makes the concurrent steady state
+        # allocation-free too (allocs stays flat after warm-up even
+        # with the window full).
+        self.min_depth = int(min_depth)
         self._lock = threading.Lock()
         self._free: Dict[int, collections.deque] = {}
         self._slots: Dict[int, List[Tuple[np.ndarray, np.ndarray]]] = {}
@@ -90,17 +99,39 @@ class QuerySlab:
         with self._lock:
             free = self._free.setdefault(bucket, collections.deque())
             slots = self._slots.setdefault(bucket, [])
+            if not slots:
+                self._top_up(bucket, self.min_depth)
             if free:
                 idx = free.popleft()
             else:
-                slots.append((
-                    np.zeros((self.vocab_size, bucket), np.float32),
-                    np.zeros((self.vocab_size,), np.float32)))
-                idx = len(slots) - 1
-                self.allocs += 1
+                self._top_up(bucket, len(slots) + 1)
+                idx = free.popleft()
             self.packs += 1
             buf, scratch = slots[idx]
         return buf, scratch, (bucket, idx)
+
+    def _top_up(self, bucket: int, depth: int) -> None:
+        """Grow the bucket's ring to ``depth`` slots (lock held)."""
+        free = self._free[bucket]
+        slots = self._slots[bucket]
+        while len(slots) < depth:
+            slots.append((
+                np.zeros((self.vocab_size, bucket), np.float32),
+                np.zeros((self.vocab_size,), np.float32)))
+            free.append(len(slots) - 1)
+            self.allocs += 1
+
+    def reserve(self, depth: int) -> None:
+        """Raise :attr:`min_depth` to ``depth`` and top every
+        already-touched ring up to it — the serve layer calls this
+        with its pipeline depth so the in-flight window never forces
+        a mid-stream allocation."""
+        if depth < 1:
+            raise ValueError("depth must be >= 1")
+        with self._lock:
+            self.min_depth = max(self.min_depth, int(depth))
+            for bucket in self._slots:
+                self._top_up(bucket, self.min_depth)
 
     def release(self, slot) -> None:
         bucket, idx = slot

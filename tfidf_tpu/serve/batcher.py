@@ -36,6 +36,29 @@ device call itself gets bounded retry and poison-query bisection: a
 batch that fails persistently is split until the poison queries are
 isolated (their requests fail with the typed :class:`PoisonQuery`),
 and every innocent co-batched request still resolves bit-identically.
+
+Pipelined execution (round 22): with ``pipeline_depth >= 2`` batch
+execution splits into two stages so the device never idles between
+dispatches. The batcher thread becomes a pure DISPATCH stage — it
+fills the slab slot, issues the (already-async) jitted search plus
+the D2H copy of the result words through ``dispatch_fn`` (a
+``(queries, k, group) -> PendingSearch``), and immediately returns to
+coalescing the next batch. A single ordered DRAIN worker (the ingest
+``_DrainAhead`` discipline: one worker = batch-major resolution)
+materializes results FIFO, releases slab slots, and resolves futures.
+The in-flight window is bounded at ``pipeline_depth`` batches; the
+dispatch stage blocks (heartbeating) when it is full. Failures
+surface at the drain stage — jax defers device errors to the first
+host read — so the supervisor's retry/breaker/bisection machinery
+runs AT DRAIN TIME (``SupervisedDispatch.run_batch``'s ``first``
+seam), re-dispatching through the same ordered window: batches
+dispatched after a failing one drain after its recovery completes,
+never reordered. Responses are bit-identical to direct search at
+every depth (the dispatch stage and the synchronous path share one
+implementation — ``TfidfRetriever.search_async``), and a batch
+admitted at epoch E resolves against E: the ``group`` snapshot rides
+the in-flight entry. ``pipeline_depth=1`` keeps the legacy one-stage
+``_execute`` path, byte for byte.
 """
 
 from __future__ import annotations
@@ -90,6 +113,40 @@ def _pow2(n: int) -> int:
     return 1 << max(0, n - 1).bit_length()
 
 
+class _Resolved:
+    """Already-materialized stand-in for a ``PendingSearch`` — wraps a
+    synchronous ``search_fn`` result so the pipelined machinery has
+    one drain path whether or not the dispatch could defer."""
+
+    __slots__ = ("_r",)
+
+    def __init__(self, result):
+        self._r = result
+
+    def materialize(self):
+        return self._r
+
+
+class _InFlight:
+    """One dispatched-but-undrained batch in the pipeline window."""
+
+    __slots__ = ("bid", "live", "queries", "offsets", "rids", "pending",
+                 "error", "t_formed", "t_dev0", "span", "dev")
+
+    def __init__(self, bid, live, queries, offsets, rids):
+        self.bid = bid
+        self.live = live            # _Pending entries riding the batch
+        self.queries = queries
+        self.offsets = offsets
+        self.rids = rids
+        self.pending = None         # PendingSearch-shaped handle
+        self.error = None           # dispatch-stage failure, deferred
+        self.t_formed = 0.0
+        self.t_dev0 = 0.0
+        self.span = None            # open "batched" span handle
+        self.dev = None             # open "device" span handle
+
+
 class _Pending:
     __slots__ = ("queries", "k", "group", "future", "deadline",
                  "enqueued_at", "obs", "ctx")
@@ -142,6 +199,17 @@ class MicroBatcher:
         immediately).
       restart_backoff_ms: base of the jittered exponential backoff
         between loop restarts.
+      pipeline_depth: bounded in-flight window (round 22) — up to
+        this many dispatched batches overlap with coalescing and
+        with each other's drains. 1 (the default here; the server
+        config defaults to 2) keeps the legacy single-stage path.
+      dispatch_fn: ``(queries, k, group) -> PendingSearch`` — the
+        async dispatch stage (the server binds
+        ``TfidfRetriever.search_async``). Only consulted at
+        ``pipeline_depth >= 2``; absent, the pipeline still runs its
+        staged machinery over the synchronous ``search_fn`` (no
+        device overlap, same ordering/recovery semantics — the
+        duck-typed fallback for retrievers without a dispatch stage).
     """
 
     def __init__(self, search_fn: Callable, *, max_batch: int = 64,
@@ -149,6 +217,8 @@ class MicroBatcher:
                  heartbeat: Optional[Callable[[], None]] = None,
                  supervisor=None, restart_budget: int = 3,
                  restart_backoff_ms: float = 50.0,
+                 pipeline_depth: int = 1,
+                 dispatch_fn: Optional[Callable] = None,
                  thread_name: str = "tfidf-serve-batcher") -> None:
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
@@ -156,9 +226,13 @@ class MicroBatcher:
             raise ValueError("max_wait_ms must be >= 0")
         if restart_budget < 0:
             raise ValueError("restart_budget must be >= 0")
+        if pipeline_depth < 1:
+            raise ValueError("pipeline_depth must be >= 1")
         self._search_fn = search_fn
+        self._dispatch_fn = dispatch_fn
         self.max_batch = max_batch
         self.max_wait = max_wait_ms / 1e3
+        self.pipeline_depth = pipeline_depth
         self._metrics = metrics
         self._heartbeat = heartbeat
         self._supervisor = supervisor
@@ -171,6 +245,26 @@ class MicroBatcher:
         self._cond = threading.Condition()
         self._closed = False
         self._drain_on_close = True
+        # Pipelined window state, all under _icond: the in-flight ring
+        # the dispatch stage appends to and the drain worker pops
+        # FIFO. A separate condition from _cond so a full window never
+        # contends with the submit path.
+        self._icond = threading.Condition()
+        self._inflight: Deque[_InFlight] = deque()
+        self._drain_stop = False
+        self._pipe_streak = False   # batcher thread only: bubble det.
+        self._inflight_gauge = None
+        self._drainer: Optional[threading.Thread] = None
+        if pipeline_depth > 1:
+            if metrics is not None:
+                self._inflight_gauge = metrics.registry.gauge(
+                    "serve_inflight_batches",
+                    "dispatched batches not yet drained (the "
+                    "pipelined execution window)")
+            self._drainer = threading.Thread(
+                target=self._drain_run, daemon=True,
+                name=thread_name + "-drain")
+            self._drainer.start()
         self._worker = threading.Thread(target=self._run, daemon=True,
                                         name=thread_name)
         self._worker.start()
@@ -202,6 +296,12 @@ class MicroBatcher:
         with self._cond:
             return sum(len(p.queries) for p in self._queue)
 
+    def inflight_batches(self) -> int:
+        """Dispatched-but-undrained batches in the pipeline window
+        (always 0 at depth 1 — execution is single-stage there)."""
+        with self._icond:
+            return len(self._inflight)
+
     # --- worker side ---
     def _take_batch(self) -> Optional[List[_Pending]]:
         """Block until a batch is due under the deadline policy, then
@@ -213,6 +313,10 @@ class MicroBatcher:
                 if not self._queue:
                     if self._closed:
                         return None
+                    # Going idle ends a pipelined burst: the next
+                    # dispatch onto an empty window is a fresh start,
+                    # not a bubble (batcher thread only).
+                    self._pipe_streak = False
                     self._cond.wait()
                     continue
                 head = self._queue[0]
@@ -302,12 +406,16 @@ class MicroBatcher:
             batch = self._take_batch()
             if batch is None:
                 return
-            self._execute(batch)
+            if self.pipeline_depth > 1:
+                self._dispatch(batch)
+            else:
+                self._execute(batch)
             if self._heartbeat is not None:
                 self._heartbeat()
 
-    def _execute(self, batch: List[_Pending]) -> None:
-        obs.name_thread("batcher")
+    def _screen(self, batch: List[_Pending]) -> List[_Pending]:
+        """Shed entries a formed batch can no longer serve (closing
+        without drain, expired deadline); returns the live rest."""
         now = time.monotonic()
         live: List[_Pending] = []
         for p in batch:
@@ -323,8 +431,11 @@ class MicroBatcher:
                     f"the batch formed"))
             else:
                 live.append(p)
-        if not live:
-            return
+        return live
+
+    def _form(self, live: List[_Pending]):
+        """Assign the batch id, close the queued spans, flatten the
+        requests: -> (bid, t_formed, queries, offsets, rids)."""
         bid = self._batch_seq
         self._batch_seq += 1
         t_formed = time.monotonic()
@@ -340,11 +451,43 @@ class MicroBatcher:
             queries.extend(p.queries)
             offsets.append(len(queries))
         rids = [p.ctx.rid for p in live if p.ctx is not None]
-        span_extra = {"rids": rids} if rids else {}
         for p in live:
             if p.ctx is not None:
                 p.ctx.batch = bid
                 p.ctx.co_occupants = len(queries)
+        return bid, t_formed, queries, offsets, rids
+
+    def _deliver(self, live, offsets, vals, ids, poison, bid) -> None:
+        """Slice the batch result back per request and resolve the
+        futures (poison rows fail typed, innocents get their rows)."""
+        if not poison:
+            vals, ids = np.asarray(vals), np.asarray(ids)
+            for p, lo, hi in zip(live, offsets, offsets[1:]):
+                p.future.set_result((vals[lo:hi], ids[lo:hi]))
+            return
+        # Poison isolation: requests carrying a poison query fail
+        # with the typed error (naming THEIR poison queries);
+        # every innocent request resolves from the bisection's
+        # per-query rows — bit-identical to a clean dispatch.
+        pset = set(poison)
+        for p, lo, hi in zip(live, offsets, offsets[1:]):
+            bad = [j - lo for j in range(lo, hi) if j in pset]
+            if bad:
+                p.future.set_exception(PoisonQuery(
+                    f"{len(bad)} of {hi - lo} queries in this "
+                    f"request poisoned batch {bid} and were "
+                    f"quarantined",
+                    queries=[p.queries[b] for b in bad]))
+            else:
+                p.future.set_result((vals[lo:hi], ids[lo:hi]))
+
+    def _execute(self, batch: List[_Pending]) -> None:
+        obs.name_thread("batcher")
+        live = self._screen(batch)
+        if not live:
+            return
+        bid, t_formed, queries, offsets, rids = self._form(live)
+        span_extra = {"rids": rids} if rids else {}
         # Recompile attribution (round 12): with a warm CompileWatch
         # armed, a recompile-count delta across THIS batch's device
         # call pins the offending batch on the trace timeline — the
@@ -402,26 +545,183 @@ class MicroBatcher:
             if self._metrics is not None:
                 self._metrics.observe_batch(len(queries),
                                             _pow2(len(queries)))
-            if not poison:
-                vals, ids = np.asarray(vals), np.asarray(ids)
-                for p, lo, hi in zip(live, offsets, offsets[1:]):
-                    p.future.set_result((vals[lo:hi], ids[lo:hi]))
-                return
-            # Poison isolation: requests carrying a poison query fail
-            # with the typed error (naming THEIR poison queries);
-            # every innocent request resolves from the bisection's
-            # per-query rows — bit-identical to a clean dispatch.
-            pset = set(poison)
-            for p, lo, hi in zip(live, offsets, offsets[1:]):
-                bad = [j - lo for j in range(lo, hi) if j in pset]
-                if bad:
-                    p.future.set_exception(PoisonQuery(
-                        f"{len(bad)} of {hi - lo} queries in this "
-                        f"request poisoned batch {bid} and were "
-                        f"quarantined",
-                        queries=[p.queries[b] for b in bad]))
+            self._deliver(live, offsets, vals, ids, poison, bid)
+
+    # --- pipelined path (round 22): dispatch stage + drain worker ---
+    def _dispatch(self, batch: List[_Pending]) -> None:
+        """Stage 1 of the pipeline (batcher thread): screen, form,
+        issue the async device call, park the in-flight entry for the
+        drain worker. Blocks only while the window is full — never on
+        device results — so the device always has the next batch
+        queued behind the one it is crunching."""
+        obs.name_thread("batcher")
+        live = self._screen(batch)
+        if not live:
+            return
+        # Window admission BEFORE forming: batch ids and queued-span
+        # outcomes are assigned in admission order, so the drain
+        # worker's FIFO pop is batch-major by construction.
+        # The drain worker outlives the dispatch worker (close() joins
+        # it second), so this wait always makes progress — and the
+        # window never exceeds depth, which is what lets the slab ring
+        # pre-provision exactly ``depth`` slots per bucket.
+        with self._icond:
+            while len(self._inflight) >= self.pipeline_depth:
+                if self._heartbeat is not None:
+                    self._heartbeat()
+                self._icond.wait(0.05)
+        was_empty = len(self._inflight) == 0
+        bubble = was_empty and self._pipe_streak
+        live = self._screen(live)
+        if not live:
+            return
+        bid, t_formed, queries, offsets, rids = self._form(live)
+        span_extra = {"rids": rids} if rids else {}
+        watch = obs_devmon.get_watch()
+        pre_rc = (watch.recompile_count
+                  if watch is not None and watch.warm else None)
+        ent = _InFlight(bid, live, queries, offsets, rids)
+        ent.t_formed = t_formed
+        # The batched + device spans BEGIN here on the batcher lane
+        # and END at drain — obs records a span on the thread that
+        # began it, so the trace shape (device nested in batched on
+        # the batcher lane, rids attached) is identical at any depth.
+        ent.span = obs.begin("batched", batch=bid, queries=len(queries),
+                             requests=len(live), **span_extra)
+        ent.t_dev0 = time.monotonic()
+        ent.dev = obs.begin("device", batch=bid, queries=len(queries),
+                            **span_extra)
+        try:
+            # Async issue: the jitted call returns device futures; the
+            # synchronous part (tracing/compile) still happens HERE,
+            # which keeps recompile attribution on the dispatch side.
+            if self._dispatch_fn is not None:
+                ent.pending = self._dispatch_fn(queries, live[0].k,
+                                                live[0].group)
+            else:
+                ent.pending = _Resolved(self._search_fn(
+                    queries, live[0].k, live[0].group))
+        except BaseException as e:  # noqa: BLE001 — fail at drain,
+            ent.error = e          # in order, like any device error
+        if (pre_rc is not None and watch.recompile_count > pre_rc):
+            obs.instant("recompile_in_batch", batch=bid,
+                        queries=len(queries))
+            for p in live:
+                if p.ctx is not None:
+                    p.ctx.note("recompile_in_batch")
+        with self._icond:
+            self._inflight.append(ent)
+            if self._inflight_gauge is not None:
+                self._inflight_gauge.set(len(self._inflight))
+            self._icond.notify_all()
+        self._pipe_streak = True
+        if bubble:
+            # The device went idle between dispatches while work kept
+            # arriving — the window drained to zero mid-streak.
+            if self._metrics is not None:
+                self._metrics.count("pipeline_bubbles")
+            obs.instant("serve_pipeline_bubble", batch=bid)
+
+    def _drain_run(self) -> None:
+        """Drain worker: materialize in-flight batches strictly in
+        dispatch order (one worker == batch-major resolution), release
+        their futures, keep the heartbeat alive through long waits."""
+        obs.name_thread("drain")
+        while True:
+            with self._icond:
+                # No heartbeat on the IDLE wait: an empty window means
+                # the dispatch worker owns liveness (it beats from
+                # _take_batch and the window wait), and a wedged loop
+                # with queued work must still starve the monitor into
+                # the stall signal. The drain worker beats only while
+                # it is actually draining — the in-flight waits that
+                # used to starve the heartbeat.
+                while not self._inflight and not self._drain_stop:
+                    self._icond.wait(0.1)
+                if not self._inflight and self._drain_stop:
+                    return
+                ent = self._inflight[0]   # peek; pop after resolution
+            try:
+                self._resolve(ent)
+            except BaseException as e:  # noqa: BLE001 — never die
+                for p in ent.live:
+                    if not p.future.done():
+                        p.future.set_exception(e)
+            with self._icond:
+                self._inflight.popleft()
+                if self._inflight_gauge is not None:
+                    self._inflight_gauge.set(len(self._inflight))
+                self._icond.notify_all()
+            if self._heartbeat is not None:
+                self._heartbeat()
+
+    def _resolve(self, ent: _InFlight) -> None:
+        """Stage 2 (drain thread): wait for the device, run the
+        supervision story (retry / breaker / poison bisection) exactly
+        as the unpipelined path would, mark phases, deliver."""
+        live, bid, queries = ent.live, ent.bid, ent.queries
+        rids, offsets = ent.rids, ent.offsets
+        span_extra = {"rids": rids} if rids else {}
+        pre_retries = self._retry_count()
+        err: Optional[BaseException] = None
+        # The drain span closes BEFORE the batched span ends: the
+        # whole resolution nests inside the batch's dispatch-to-
+        # deliver lifetime (trace_check pins the containment).
+        with obs.span("drain", batch=bid, queries=len(queries),
+                      **span_extra):
+            poison: List[int] = []
+            try:
+                # Attempt 1 consumes the already-dispatched pending
+                # (or re-raises the captured dispatch error); retries
+                # and bisection halves re-dispatch synchronously —
+                # the fault seam, attempt accounting and breaker
+                # story are the legacy path's, verbatim.
+                def first(ent=ent):
+                    if ent.error is not None:
+                        raise ent.error
+                    return ent.pending.materialize()
+                if self._supervisor is not None:
+                    # The supervisor fires the device_dispatch seam
+                    # itself, once per attempt — same budget burn as
+                    # the unpipelined path.
+                    vals, ids, poison = self._supervisor.run_batch(
+                        queries, live[0].k, live[0].group,
+                        batch_id=bid, rids=rids or None, first=first)
                 else:
-                    p.future.set_result((vals[lo:hi], ids[lo:hi]))
+                    faults.fire("device_dispatch",
+                                queries=len(queries), batch=bid)
+                    vals, ids = first()
+                t_mat = time.monotonic()
+                obs.end(ent.dev)
+                ent.dev = None
+                for p in live:
+                    if p.ctx is not None:
+                        p.ctx.mark("batch_wait", ent.t_dev0 - ent.t_formed)
+                        p.ctx.mark("device", t_mat - ent.t_dev0)
+                        p.ctx.mark_device_end(t_mat)
+            except BaseException as e:  # noqa: BLE001 — deliver
+                err = e
+                if ent.dev is not None:
+                    obs.end(ent.dev, outcome="error")
+                    ent.dev = None
+            else:
+                retry_delta = self._retry_count() - pre_retries
+                if retry_delta:
+                    for p in live:
+                        if p.ctx is not None:
+                            p.ctx.note("dispatch_retry", n=retry_delta)
+                if self._metrics is not None:
+                    self._metrics.observe_batch(len(queries),
+                                                _pow2(len(queries)))
+                self._deliver(live, offsets, vals, ids, poison, bid)
+        if err is not None:
+            obs.end(ent.span, outcome="error")
+            ent.span = None
+            for p in live:
+                p.future.set_exception(err)
+            return
+        obs.end(ent.span)
+        ent.span = None
 
     def _retry_count(self):
         """Current ``serve_dispatch_retries_total`` (0 without metrics
@@ -443,6 +743,14 @@ class MicroBatcher:
             self._drain_on_close = drain
             self._cond.notify_all()
         self._worker.join()
+        if self._drainer is not None:
+            # Worker joined => everything it will ever dispatch is in
+            # the window; tell the drainer to exit once it's empty and
+            # wait — close() returns with zero batches in flight.
+            with self._icond:
+                self._drain_stop = True
+                self._icond.notify_all()
+            self._drainer.join()
 
     @property
     def closed(self) -> bool:

@@ -209,9 +209,13 @@ class TfidfServer:
             max_wait_ms=self.config.max_wait_ms, metrics=self.metrics,
             heartbeat=lambda: self.health.heartbeat("batcher"),
             supervisor=self._dispatcher,
-            restart_budget=self.config.restart_budget)
+            restart_budget=self.config.restart_budget,
+            pipeline_depth=self.config.pipeline_depth,
+            dispatch_fn=self._run_batch_async)
         self.health.register(
-            "batcher", busy_fn=lambda: self._batcher.queued_queries() > 0)
+            "batcher",
+            busy_fn=lambda: (self._batcher.queued_queries() > 0
+                             or self._batcher.inflight_batches() > 0))
         if self.config.health_period_ms is not None:
             self.health.start()
 
@@ -219,15 +223,34 @@ class TfidfServer:
         """Push the config's query-slab knob onto an (installable)
         index. Duck-typed: plain retrievers and segmented IndexViews
         that expose the attribute get it; mesh-sharded wrappers (no
-        ``query_slab`` attr) keep their own staging contract."""
+        ``query_slab`` attr) keep their own staging contract. The
+        pipeline depth rides along: with up to ``depth`` batches in
+        flight, the slab pre-provisions that many slots per ring so
+        the concurrent steady state stays allocation-free."""
         if (self.config.query_slab is not None
                 and hasattr(retriever, "query_slab")):
             retriever.query_slab = self.config.query_slab
+        if hasattr(retriever, "slab_depth"):
+            retriever.slab_depth = self.config.pipeline_depth
 
     # --- the batch kernel the batcher drives ---
     def _run_batch(self, queries, k, group):
         epoch, retriever = group
         return retriever.search(queries, k)
+
+    def _run_batch_async(self, queries, k, group):
+        """Dispatch stage of the pipelined path: issue the device call
+        and hand back a :class:`~tfidf_tpu.models.retrieval.
+        PendingSearch` the drain worker materializes. Duck-typed so
+        mesh-sharded and test-double retrievers without an async
+        seam still pipeline (their search runs synchronously here;
+        ordering and recovery semantics are unchanged)."""
+        epoch, retriever = group
+        dispatch = getattr(retriever, "search_async", None)
+        if dispatch is not None:
+            return dispatch(queries, k)
+        from tfidf_tpu.models.retrieval import PendingSearch
+        return PendingSearch.resolved(*retriever.search(queries, k))
 
     # --- public API ---
     @property

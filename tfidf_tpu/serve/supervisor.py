@@ -291,7 +291,8 @@ class SupervisedDispatch:
     # --- one dispatch with retry ---
     def run(self, queries: Sequence, k: int, group,
             batch_id: Optional[int] = None,
-            rids: Optional[Sequence[str]] = None
+            rids: Optional[Sequence[str]] = None,
+            first: Optional[Callable] = None
             ) -> Tuple[np.ndarray, np.ndarray]:
         """Dispatch with bounded retry on transient failures; raises
         the final error when the budget is exhausted or the failure is
@@ -299,7 +300,18 @@ class SupervisedDispatch:
         each attempt, so injected transients exercise this exact
         loop. ``rids`` (the batch's request ids, round 16) stamp the
         ``dispatch_retry`` spans and flight events so a retry's
-        backoff is attributable to the requests that paid it."""
+        backoff is attributable to the requests that paid it.
+
+        ``first`` (round 22) is the pipelined drain stage's seam: a
+        zero-arg callable standing in for the FIRST attempt only —
+        materializing a batch whose dispatch was already issued
+        asynchronously (or re-raising its captured dispatch-stage
+        error). The fault seam still fires inside that attempt, so
+        kill/poison plans strike at drain time, exactly where a real
+        deferred device failure surfaces; every RETRY re-dispatches
+        synchronously through ``search_fn``. Attempt accounting,
+        breaker story and retry counts are identical to the
+        unpipelined path."""
         attempt = 0
         text = _match_text(queries)
         while True:
@@ -314,7 +326,10 @@ class SupervisedDispatch:
             try:
                 faults.fire("device_dispatch", text=text,
                             queries=len(queries), batch=batch_id)
-                out = self._search_fn(queries, k, group)
+                if first is not None and attempt == 1:
+                    out = first()
+                else:
+                    out = self._search_fn(queries, k, group)
             except BaseException as e:  # noqa: BLE001 — classified below
                 if self.breaker is not None:
                     self.breaker.record_failure()
@@ -347,7 +362,8 @@ class SupervisedDispatch:
     # --- batch-level: retry then bisect ---
     def run_batch(self, queries: Sequence, k: int, group,
                   batch_id: Optional[int] = None,
-                  rids: Optional[Sequence[str]] = None
+                  rids: Optional[Sequence[str]] = None,
+                  first: Optional[Callable] = None
                   ) -> Tuple[Optional[np.ndarray],
                              Optional[np.ndarray], List[int]]:
         """Dispatch the whole batch; on persistent failure, bisect to
@@ -362,10 +378,15 @@ class SupervisedDispatch:
         overload/weather, not a poison query — the batch fails with
         the transient error (clients back off and retry) rather than
         quarantining innocent queries. Raises too when the full batch
-        fails but no subset does (a non-separable failure)."""
+        fails but no subset does (a non-separable failure).
+
+        ``first`` rides through to :meth:`run`'s first attempt only
+        (the pipelined drain materialization); bisection halves always
+        re-dispatch synchronously — a poison query isolated at drain
+        time bisects exactly like one isolated at dispatch time."""
         try:
             vals, ids = self.run(queries, k, group, batch_id,
-                                 rids=rids)
+                                 rids=rids, first=first)
             return np.asarray(vals), np.asarray(ids), []
         except BaseException as root:  # noqa: BLE001 — bisect below
             if self._retryable(root):

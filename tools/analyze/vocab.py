@@ -41,7 +41,12 @@ SPANS = {
 }
 
 #: Trace instants (``obs.instant``) — point events, not spans.
-INSTANTS = {"worker_restart", "recompile_in_batch"}
+#: ``serve_pipeline_bubble`` (round 22): the pipelined batcher
+#: dispatched onto an EMPTY in-flight window mid-burst — the device
+#: idled between dispatches, exactly the gap depth-D execution exists
+#: to close (serve_bench --ab-pipeline reports the bubble fraction).
+INSTANTS = {"worker_restart", "recompile_in_batch",
+            "serve_pipeline_bubble"}
 
 #: Spans that cover *device work in flight* (dispatch staging, jitted
 #: calls, TraceAnnotation scopes). A host materialization inside one —
@@ -127,6 +132,7 @@ ENV_CLI_FLAGS = {
     "TFIDF_TPU_INGEST_WORKERS": "--ingest-workers",
     "TFIDF_TPU_QUERY_SLAB": "--query-slab",
     "TFIDF_TPU_SCORE_TILING": "--score-tiling",
+    "TFIDF_TPU_SERVE_PIPELINE": "--serve-pipeline-depth",
     "TFIDF_TPU_REPLICAS": "--replicas",
     "TFIDF_TPU_REPLICA_TIMEOUT_S": "--replica-timeout-s",
 }

@@ -70,7 +70,8 @@ stage "replica_front_smoke" env JAX_PLATFORMS=cpu timeout -k 10 600 \
 #    ledger (unchanged artifacts must pass; a refreshed artifact that
 #    regressed fails here)
 for artifact in BENCH_r05.json SERVE_r01.json SERVE_r02.json \
-                SERVE_r03.json SERVE_r04.json REPLICA_r01.json \
+                SERVE_r03.json SERVE_r04.json SERVE_r05.json \
+                REPLICA_r01.json \
                 INGEST_MH_r01.json RETR_r01.json; do
     if [ -f "${artifact}" ]; then
         stage "perf_gate:${artifact}" \

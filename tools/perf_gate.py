@@ -117,6 +117,18 @@ _GATES = {
         # tiling-off pass is the contract — any byte divergence at
         # any probed width fails absolutely.
         "tiled_parity_ok": ("higher", 0.0),
+        # Round 22 pipelined-execution A/B (--ab-pipeline): parity
+        # across depths AND vs direct search is zero-tolerance, as
+        # are per-depth steady-state recompiles (the absolute
+        # zero-baseline rule fires on any nonzero count). The qps
+        # columns gate directionally: the depth-2 window must keep
+        # beating the depth-1 baseline (the gain column heading to
+        # zero is the overlap rotting back into lockstep execution).
+        "pipeline_parity_ok": ("higher", 0.0),
+        "pipeline_recompiles_depth2": ("lower", 0.0),
+        "pipeline_recompiles_depth4": ("lower", 0.0),
+        "pipeline_qps_depth2": ("higher", 0.30),
+        "pipeline_qps_gain_depth2": ("higher", 0.50),
     },
     # Multi-process sharded ingest (tools/ingest_mh_bench.py): parity
     # is zero-tolerance — the N-worker merged index must stay
@@ -223,7 +235,8 @@ _GATES = {
 }
 # Context keys that must MATCH for two records to be comparable.
 _MATCH_KEYS = {"bench": ("backend", "n_docs", "wire"),
-               "serve_bench": ("backend", "docs", "k", "max_batch"),
+               "serve_bench": ("backend", "docs", "k", "max_batch",
+                               "pipeline_depth"),
                "chaos": ("backend", "docs", "k", "max_batch", "plan",
                          "seed"),
                "mutate": ("backend", "k", "max_batch", "rate",
@@ -241,7 +254,13 @@ _MATCH_KEYS = {"bench": ("backend", "n_docs", "wire"),
 # how records that predate a context key stay comparable to their
 # successors (pre-round-14 bench records carry no "wire"; they were
 # all ragged-wire runs by construction).
-_MATCH_DEFAULTS = {"wire": "ragged"}
+_MATCH_DEFAULTS = {"wire": "ragged",
+                   # Pre-round-22 serve records carry no
+                   # pipeline_depth; the serving default (2) keeps
+                   # them comparable to their successors so the
+                   # pipelined runs are gated against the unpipelined
+                   # history they must beat.
+                   "pipeline_depth": 2}
 
 
 def comparable(rec: dict, cand: dict) -> bool:

@@ -120,6 +120,18 @@ _SERVE_METRICS = {
     # tiled-over-block-split ratio at the widest width (trend).
     "tiled_parity_ok": "tiling.parity_ok",
     "tiled_speedup_widest": "tiling.speedup_widest",
+    # Round 22 pipelined-execution receipts (--ab-pipeline runs):
+    # parity is the bit-identity verdict across depths 1/2/4 AND vs
+    # direct search (zero-tolerance); the per-depth recompile counts
+    # are structural zeros; the depth-2/depth-1 cache-off qps columns
+    # carry the win itself, gated directionally so the overlap can't
+    # quietly rot back into lockstep execution.
+    "pipeline_parity_ok": "pipeline.parity_ok",
+    "pipeline_qps_depth1": "pipeline.qps.1",
+    "pipeline_qps_depth2": "pipeline.qps.2",
+    "pipeline_qps_gain_depth2": "pipeline.qps_gain_depth2",
+    "pipeline_recompiles_depth2": "pipeline.recompiles.2",
+    "pipeline_recompiles_depth4": "pipeline.recompiles.4",
 }
 # Chaos artifacts (serve_bench --chaos): the fault-plan receipts. The
 # gated metric is parity_ok — every non-shed non-poisoned response
@@ -272,6 +284,12 @@ _SERVE_CONTEXT = {"backend": "backend", "docs": "docs", "k": "k",
                   "requests": "requests", "mode": "mode",
                   "concurrency": "concurrency",
                   "max_batch": "max_batch",
+                  # Pipelined execution (round 22): runs at
+                  # different in-flight depths are different
+                  # experiments — matched by perf_gate with the
+                  # pre-pipeline default (2) backfilled for older
+                  # records (_MATCH_DEFAULTS).
+                  "pipeline_depth": "pipeline_depth",
                   "fingerprint": "fingerprint.config_sha"}
 
 

@@ -21,6 +21,7 @@ Usage: python tools/serve_bench.py --requests 256 --out SERVE_r01.json
 from __future__ import annotations
 
 import argparse
+import collections
 import json
 import os
 import shutil
@@ -724,6 +725,20 @@ def main() -> int:
                          "block-split pass at EVERY width). perf_gate "
                          "zero-tolerates the parity; exit 1 on any "
                          "divergence")
+    ap.add_argument("--ab-pipeline", action="store_true",
+                    help="measure the round-22 pipelined execution: "
+                         "drive the same cache-off load through "
+                         "throwaway servers at pipeline depth 1 "
+                         "(unpipelined legacy), 2 and 4, and embed a "
+                         "'pipeline' artifact object — per-depth "
+                         "cache-off qps, p50/p99, pipeline-bubble "
+                         "fraction, per-depth recompile receipt, and "
+                         "a parity verdict (every depth's served "
+                         "rows bit-identical to the depth-1 pass AND "
+                         "to direct search). perf_gate zero-tolerates "
+                         "the parity/recompiles and gates the "
+                         "depth-2-vs-depth-1 qps win directionally; "
+                         "exit 1 on any divergence")
     ap.add_argument("--chaos", metavar="PLAN", default=None,
                     help="arm this fault-injection plan for the whole "
                          "load (grammar in tfidf_tpu/faults.py, e.g. "
@@ -1168,6 +1183,149 @@ def main() -> int:
             # load only, as it does without --ab-tiled.
             compiles_warm = compiled_programs()
 
+        # Pipelined-execution receipt (--ab-pipeline): the same
+        # cache-off query pool through throwaway servers at depth
+        # 1/2/4 — BEFORE the main run. The load is an OPEN-loop burst
+        # (a sliding window of outstanding futures, not the closed
+        # loop `drive` runs): a closed loop's whole client population
+        # rides one batch, so the in-flight window would never hold
+        # two batches and every depth would measure the same thing.
+        # Sustained backlog is the regime the window exists for —
+        # execution overlap between one batch's drain and the next
+        # batch's form/pack/dispatch. Depth 1 is the unpipelined
+        # legacy path (the baseline the depth-2 qps win is measured
+        # against); every depth's pinned rows must be bit-identical
+        # to the depth-1 pass AND to direct search, per-depth
+        # steady-state recompiles must be zero, and the bubble
+        # fraction says how often the device still idled between
+        # dispatches (the gap the window exists to close).
+        pipeline_ab = None
+        if (args.ab_pipeline and not args.chaos
+                and args.mesh_shards is None):
+            ab_depths = [1, 2, 4]
+            pinned_pipe = [draw() for _ in range(8)]
+            # Outstanding-future bound: deep enough to keep batches
+            # forming behind a full window, comfortably inside the
+            # admission bound (single-query requests).
+            ab_window = max(8, min(96, args.queue_depth - 8))
+
+            def pipeline_burst(ab_server):
+                outstanding = collections.deque()
+                t0 = time.perf_counter()
+                for _ in range(args.requests):
+                    if len(outstanding) >= ab_window:
+                        outstanding.popleft().result(timeout=120)
+                    outstanding.append(ab_server.submit(
+                        [draw()], args.k, use_cache=False))
+                while outstanding:
+                    outstanding.popleft().result(timeout=120)
+                return time.perf_counter() - t0
+
+            def pipeline_pass(depth):
+                ab_server = TfidfServer(retriever, ServeConfig(
+                    max_batch=args.max_batch,
+                    max_wait_ms=args.max_wait_ms,
+                    queue_depth=args.queue_depth,
+                    cache_entries=0,
+                    default_deadline_ms=args.deadline_ms,
+                    pipeline_depth=depth))
+                ab_server.mark_warm()
+                for nb in sorted(buckets):  # warm every bucket
+                    ab_server.submit(
+                        [draw() for _ in range(nb)], args.k,
+                        use_cache=False).result(timeout=120)
+                reg0 = ab_server.metrics.registry.snapshot()
+                snap0 = ab_server.metrics_snapshot()
+                pre_compiles = compiled_programs()
+                wall = pipeline_burst(ab_server)
+                served = ab_server.submit(
+                    pinned_pipe, args.k,
+                    use_cache=False).result(timeout=60)
+                snap1 = ab_server.metrics_snapshot()
+                reg1 = ab_server.metrics.registry.snapshot()
+                ab_server.close(drain=True)
+                queries = snap1["queries"] - snap0["queries"]
+                batches = (snap1["batch"]["count"]
+                           - snap0["batch"]["count"])
+                bubbles = (
+                    reg1.get("serve_pipeline_bubbles_total", 0)
+                    - reg0.get("serve_pipeline_bubbles_total", 0))
+                lat_ab = snap1["latency_s"]
+                return {
+                    "wall_s": round(wall, 4),
+                    "qps": round(queries / wall, 2) if wall else 0.0,
+                    "p50_ms": round(lat_ab["p50"] * 1e3, 3),
+                    "p99_ms": round(lat_ab["p99"] * 1e3, 3),
+                    "batches": batches,
+                    "bubble_fraction": round(bubbles / batches, 4)
+                    if batches else None,
+                    "recompiles": compiled_programs() - pre_compiles,
+                }, served
+
+            # Best-of-5, trials INTERLEAVED across depths: closed-loop
+            # qps at this scale is box-noise-bound, and interleaving
+            # spreads warm-state drift evenly instead of crediting it
+            # to whichever depth ran last. Rows from every trial feed
+            # the parity check; the qps column keeps each depth's best.
+            stats_by_depth, rows_by_depth = {}, {}
+            parity = True
+            for _trial in range(5):
+                for d in ab_depths:
+                    stats, served = pipeline_pass(d)
+                    if (d not in stats_by_depth
+                            or stats["qps"]
+                            > stats_by_depth[d]["qps"]):
+                        stats_by_depth[d] = stats
+                    if d in rows_by_depth:
+                        parity = parity and (
+                            np.array_equal(served[0],
+                                           rows_by_depth[d][0])
+                            and np.array_equal(served[1],
+                                               rows_by_depth[d][1]))
+                    else:
+                        rows_by_depth[d] = served
+            base_rows = rows_by_depth[ab_depths[0]]
+            dvals_p, dids_p = retriever.search(pinned_pipe, k=args.k)
+            parity = parity and all(
+                np.array_equal(rows_by_depth[d][0], base_rows[0])
+                and np.array_equal(rows_by_depth[d][1], base_rows[1])
+                for d in ab_depths) and (
+                np.array_equal(base_rows[0], dvals_p)
+                and np.array_equal(base_rows[1], dids_p))
+            q1 = stats_by_depth[1]["qps"]
+            q2 = stats_by_depth[2]["qps"]
+            pipeline_ab = {
+                "parity_ok": int(parity),
+                "depths": ab_depths,
+                "qps": {str(d): stats_by_depth[d]["qps"]
+                        for d in ab_depths},
+                "p50_ms": {str(d): stats_by_depth[d]["p50_ms"]
+                           for d in ab_depths},
+                "p99_ms": {str(d): stats_by_depth[d]["p99_ms"]
+                           for d in ab_depths},
+                "bubble_fraction": {
+                    str(d): stats_by_depth[d]["bubble_fraction"]
+                    for d in ab_depths},
+                "recompiles": {
+                    str(d): stats_by_depth[d]["recompiles"]
+                    for d in ab_depths},
+                "qps_gain_depth2": (round(q2 / q1 - 1.0, 4)
+                                    if q1 else None),
+            }
+            from tfidf_tpu.obs import devmon as obs_devmon4
+            obs_devmon4.set_watch(server.compile_watch)
+            log.info("serve_bench",
+                     msg=f"pipeline A/B: parity "
+                         f"{'ok' if parity else 'MISMATCH'}; qps "
+                         f"{q1} @depth1 -> {q2} @depth2 "
+                         f"({pipeline_ab['qps_gain_depth2']:+.1%}), "
+                         f"{stats_by_depth[4]['qps']} @depth4; "
+                         f"bubbles "
+                         f"{pipeline_ab['bubble_fraction']}")
+            # Throwaway passes ran after the main warm line — re-draw
+            # so recompiles_after_warmup measures the main load only.
+            compiles_warm = compiled_programs()
+
         wall, n_shed, n_poisoned, n_failed, completed = drive(
             server, args.requests)
         shed = [n_shed]
@@ -1291,6 +1449,10 @@ def main() -> int:
             "rate_rps": args.rate,
             "max_batch": args.max_batch,
             "max_wait_ms": args.max_wait_ms,
+            # Comparability context (round 22): runs at different
+            # pipeline depths are different experiments — the ledger
+            # matches baselines on this key.
+            "pipeline_depth": serve_cfg.pipeline_depth,
             "wall_s": round(wall, 4),
             "throughput_rps": round(snap["requests"] / wall, 2),
             "throughput_qps": round(snap["queries"] / wall, 2),
@@ -1323,6 +1485,8 @@ def main() -> int:
             artifact["slab"] = slab_ab
         if tiled_ab is not None:
             artifact["tiling"] = tiled_ab
+        if pipeline_ab is not None:
+            artifact["pipeline"] = pipeline_ab
         if chaos is not None:
             artifact["chaos"] = chaos
         if mesh is not None:
@@ -1355,6 +1519,21 @@ def main() -> int:
                       msg="tiled parity FAILED: tiled served rows "
                           "diverge from the block-split pass")
             return 1
+        if pipeline_ab is not None:
+            if not pipeline_ab["parity_ok"]:
+                log.error("serve_bench_pipeline_parity",
+                          msg="pipeline parity FAILED: some depth's "
+                              "served rows diverge from the depth-1 "
+                              "pass or direct search")
+                return 1
+            bad_rc = {d: n for d, n in
+                      pipeline_ab["recompiles"].items() if n}
+            if bad_rc:
+                log.error("serve_bench_pipeline_recompiles",
+                          msg=f"pipeline A/B recompiled in steady "
+                              f"state: {bad_rc} (expected 0 at every "
+                              f"depth)")
+                return 1
         if chaos is not None and not chaos["parity_ok"]:
             log.error("serve_bench_chaos_parity",
                       msg=f"chaos parity FAILED: "

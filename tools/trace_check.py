@@ -285,21 +285,55 @@ def _check_serve(by_name, notes) -> List[str]:
     # Round 13 recovery nesting: a dispatch retry happens INSIDE the
     # batch it is retrying — its span must be contained in a batched
     # span on the same lane (same pid/tid), so the timeline charges
-    # the backoff to the right batch and never floats free.
+    # the backoff to the right batch and never floats free. Pipelined
+    # execution (round 22) moves retries to the drain worker: there
+    # the container is a ``drain`` span on the retry's lane instead.
+    drains = by_name.get("drain", [])
     retries = by_name.get("dispatch_retry", [])
     for r in retries:
-        lane_batches = [b for b in batches
-                        if (b.get("pid"), b.get("tid"))
-                        == (r.get("pid"), r.get("tid"))]
-        if not any(_contained(r, b) for b in lane_batches):
+        lane = (r.get("pid"), r.get("tid"))
+        containers = [b for b in batches + drains
+                      if (b.get("pid"), b.get("tid")) == lane]
+        if not any(_contained(r, b) for b in containers):
             errors.append(
                 f"dispatch_retry span (batch "
                 f"{(r.get('args') or {}).get('batch')!r}) not nested "
-                f"inside any batched span on its lane")
+                f"inside any batched or drain span on its lane")
             break
     if retries:
         notes.append(f"dispatch retries: {len(retries)} "
-                     f"(all nested in batches)")
+                     f"(all nested in batches or drains)")
+    # Round 22 pipeline shape: every drain span resolves a batch some
+    # batched span dispatched (same id — the window is FIFO over real
+    # batches, not phantoms), and the resolution it times lies inside
+    # the batched span's interval (dispatch-to-resolve is one
+    # overlapped lifetime, so a drain that ends after its batched
+    # span closed would be a torn pipeline).
+    if drains:
+        bid_spans = {}
+        for b in batches:
+            bid = (b.get("args") or {}).get("batch")
+            if bid is not None:
+                bid_spans.setdefault(bid, []).append(b)
+        for d in drains:
+            bid = (d.get("args") or {}).get("batch")
+            if bid is None:
+                errors.append("drain span without a batch id")
+                break
+            owners = bid_spans.get(bid)
+            if not owners:
+                errors.append(f"drain span resolves batch {bid!r} "
+                              f"but no batched span dispatched it")
+                break
+            if not any(_contained(d, b, slack=5e3) for b in owners):
+                errors.append(
+                    f"drain span for batch {bid!r} not contained in "
+                    f"its batched span — resolution outlived the "
+                    f"dispatch-to-deliver lifetime")
+                break
+        else:
+            notes.append(f"pipeline drains: {len(drains)} "
+                         f"(each inside its batched span)")
     # Round 16 request identity: when the trace carries rids, every
     # request span's rid is unique (a reused id would alias two
     # requests' forensics), and every rid-stamped queued span names a
