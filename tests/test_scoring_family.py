@@ -1,0 +1,759 @@
+"""Scoring-family subsystem (round 23): BM25, field weights and
+filtered queries through the same tiled kernel.
+
+The contracts pinned here, in order of how expensive they are to lose:
+
+* **Default bit-identity by construction**: the tfidf scorer with no
+  filter runs EXACTLY the pre-round-23 code path — ``scorer="tfidf"``
+  and no-arg ``search`` must be bit-equal on every tier.
+* **Oracle bit-parity per scorer**: doc IDS and TIE ORDER match the
+  pure-numpy oracle (``scoring.oracle``) exactly; scores allclose
+  (L-slot accumulation order is float32's one degree of freedom).
+* **Tiled == untiled per scorer**: ``--score-tiling=off`` stays an
+  exact fallback for every family member, not just tfidf.
+* **Filters are visibility, composed by AND**: filter ∘ tombstone over
+  the segmented index behaves as a boolean AND of allow-masks; corpus
+  statistics stay global.
+* **The family rides every tier**: segmented views, the mesh-sharded
+  retriever, the serve batcher (mixed-scorer batches never share a
+  dispatch or a cache row), snapshots, and the canary.
+* **Zero recompiles after warm**: k1/b are traced scalars and every
+  scorer face shares one tiled jit — a scorer/parameter switch never
+  mints a program.
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tfidf_tpu.config import PipelineConfig, ServeConfig, VocabMode
+from tfidf_tpu.io.corpus import Corpus
+from tfidf_tpu.models import TfidfRetriever
+from tfidf_tpu.models.retrieval import query_matrix
+from tfidf_tpu.ops.sparse import (score_topk_tiled,
+                                  score_topk_tiled_cache_size)
+from tfidf_tpu.recall import retrieval_recall_at_k, scorer_overlap_at_k
+from tfidf_tpu.scoring import oracle
+from tfidf_tpu.scoring.family import (DEFAULT_B, DEFAULT_K1, ScorerSpec,
+                                      parse_scorer, resolve_scorer,
+                                      scorer_key, spec_from_parts)
+from tfidf_tpu.scoring.filters import (FilterSpec, filter_key,
+                                       filter_mask, parse_filter)
+from tfidf_tpu.serve import TfidfServer
+
+CFG = PipelineConfig(vocab_mode=VocabMode.HASHED, vocab_size=512,
+                     max_doc_len=32, doc_chunk=32)
+
+WORDS = ("alpha beta gamma delta epsilon zeta eta theta iota kappa "
+         "lam mu nu xi omicron pi").split()
+
+# Oracle-parity corpora draw from a WIDE vocabulary: ids + tie order
+# are pinned bit-identical vs the numpy oracle, which requires score
+# gaps above float32 fusion noise (~1 ulp) between distinct docs — a
+# 16-word pool makes sub-ulp near-ties common, 64 words does not.
+# Exact ties (duplicate docs) stay covered by the dedicated tie tests.
+WIDE_WORDS = [f"term{i:02d}" for i in range(64)]
+
+
+def make_corpus(n_docs, seed=0, vocab=WORDS, prefix="doc"):
+    rng = random.Random(seed)
+    names = [f"{prefix}{i}" for i in range(n_docs)]
+    docs = [" ".join(rng.choice(vocab)
+                     for _ in range(rng.randint(3, 20))).encode()
+            for _ in range(n_docs)]
+    return Corpus(names=names, docs=docs)
+
+
+def make_queries(n, seed=0, vocab=WORDS):
+    rng = random.Random(1000 + seed)
+    return [" ".join(rng.choice(vocab)
+                     for _ in range(rng.randint(1, 4)))
+            for _ in range(n)]
+
+
+SCORERS = ["tfidf", "bm25", "bm25:k1=1.5,b=0.6", "bm25:k1=0.0,b=0.0"]
+
+
+def oracle_search(r, queries, k, scorer=None, filter=None):
+    """The NumPy reference every device path is pinned against: the
+    retriever's own derived host face + the same query columns, ranked
+    by the oracle's lexsort (score desc, row asc — lax.top_k's
+    discipline), trimmed to the device result width."""
+    spec = r.scorer if scorer is None else parse_scorer(scorer)
+    data, cols = r.scorer_face(spec)
+    rows = data.shape[0]
+    live = np.zeros((rows,), bool)
+    live[:r._num_docs] = True
+    fspec = parse_filter(filter)
+    if fspec is not None:
+        live[:r._num_docs] &= filter_mask(fspec, r._num_docs,
+                                          names=r.names)
+    qmat = query_matrix(
+        queries, r.config, np.asarray(r._idf),
+        mode="counts" if spec.kind == "bm25" else "cosine")
+    vals, ids = oracle.oracle_topk(data, cols, live, qmat, k)
+    width = min(k, r._num_docs)
+    return vals[:, :width], ids[:, :width]
+
+
+def assert_matches_oracle(got, want, ctx=""):
+    gv, gi = got
+    wv, wi = want
+    np.testing.assert_array_equal(np.asarray(gi), wi, err_msg=ctx)
+    np.testing.assert_allclose(np.asarray(gv), wv, rtol=1e-5,
+                               atol=1e-6, err_msg=ctx)
+
+
+class TestSpecParsing:
+    """Host-side spec layer: canonical keys, every input form, and
+    loud failure on malformed requests."""
+
+    def test_canonical_keys_round_trip(self):
+        for raw in SCORERS:
+            spec = parse_scorer(raw)
+            assert parse_scorer(spec.key()) == spec
+        assert scorer_key(None) == "tfidf"
+        assert scorer_key("bm25") == f"bm25:b={DEFAULT_B:g},k1={DEFAULT_K1:g}"
+        assert scorer_key({"kind": "bm25", "k1": 1.5, "b": 0.6}) == \
+            scorer_key("bm25:k1=1.5,b=0.6") == "bm25:b=0.6,k1=1.5"
+
+    def test_tfidf_normalizes_params(self):
+        # Spec equality == scoring equality: tfidf ignores k1/b, so
+        # the spec forgets them too.
+        assert parse_scorer({"kind": "tfidf", "k1": 9.0}) == ScorerSpec()
+        assert scorer_key("tfidf") == "tfidf"
+
+    @pytest.mark.parametrize("bad", [
+        "cosine", "bm25:k1=", "bm25:q=3", "bm25:k1=-1",
+        "bm25:b=1.5", 42, {"kind": "bm25", "alpha": 1},
+    ])
+    def test_malformed_specs_raise(self, bad):
+        with pytest.raises((ValueError, TypeError)):
+            parse_scorer(bad)
+
+    def test_spec_from_parts(self):
+        # Bare kind + standalone knobs compose; inline params win.
+        assert spec_from_parts(None, None, None) == ScorerSpec()
+        assert spec_from_parts("bm25", 1.5, None) == \
+            ScorerSpec("bm25", k1=1.5, b=DEFAULT_B)
+        assert spec_from_parts("bm25:k1=2.0,b=0.5", 1.5, 0.9) == \
+            ScorerSpec("bm25", k1=2.0, b=0.5)
+
+    def test_resolve_scorer_env(self, monkeypatch):
+        monkeypatch.delenv("TFIDF_TPU_SCORER", raising=False)
+        assert resolve_scorer() == ScorerSpec()
+        monkeypatch.setenv("TFIDF_TPU_SCORER", "bm25")
+        monkeypatch.setenv("TFIDF_TPU_BM25_K1", "1.7")
+        monkeypatch.setenv("TFIDF_TPU_BM25_B", "0.4")
+        assert resolve_scorer() == ScorerSpec("bm25", k1=1.7, b=0.4)
+        # An inline spec ignores the standalone knobs...
+        monkeypatch.setenv("TFIDF_TPU_SCORER", "bm25:k1=2.5")
+        assert resolve_scorer() == ScorerSpec("bm25", k1=2.5)
+        # ...and an explicit argument beats the env outright.
+        assert resolve_scorer("tfidf") == ScorerSpec()
+
+    def test_filter_forms_and_keys(self):
+        assert parse_filter(None) is None and filter_key(None) == ""
+        f = parse_filter({"ids": [7, 3, 3]})
+        assert f.key() == '{"ids":[3,7]}'
+        assert parse_filter(f.key()).key() == f.key()  # round-trips
+        assert filter_key({"id_range": [2, 9]}) == '{"id_range":[2,9]}'
+        assert filter_key({"prefix": "a/"}) == '{"prefix":"a/"}'
+
+    @pytest.mark.parametrize("bad", [
+        {"ids": [1], "prefix": "x"}, {"tenant": "a"}, {"ids": "1,2"},
+        {"ids": [True]}, {"id_range": [3]}, {"id_range": [5, 1]},
+        {"prefix": 7}, "not json", [1, 2],
+    ])
+    def test_malformed_filters_raise(self, bad):
+        with pytest.raises((ValueError, TypeError)):
+            parse_filter(bad)
+
+    def test_filter_mask_semantics(self):
+        names = ["a/1", "a/2", "b/1", None]
+        m = filter_mask(FilterSpec(kind="ids", ids=(0, 2, 99)), 4)
+        assert m.tolist() == [True, False, True, False]  # 99 ignored
+        m = filter_mask(FilterSpec(kind="id_range", lo=-5, hi=2), 4)
+        assert m.tolist() == [True, True, False, False]  # clamped
+        m = filter_mask(FilterSpec(kind="prefix", prefix="a/"), 4,
+                        names=names)
+        assert m.tolist() == [True, True, False, False]  # None: never
+        with pytest.raises(ValueError):
+            filter_mask(FilterSpec(kind="prefix", prefix="a"), 4)
+
+
+class TestFaceParity:
+    """The derived device faces vs their pure-numpy mirrors: the
+    elementwise weight math is IEEE on both sides, so the arrays
+    themselves compare BIT-equal, not just allclose."""
+
+    @pytest.mark.parametrize("spec", SCORERS)
+    def test_device_face_equals_oracle_face(self, spec):
+        r = TfidfRetriever(CFG).index(make_corpus(23, seed=3))
+        s = parse_scorer(spec)
+        got_d, got_c = r.scorer_face(s)
+        tol = dict(rtol=3e-7, atol=1e-7)  # XLA FMA fusion: 1 ulp
+        ids = np.asarray(r._ids)
+        head = np.asarray(r._head)
+        counts, lengths = oracle.counts_from_sorted(ids, head)
+        df = oracle.df_from_sorted(ids, head, CFG.vocab_size)
+        n = r._num_docs
+        if s.kind == "tfidf":
+            want_d, want_c = oracle.tfidf_face(ids, counts, head,
+                                               lengths, df, n)
+        else:
+            avgdl = np.float32(np.float32(int(lengths[:n].sum()))
+                               / np.float32(n))
+            want_d, want_c = oracle.bm25_face(ids, counts, head,
+                                              lengths, df, n, avgdl,
+                                              s.k1, s.b)
+        np.testing.assert_allclose(got_d, want_d, **tol)
+        np.testing.assert_array_equal(got_c, want_c)
+
+    def test_bm25_idf_stays_positive(self):
+        # Lucene idf > 0 even at df == N — the repo-wide ``vals > 0``
+        # real-result mask survives ubiquitous terms (raw Robertson
+        # idf would go negative past df > N/2 and mask real hits).
+        df = np.array([0, 1, 50, 99, 100])
+        idf = oracle.bm25_idf(df, 100)
+        assert idf[0] == 0.0
+        assert (idf[1:] > 0).all()
+
+
+class TestFlatParity:
+    """TfidfRetriever.search: every (scorer, filter) bit-identical to
+    the oracle and to the untiled fallback, default path untouched."""
+
+    @pytest.mark.parametrize("spec", SCORERS)
+    @pytest.mark.parametrize("q", [1, 7, 65])
+    def test_oracle_parity_across_widths(self, spec, q):
+        r = TfidfRetriever(CFG).index(
+            make_corpus(31, seed=q, vocab=WIDE_WORDS))
+        queries = make_queries(q, seed=q, vocab=WIDE_WORDS)
+        got = r.search(queries, k=5, scorer=spec)
+        want = oracle_search(r, queries, 5, scorer=spec)
+        assert_matches_oracle(got, want, ctx=f"{spec} q={q}")
+
+    @pytest.mark.parametrize("spec", SCORERS)
+    def test_tiled_equals_untiled(self, spec, monkeypatch):
+        r = TfidfRetriever(CFG).index(make_corpus(29, seed=11))
+        queries = make_queries(9, seed=11)
+        monkeypatch.setenv("TFIDF_TPU_SCORE_TILING", "on")
+        on = r.search(queries, k=6, scorer=spec)
+        monkeypatch.setenv("TFIDF_TPU_SCORE_TILING", "off")
+        off = r.search(queries, k=6, scorer=spec)
+        np.testing.assert_array_equal(on[0], off[0])
+        np.testing.assert_array_equal(on[1], off[1])
+
+    def test_default_scorer_is_the_legacy_path_bitwise(self):
+        r = TfidfRetriever(CFG).index(
+            make_corpus(17, seed=2, vocab=WIDE_WORDS))
+        queries = make_queries(8, seed=2, vocab=WIDE_WORDS)
+        plain = r.search(queries, k=4)
+        explicit = r.search(queries, k=4, scorer="tfidf")
+        np.testing.assert_array_equal(plain[0], explicit[0])
+        np.testing.assert_array_equal(plain[1], explicit[1])
+        assert_matches_oracle(plain, oracle_search(r, queries, 4))
+
+    def test_index_level_default_scorer(self):
+        # A retriever CONSTRUCTED bm25-default serves bm25 with no
+        # per-call argument — and a per-call tfidf still overrides.
+        corpus = make_corpus(19, seed=4)
+        queries = make_queries(7, seed=4)
+        base = TfidfRetriever(CFG).index(corpus)
+        bm = TfidfRetriever(CFG, scorer="bm25").index(corpus)
+        dv, di = bm.search(queries, k=5)
+        wv, wi = base.search(queries, k=5, scorer="bm25")
+        np.testing.assert_array_equal(di, wi)
+        np.testing.assert_array_equal(dv, wv)
+        tv, ti = bm.search(queries, k=5, scorer="tfidf")
+        bv, bi = base.search(queries, k=5)
+        np.testing.assert_array_equal(ti, bi)
+        np.testing.assert_array_equal(tv, bv)
+
+    def test_bm25_actually_ranks_differently(self):
+        # Guard against the subsystem degenerating into a renamed
+        # tfidf: on a seeded corpus the two top-k sets must differ.
+        r = TfidfRetriever(CFG).index(make_corpus(60, seed=5))
+        queries = make_queries(32, seed=5)
+        _, ti = r.search(queries, k=10)
+        _, bi = r.search(queries, k=10, scorer="bm25")
+        assert scorer_overlap_at_k(ti, bi, 10) < 1.0
+
+    def test_bm25_k1_zero_ignores_tf(self):
+        # k1=0 collapses the saturation to 1: a doc repeating the
+        # query term scores exactly like one mentioning it once, so
+        # ties resolve by row — observable, parameter-level semantics.
+        corpus = Corpus(names=["d0", "d1", "d2"],
+                        docs=[b"alpha beta", b"alpha alpha alpha beta",
+                              b"gamma delta"])
+        r = TfidfRetriever(CFG).index(corpus)
+        vals, ids = r.search(["alpha"], k=3, scorer="bm25:k1=0,b=0")
+        assert ids[0, 0] == 0 and ids[0, 1] == 1
+        assert vals[0, 0] == vals[0, 1]
+
+    def test_pallas_scope_extends_to_bm25(self):
+        # The fused gather-accumulate kernel runs the bm25 face with
+        # the same contract as phase B: ids bit-identical to the XLA
+        # lowering, scores allclose.
+        r = TfidfRetriever(CFG).index(make_corpus(37, seed=6))
+        data, cols = r._scorer_face(parse_scorer("bm25"))
+        qmat = jnp.asarray(query_matrix(
+            make_queries(9, seed=6), CFG, np.asarray(r._idf),
+            mode="counts"))
+        want_v, want_i = score_topk_tiled(data, cols, None, qmat, 5,
+                                          tile=16, method="xla")
+        got_v, got_i = score_topk_tiled(data, cols, None, qmat, 5,
+                                        tile=16, method="pallas")
+        np.testing.assert_array_equal(np.asarray(got_i),
+                                      np.asarray(want_i))
+        np.testing.assert_allclose(np.asarray(got_v),
+                                   np.asarray(want_v), rtol=1e-6)
+
+
+class TestFilteredQueries:
+    """Query-time visibility: results come only from the allowed set,
+    statistics stay global, tombstones compose by AND."""
+
+    @pytest.mark.parametrize("spec", ["tfidf", "bm25"])
+    @pytest.mark.parametrize("filt", [
+        {"ids": [0, 3, 5, 8, 12]},
+        {"id_range": [4, 15]},
+        {"prefix": "doc1"},            # doc1, doc10..doc19
+    ])
+    def test_filter_oracle_parity(self, spec, filt):
+        r = TfidfRetriever(CFG).index(
+            make_corpus(25, seed=7, vocab=WIDE_WORDS))
+        queries = make_queries(11, seed=7, vocab=WIDE_WORDS)
+        got = r.search(queries, k=6, scorer=spec, filter=filt)
+        want = oracle_search(r, queries, 6, scorer=spec, filter=filt)
+        assert_matches_oracle(got, want, ctx=f"{spec} {filt}")
+        allow = filter_mask(parse_filter(filt), r._num_docs,
+                            names=r.names)
+        ids = np.asarray(got[1])
+        real = ids[ids >= 0]
+        assert allow[real].all(), "a filtered-out doc surfaced"
+
+    def test_filter_keeps_global_statistics(self):
+        # The SAME doc retrieved through two different filters scores
+        # the SAME value — filters restrict candidates, they never
+        # reweigh terms (tenant isolation without score skew).
+        r = TfidfRetriever(CFG).index(make_corpus(20, seed=8))
+        queries = make_queries(12, seed=8)
+        gv, gi = r.search(queries, k=20)
+        fv, fi = r.search(queries, k=20, filter={"id_range": [0, 10]})
+        glob = {(q, int(d)): gv[q, c] for q in range(len(queries))
+                for c, d in enumerate(gi[q]) if d >= 0}
+        seen = 0
+        for q in range(len(queries)):
+            for c, d in enumerate(fi[q]):
+                if d >= 0:
+                    assert fv[q, c] == glob[(q, int(d))]
+                    seen += 1
+        assert seen > 0
+
+    def test_empty_filter_result_masks_clean(self):
+        r = TfidfRetriever(CFG).index(make_corpus(10, seed=9))
+        vals, ids = r.search(make_queries(3, seed=9), k=4,
+                             filter={"ids": []})
+        assert (ids == -1).all() and (vals == 0.0).all()
+
+    def test_filter_composes_with_tombstones(self):
+        # Segmented index: delete doc A, filter allows {A, B} — only B
+        # can surface. The boolean AND, observed end to end.
+        from tfidf_tpu.index.segmented import SegmentedIndex
+        idx = SegmentedIndex(CFG, delta_docs=4, compact_at=64)
+        rng = random.Random(10)
+        for i in range(12):
+            idx.add_docs([f"d{i}"],
+                         [" ".join(rng.choice(WORDS) for _ in range(8))])
+        idx.delete_docs(["d2", "d5"])
+        view = idx.view()
+        allow = {"ids": [2, 3, 5, 7]}
+        vals, ids = view.search(make_queries(9, seed=10), k=12,
+                                filter=allow)
+        surfaced = {int(d) for d in ids[ids >= 0]}
+        assert surfaced <= {3, 7}, surfaced
+        # Parity against the flat rebuild of the LIVE corpus under the
+        # equivalent name-set filter (rows renumber after rebuild).
+        oracle_r = idx.rebuild_retriever()
+        want_names = {"d3", "d7"}
+        rows = [i for i, nm in enumerate(oracle_r.names)
+                if nm in want_names]
+        wv, wi = oracle_r.search(make_queries(9, seed=10), k=12,
+                                 filter={"ids": rows})
+        got = [[None if d < 0 else view.names[d] for d in row]
+               for row in ids]
+        want = [[None if d < 0 else oracle_r.names[d] for d in row]
+                for row in wi]
+        assert got == want
+        np.testing.assert_array_equal(vals, wv)
+
+
+class TestFieldedIndex:
+    """Per-field weights: stacked sub-indexes sharing one vocab, the
+    weighted sum over fields IS the single row's dot."""
+
+    def _fielded(self, w_title=3.0, w_body=1.0):
+        names = [f"d{i}" for i in range(8)]
+        rng = random.Random(20)
+        titles = Corpus(names=names, docs=[
+            b"alpha beta", b"gamma delta", b"epsilon zeta",
+            b"eta theta", b"iota kappa", b"lam mu",
+            b"nu xi", b"omicron pi"])
+        bodies = Corpus(names=names, docs=[
+            (" ".join(rng.choice(WORDS) for _ in range(12))).encode()
+            for _ in range(8)])
+        r = TfidfRetriever(CFG).index_fields(
+            [("title", titles, w_title), ("body", bodies, w_body)])
+        return r, titles, bodies
+
+    def test_fielded_oracle_parity_both_scorers(self):
+        r, _, _ = self._fielded()
+        queries = make_queries(9, seed=20)
+        for spec in ("tfidf", "bm25"):
+            got = r.search(queries, k=5, scorer=spec)
+            want = oracle_search(r, queries, 5, scorer=spec)
+            assert_matches_oracle(got, want, ctx=spec)
+
+    def test_title_weight_dominates(self):
+        # "gamma delta" is d1's TITLE and appears nowhere else's
+        # title; with a heavy title weight d1 must rank first even
+        # though body text competes.
+        r, _, _ = self._fielded(w_title=5.0, w_body=0.5)
+        _, ids = r.search(["gamma delta"], k=3)
+        assert ids[0, 0] == 1
+
+    def test_field_weights_scale_stored_face(self):
+        # Doubling every field weight scales scores but cannot change
+        # the ranking — the weighted-sum factorization, observed.
+        r1, _, _ = self._fielded(w_title=1.0, w_body=1.0)
+        r2, _, _ = self._fielded(w_title=2.0, w_body=2.0)
+        queries = make_queries(7, seed=21)
+        _, i1 = r1.search(queries, k=4)
+        _, i2 = r2.search(queries, k=4)
+        np.testing.assert_array_equal(i1, i2)
+
+    def test_misaligned_fields_raise(self):
+        names = ["a", "b"]
+        t = Corpus(names=names, docs=[b"x", b"y"])
+        bad = Corpus(names=["a", "c"], docs=[b"x", b"y"])
+        with pytest.raises(ValueError):
+            TfidfRetriever(CFG).index_fields(
+                [("title", t, 1.0), ("body", bad, 1.0)])
+        with pytest.raises(ValueError):
+            TfidfRetriever(CFG).index_fields([])
+
+
+class TestSegmentedParity:
+    """Segmented views serve the family with flat-rebuild bit-parity —
+    the stacked face derivation is the same traced math."""
+
+    def _index(self, n=14, seed=30, deletes=("d3", "d8")):
+        from tfidf_tpu.index.segmented import SegmentedIndex
+        idx = SegmentedIndex(CFG, delta_docs=4, compact_at=64)
+        rng = random.Random(seed)
+        for i in range(n):
+            idx.add_docs([f"d{i}"],
+                         [" ".join(rng.choice(WORDS) for _ in range(9))])
+        idx.delete_docs(list(deletes))
+        return idx
+
+    @pytest.mark.parametrize("spec", SCORERS)
+    def test_view_matches_flat_rebuild(self, spec):
+        idx = self._index()
+        view = idx.view()
+        oracle_r = idx.rebuild_retriever()
+        queries = make_queries(13, seed=30)
+        vv, vi = view.search(queries, k=5, scorer=spec)
+        wv, wi = oracle_r.search(queries, k=5, scorer=spec)
+        got = [[None if d < 0 else view.names[d] for d in row]
+               for row in vi]
+        want = [[None if d < 0 else oracle_r.names[d] for d in row]
+                for row in wi]
+        assert got == want, spec
+        np.testing.assert_array_equal(vv, wv)
+
+    def test_view_tiled_equals_untiled(self, monkeypatch):
+        idx = self._index(seed=31)
+        view = idx.view()
+        queries = make_queries(8, seed=31)
+        for spec in ("bm25", "tfidf"):
+            monkeypatch.setenv("TFIDF_TPU_SCORE_TILING", "on")
+            on = view.search(queries, k=4, scorer=spec,
+                             filter={"prefix": "d1"})
+            monkeypatch.setenv("TFIDF_TPU_SCORE_TILING", "off")
+            off = view.search(queries, k=4, scorer=spec,
+                              filter={"prefix": "d1"})
+            np.testing.assert_array_equal(on[0], off[0])
+            np.testing.assert_array_equal(on[1], off[1])
+
+
+def needs_devices(n):
+    return pytest.mark.skipif(len(jax.devices()) < n,
+                              reason=f"needs {n} virtual devices")
+
+
+@needs_devices(2)
+class TestMeshParity:
+    """The sharded retriever serves the family bit-identically to its
+    single-device source — the mesh program is scorer-agnostic."""
+
+    @pytest.mark.parametrize("spec", ["tfidf", "bm25",
+                                      "bm25:k1=1.5,b=0.6"])
+    def test_sharded_matches_single(self, spec):
+        from tfidf_tpu.parallel.serving import (make_serving_plan,
+                                                shard_index)
+        single = TfidfRetriever(CFG).index(make_corpus(13, seed=40))
+        sharded = shard_index(single, make_serving_plan(2))
+        queries = make_queries(9, seed=40)
+        for filt in (None, {"id_range": [0, 7]}, {"prefix": "doc1"}):
+            v1, i1 = single.search(queries, 5, scorer=spec,
+                                   filter=filt)
+            v2, i2 = sharded.search(queries, 5, scorer=spec,
+                                    filter=filt)
+            np.testing.assert_array_equal(i1, i2,
+                                          err_msg=f"{spec} {filt}")
+            np.testing.assert_array_equal(v1, v2)
+
+
+class TestSnapshotRoundTrip:
+    """The scorer rides snapshots; the default writes NOTHING — a
+    round-22 snapshot and a round-23 default snapshot stay
+    byte-identical."""
+
+    def test_default_meta_is_unchanged(self, tmp_path):
+        r = TfidfRetriever(CFG).index(make_corpus(9, seed=50))
+        r.snapshot(str(tmp_path), epoch=1)
+        r2, meta = TfidfRetriever.restore(str(tmp_path), CFG)
+        assert "scorer" not in meta and "fields" not in meta
+        assert r2.scorer == ScorerSpec()
+
+    def test_bm25_scorer_round_trips(self, tmp_path):
+        corpus = make_corpus(15, seed=51)
+        r = TfidfRetriever(CFG, scorer="bm25:k1=1.5,b=0.6").index(corpus)
+        r.snapshot(str(tmp_path), epoch=2)
+        r2, meta = TfidfRetriever.restore(str(tmp_path), CFG)
+        assert meta["scorer"] == "bm25:b=0.6,k1=1.5"
+        assert r2.scorer == r.scorer
+        queries = make_queries(8, seed=51)
+        a = r.search(queries, k=5)
+        b = r2.search(queries, k=5)
+        np.testing.assert_array_equal(a[0], b[0])
+        np.testing.assert_array_equal(a[1], b[1])
+
+    def test_fielded_index_round_trips(self, tmp_path):
+        names = [f"d{i}" for i in range(6)]
+        titles = Corpus(names=names,
+                        docs=[f"{WORDS[i]} {WORDS[i + 1]}".encode()
+                              for i in range(6)])
+        bodies = Corpus(names=names,
+                        docs=[" ".join(WORDS[i:i + 5]).encode()
+                              for i in range(6)])
+        r = TfidfRetriever(CFG).index_fields(
+            [("title", titles, 2.0), ("body", bodies, 1.0)])
+        r.snapshot(str(tmp_path), epoch=3)
+        r2, meta = TfidfRetriever.restore(str(tmp_path), CFG)
+        assert r2._fields == r._fields
+        queries = make_queries(6, seed=52)
+        for spec in ("tfidf", "bm25"):
+            a = r.search(queries, k=4, scorer=spec)
+            b = r2.search(queries, k=4, scorer=spec)
+            np.testing.assert_array_equal(a[0], b[0], err_msg=spec)
+            np.testing.assert_array_equal(a[1], b[1], err_msg=spec)
+
+
+class TestServeFamily:
+    """The serve tier: per-request scorer/filter, group keys, cache
+    isolation, the live default change, and the canary's scorer-aware
+    golden capture."""
+
+    CORPUS = None  # built once per class below
+
+    @pytest.fixture()
+    def retriever(self):
+        if TestServeFamily.CORPUS is None:
+            TestServeFamily.CORPUS = make_corpus(30, seed=60)
+        return TfidfRetriever(CFG).index(TestServeFamily.CORPUS)
+
+    def _cfg(self, **kw):
+        kw.setdefault("max_batch", 8)
+        kw.setdefault("max_wait_ms", 5)
+        kw.setdefault("queue_depth", 64)
+        kw.setdefault("cache_entries", 64)
+        return ServeConfig(**kw)
+
+    def test_served_parity_per_scorer_and_filter(self, retriever):
+        queries = make_queries(10, seed=60)
+        with TfidfServer(retriever, self._cfg()) as srv:
+            for spec in SCORERS:
+                for filt in (None, {"id_range": [0, 15]}):
+                    sv, si = srv.search(queries, k=5, scorer=spec,
+                                        filter=filt)
+                    dv, di = retriever.search(queries, k=5,
+                                              scorer=spec, filter=filt)
+                    np.testing.assert_array_equal(
+                        si, di, err_msg=f"{spec} {filt}")
+                    np.testing.assert_array_equal(sv, dv)
+
+    def test_cache_never_aliases_across_scorers(self, retriever):
+        # Warm the cache under tfidf, then ask the SAME bytes under
+        # bm25 (and vice versa, twice each): every answer must match a
+        # direct search of its own scorer — a shared row would leak
+        # the other family member's ranking.
+        queries = make_queries(6, seed=61)
+        with TfidfServer(retriever, self._cfg()) as srv:
+            want = {s: retriever.search(queries, k=5, scorer=s)
+                    for s in ("tfidf", "bm25")}
+            for _round in range(2):
+                for s in ("tfidf", "bm25"):
+                    sv, si = srv.search(queries, k=5, scorer=s)
+                    np.testing.assert_array_equal(si, want[s][1])
+                    np.testing.assert_array_equal(sv, want[s][0])
+            hits = srv.metrics_snapshot()["cache"]["hits"]
+            assert hits >= len(queries) * 2   # second round all-hit
+
+    def test_mixed_scorer_batch_isolation(self, retriever):
+        # Concurrent submits alternating scorer: coalescing groups by
+        # (epoch, retriever, scorer, filter), so each future resolves
+        # to ITS scorer's bytes even when admitted together.
+        queries = make_queries(12, seed=62)
+        specs = [SCORERS[i % len(SCORERS)] for i in range(12)]
+        with TfidfServer(retriever, self._cfg(max_wait_ms=20,
+                                              cache_entries=0)) as srv:
+            futs = [srv.submit([q], k=4, scorer=s)
+                    for q, s in zip(queries, specs)]
+            for q, s, f in zip(queries, specs, futs):
+                sv, si = f.result(timeout=30)
+                dv, di = retriever.search([q], k=4, scorer=s)
+                np.testing.assert_array_equal(si, di, err_msg=s)
+                np.testing.assert_array_equal(sv, dv)
+
+    def test_malformed_request_fails_loud_not_wide(self, retriever):
+        with TfidfServer(retriever, self._cfg()) as srv:
+            with pytest.raises(ValueError):
+                srv.submit(["alpha"], k=3, scorer="bogus")
+            with pytest.raises(ValueError):
+                srv.submit(["alpha"], k=3, filter={"tenant": "x"})
+            # The server is still healthy after the rejects.
+            sv, si = srv.search(["alpha"], k=3)
+            dv, di = retriever.search(["alpha"], k=3)
+            np.testing.assert_array_equal(si, di)
+
+    def test_default_scorer_from_config(self, retriever):
+        queries = make_queries(5, seed=63)
+        cfg = self._cfg(scorer="bm25", bm25_k1=1.5, bm25_b=0.6)
+        with TfidfServer(retriever, cfg) as srv:
+            assert srv.default_scorer_key() == "bm25:b=0.6,k1=1.5"
+            sv, si = srv.search(queries, k=4)
+            dv, di = retriever.search(queries, k=4,
+                                      scorer="bm25:k1=1.5,b=0.6")
+            np.testing.assert_array_equal(si, di)
+            np.testing.assert_array_equal(sv, dv)
+
+    def test_set_scorer_bumps_epoch_and_recaptures_canary(self,
+                                                          retriever):
+        from tfidf_tpu.serve.canary import CanaryProber
+        queries = make_queries(6, seed=64)
+        with TfidfServer(retriever,
+                         self._cfg(scorer="bm25")) as srv:
+            canary = CanaryProber(srv, queries[:4], k=3)
+            assert canary.probe() == 1.0      # golden captured bm25
+            e0 = srv.epoch
+            e1 = srv.set_scorer("tfidf")
+            assert e1 == e0 + 1
+            assert srv.default_scorer_key() == "tfidf"
+            # The golden re-captured under the NEW default: parity
+            # holds, and served bytes are now the tfidf bytes.
+            assert canary.probe() == 1.0
+            sv, si = srv.search(queries, k=4)
+            dv, di = retriever.search(queries, k=4)
+            np.testing.assert_array_equal(si, di)
+            np.testing.assert_array_equal(sv, dv)
+            canary.close()
+
+    def test_config_validates_scorer_knobs(self):
+        with pytest.raises(ValueError):
+            ServeConfig(scorer="bogus")
+        with pytest.raises(ValueError):
+            ServeConfig(bm25_k1=-1.0)
+        with pytest.raises(ValueError):
+            ServeConfig(bm25_b=1.5)
+
+
+class TestRecompileDiscipline:
+    """Scorer switching after warm mints NOTHING: k1/b/N/avgdl are
+    traced scalars and every derived face shares one tiled jit."""
+
+    def test_zero_programs_across_scorer_and_param_switches(self):
+        from tfidf_tpu.models.retrieval import _search_tiled
+        r = TfidfRetriever(CFG).index(make_corpus(21, seed=70))
+        queries = make_queries(8, seed=70)
+
+        def total():
+            return (_search_tiled._cache_size()
+                    + score_topk_tiled_cache_size())
+
+        # Warm: the default path, the scored unfiltered path, and the
+        # scored filtered path (the live-mask arg changes the jit
+        # signature once) at this (bucket, k).
+        r.search(queries, k=5)
+        r.search(queries, k=5, scorer="bm25")
+        r.search(queries, k=5, filter={"id_range": [0, 10]})
+        warm = total()
+        for spec in ("bm25:k1=0.5,b=0.2", "bm25:k1=2.0,b=1.0",
+                     "bm25", "tfidf"):
+            r.search(queries, k=5, scorer=spec)
+        for filt in ({"ids": [1, 5, 9]}, {"prefix": "doc2"},
+                     {"id_range": [3, 18]}):
+            r.search(queries, k=5, scorer="bm25", filter=filt)
+            r.search(queries, k=5, filter=filt)
+        # Same pow2 bucket at a different query count: still warm.
+        r.search(queries[:5], k=5, scorer="bm25:k1=1.7,b=0.3")
+        assert total() == warm, (
+            f"scorer/parameter switching compiled "
+            f"{total() - warm} new program(s)")
+
+    def test_faces_cache_per_key_until_install(self):
+        r = TfidfRetriever(CFG).index(make_corpus(11, seed=71))
+        f1 = r._scorer_face(parse_scorer("bm25"))
+        f2 = r._scorer_face(parse_scorer("bm25:k1=1.2,b=0.75"))
+        assert f1 is f2                       # same canonical key
+        f3 = r._scorer_face(parse_scorer("bm25:k1=2.0"))
+        assert f3 is not f1
+        r.index(make_corpus(11, seed=72))     # install invalidates
+        assert r._scorer_face(parse_scorer("bm25")) is not f1
+
+
+class TestRecallHelpers:
+    """The satellite metrics the scoring artifact embeds."""
+
+    def test_recall_at_k(self):
+        got = np.array([[1, 2, 3], [4, -1, -1]])
+        ora = np.array([[3, 2, 9], [4, 5, -1]])
+        # q0: {1,2,3} vs {3,2,9} -> 2/3; q1: {4} vs {4,5} -> 1/2
+        assert retrieval_recall_at_k(got, ora, 3) == \
+            pytest.approx((2 / 3 + 1 / 2) / 2)
+        assert retrieval_recall_at_k(ora, ora, 3) == 1.0
+        # Empty-oracle queries drop out of the mean...
+        ora2 = np.array([[3, 2, 9], [-1, -1, -1]])
+        assert retrieval_recall_at_k(got, ora2, 3) == \
+            pytest.approx(2 / 3)
+        # ...and no defined queries at all is an error, not a 0.0.
+        with pytest.raises(ValueError):
+            retrieval_recall_at_k(got, np.full((2, 3), -1), 3)
+        with pytest.raises(ValueError):
+            retrieval_recall_at_k(got, ora[:1], 3)
+
+    def test_scorer_overlap(self):
+        a = np.array([[1, 2, 3], [7, 8, -1]])
+        b = np.array([[3, 2, 1], [9, -1, -1]])
+        # q0 jaccard 1.0; q1: {7,8} vs {9} -> 0
+        assert scorer_overlap_at_k(a, b, 3) == pytest.approx(0.5)
+        assert scorer_overlap_at_k(a, a, 3) == 1.0
+        empty = np.full((2, 3), -1)
+        with pytest.raises(ValueError):
+            scorer_overlap_at_k(empty, empty, 3)

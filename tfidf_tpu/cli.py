@@ -79,6 +79,19 @@ protocol (one JSON object per line):
       event — tools/doctor.py --request RID renders the timeline)
   {"id": 2, "queries": [...], "deadline_ms": 50}
       -> {"id": 2, "error": "deadline_exceeded"} when shed
+  {"id": 3, "queries": [...], "scorer": "bm25:k1=1.5,b=0.6",
+   "filter": {"prefix": "tenant-a/"}}
+      -> per-request scoring-family member + candidate filter
+      (scorer: "tfidf" | "bm25" | "bm25:k1=...,b=..." | {"kind": ...};
+      filter: {"ids": [...]} row ids | {"id_range": [lo, hi)} |
+      {"prefix": "..."} on doc names; omitted = the server default
+      scorer, unfiltered. Requests only batch with same-scorer /
+      same-filter peers; cache rows key on both)
+  {"op": "set_scorer", "scorer": "bm25"}
+      -> {"scorer": "bm25:b=0.75,k1=1.2", "epoch": N}  (change the
+      DEFAULT scorer live: epoch bump + cache clear + canary oracle
+      re-capture under the new default — a scorer change is a
+      visibility change)
   {"op": "metrics"}            -> {"metrics": {...}}  (SLO snapshot —
       the "slo" object carries windowed objective compliance and
       fast/slow burn rates when --slo-ms is set — plus uptime_s /
@@ -513,6 +526,27 @@ def _build_parser() -> argparse.ArgumentParser:
                          "past it the replica is declared dead and "
                          "restarted (default 120; env "
                          "TFIDF_TPU_REPLICA_TIMEOUT_S)")
+    sv.add_argument("--scorer", metavar="SPEC", default=None,
+                    help="default scoring-family member for requests "
+                         "that name none: 'tfidf' (bit-identical "
+                         "legacy default) or 'bm25' / "
+                         "'bm25:k1=1.5,b=0.6'. Per-request \"scorer\" "
+                         "JSONL fields override; the set_scorer op "
+                         "changes it live (epoch bump + cache clear + "
+                         "canary re-capture). BM25 scores through the "
+                         "SAME tiled kernel — weights precompute into "
+                         "the sparse face (default tfidf; env "
+                         "TFIDF_TPU_SCORER; docs/SERVING.md "
+                         "'Scoring family')")
+    sv.add_argument("--bm25-k1", type=float, default=None,
+                    metavar="K1",
+                    help="BM25 term-frequency saturation for a bare "
+                         "--scorer bm25 (an inline k1= in the spec "
+                         "wins; default 1.2; env TFIDF_TPU_BM25_K1)")
+    sv.add_argument("--bm25-b", type=float, default=None, metavar="B",
+                    help="BM25 length-normalization strength, same "
+                         "resolution rules as --bm25-k1 (default "
+                         "0.75; env TFIDF_TPU_BM25_B)")
     sv.add_argument("--faults", metavar="PLAN", default=None,
                     help="arm a deterministic fault-injection plan "
                          "(chaos testing; also env TFIDF_TPU_FAULTS; "
@@ -1116,6 +1150,16 @@ def _serve_handle_line(server, line, write, default_k, build_retriever,
             write({"id": req.get("id"),
                    "error": f"delete_docs failed: {e}"})
         return True
+    if op == "set_scorer":
+        try:
+            epoch = server.set_scorer(req.get("scorer"))
+            write({"id": req.get("id"),
+                   "scorer": server.default_scorer_key(),
+                   "epoch": epoch})
+        except (ValueError, TypeError) as e:
+            write({"id": req.get("id"),
+                   "error": f"set_scorer failed: {e}"})
+        return True
     if op is not None:
         write({"id": req.get("id"), "error": f"unknown op {op!r}"})
         return True
@@ -1162,8 +1206,12 @@ def _serve_handle_line(server, line, write, default_k, build_retriever,
     try:
         server.submit(queries, k,
                       deadline_ms=req.get("deadline_ms"),
-                      use_cache=bool(req.get("use_cache", True))
+                      use_cache=bool(req.get("use_cache", True)),
+                      scorer=req.get("scorer"),
+                      filter=req.get("filter")
                       ).add_done_callback(on_done)
+    except (ValueError, TypeError) as e:  # malformed scorer/filter spec
+        write({"id": line_id, "error": f"bad request: {e}"})
     except PoisonQuery as e:     # quarantined: the protocol's 4xx
         write({"id": line_id, "error": "poison_query", "detail": str(e),
                **({"rid": e.rid} if getattr(e, "rid", None) else {})})
@@ -1216,7 +1264,8 @@ def _run_serve(args) -> int:
                     else args.query_slab == "on"),
         pipeline_depth=args.serve_pipeline_depth,
         replicas=args.replicas,
-        replica_timeout_s=args.replica_timeout_s)
+        replica_timeout_s=args.replica_timeout_s,
+        scorer=args.scorer, bm25_k1=args.bm25_k1, bm25_b=args.bm25_b)
 
     if serve_cfg.replicas:
         # Replicated tier: this process becomes the FRONT — it owns

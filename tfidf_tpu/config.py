@@ -371,6 +371,19 @@ class ServeConfig:
         boot to ready (jax import + snapshot restore + warm) or to
         ack a control op before declaring it dead. CLI
         ``--replica-timeout-s`` / env ``TFIDF_TPU_REPLICA_TIMEOUT_S``.
+      scorer: default scoring-family member for requests that name
+        none (round 23): ``"tfidf"`` (the bit-identical legacy
+        default) or ``"bm25"`` / ``"bm25:k1=1.5,b=0.6"``
+        (``tfidf_tpu/scoring``). Per-request ``"scorer"`` JSONL
+        fields override it. None = tfidf. CLI ``--scorer`` / env
+        ``TFIDF_TPU_SCORER``.
+      bm25_k1: BM25 term-frequency saturation for the default scorer
+        when ``scorer`` is bare ``"bm25"`` (ignored otherwise — an
+        inline ``k1=`` in the spec wins). None = 1.2. CLI
+        ``--bm25-k1`` / env ``TFIDF_TPU_BM25_K1``.
+      bm25_b: BM25 length-normalization strength, same resolution
+        rules as ``bm25_k1``. None = 0.75. CLI ``--bm25-b`` / env
+        ``TFIDF_TPU_BM25_B``.
     """
 
     max_batch: int = 256
@@ -401,6 +414,9 @@ class ServeConfig:
     pipeline_depth: int = 2
     replicas: Optional[int] = None
     replica_timeout_s: float = 120.0
+    scorer: Optional[str] = None
+    bm25_k1: Optional[float] = None
+    bm25_b: Optional[float] = None
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -465,6 +481,15 @@ class ServeConfig:
             raise ValueError("replicas requires snapshot_dir — the "
                              "replicas spin up from (and restart "
                              "from) the shared snapshot")
+        if self.bm25_k1 is not None and self.bm25_k1 < 0:
+            raise ValueError("bm25_k1 must be >= 0")
+        if self.bm25_b is not None and not 0 <= self.bm25_b <= 1:
+            raise ValueError("bm25_b must be in [0, 1]")
+        if self.scorer is not None:
+            # Validate eagerly (jax-free): a typo'd --scorer fails at
+            # config time, not at the first request.
+            from tfidf_tpu.scoring.family import spec_from_parts
+            spec_from_parts(self.scorer, self.bm25_k1, self.bm25_b)
 
     @staticmethod
     def from_env(**overrides) -> "ServeConfig":
@@ -507,6 +532,9 @@ class ServeConfig:
                 ("replicas", "TFIDF_TPU_REPLICAS", int),
                 ("replica_timeout_s", "TFIDF_TPU_REPLICA_TIMEOUT_S",
                  float),
+                ("scorer", "TFIDF_TPU_SCORER", str),
+                ("bm25_k1", "TFIDF_TPU_BM25_K1", float),
+                ("bm25_b", "TFIDF_TPU_BM25_B", float),
                 ("query_slab", "TFIDF_TPU_QUERY_SLAB",
                  lambda raw: raw.strip().lower() not in
                  ("0", "off", "false", "no"))):

@@ -57,6 +57,10 @@ from tfidf_tpu.ops.sparse import (score_tile_rows, score_tiling,
                                   sorted_term_counts_host, sparse_scores)
 from tfidf_tpu.ops.scoring import idf_from_df
 from tfidf_tpu.ops.topk import merge_topk, segment_score_topk
+from tfidf_tpu.scoring.family import (ScorerSpec, avgdl_f32,
+                                      parse_scorer)
+from tfidf_tpu.scoring.filters import (FilterSpec, filter_mask,
+                                       parse_filter)
 from tfidf_tpu.streaming import StreamingTfidf
 
 __all__ = ["SegmentedIndex", "IndexView"]
@@ -70,9 +74,11 @@ def _jax():  # deferred so tools can import the module without a backend
 
 @functools.lru_cache(maxsize=1)
 def _jitted():
-    """The two per-visibility-change device programs, shaped only by
+    """The per-visibility-change device programs, shaped only by
     (capacity, length) / vocab — steady-state mutation re-runs warm
-    executables, never traces (the zero-recompiles pin)."""
+    executables, never traces (the zero-recompiles pin). Round 23 adds
+    the bm25 twins: same shapes, scorer parameters traced, so a
+    scorer's face refresh joins the warm set after one trace."""
     jax, jnp = _jax()
 
     @jax.jit
@@ -92,17 +98,33 @@ def _jitted():
         cols = jnp.where(head, ids, 0)
         return data, cols
 
-    return idf_fn, refresh_weights
+    @jax.jit
+    def bm25_idf_fn(df, num_docs):
+        from tfidf_tpu.scoring.family import bm25_idf_from_df
+        return bm25_idf_from_df(df, num_docs)
+
+    @jax.jit
+    def refresh_weights_bm25(ids, counts, head, lengths, idf, avgdl,
+                             k1, b):
+        # The ONE bm25 elementwise sequence (scoring.family) over a
+        # segment's stored triple — the same function the flat
+        # retriever's derived face traces, which is the whole
+        # flat-vs-segmented bm25 bit-parity argument (avgdl/k1/b are
+        # traced f32: retuning never compiles).
+        from tfidf_tpu.scoring.family import bm25_weights
+        return bm25_weights(ids, counts, head, lengths, idf, avgdl,
+                            k1, b)
+
+    return idf_fn, refresh_weights, bm25_idf_fn, refresh_weights_bm25
 
 
 def index_compile_cache_size() -> int:
     """Total compiled-program count across the segmented search path —
     the mutate bench's recompile receipt (diffed across the measured
     window; must be flat after warm-up)."""
-    idf_fn, refresh_weights = _jitted()
     return sum(f._cache_size() for f in
-               (idf_fn, refresh_weights, segment_score_topk,
-                merge_topk)) + score_topk_tiled_cache_size()
+               _jitted() + (segment_score_topk,
+                            merge_topk)) + score_topk_tiled_cache_size()
 
 
 class _ViewPart:
@@ -135,7 +157,10 @@ class IndexView:
     def __init__(self, owner: "SegmentedIndex", version: int,
                  config: PipelineConfig, parts: List[_ViewPart],
                  names: List[str], idf, idf_np: np.ndarray,
-                 num_live: int) -> None:
+                 num_live: int,
+                 triples: Optional[list] = None,
+                 df_np: Optional[np.ndarray] = None,
+                 total_len: int = 0) -> None:
         self.owner = owner
         self.version = version
         self.config = config
@@ -147,6 +172,17 @@ class IndexView:
         # Lazily-built stacked face of every part (round 21): the
         # one-dispatch tiled search scans segments as ONE row block.
         self._stack: Optional[tuple] = None
+        # Scorer family (round 23): the per-part stored triples, the
+        # corrected global DF and the exact live token total this view
+        # was built against — everything a non-default scorer's face
+        # derivation needs — plus the per-scorer stacked faces and
+        # per-filter live masks, cached lazily (views are immutable,
+        # so each derives at most once).
+        self._triples = triples or []
+        self._df_np = df_np
+        self._total_len = int(total_len)
+        self._scorer_stacks: dict = {}
+        self._filter_masks: dict = {}
 
     @property
     def indexed(self) -> bool:
@@ -163,6 +199,9 @@ class IndexView:
             out += [p.data, p.cols, p.live]
         if self._stack is not None:
             out += list(self._stack)
+        for st in self._scorer_stacks.values():
+            out += list(st)
+        out += list(self._filter_masks.values())
         return out
 
     def _stacked(self):
@@ -205,13 +244,19 @@ class IndexView:
         artifact, not a historical one)."""
         return self.owner.save(path, epoch=epoch, extra_meta=extra_meta)
 
-    def search(self, queries: Sequence[Union[str, bytes]], k: int = 10
+    def search(self, queries: Sequence[Union[str, bytes]], k: int = 10,
+               *, scorer=None, filter=None
                ) -> Tuple[np.ndarray, np.ndarray]:
         """Ranked retrieval over the live segments: (scores, doc
         positions), each [Q, k'] with k' = min(k, live docs).
         ``doc positions`` index :attr:`names`; -1 marks padding. Same
         bucketing discipline as ``TfidfRetriever.search``, so the
-        compiled-program budget is shared.
+        compiled-program budget is shared. ``scorer``/``filter``
+        (round 23) select another scorer-family member / restrict the
+        candidate set; the default combination runs the pre-round-23
+        body unchanged. Filter doc ids are POSITIONS in this view's
+        row space (what results return); name-prefix filters are the
+        position-independent form.
 
         Tiled (round 21, default ON): every segment stacks into ONE
         doc-tiled scan — K segments cost one device dispatch plus the
@@ -220,6 +265,12 @@ class IndexView:
         query split; results are bit-identical either way (stacked row
         order is the per-part base order, so the tie discipline
         matches — see ``ops.sparse``'s parity argument)."""
+        if scorer is not None or filter is not None:
+            spec = (ScorerSpec() if scorer is None
+                    else parse_scorer(scorer))
+            fspec = parse_filter(filter)
+            if not (spec.is_default and fspec is None):
+                return self._search_scored(queries, k, spec, fspec)
         _, jnp = _jax()
         tiled = score_tiling()
         if not tiled and len(queries) > _LEGACY_QUERY_BLOCK:
@@ -260,6 +311,125 @@ class IndexView:
                 ids_cat = jnp.concatenate(ids_parts, axis=1)
             ksel = min(k, vals_cat.shape[1])
             vals, idx = merge_topk(vals_cat, ids_cat, k=ksel)
+        vals = np.asarray(vals)[:nq, :width]
+        idx = np.asarray(idx)[:nq, :width]
+        ok = vals > 0
+        return np.where(ok, vals, 0.0), np.where(ok, idx, -1)
+
+    def _face(self, spec: ScorerSpec):
+        """The stacked ``(data, cols)`` face of one scorer, cached per
+        key for this view's lifetime. tfidf IS the default stacked
+        face; bm25 refreshes every part's stored triple through the
+        shared ``refresh_weights_bm25`` jit against this view's global
+        DF/avgdl, then stacks with the identical pow2-pad discipline —
+        row order (and therefore tie order) matches the default stack
+        by construction."""
+        key = spec.key()
+        st = self._scorer_stacks.get(key)
+        if st is not None:
+            return st
+        if spec.kind == "tfidf":
+            data, cols, _ = self._stacked()
+            st = (data, cols)
+        else:
+            _, jnp = _jax()
+            _, _, bm25_idf_fn, refresh_bm25 = _jitted()
+            idf_b = bm25_idf_fn(
+                jnp.asarray(self._df_np.astype(np.int32)),
+                jnp.int32(self._num_docs))
+            avgdl = avgdl_f32(self._total_len, self._num_docs)
+            d_parts, c_parts = [], []
+            for ids_d, counts_d, head_d, lens_d in self._triples:
+                d_, c_ = refresh_bm25(ids_d, counts_d, head_d, lens_d,
+                                      idf_b, avgdl,
+                                      np.float32(spec.k1),
+                                      np.float32(spec.b))
+                d_parts.append(d_)
+                c_parts.append(c_)
+            if len(d_parts) == 1:
+                data, cols = d_parts[0], c_parts[0]
+            else:
+                data = jnp.concatenate(d_parts, axis=0)
+                cols = jnp.concatenate(c_parts, axis=0)
+            total = data.shape[0]
+            pad = _next_pow2(total) - total
+            if pad:
+                data = jnp.pad(data, ((0, pad), (0, 0)))
+                cols = jnp.pad(cols, ((0, pad), (0, 0)))
+            st = (data, cols)
+        self._scorer_stacks[key] = st
+        return st
+
+    def scorer_face(self, spec=None) -> Tuple[np.ndarray, np.ndarray]:
+        """Host copy of a scorer's stacked ``(data, cols)`` face,
+        trimmed to the concatenated part rows (the pow2 search pad
+        stripped) — the row space ``MeshShardedRetriever._host_blocks``
+        shards, derived through the SAME device programs this view
+        searches with (the sharded-vs-view bit-parity contract)."""
+        spec = ScorerSpec() if spec is None else parse_scorer(spec)
+        data, cols = self._face(spec)
+        total = sum(p.rows for p in self._parts)
+        return np.asarray(data)[:total], np.asarray(cols)[:total]
+
+    def _filter_live(self, fspec: Optional[FilterSpec]):
+        """The stacked live mask ∧ one filter's allow-mask (tombstone
+        composition is literally this boolean AND), cached per
+        canonical filter key; no filter returns the tombstone mask
+        itself."""
+        if fspec is None:
+            return self._stacked()[2]
+        key = fspec.key()
+        live = self._filter_masks.get(key)
+        if live is None:
+            _, jnp = _jax()
+            base = np.asarray(self._stacked()[2])
+            npos = min(base.shape[0], len(self.names))
+            mask = np.zeros((base.shape[0],), bool)
+            mask[:npos] = filter_mask(fspec, npos, names=self.names)
+            live = jnp.asarray(base & mask)
+            self._filter_masks[key] = live
+        return live
+
+    def _search_scored(self, queries: Sequence[Union[str, bytes]],
+                       k: int, spec: ScorerSpec,
+                       fspec: Optional[FilterSpec]
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+        """Non-default (scorer, filter) search over this view: same
+        stacked kernel, derived face + composed live mask, bm25
+        queries packed as raw counts. Tiled and untiled lowerings are
+        bit-identical per scorer (the untiled path scores the stack as
+        one segment — same rows, same tie space)."""
+        _, jnp = _jax()
+        tiled = score_tiling()
+        if not tiled and len(queries) > _LEGACY_QUERY_BLOCK:
+            blk = _LEGACY_QUERY_BLOCK
+            parts = [self._search_scored(queries[s:s + blk], k, spec,
+                                         fspec)
+                     for s in range(0, len(queries), blk)]
+            return (np.concatenate([p[0] for p in parts]),
+                    np.concatenate([p[1] for p in parts]))
+        nq = len(queries)
+        width = min(k, self._num_docs)
+        if not self._parts or width == 0:
+            return (np.zeros((nq, width), np.float32),
+                    np.full((nq, width), -1, np.int64))
+        bucket = 1 << max(0, nq - 1).bit_length()
+        qmat = jnp.asarray(query_matrix(
+            queries, self.config, self._idf_np, pad_to=bucket,
+            mode="counts" if spec.kind == "bm25" else "cosine"))
+        data, cols = self._face(spec)
+        live = self._filter_live(fspec)
+        rows = int(data.shape[0])
+        if tiled:
+            tile = score_tile_rows(rows)
+            with obs.span("score_tile", tiles=-(-rows // tile),
+                          rows=rows, segments=len(self._parts),
+                          queries=int(bucket)):
+                vals, idx = score_topk_tiled(data, cols, live, qmat,
+                                             k, tile=tile)
+        else:
+            vals, idx = segment_score_topk(data, cols, live, qmat,
+                                           k=min(k, rows))
         vals = np.asarray(vals)[:nq, :width]
         idx = np.asarray(idx)[:nq, :width]
         ok = vals > 0
@@ -547,20 +717,26 @@ class SegmentedIndex:
         are bit-identical to a from-scratch rebuild of the live
         corpus."""
         _, jnp = _jax()
-        idf_fn, refresh_weights = _jitted()
+        idf_fn, refresh_weights = _jitted()[:2]
         with self._lock:
             if self._view is not None:
                 return self._view
             src = self._sealed + ([self._delta] if self._delta.used
                                   else [])
             df = np.zeros((self.config.vocab_size,), np.int64)
+            total_len = 0
             for seg in src:
                 df += seg.df
+                # Exact-integer live token total — the avgdl numerator
+                # a non-default scorer's face derivation will need.
+                total_len += int((seg.lengths.astype(np.int64)
+                                  * seg.live).sum())
             num_live = self._live_locked()
             idf = idf_fn(jnp.asarray(df.astype(np.int32)),
                          jnp.int32(num_live))
             idf_np = np.asarray(idf)
             parts: List[_ViewPart] = []
+            triples: list = []
             names: List[str] = []
             base = 0
             for seg in src:
@@ -570,11 +746,14 @@ class SegmentedIndex:
                 parts.append(_ViewPart(data, cols,
                                        jnp.asarray(seg.live), base,
                                        seg.capacity))
+                triples.append((ids_d, counts_d, head_d, lens_d))
                 names += [n if n is not None else ""
                           for n in seg.names]
                 base += seg.capacity
             self._view = IndexView(self, self._version, self.config,
-                                   parts, names, idf, idf_np, num_live)
+                                   parts, names, idf, idf_np, num_live,
+                                   triples=triples, df_np=df,
+                                   total_len=total_len)
             return self._view
 
     # --- oracle / fallback --------------------------------------------

@@ -44,9 +44,16 @@ from tfidf_tpu.obs import devmon
 from tfidf_tpu.ops.hashing import words_to_ids
 from tfidf_tpu.ops.scoring import idf_from_df
 from tfidf_tpu.ops.sparse import (score_method, score_tile_rows,
-                                  score_tiling, score_topk_tiled_trace,
+                                  score_tiling, score_topk_tiled,
+                                  score_topk_tiled_cache_size,
+                                  score_topk_tiled_trace,
                                   sorted_term_counts, sparse_df,
                                   sparse_scores)
+from tfidf_tpu.scoring.family import (ScorerSpec, avgdl_f32,
+                                      bm25_face_trace, doc_lengths_host,
+                                      parse_scorer, resolve_scorer)
+from tfidf_tpu.scoring.filters import (FilterSpec, filter_key,
+                                       filter_mask, parse_filter)
 from tfidf_tpu.ops.tokenize import whitespace_tokenize
 from tfidf_tpu.parallel.mesh import DOCS_AXIS, MeshPlan
 from tfidf_tpu.parallel.compat import shard_map
@@ -193,6 +200,28 @@ def _search_tiled(ids, weights, head, qmat, *, k: int, tile: int,
                                   method=method)
 
 
+@jax.jit
+def _tfidf_face(ids, weights, head):
+    """Stored triple -> the dense-safe ``(data, cols)`` pair the tiled
+    kernel consumes — the exact two ``where`` ops ``_search_tiled``
+    fuses inline, lifted out for the scorer-family path (round 23)
+    where the face is cached per scorer instead of re-masked per
+    dispatch. Elementwise, so bit-identical to the fused form."""
+    return jnp.where(head, weights, 0.0), jnp.where(head, ids, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("vocab_size",))
+def _bm25_face(ids, head, num_docs, avgdl, k1, b, *, vocab_size: int):
+    """The BM25 derived face (round 23): everything — counts, lengths,
+    df — re-derived on device from the stored ``(ids, head)`` pair via
+    ``scoring.family.bm25_face_trace``, so the snapshot format and
+    ``_build_index`` stay byte-identical to round 22. ``num_docs`` /
+    ``avgdl`` / ``k1`` / ``b`` are TRACED scalars: retuning k1/b
+    re-derives a face without compiling a new program."""
+    return bm25_face_trace(ids, head, num_docs, avgdl, k1, b,
+                           vocab_size=vocab_size)
+
+
 def _make_search_sharded(plan: MeshPlan, k: int):
     """Docs-sharded search: local gather-score + local top-k + all_gather."""
     mesh = plan.mesh
@@ -239,18 +268,25 @@ def _make_search_sharded(plan: MeshPlan, k: int):
 def fill_query_matrix(queries: Sequence[Union[str, bytes]],
                       config: PipelineConfig, idf: np.ndarray,
                       out: np.ndarray,
-                      scratch: Optional[np.ndarray] = None) -> np.ndarray:
-    """Pack queries into the [V, Q] cosine block ``out`` IN PLACE.
+                      scratch: Optional[np.ndarray] = None,
+                      mode: str = "cosine") -> np.ndarray:
+    """Pack queries into the [V, Q] query block ``out`` IN PLACE.
 
     THE query-packing implementation — :func:`query_matrix` and the
     slab path both run this exact float-op sequence, so the
     zero-allocation path is bit-identical to the allocating one by
     construction (pinned as a property in tests/test_queryslab.py).
-    Each column: float32 term counts accumulated directly into the
-    column, ``/ len(words)``, ``* idf``, L2-normalized via the reused
-    ``[V]`` ``scratch`` — no per-query temporaries at all. A zero/
-    empty column scores 0 against every document.
+    ``mode="cosine"`` (the tfidf scorer): float32 term counts
+    accumulated directly into the column, ``/ len(words)``, ``* idf``,
+    L2-normalized via the reused ``[V]`` ``scratch`` — no per-query
+    temporaries at all. ``mode="counts"`` (the bm25 scorer, round 23):
+    the accumulation STOPS at raw counts — BM25 absorbs everything
+    else into the doc-side weights, so its query column is the bare
+    term-count vector (``idf`` is accepted and ignored). A zero/empty
+    column scores 0 against every document either way.
     """
+    if mode not in ("cosine", "counts"):
+        raise ValueError(f"unknown query mode {mode!r}")
     out.fill(0.0)
     idf = np.asarray(idf)
     if scratch is None:
@@ -267,6 +303,8 @@ def fill_query_matrix(queries: Sequence[Union[str, bytes]],
         # values bincount+astype produced; then the same two
         # elementwise ops, in place.
         np.add.at(col, ids, one)
+        if mode == "counts":
+            continue
         col /= len(words)
         col *= idf
         np.multiply(col, col, out=scratch)
@@ -280,8 +318,10 @@ def fill_query_matrix(queries: Sequence[Union[str, bytes]],
 
 def query_matrix(queries: Sequence[Union[str, bytes]],
                  config: PipelineConfig, idf: np.ndarray,
-                 pad_to: Optional[int] = None) -> np.ndarray:
-    """Host-side packing of queries into a dense [V, Q] cosine block.
+                 pad_to: Optional[int] = None,
+                 mode: str = "cosine") -> np.ndarray:
+    """Host-side packing of queries into a dense [V, Q] query block
+    (cosine columns by default; ``mode="counts"`` for bm25).
 
     Shared by :meth:`TfidfRetriever.search` and the segmented index's
     views (``tfidf_tpu/index``) so both paths build byte-identical
@@ -293,7 +333,7 @@ def query_matrix(queries: Sequence[Union[str, bytes]],
     packing implementation for the allocating and slab paths alike.
     """
     q = np.empty((config.vocab_size, pad_to or len(queries)), np.float32)
-    return fill_query_matrix(queries, config, idf, q)
+    return fill_query_matrix(queries, config, idf, q, mode=mode)
 
 
 def config_fingerprint(cfg: PipelineConfig) -> str:
@@ -331,7 +371,8 @@ class TfidfRetriever:
     """
 
     def __init__(self, config: Optional[PipelineConfig] = None,
-                 plan: Optional[MeshPlan] = None):
+                 plan: Optional[MeshPlan] = None,
+                 scorer=None):
         self.config = config or PipelineConfig(vocab_mode=VocabMode.HASHED)
         if self.config.vocab_mode is not VocabMode.HASHED:
             raise ValueError("TfidfRetriever requires HASHED vocab")
@@ -339,6 +380,16 @@ class TfidfRetriever:
                                  or plan.n_seq_shards != 1):
             raise ValueError("retrieval shards the docs axis only")
         self.plan = plan
+        # The index-default scorer (round 23): what search() runs when
+        # a request names none. Explicit arg > TFIDF_TPU_SCORER > the
+        # tfidf default. Per-scorer derived faces and per-filter live
+        # masks cache here; both invalidate on every index install.
+        self.scorer: ScorerSpec = resolve_scorer(scorer)
+        self._faces: dict = {}
+        self._filters: dict = {}
+        # Fielded index (round 23): [(name, weight, start, stop)] slot
+        # spans when index_fields() built this index, else None.
+        self._fields: Optional[List[Tuple[str, float, int, int]]] = None
         self.names: List[str] = []
         self._idf: Optional[jax.Array] = None
         self._ids = self._weights = self._head = None
@@ -375,6 +426,9 @@ class TfidfRetriever:
         self._idf = idf
         self.names = list(corpus.names)
         self._num_docs = len(corpus)
+        self._faces.clear()
+        self._filters.clear()
+        self._fields = None
         return self
 
     def index_dir(self, input_dir: str, strict: bool = True,
@@ -428,6 +482,69 @@ class TfidfRetriever:
         # rows [0, num_docs), so the tail-padding search guard holds.
         self.names = names
         self._num_docs = num_docs
+        self._faces.clear()
+        self._filters.clear()
+        self._fields = None
+        return self
+
+    def index_fields(self, fields) -> "TfidfRetriever":
+        """Fielded indexing (round 23): ``fields`` is a sequence of
+        ``(name, corpus, weight)`` — the same documents tokenized per
+        field (title, body, ...), every corpus row-aligned (same
+        length, same names). Each field builds its own sub-index
+        (per-field DF, per-field normalization) and the sub-indexes
+        STACK along the slot axis sharing one vocab, with the tfidf
+        weights pre-scaled by the field weight — so one doc row's dot
+        against a query IS the weighted sum over fields, and the
+        default search path runs completely unchanged on the stacked
+        triple. Query columns use the union IDF (every field's rows
+        count as documents: N = n_fields * D). The bm25 face derives
+        per field slice (own df/avgdl) scaled the same way."""
+        if self.plan is not None:
+            raise ValueError("fielded indexes are single-device (wrap "
+                             "in MeshShardedRetriever to shard)")
+        fields = list(fields)
+        if not fields:
+            raise ValueError(
+                "index_fields needs at least one (name, corpus, weight)")
+        cfg = self.config
+        names: Optional[List[str]] = None
+        num_docs = 0
+        spans: List[Tuple[str, float, int, int]] = []
+        ids_parts, w_parts, h_parts = [], [], []
+        df_total = None
+        start = 0
+        for fname, corpus, weight in fields:
+            if names is None:
+                num_docs = len(corpus)
+                names = list(corpus.names)
+            elif len(corpus) != num_docs or list(corpus.names) != names:
+                raise ValueError(
+                    f"field {fname!r} is not row-aligned with "
+                    f"{fields[0][0]!r} (same docs, same order)")
+            batch = pack_corpus(corpus, cfg, want_words=False)
+            ids, weights, head, _ = _build_index(
+                batch.token_ids, batch.lengths, jnp.int32(num_docs),
+                vocab_size=cfg.vocab_size)
+            df_f = sparse_df(ids, head, cfg.vocab_size)
+            df_total = df_f if df_total is None else df_total + df_f
+            ids_parts.append(ids)
+            w_parts.append(weights * jnp.float32(weight))
+            h_parts.append(head)
+            stop = start + int(ids.shape[1])
+            spans.append((str(fname), float(weight), start, stop))
+            start = stop
+        self._ids = jnp.concatenate(ids_parts, axis=1)
+        self._weights = jnp.concatenate(w_parts, axis=1)
+        self._head = jnp.concatenate(h_parts, axis=1)
+        self._idf = idf_from_df(df_total,
+                                jnp.int32(len(fields) * num_docs),
+                                jnp.float32)
+        self.names = names
+        self._num_docs = num_docs
+        self._faces.clear()
+        self._filters.clear()
+        self._fields = spans
         return self
 
     @property
@@ -466,6 +583,14 @@ class TfidfRetriever:
             "config_sha": config_fingerprint(self.config),
             "vocab_size": int(self.config.vocab_size),
         }
+        # Scorer family (round 23): non-default scorers and fielded
+        # slot spans ride the meta dict so restore() serves the same
+        # family member. Default tfidf writes NOTHING — a round-22
+        # snapshot and a round-23 default snapshot are byte-identical.
+        if not self.scorer.is_default:
+            meta["scorer"] = self.scorer.key()
+        if self._fields is not None:
+            meta["fields"] = [[f, w, s, e] for f, w, s, e in self._fields]
         if extra_meta:
             meta.update(extra_meta)
         return ckpt.save_index(path, arrays, meta)
@@ -505,6 +630,11 @@ class TfidfRetriever:
             raise ckpt.SnapshotMismatch(
                 f"snapshot names ({len(r.names)}) != num_docs "
                 f"({r._num_docs})")
+        r.scorer = parse_scorer(meta.get("scorer"))
+        fields = meta.get("fields")
+        if fields:
+            r._fields = [(str(f), float(w), int(s), int(e))
+                         for f, w, s, e in fields]
         return r, meta
 
     # --- querying ---
@@ -548,24 +678,98 @@ class TfidfRetriever:
             self._slab.reserve(self.slab_depth)
         return self._slab
 
-    def search(self, queries: Sequence[Union[str, bytes]], k: int = 10
+    def search(self, queries: Sequence[Union[str, bytes]], k: int = 10,
+               *, scorer=None, filter=None
                ) -> Tuple[np.ndarray, np.ndarray]:
         """Ranked retrieval: (scores, doc_indices), each [Q, k'] with
         k' = min(k, num_docs) — the same width on both execution paths.
 
         ``doc_indices`` index into :attr:`names`; -1 marks padding when
-        fewer than k documents score. Scores are cosine similarities;
-        padded/empty matches score 0.
+        fewer than k documents score. Scores are cosine similarities
+        under the default tfidf scorer; ``scorer`` selects another
+        family member for this call (``"bm25"``,
+        ``"bm25:k1=1.5,b=0.6"``, a dict, a :class:`ScorerSpec`) and
+        ``filter`` restricts the candidate set (see
+        :mod:`tfidf_tpu.scoring.filters`) — both default to the
+        index-level :attr:`scorer` / no filter, and the default
+        combination runs EXACTLY the pre-round-23 code path.
 
         One implementation with :meth:`search_async` — this is the
         dispatch stage plus an immediate materialization, so the
         pipelined serve path and the synchronous path can never
         diverge by a byte.
         """
-        return self.search_async(queries, k).materialize()
+        return self.search_async(queries, k, scorer=scorer,
+                                 filter=filter).materialize()
+
+    def _scorer_face(self, spec: ScorerSpec):
+        """The derived ``(data, cols)`` doc face of one scorer, cached
+        per :meth:`ScorerSpec.key` until the next index install. tfidf
+        is the stored weights re-masked (``_tfidf_face`` — the same
+        two elementwise ops ``_search_tiled`` fuses); bm25 re-derives
+        counts/lengths/df from ``(ids, head)`` on device
+        (``_bm25_face``), per field slice when the index is fielded."""
+        key = spec.key()
+        face = self._faces.get(key)
+        if face is not None:
+            return face
+        if spec.kind == "tfidf":
+            face = _tfidf_face(self._ids, self._weights, self._head)
+        elif self._fields is None:
+            n = self._num_docs
+            lens = doc_lengths_host(self._ids)
+            avgdl = avgdl_f32(int(lens[:n].sum()), n)
+            face = _bm25_face(self._ids, self._head, jnp.int32(n),
+                              avgdl, np.float32(spec.k1),
+                              np.float32(spec.b),
+                              vocab_size=self.config.vocab_size)
+        else:
+            n = self._num_docs
+            data_parts, cols_parts = [], []
+            for _fname, weight, start, stop in self._fields:
+                ids_f = self._ids[:, start:stop]
+                head_f = self._head[:, start:stop]
+                lens = doc_lengths_host(ids_f)
+                avgdl = avgdl_f32(int(lens[:n].sum()), n)
+                d, c = _bm25_face(ids_f, head_f, jnp.int32(n), avgdl,
+                                  np.float32(spec.k1),
+                                  np.float32(spec.b),
+                                  vocab_size=self.config.vocab_size)
+                data_parts.append(d * jnp.float32(weight))
+                cols_parts.append(c)
+            face = (jnp.concatenate(data_parts, axis=1),
+                    jnp.concatenate(cols_parts, axis=1))
+        self._faces[key] = face
+        return face
+
+    def scorer_face(self, spec=None) -> Tuple[np.ndarray, np.ndarray]:
+        """Host copy of a scorer's ``(data, cols)`` face, derived
+        through the SAME device programs the flat search consumes —
+        the bit-parity contract ``MeshShardedRetriever`` builds its
+        sharded blocks on."""
+        spec = self.scorer if spec is None else parse_scorer(spec)
+        data, cols = self._scorer_face(spec)
+        return np.asarray(data), np.asarray(cols)
+
+    def _filter_live(self, fspec: Optional[FilterSpec]):
+        """Device live mask of one filter ∧ the real-rows guard,
+        cached per canonical filter key; ``None`` filter -> ``None``
+        (the unmasked kernel, shared with the default path)."""
+        if fspec is None:
+            return None
+        key = fspec.key()
+        live = self._filters.get(key)
+        if live is None:
+            host = np.zeros((int(self._ids.shape[0]),), bool)
+            host[:self._num_docs] = filter_mask(
+                fspec, self._num_docs, names=self.names)
+            live = jnp.asarray(host)
+            self._filters[key] = live
+        return live
 
     def search_async(self, queries: Sequence[Union[str, bytes]],
-                     k: int = 10) -> "PendingSearch":
+                     k: int = 10, *, scorer=None,
+                     filter=None) -> "PendingSearch":
         """Dispatch stage of :meth:`search` (round 22): stage the
         query block, issue the (async) jitted search, start the D2H
         copy of the result words, and return WITHOUT blocking. The
@@ -583,6 +787,14 @@ class TfidfRetriever:
         """
         if not self.indexed:
             raise RuntimeError("index() a corpus before search()")
+        # Scorer-family routing (round 23): the DEFAULT combination
+        # (index-level tfidf, no filter) falls through to the exact
+        # pre-subsystem body below — bit-identity by construction, the
+        # acceptance pin. Everything else takes the derived-face path.
+        spec = self.scorer if scorer is None else parse_scorer(scorer)
+        fspec = parse_filter(filter)
+        if not (spec.is_default and fspec is None):
+            return self._search_scored(queries, k, spec, fspec)
         # Tiled scoring (round 21, default ON): the doc axis scans in
         # fixed tiles against the FULL query block, so the per-dispatch
         # intermediate is [tile * L, Q] — bounded regardless of Q — and
@@ -724,6 +936,83 @@ class TfidfRetriever:
                         pass  # already deleted with a failed dispatch
                 if slab_ref is not None:
                     slab_ref.release(slab_slot)
+            ok = (v > 0) & (i < num_docs)
+            return np.where(ok, v, 0.0), np.where(ok, i, -1)
+
+        return PendingSearch(materialize)
+
+    def _search_scored(self, queries: Sequence[Union[str, bytes]],
+                       k: int, spec: ScorerSpec,
+                       fspec: Optional[FilterSpec]) -> "PendingSearch":
+        """Any non-default (scorer, filter) combination (round 23).
+
+        Same kernel, different precomputation: the cached derived face
+        replaces the inline masking, the filter folds into the live
+        mask the tombstone machinery already owns (sub-zero sentinel
+        before top-k), and bm25 queries pack as RAW counts. The result
+        contract — shapes, ``vals > 0`` masking, tie order — is
+        exactly :meth:`search`'s; tiled and untiled lowerings stay
+        bit-identical per scorer (pinned against the NumPy oracle in
+        tests/test_scoring_family.py). The tfidf face and every bm25
+        face share ONE tiled-search jit (same shapes, same statics),
+        so scorer switching compiles nothing after warm — the grown
+        compile pin."""
+        if self.plan is not None:
+            raise ValueError(
+                "plan-sharded TfidfRetriever serves the default scorer "
+                "only — shard non-default scorers via "
+                "MeshShardedRetriever")
+        nq = len(queries)
+        tiled = score_tiling()
+        if not tiled and nq > _LEGACY_QUERY_BLOCK:
+            # The untiled [nse, Qb] intermediate forces the same
+            # serial 64-wide block split as the default path; per-
+            # query independence makes the concatenation exact.
+            parts = [self.search(queries[s:s + _LEGACY_QUERY_BLOCK],
+                                 k, scorer=spec, filter=fspec)
+                     for s in range(0, nq, _LEGACY_QUERY_BLOCK)]
+            return PendingSearch.resolved(
+                np.concatenate([p[0] for p in parts]),
+                np.concatenate([p[1] for p in parts]))
+        rows = int(self._ids.shape[0])
+        num_docs = self._num_docs
+        kk = min(k, rows)
+        bucket = 1 << max(0, nq - 1).bit_length()
+        data, cols = self._scorer_face(spec)
+        live = self._filter_live(fspec)
+        qmat = jnp.asarray(query_matrix(
+            queries, self.config, self._idf_host(), pad_to=bucket,
+            mode="counts" if spec.kind == "bm25" else "cosine"))
+        if tiled:
+            watch = devmon.get_watch()
+            before = (score_topk_tiled_cache_size()
+                      if watch is not None else None)
+            tile = score_tile_rows(rows)
+            with obs.span("score_tile", tiles=-(-rows // tile),
+                          rows=rows, queries=int(bucket)):
+                vals, idx = score_topk_tiled(data, cols, live, qmat, kk)
+            if (before is not None
+                    and score_topk_tiled_cache_size() > before):
+                devmon.note_compile("search_scored",
+                                    queries=int(bucket), k=kk,
+                                    docs=rows, dtype="float32")
+        else:
+            from tfidf_tpu.ops.topk import segment_score_topk
+            if live is None:
+                # The untiled kernel masks unconditionally; the
+                # no-filter live vector is the real-rows guard,
+                # cached under the empty filter key.
+                live = self._filters.get("")
+                if live is None:
+                    live = jnp.asarray(np.arange(rows) < num_docs)
+                    self._filters[""] = live
+            vals, idx = segment_score_topk(data, cols, live, qmat, kk)
+        _start_d2h(vals, idx)
+        width = min(k, num_docs)
+
+        def materialize(vals=vals, idx=idx):
+            v = np.asarray(vals)[:nq, :width]
+            i = np.asarray(idx)[:nq, :width]
             ok = (v > 0) & (i < num_docs)
             return np.where(ok, v, 0.0), np.where(ok, i, -1)
 

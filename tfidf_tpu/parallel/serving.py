@@ -234,6 +234,13 @@ class MeshShardedRetriever:
         self._live = jax.device_put(live, sh1)
         self._idf = jnp.asarray(idf)
         self._idf_np = np.asarray(idf)
+        # Scorer family (round 23): the padded host live mask plus the
+        # per-scorer sharded face and per-filter sharded live caches —
+        # derived lazily from the retained source, placed once, reused
+        # every search at that (scorer, filter).
+        self._live_np = live
+        self._scorer_cache: Dict[str, tuple] = {}
+        self._filter_cache: Dict[str, object] = {}
 
     @staticmethod
     def _host_blocks(source):
@@ -291,7 +298,11 @@ class MeshShardedRetriever:
 
     def index_arrays(self) -> list:
         """Live device arrays for the HBM census owner registration."""
-        return [self._idf, self._data, self._cols, self._live]
+        out = [self._idf, self._data, self._cols, self._live]
+        for d, c in self._scorer_cache.values():
+            out += [d, c]
+        out += list(self._filter_cache.values())
+        return out
 
     def shard_stats(self) -> dict:
         """Per-shard HBM truth: bytes each docs-shard holds (summed
@@ -315,25 +326,83 @@ class MeshShardedRetriever:
                 "imbalance": round(imbalance, 4),
                 "total_bytes": sum(per)}
 
+    def _scorer_blocks(self, spec) -> tuple:
+        """The sharded ``(data, cols)`` face of one scorer, cached per
+        key. The face derives ON THE SOURCE through its own device
+        programs (``scorer_face`` — the same jits its single-device
+        search scores with), pads to the shard multiple and re-places
+        block-sharded: placement never touches the bytes, so the
+        sharded scored search stays bit-identical to the source's."""
+        jax, _ = _jax()
+        from jax.sharding import PartitionSpec as P
+        key = spec.key()
+        blk = self._scorer_cache.get(key)
+        if blk is None:
+            face = getattr(self._source, "scorer_face", None)
+            if face is None:
+                raise ValueError(
+                    "non-default scorers need the retained "
+                    "single-device source (shard_index(..., "
+                    "keep_source=True))")
+            data, cols = face(spec)
+            pad = self._rows - data.shape[0]
+            if pad:
+                data = np.pad(data, ((0, pad), (0, 0)))
+                cols = np.pad(cols, ((0, pad), (0, 0)))
+            sh2 = self.plan.sharding(P(DOCS_AXIS, None))
+            blk = (jax.device_put(data, sh2),
+                   jax.device_put(cols, sh2))
+            self._scorer_cache[key] = blk
+        return blk
+
+    def _filter_live(self, fspec):
+        """The sharded live mask ∧ one filter's allow-mask (host AND,
+        then placement), cached per canonical key; no filter returns
+        the default live block."""
+        if fspec is None:
+            return self._live
+        jax, _ = _jax()
+        from jax.sharding import PartitionSpec as P
+        from tfidf_tpu.scoring.filters import filter_mask
+        key = fspec.key()
+        live = self._filter_cache.get(key)
+        if live is None:
+            npos = min(self._rows, len(self.names)) or self._num_docs
+            mask = np.zeros((self._rows,), bool)
+            mask[:npos] = filter_mask(fspec, npos, names=self.names)
+            live = jax.device_put(self._live_np & mask,
+                                  self.plan.sharding(P(DOCS_AXIS)))
+            self._filter_cache[key] = live
+        return live
+
     # --- querying ------------------------------------------------------
-    def search(self, queries: Sequence[Union[str, bytes]], k: int = 10
+    def search(self, queries: Sequence[Union[str, bytes]], k: int = 10,
+               *, scorer=None, filter=None
                ) -> Tuple[np.ndarray, np.ndarray]:
         """Ranked retrieval: (scores, doc_indices), each [Q, k'] with
         k' = min(k, num_docs) — bit-identical to the source's
         single-device ``search`` (same blocking, same query bucketing,
-        same compiled-program budget discipline)."""
+        same compiled-program budget discipline). ``scorer``/``filter``
+        (round 23) swap in the derived sharded face / composed live
+        mask; the mesh program itself is scorer-agnostic, so every
+        scorer shares the one compiled sharded-search per (plan, k)."""
         _, jnp = _jax()
         from tfidf_tpu.models.retrieval import (_LEGACY_QUERY_BLOCK,
                                                 query_matrix)
         from tfidf_tpu.obs import devmon
         from tfidf_tpu.ops.sparse import score_tiling
+        from tfidf_tpu.scoring.family import ScorerSpec, parse_scorer
+        from tfidf_tpu.scoring.filters import parse_filter
 
+        spec = ScorerSpec() if scorer is None else parse_scorer(scorer)
+        fspec = parse_filter(filter)
         # Tiled (round 21): one dispatch at any Q — the per-shard doc
         # scan bounds memory, so the legacy host-side query split only
         # applies on the --score-tiling=off fallback.
         if (not score_tiling()
                 and len(queries) > _LEGACY_QUERY_BLOCK):
-            parts = [self.search(queries[s:s + _LEGACY_QUERY_BLOCK], k)
+            parts = [self.search(queries[s:s + _LEGACY_QUERY_BLOCK], k,
+                                 scorer=spec, filter=fspec)
                      for s in range(0, len(queries),
                                     _LEGACY_QUERY_BLOCK)]
             return (np.concatenate([p[0] for p in parts]),
@@ -343,9 +412,15 @@ class MeshShardedRetriever:
         if width == 0 or nq == 0:
             return (np.zeros((nq, width), np.float32),
                     np.full((nq, width), -1, np.int64))
+        if spec.is_default:
+            data, cols = self._data, self._cols
+        else:
+            data, cols = self._scorer_blocks(spec)
+        live = self._filter_live(fspec)
         bucket = 1 << max(0, nq - 1).bit_length()
-        qmat = jnp.asarray(query_matrix(queries, self.config,
-                                        self._idf_np, pad_to=bucket))
+        qmat = jnp.asarray(query_matrix(
+            queries, self.config, self._idf_np, pad_to=bucket,
+            mode="counts" if spec.kind == "bm25" else "cosine"))
         fn = _mesh_search_fn(self.plan, k)
         # Compile fingerprinting (round 12): a cache-size delta across
         # the call = a fresh sharded-search program; with a
@@ -353,7 +428,7 @@ class MeshShardedRetriever:
         # recompile flight event. Same seam retrieval.search uses.
         watch = devmon.get_watch()
         before = fn._cache_size() if watch is not None else None
-        vals, idx = fn(self._data, self._cols, self._live, qmat)
+        vals, idx = fn(data, cols, live, qmat)
         if before is not None and fn._cache_size() > before:
             devmon.note_compile(
                 "mesh_search", shards=self.n_shards,

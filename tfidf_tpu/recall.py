@@ -103,6 +103,56 @@ def exact_doc_recall(ref_terms: DocTerms, got_words: Sequence[bytes],
     return min(1.0, hit / len(required))
 
 
+def retrieval_recall_at_k(got_ids: np.ndarray, oracle_ids: np.ndarray,
+                          k: int) -> float:
+    """Mean per-query recall@k of RETRIEVED DOC ids vs an oracle
+    ranking — the scoring-family suite's metric (round 23): each
+    scorer's device top-k is recalled against ITS OWN NumPy-oracle
+    top-k (``scoring.oracle.oracle_topk``), so 1.0 is the bit-parity
+    expectation, not a vocabulary accident. ``-1`` slots (fewer than k
+    positive-score docs) are empty on both sides and drop out of the
+    denominator; a query where the oracle retrieves nothing is skipped
+    (recall undefined — both sides agree nothing matches)."""
+    got = np.asarray(got_ids)
+    ora = np.asarray(oracle_ids)
+    if got.shape[0] != ora.shape[0]:
+        raise ValueError(f"query-count mismatch: {got.shape[0]} vs "
+                         f"{ora.shape[0]}")
+    scores = []
+    for qi in range(ora.shape[0]):
+        want = {int(d) for d in ora[qi][:k] if d >= 0}
+        if not want:
+            continue
+        have = {int(d) for d in got[qi][:k] if d >= 0}
+        scores.append(len(have & want) / len(want))
+    if not scores:
+        raise ValueError("no queries with defined recall")
+    return float(np.mean(scores))
+
+
+def scorer_overlap_at_k(ids_a: np.ndarray, ids_b: np.ndarray,
+                        k: int) -> float:
+    """Mean Jaccard overlap of two scorers' top-k doc sets over the
+    same queries — how DIFFERENT two family members' rankings are
+    (bm25 vs tfidf in the scoring artifact: well below 1.0 on a Zipf
+    corpus, or the bm25 face derivation is secretly the tfidf one).
+    Queries where both sides retrieve nothing are skipped."""
+    a, b = np.asarray(ids_a), np.asarray(ids_b)
+    if a.shape[0] != b.shape[0]:
+        raise ValueError(f"query-count mismatch: {a.shape[0]} vs "
+                         f"{b.shape[0]}")
+    scores = []
+    for qi in range(a.shape[0]):
+        sa = {int(d) for d in a[qi][:k] if d >= 0}
+        sb = {int(d) for d in b[qi][:k] if d >= 0}
+        if not sa and not sb:
+            continue
+        scores.append(len(sa & sb) / len(sa | sb))
+    if not scores:
+        raise ValueError("no queries with any retrieved docs")
+    return float(np.mean(scores))
+
+
 def corpus_recall(per_doc_ref: Dict[str, DocTerms], names: Sequence[str],
                   topk_ids: np.ndarray, topk_vals: np.ndarray, k: int,
                   vocab_size: int, seed: int = 0) -> float:

@@ -1,0 +1,48 @@
+"""The pluggable scorer family (round 23) — ROADMAP item 4.
+
+Every retrieval path in this repo scores documents through ONE sparse
+kernel: a row-sparse ``(data, cols)`` doc block dotted against a dense
+``[V, Q]`` query block, masked by a live vector, selected by a
+streaming top-k (``ops.sparse.score_topk_tiled`` and its untiled
+fallback ``ops.topk.segment_score_topk``). That kernel never knew it
+was computing TF-IDF: the scorer lives entirely in how the doc weights
+and the query columns are PRE-computed. This package makes that
+explicit — a :class:`ScorerSpec` names the precomputation family:
+
+* ``tfidf`` (default): L2-normalized ``tf * log(N/df)`` doc rows x
+  cosine query columns — byte-for-byte today's arrays, so the default
+  path is bit-identical to the pre-subsystem output by construction.
+* ``bm25`` (k1, b): Lucene-idf saturated term weights on the doc side
+  (:func:`bm25_weights`), RAW term counts on the query side — BM25 is
+  the same sparse dot because the whole formula except the query's
+  term count factorizes into the per-(doc, term) weight.
+* field weights: title/body sub-indexes stacked along the slot axis
+  sharing one vocab; the weighted sum across fields IS the single
+  row's dot (``TfidfRetriever.index_fields``).
+
+Query-time document filters (:mod:`tfidf_tpu.scoring.filters`) fold
+into the same live mask tombstones already ride — a filtered-out doc
+scores the sub-zero sentinel and can never surface.
+
+:mod:`tfidf_tpu.scoring.oracle` is the NumPy reference every variant
+is pinned bit-identical against (ids + tie order;
+tests/test_scoring_family.py).
+
+Import-time contract: this package imports no jax at module scope
+(``config.py`` validates scorer specs without a backend); the traced
+helpers import jax lazily inside jitted callers.
+"""
+
+from tfidf_tpu.scoring.family import (DEFAULT_B, DEFAULT_K1, ScorerSpec,
+                                      bm25_face_trace, bm25_idf_from_df,
+                                      bm25_weights, parse_scorer,
+                                      resolve_scorer, scorer_key)
+from tfidf_tpu.scoring.filters import (FilterSpec, filter_key,
+                                       filter_mask, parse_filter)
+
+__all__ = [
+    "ScorerSpec", "parse_scorer", "scorer_key", "resolve_scorer",
+    "DEFAULT_K1", "DEFAULT_B",
+    "bm25_idf_from_df", "bm25_weights", "bm25_face_trace",
+    "FilterSpec", "parse_filter", "filter_key", "filter_mask",
+]

@@ -1,0 +1,230 @@
+"""Scorer specs + the BM25 weight math (round 23).
+
+Two layers live here:
+
+* **Spec parsing** (host, jax-free): :class:`ScorerSpec`,
+  :func:`parse_scorer`, :func:`scorer_key` — one canonical string form
+  (``"tfidf"``, ``"bm25:b=0.75,k1=1.2"``) that round-trips through the
+  serve batcher's group key, the result-cache key, snapshot meta and
+  the JSONL protocol's per-request ``"scorer"`` field.
+
+* **Traced weight math** (device, shared): :func:`bm25_idf_from_df`
+  and :func:`bm25_weights` are the ONE elementwise float sequence both
+  the flat retriever's lazy face derivation and the segmented index's
+  per-part refresh run — XLA preserves IEEE elementwise semantics, so
+  flat-vs-segmented BM25 bit-parity holds the same way the tfidf
+  ``refresh_weights`` parity always has.
+
+BM25 factorization: with Lucene idf
+``log1p((N - df + 0.5) / (df + 0.5))`` (always > 0 for df >= 1 — the
+``vals > 0`` result-mask semantics survive) the per-(doc, term) weight
+
+    w(d, t) = idf(t) * c * (k1 + 1) / (c + k1 * (1 - b + b * dl/avgdl))
+
+absorbs everything except the query's raw term count, so BM25(q, d) =
+``sum_t count_q(t) * w(d, t)`` — exactly the sparse dot the tiled
+kernel already computes. ``k1``/``b`` enter as TRACED f32 scalars
+(changing them re-derives a face, never re-compiles a program), and
+``avgdl`` is computed identically everywhere as
+``float32(exact-int total live length) / float32(num live docs)``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Union
+
+DEFAULT_K1 = 1.2
+DEFAULT_B = 0.75
+
+_KINDS = ("tfidf", "bm25")
+
+
+@dataclasses.dataclass(frozen=True)
+class ScorerSpec:
+    """One member of the scorer family. ``k1``/``b`` are only
+    meaningful for ``bm25``; they are normalized to the defaults for
+    ``tfidf`` so spec equality == scoring equality."""
+
+    kind: str = "tfidf"
+    k1: float = DEFAULT_K1
+    b: float = DEFAULT_B
+
+    def __post_init__(self):
+        if self.kind not in _KINDS:
+            raise ValueError(f"unknown scorer {self.kind!r} "
+                             f"(choose one of {', '.join(_KINDS)})")
+        if self.kind == "tfidf":
+            object.__setattr__(self, "k1", DEFAULT_K1)
+            object.__setattr__(self, "b", DEFAULT_B)
+        if not self.k1 >= 0:
+            raise ValueError(f"bm25 k1 must be >= 0 (got {self.k1})")
+        if not 0 <= self.b <= 1:
+            raise ValueError(f"bm25 b must be in [0, 1] (got {self.b})")
+
+    @property
+    def is_default(self) -> bool:
+        return self.kind == "tfidf"
+
+    def key(self) -> str:
+        """The canonical string form — parseable by
+        :func:`parse_scorer`, stable under float formatting, the
+        batch-group / cache-key / snapshot-meta representation."""
+        if self.kind == "tfidf":
+            return "tfidf"
+        return f"bm25:b={self.b:g},k1={self.k1:g}"
+
+
+def parse_scorer(spec: Union[None, str, dict, ScorerSpec]) -> ScorerSpec:
+    """Anything-to-spec: None (default tfidf), a spec (pass-through),
+    a dict (``{"kind": "bm25", "k1": 1.5}`` — the JSONL form), or a
+    string (``"bm25"``, ``"bm25:k1=1.5,b=0.6"`` — the CLI/key form)."""
+    if spec is None:
+        return ScorerSpec()
+    if isinstance(spec, ScorerSpec):
+        return spec
+    if isinstance(spec, dict):
+        unknown = set(spec) - {"kind", "k1", "b"}
+        if unknown:
+            raise ValueError(f"unknown scorer fields {sorted(unknown)}")
+        return ScorerSpec(kind=str(spec.get("kind", "tfidf")),
+                          k1=float(spec.get("k1", DEFAULT_K1)),
+                          b=float(spec.get("b", DEFAULT_B)))
+    if not isinstance(spec, str):
+        raise ValueError(f"cannot parse scorer spec {spec!r}")
+    text = spec.strip()
+    kind, _, params = text.partition(":")
+    kw = {"kind": kind.strip().lower()}
+    if params.strip():
+        for part in params.split(","):
+            name, _, val = part.partition("=")
+            name = name.strip().lower()
+            if name not in ("k1", "b") or not val.strip():
+                raise ValueError(
+                    f"bad scorer param {part!r} in {spec!r} "
+                    f"(expected k1=<float> / b=<float>)")
+            kw[name] = float(val)
+    return ScorerSpec(**kw)
+
+
+def scorer_key(spec: Union[None, str, dict, ScorerSpec]) -> str:
+    """Canonical key of any spec form (``parse_scorer(x).key()``)."""
+    return parse_scorer(spec).key()
+
+
+def resolve_scorer(explicit: Union[None, str, dict, ScorerSpec] = None
+                   ) -> ScorerSpec:
+    """Resolve the index-default scorer: explicit setting >
+    ``TFIDF_TPU_SCORER`` (with ``TFIDF_TPU_BM25_K1`` /
+    ``TFIDF_TPU_BM25_B`` riding along for a bare ``bm25``) > tfidf."""
+    if explicit is not None:
+        return parse_scorer(explicit)
+    raw = os.environ.get("TFIDF_TPU_SCORER", "").strip()
+    if not raw:
+        return ScorerSpec()
+    spec = parse_scorer(raw)
+    if spec.kind == "bm25" and ":" not in raw:
+        k1 = os.environ.get("TFIDF_TPU_BM25_K1", "").strip()
+        b = os.environ.get("TFIDF_TPU_BM25_B", "").strip()
+        spec = ScorerSpec(kind="bm25",
+                          k1=float(k1) if k1 else DEFAULT_K1,
+                          b=float(b) if b else DEFAULT_B)
+    return spec
+
+
+def spec_from_parts(kind: Optional[str], k1: Optional[float],
+                    b: Optional[float]) -> ScorerSpec:
+    """Compose a spec from the serve config's three optional knobs
+    (``--scorer`` / ``--bm25-k1`` / ``--bm25-b``). A ``--scorer``
+    carrying inline params (``"bm25:k1=1.5"``) wins outright — the
+    standalone knobs only flesh out a bare kind."""
+    if kind and ":" in kind:
+        return parse_scorer(kind)
+    return ScorerSpec(kind=(kind or "tfidf").strip().lower(),
+                      k1=DEFAULT_K1 if k1 is None else float(k1),
+                      b=DEFAULT_B if b is None else float(b))
+
+
+# --- traced BM25 weight math (jax imported lazily) --------------------
+
+
+def bm25_idf_from_df(df, num_docs, dtype=None):
+    """Lucene BM25 idf: ``log1p((N - df + 0.5) / (df + 0.5))``, 0
+    where df == 0 (empty hashed buckets). Strictly positive for every
+    present term — unlike the raw Robertson idf, which goes negative
+    past df > N/2 and would break the repo-wide ``vals > 0``
+    real-result mask."""
+    import jax.numpy as jnp
+    dtype = dtype or jnp.float32
+    dff = df.astype(dtype)
+    n = jnp.asarray(num_docs, dtype)
+    half = jnp.asarray(0.5, dtype)
+    idf = jnp.log1p((n - dff + half) / (dff + half))
+    return jnp.where(df > 0, idf, jnp.zeros((), dtype))
+
+
+def bm25_weights(ids, counts, head, lengths, idf, avgdl, k1, b):
+    """Per-slot BM25 doc weights + dense-safe columns.
+
+    Args (all traced): row-sparse triple ``ids/counts/head [D, L]``,
+    ``lengths [D]`` (token count per doc), ``idf [V]`` (from
+    :func:`bm25_idf_from_df`), scalars ``avgdl``/``k1``/``b`` (f32).
+
+    Returns ``(data [D, L] f32, cols [D, L] i32)`` — zeros / column 0
+    off-head, ready for the tiled kernel. ONE elementwise sequence:
+    every face derivation (flat lazy face, segmented per-part refresh,
+    fielded slices) runs exactly this, which is the whole
+    cross-path bit-parity argument.
+    """
+    import jax.numpy as jnp
+    f32 = jnp.float32
+    c = counts.astype(f32)
+    dl = jnp.maximum(lengths, 1).astype(f32)[:, None]
+    k1 = jnp.asarray(k1, f32)
+    b = jnp.asarray(b, f32)
+    one = jnp.asarray(1.0, f32)
+    sat = (c * (k1 + one)) / (c + k1 * (one - b + b * (dl / avgdl)))
+    safe = jnp.where(head, ids, 0)
+    data = jnp.where(head, idf[safe] * sat, jnp.zeros((), f32))
+    return data.astype(f32), safe.astype(jnp.int32)
+
+
+def bm25_face_trace(ids, head, num_docs, avgdl, k1, b, *,
+                    vocab_size: int):
+    """BM25 face from a STORED flat index's ``(ids, head)`` alone —
+    counts/lengths/df are all re-derivable because padding slots carry
+    the INT32_MAX sort sentinel: lengths = non-sentinel count, counts
+    via the run-length trick (``sorted_term_counts_masked`` over the
+    already-sorted rows is the identity sort), df via ``sparse_df``.
+    This is what lets the snapshot format and ``_build_index`` stay
+    byte-identical to round 22 — BM25 is a derived view, not a stored
+    one."""
+    import jax.numpy as jnp
+
+    from tfidf_tpu.ops.sparse import sorted_term_counts_masked, sparse_df
+
+    valid = ids != jnp.iinfo(jnp.int32).max
+    _, counts, _ = sorted_term_counts_masked(ids, valid)
+    lengths = valid.sum(axis=1, dtype=jnp.int32)
+    df = sparse_df(ids, head, vocab_size)
+    idf = bm25_idf_from_df(df, num_docs)
+    return bm25_weights(ids, counts, head, lengths, idf, avgdl, k1, b)
+
+
+def doc_lengths_host(ids) -> "object":
+    """Host int64 per-row token counts of a stored flat index (the
+    non-sentinel slot count) — the exact-integer numerator of avgdl."""
+    import numpy as np
+    arr = np.asarray(ids)
+    return (arr != np.iinfo(np.int32).max).sum(axis=1).astype(np.int64)
+
+
+def avgdl_f32(total_len: int, num_docs: int):
+    """THE avgdl: float32(exact-int total) / float32(N) — a single
+    correctly-rounded divide of two exactly-converted integers, so
+    every path (flat, segmented, mesh, oracle) that feeds the same
+    integers gets the same float32 bits."""
+    import numpy as np
+    n = max(1, int(num_docs))
+    return np.float32(np.float32(int(total_len)) / np.float32(n))

@@ -29,7 +29,7 @@ import numpy as np
 from tfidf_tpu.config import PipelineConfig
 from tfidf_tpu.ops.tokenize import whitespace_tokenize
 
-Key = Tuple[Tuple[bytes, ...], int, int]
+Key = Tuple[Tuple[bytes, ...], int, int, str, str]
 Row = Tuple[np.ndarray, np.ndarray]
 
 
@@ -65,8 +65,15 @@ class ResultCache:
             return len(self._rows)
 
     @staticmethod
-    def key(tokens: Sequence[bytes], k: int, epoch: int) -> Key:
-        return (tuple(tokens), int(k), int(epoch))
+    def key(tokens: Sequence[bytes], k: int, epoch: int,
+            scorer: str = "tfidf", filter: str = "") -> Key:
+        """``scorer``/``filter`` (round 23) are the CANONICAL keys
+        (``scoring.scorer_key`` / ``scoring.filter_key``): two requests
+        share an entry only when they would score identically — same
+        tokens, same k, same epoch, same scorer-family member, same
+        candidate set."""
+        return (tuple(tokens), int(k), int(epoch), str(scorer),
+                str(filter))
 
     def get(self, key: Key) -> Optional[Row]:
         if not self.enabled:

@@ -134,8 +134,19 @@ class CanaryProber:
         # sharded-vs-itself tautology.
         oracle_fn = getattr(retriever, "parity_oracle", None)
         source = oracle_fn() if oracle_fn is not None else None
-        vals, ids = (source if source is not None
-                     else retriever).search(self._queries, self._k)
+        src = source if source is not None else retriever
+        # Per-scorer golden (round 23): the oracle captures under the
+        # server's DEFAULT scorer — the one probes replay with — so the
+        # parity pin holds under non-default scorers too. A scorer
+        # change routes through ``_install_index`` (epoch bump + this
+        # listener), so a stale-scorer oracle can never be compared:
+        # the epoch check skips any probe that straddled the change.
+        get_key = getattr(self._server, "default_scorer_key", None)
+        skey = get_key() if get_key is not None else "tfidf"
+        if skey != "tfidf":
+            vals, ids = src.search(self._queries, self._k, scorer=skey)
+        else:
+            vals, ids = src.search(self._queries, self._k)
         with self._lock:
             self._oracle[epoch] = (np.asarray(vals), np.asarray(ids))
             # Keep the previous epoch for probes racing a swap; drop

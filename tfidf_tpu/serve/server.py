@@ -62,6 +62,9 @@ from tfidf_tpu.obs.slo import SloTracker
 from tfidf_tpu.serve.batcher import (DeadlineExceeded, MicroBatcher,
                                      Overloaded, PoisonQuery,
                                      ServeError, ServerClosed)
+from tfidf_tpu.scoring.family import (parse_scorer, scorer_key,
+                                      spec_from_parts)
+from tfidf_tpu.scoring.filters import filter_key
 from tfidf_tpu.serve.cache import ResultCache, normalize_query
 from tfidf_tpu.serve.metrics import ServeMetrics
 from tfidf_tpu.serve.supervisor import (CircuitBreaker, QuarantineList,
@@ -118,6 +121,12 @@ class TfidfServer:
         self._t0 = time.monotonic()     # uptime_s anchor
         self._swap_listeners: List[Callable] = []
         self._cache = ResultCache(self.config.cache_entries)
+        # Default scorer (round 23): requests that name no scorer score
+        # under this family member (--scorer / TFIDF_TPU_SCORER, with
+        # --bm25-k1/--bm25-b fleshing out a bare "bm25"). Per-request
+        # "scorer" fields override per batch group, never globally.
+        self._default_scorer = spec_from_parts(
+            self.config.scorer, self.config.bm25_k1, self.config.bm25_b)
         # Live mutation (round 17): an attached SegmentedIndex turns
         # add_docs/delete_docs on; every visibility change funnels
         # through _install_index (epoch bump + cache clear + listener
@@ -235,8 +244,13 @@ class TfidfServer:
 
     # --- the batch kernel the batcher drives ---
     def _run_batch(self, queries, k, group):
-        epoch, retriever = group
-        return retriever.search(queries, k)
+        epoch, retriever, skey, fkey = group
+        if skey == "tfidf" and not fkey:
+            # The bit-identical legacy call — also what keeps every
+            # test-double retriever (2-arg search) working unchanged.
+            return retriever.search(queries, k)
+        return retriever.search(queries, k, scorer=skey,
+                                filter=fkey or None)
 
     def _run_batch_async(self, queries, k, group):
         """Dispatch stage of the pipelined path: issue the device call
@@ -245,12 +259,16 @@ class TfidfServer:
         mesh-sharded and test-double retrievers without an async
         seam still pipeline (their search runs synchronously here;
         ordering and recovery semantics are unchanged)."""
-        epoch, retriever = group
+        epoch, retriever, skey, fkey = group
         dispatch = getattr(retriever, "search_async", None)
         if dispatch is not None:
-            return dispatch(queries, k)
+            if skey == "tfidf" and not fkey:
+                return dispatch(queries, k)
+            return dispatch(queries, k, scorer=skey,
+                            filter=fkey or None)
         from tfidf_tpu.models.retrieval import PendingSearch
-        return PendingSearch.resolved(*retriever.search(queries, k))
+        return PendingSearch.resolved(
+            *self._run_batch(queries, k, group))
 
     # --- public API ---
     @property
@@ -266,7 +284,8 @@ class TfidfServer:
 
     def submit(self, queries: Sequence[Union[str, bytes]], k: int = 10,
                deadline_ms: Optional[float] = None, *,
-               use_cache: bool = True) -> Future:
+               use_cache: bool = True, scorer=None,
+               filter=None) -> Future:
         """Admit one request; returns a Future resolving to ``(vals,
         ids)`` — the exact arrays a direct ``retriever.search(queries,
         k)`` returns. Raises :class:`Overloaded` when the admission
@@ -276,6 +295,14 @@ class TfidfServer:
         and fill — the canary prober's lever: its parity check must
         exercise the device path, not a memoized row.
 
+        ``scorer``/``filter`` (round 23) select the scoring-family
+        member and candidate filter for THIS request (any form
+        ``tfidf_tpu.scoring`` parses; None = the server's default
+        scorer, unfiltered). They canonicalize into the batch group —
+        the batcher never coalesces requests that would score
+        differently — and into the cache key, so a bm25 row can never
+        answer a tfidf probe.
+
         The returned Future carries the request id as ``.rid`` (None
         with ``TFIDF_TPU_REQTRACE=off``) — the key that joins the
         JSONL response, the request's spans, its flight digest and
@@ -283,6 +310,11 @@ class TfidfServer:
         t0 = time.monotonic()
         queries = list(queries)
         n = len(queries)
+        # Canonicalize up front: a malformed spec is the submitter's
+        # synchronous error, never a failed batch.
+        skey = (scorer_key(scorer) if scorer is not None
+                else self._default_scorer.key())
+        fkey = filter_key(filter)
         # Request identity (round 16): minted at admission, carried on
         # the request through batcher -> cache -> supervisor -> device
         # dispatch -> drain, stamped on every span it touches.
@@ -362,7 +394,8 @@ class TfidfServer:
 
         if use_cache:
             t_cache = time.monotonic()
-            keys = [self._cache.key(normalize_query(q, cfg), k, epoch)
+            keys = [self._cache.key(normalize_query(q, cfg), k, epoch,
+                                    skey, fkey)
                     for q in queries]
             rows = [self._cache.get(key) for key in keys]
             hits = sum(r is not None for r in rows)
@@ -393,7 +426,8 @@ class TfidfServer:
             return out
 
         inner = self._batcher.submit([queries[i] for i in miss_pos], k,
-                                     group=(epoch, retriever),
+                                     group=(epoch, retriever, skey,
+                                            fkey),
                                      deadline=deadline, ctx=ctx)
 
         def on_done(f: Future) -> None:
@@ -444,10 +478,29 @@ class TfidfServer:
         return out
 
     def search(self, queries: Sequence[Union[str, bytes]], k: int = 10,
-               timeout: Optional[float] = None
-               ) -> Tuple[np.ndarray, np.ndarray]:
+               timeout: Optional[float] = None, *, scorer=None,
+               filter=None) -> Tuple[np.ndarray, np.ndarray]:
         """Blocking convenience wrapper over :meth:`submit`."""
-        return self.submit(queries, k).result(timeout=timeout)
+        return self.submit(queries, k, scorer=scorer,
+                           filter=filter).result(timeout=timeout)
+
+    def default_scorer_key(self) -> str:
+        """Canonical key of the scorer requests score under when they
+        name none — what the canary prober captures its oracle with."""
+        return self._default_scorer.key()
+
+    def set_scorer(self, spec) -> int:
+        """Change the server's DEFAULT scorer live (the ``set_scorer``
+        JSONL op). Routed through :meth:`_install_index` — same
+        retriever, but the epoch bumps, the result cache clears and
+        the canary oracle re-captures under the new default, because a
+        scorer change IS a visibility change: the same query now
+        returns different bytes. Returns the new epoch."""
+        parsed = parse_scorer(spec)
+        with self._lock:
+            retriever = self._retriever
+            self._default_scorer = parsed
+        return self._install_index(retriever, "scorer_change")
 
     def swap_index(self, retriever: TfidfRetriever) -> int:
         """Hot-swap the serving index: new submissions score against

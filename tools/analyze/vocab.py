@@ -135,6 +135,9 @@ ENV_CLI_FLAGS = {
     "TFIDF_TPU_SERVE_PIPELINE": "--serve-pipeline-depth",
     "TFIDF_TPU_REPLICAS": "--replicas",
     "TFIDF_TPU_REPLICA_TIMEOUT_S": "--replica-timeout-s",
+    "TFIDF_TPU_SCORER": "--scorer",
+    "TFIDF_TPU_BM25_K1": "--bm25-k1",
+    "TFIDF_TPU_BM25_B": "--bm25-b",
 }
 
 #: Shared attributes the T001 thread lint tolerates without a lock,

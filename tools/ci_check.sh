@@ -72,7 +72,8 @@ stage "replica_front_smoke" env JAX_PLATFORMS=cpu timeout -k 10 600 \
 for artifact in BENCH_r05.json SERVE_r01.json SERVE_r02.json \
                 SERVE_r03.json SERVE_r04.json SERVE_r05.json \
                 REPLICA_r01.json \
-                INGEST_MH_r01.json RETR_r01.json; do
+                INGEST_MH_r01.json RETR_r01.json \
+                SCORING_r01.json; do
     if [ -f "${artifact}" ]; then
         stage "perf_gate:${artifact}" \
             python tools/perf_gate.py "${artifact}"

@@ -214,6 +214,27 @@ _GATES = {
         "qps_q512": ("higher", 0.30),
         "index_docs_per_sec": ("higher", 0.30),
     },
+    # Scoring-family sweep (tools/retrieval_bench.py --scorers, round
+    # 23): parity_ok must stay 1 (every scorer variant bit-identical
+    # to the untiled fallback AND to the pure-NumPy oracle — ids and
+    # tie order, not just scores), recompiles_after_warmup must stay 0
+    # (tfidf and bm25 faces share the same compiled search programs —
+    # scorer switching may never mint a new one), and the per-scorer
+    # recall@10 columns must stay 1.0 with a hair of band (they are
+    # device-vs-oracle receipts, deterministic at a fixed corpus).
+    # The per-scorer QPS columns gate directionally.
+    "scoring": {
+        "parity_ok": ("higher", 0.0),
+        "recompiles_after_warmup": ("lower", 0.0),
+        "recall_at_10_tfidf": ("higher", 0.0),
+        "recall_at_10_bm25": ("higher", 0.0),
+        "qps_q64_tfidf": ("higher", 0.30),
+        "qps_q256_tfidf": ("higher", 0.30),
+        "qps_q64_bm25": ("higher", 0.30),
+        "qps_q256_bm25": ("higher", 0.30),
+        "qps_q64_bm25_filter": ("higher", 0.30),
+        "qps_q256_bm25_filter": ("higher", 0.30),
+    },
     # The mesh dryrun verdict: ok must STAY 1 (zero-tolerance, the
     # absolute zero-baseline rule below never fires because ok is the
     # higher-is-better direction with a nonzero baseline).
@@ -249,6 +270,7 @@ _MATCH_KEYS = {"bench": ("backend", "n_docs", "wire"),
                                  "n_replicas", "host_cores"),
                "retrieval": ("backend", "docs", "doc_len", "k",
                              "tiling"),
+               "scoring": ("backend", "docs", "doc_len", "k"),
                "multichip": ("n_devices",)}
 # Defaults applied to BOTH sides of a match when the key is absent —
 # how records that predate a context key stay comparable to their

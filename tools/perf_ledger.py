@@ -267,6 +267,29 @@ _RETRIEVAL_METRICS = {
 _RETRIEVAL_CONTEXT = {"backend": "backend", "docs": "docs",
                       "doc_len": "doc_len", "k": "k",
                       "tiling": "tiling", "tile_rows": "tile_rows"}
+# Scoring-family sweep (tools/retrieval_bench.py --scorers, round 23):
+# per-scorer QPS through the same tiled kernel. parity_ok (every
+# variant bit-identical to the untiled fallback AND to the NumPy
+# oracle, tie order included) and recompiles_after_warmup (scorer
+# switching mints zero new search programs) are zero-tolerance; the
+# per-scorer QPS columns gate directionally; the recall/overlap
+# columns are embedded receipts that the family members rank
+# correctly and differently.
+_SCORING_METRICS = {
+    "parity_ok": "parity_ok",
+    "recompiles_after_warmup": "recompiles_after_warmup",
+    "qps_q64_tfidf": "qps_q64_tfidf",
+    "qps_q256_tfidf": "qps_q256_tfidf",
+    "qps_q64_bm25": "qps_q64_bm25",
+    "qps_q256_bm25": "qps_q256_bm25",
+    "qps_q64_bm25_filter": "qps_q64_bm25_filter",
+    "qps_q256_bm25_filter": "qps_q256_bm25_filter",
+    "recall_at_10_tfidf": "recall_at_10_tfidf",
+    "recall_at_10_bm25": "recall_at_10_bm25",
+    "bm25_vs_tfidf_overlap_at_10": "bm25_vs_tfidf_overlap_at_10",
+}
+_SCORING_CONTEXT = {"backend": "backend", "docs": "docs",
+                    "doc_len": "doc_len", "k": "k"}
 # Multi-chip dryrun artifacts (MULTICHIP_r0X.json): a driver wrapper
 # with no parsed payload — just the mesh smoke's verdict. "ok" is the
 # gated metric (1 must stay 1); n_devices is comparability context.
@@ -322,6 +345,8 @@ def classify(payload: dict) -> Optional[str]:
         return "ingest_mh"
     if payload.get("metric") == "retrieval_bench":
         return "retrieval"
+    if payload.get("metric") == "scoring_bench":
+        return "scoring"
     if payload.get("metric") == "replica_bench":
         # Checked before the serve_bench branches: a replica artifact
         # also carries a "chaos" rehearsal block, which must not
@@ -366,6 +391,7 @@ def normalize(path: str) -> Tuple[Optional[dict], Optional[str]]:
                     "ingest_mh": _INGEST_MH_METRICS,
                     "replica_serve": _REPLICA_METRICS,
                     "retrieval": _RETRIEVAL_METRICS,
+                    "scoring": _SCORING_METRICS,
                     "multichip": _MULTICHIP_METRICS}[kind]
     ctx_paths = {"serve_bench": _SERVE_CONTEXT,
                  "bench": _BENCH_CONTEXT,
@@ -375,6 +401,7 @@ def normalize(path: str) -> Tuple[Optional[dict], Optional[str]]:
                  "ingest_mh": _INGEST_MH_CONTEXT,
                  "replica_serve": _REPLICA_CONTEXT,
                  "retrieval": _RETRIEVAL_CONTEXT,
+                 "scoring": _SCORING_CONTEXT,
                  "multichip": _MULTICHIP_CONTEXT}[kind]
     metrics = {name: (int(v) if isinstance(v, bool) else v)
                for name, p in metric_paths.items()
@@ -472,7 +499,9 @@ def backfill_paths() -> List[str]:
             + sorted(glob.glob(os.path.join(_common.REPO,
                                             "REPLICA_r*.json")))
             + sorted(glob.glob(os.path.join(_common.REPO,
-                                            "RETR_r*.json"))))
+                                            "RETR_r*.json")))
+            + sorted(glob.glob(os.path.join(_common.REPO,
+                                            "SCORING_r*.json"))))
 
 
 def main() -> int:

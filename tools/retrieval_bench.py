@@ -23,9 +23,22 @@ only real if this sweep shows it, so the artifact carries:
 gates the QPS columns directionally. Exit 1 when parity or the
 recompile pin fails — the bench IS the regression test.
 
+``--scorers`` (round 23) switches to the SCORING-FAMILY artifact
+(``SCORING_r01.json``, ledger kind ``scoring``): per scorer variant
+(tfidf, bm25, bm25+filter) it measures QPS at Q=64/256 through the
+same tiled kernel, pins bit-parity three ways (tiled vs the
+``TFIDF_TPU_SCORE_TILING=off`` fallback; device ids vs the pure-NumPy
+oracle of ``tfidf_tpu.scoring.oracle``, tie order included), embeds
+per-scorer retrieval recall@10 vs that oracle plus the bm25-vs-tfidf
+top-10 overlap (proof the family members actually rank differently),
+and re-pins zero recompiles after warm-up across every variant —
+scorer switching must never mint new search programs.
+
 Usage::
 
     python tools/retrieval_bench.py [--docs 100000] [--out RETR_r01.json]
+    python tools/retrieval_bench.py --scorers [--docs 20000] \\
+        [--out SCORING_r01.json]
 """
 
 from __future__ import annotations
@@ -43,13 +56,134 @@ import _common  # noqa: E402,F401  repo-root sys.path bootstrap
 import numpy as np  # noqa: E402
 
 
-def _measure(r, queries, k, repeats):
+def _measure(r, queries, k, repeats, **search_kw):
     best = float("inf")
     for _ in range(repeats):
         t0 = time.perf_counter()
-        vals, idx = r.search(queries, k=k)
+        vals, idx = r.search(queries, k=k, **search_kw)
         best = min(best, time.perf_counter() - t0)
     return best, vals, idx
+
+
+def _scoring_main(args) -> int:
+    """--scorers: the scoring-family artifact (module docstring)."""
+    import bench as benchmod
+    benchmod.N_DOCS = args.docs
+    benchmod.DOC_LEN = args.length
+
+    import jax
+    from tfidf_tpu.config import PipelineConfig, VocabMode
+    from tfidf_tpu.models.retrieval import (TfidfRetriever, _search_tiled,
+                                            query_matrix)
+    from tfidf_tpu.ops.sparse import score_topk_tiled_cache_size
+    from tfidf_tpu.recall import retrieval_recall_at_k, scorer_overlap_at_k
+    from tfidf_tpu.scoring import parse_filter, parse_scorer
+    from tfidf_tpu.scoring.filters import filter_mask
+    from tfidf_tpu.scoring.oracle import oracle_topk
+
+    backend = jax.default_backend()
+    print(f"backend={backend}", file=sys.stderr)
+    tmp = tempfile.mkdtemp(prefix="scoring_bench_")
+    try:
+        print(f"generating {args.docs}-doc corpus...", file=sys.stderr)
+        input_dir = benchmod.make_corpus(tmp)
+        cfg = PipelineConfig(vocab_mode=VocabMode.HASHED,
+                             vocab_size=benchmod.VOCAB,
+                             max_doc_len=args.length, topk=None,
+                             engine="sparse")
+        r = TfidfRetriever(cfg)
+        r.index_dir(input_dir, doc_len=args.length)
+        jax.block_until_ready((r._ids, r._weights))
+
+        rng = np.random.default_rng(7)
+        pool = [" ".join(f"w{rng.integers(0, benchmod.N_WORDS)}"
+                         for _ in range(5)) for _ in range(256)]
+        half_filter = {"id_range": [0, args.docs // 2]}
+        variants = [("tfidf", "tfidf", None),
+                    ("bm25", "bm25", None),
+                    ("bm25_filter", "bm25", half_filter)]
+
+        def cache_size():
+            return _search_tiled._cache_size() + score_topk_tiled_cache_size()
+
+        def oracle(queries, spec, fspec, k):
+            data, cols = r.scorer_face(spec)
+            live = np.zeros((data.shape[0],), bool)
+            live[:r._num_docs] = (True if fspec is None else filter_mask(
+                fspec, r._num_docs, names=r.names))
+            qmat = query_matrix(
+                queries, r.config, np.asarray(r._idf),
+                mode="counts" if spec.kind == "bm25" else "cosine")
+            return oracle_topk(data, cols, live, qmat, k)
+
+        artifact = {"metric": "scoring_bench", "backend": backend,
+                    "docs": args.docs, "doc_len": args.length,
+                    "k": args.k}
+        recompiles = 0
+        parity_ok = True
+        ids_by_variant = {}
+        for name, skey, flt in variants:
+            spec = parse_scorer(skey)
+            fspec = parse_filter(flt)
+            kw = {"scorer": spec}
+            if flt is not None:
+                kw["filter"] = flt
+            for q in (64, 256):
+                queries = pool[:q]
+                r.search(queries, k=args.k, **kw)    # warm this bucket
+                warm = cache_size()
+                best, vals, idx = _measure(r, queries, args.k,
+                                           args.repeats, **kw)
+                recompiles += cache_size() - warm
+                assert vals.shape[0] == q
+                artifact[f"qps_q{q}_{name}"] = round(q / best, 1)
+                print(json.dumps({"metric": "scoring_qps",
+                                  "scorer": name, "batch": q,
+                                  "k": args.k,
+                                  "value": round(q / best, 1)}),
+                      flush=True)
+            # --- parity: tiled vs untiled, device vs NumPy oracle ---
+            queries = pool[:64]
+            on_v, on_i = r.search(queries, k=args.k, **kw)
+            os.environ["TFIDF_TPU_SCORE_TILING"] = "off"
+            try:
+                off_v, off_i = r.search(queries, k=args.k, **kw)
+            finally:
+                os.environ["TFIDF_TPU_SCORE_TILING"] = "on"
+            tiled_same = (np.array_equal(on_v, off_v)
+                          and np.array_equal(on_i, off_i))
+            ov, oi = oracle(queries, spec, fspec, args.k)
+            oracle_same = (np.array_equal(np.asarray(on_i), oi[:, :args.k])
+                           and np.allclose(np.asarray(on_v),
+                                           ov[:, :args.k], rtol=1e-5,
+                                           atol=1e-6))
+            parity_ok &= tiled_same and oracle_same
+            artifact[f"parity_{name}"] = int(tiled_same and oracle_same)
+            artifact[f"recall_at_10_{name}"] = round(
+                retrieval_recall_at_k(np.asarray(on_i), oi, 10), 4)
+            ids_by_variant[name] = np.asarray(on_i)
+            print(f"parity {name}: tiled_vs_untiled="
+                  f"{'ok' if tiled_same else 'MISMATCH'} vs_oracle="
+                  f"{'ok' if oracle_same else 'MISMATCH'}",
+                  file=sys.stderr)
+
+        artifact["bm25_vs_tfidf_overlap_at_10"] = round(
+            scorer_overlap_at_k(ids_by_variant["tfidf"],
+                                ids_by_variant["bm25"], 10), 4)
+        artifact["parity_ok"] = int(parity_ok)
+        artifact["recompiles_after_warmup"] = int(recompiles)
+        print(json.dumps(artifact, indent=1))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(artifact, f, indent=1)
+            print(f"wrote {args.out}", file=sys.stderr)
+        if not parity_ok or recompiles:
+            print("scoring_bench: FAIL (parity or recompile pin)",
+                  file=sys.stderr)
+            return 1
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def main() -> int:
@@ -65,8 +199,15 @@ def main() -> int:
                     help="widths A/B'd against --score-tiling=off "
                          "(either side of the legacy 64 split)")
     ap.add_argument("--out", default=None,
-                    help="write the JSON artifact here (RETR_r0X.json)")
+                    help="write the JSON artifact here (RETR_r0X.json, "
+                         "or SCORING_r0X.json with --scorers)")
+    ap.add_argument("--scorers", action="store_true",
+                    help="scoring-family mode: per-scorer QPS + "
+                         "three-way parity + recall artifact "
+                         "(module docstring)")
     args = ap.parse_args()
+    if args.scorers:
+        return _scoring_main(args)
 
     import bench as benchmod
     benchmod.N_DOCS = args.docs
