@@ -463,6 +463,20 @@ def _build_parser() -> argparse.ArgumentParser:
                          "--ab-slab measures it). 'off' forces the "
                          "legacy per-batch allocation, bit-identical "
                          "(default on; env TFIDF_TPU_QUERY_SLAB)")
+    sv.add_argument("--disttrace", choices=["on", "off"], default=None,
+                    help="fleet-wide distributed tracing: the front "
+                         "mints a compact trace context per admitted "
+                         "request and every hop (route, replica "
+                         "request/queued/batched/device, two-phase "
+                         "txn_phase) carries the same t<16hex> id; "
+                         "replica span rings pull over the data plane "
+                         "({\"op\": \"trace_export\"}) and "
+                         "tools/trace_merge.py aligns the clocks into "
+                         "one Perfetto timeline. 'off' drops the "
+                         "context at admission — requests degrade to "
+                         "local rids, never fail (default on; env "
+                         "TFIDF_TPU_DISTTRACE; docs/OBSERVABILITY.md "
+                         "'Trace a slow query across the tier')")
     sv.add_argument("--serve-pipeline-depth", type=int, default=None,
                     metavar="D",
                     help="pipelined serve execution: up to D dispatched "
@@ -1074,6 +1088,21 @@ def _serve_handle_line(server, line, write, default_k, build_retriever,
     if op == "obs_export":
         write({"id": req.get("id"), "obs_export": server.obs_export()})
         return True
+    if op == "trace_export":
+        # The replica half of the fleet span pull: the front's
+        # trace_export() collects this bundle over the SAME data plane
+        # as obs_export and stamps identity + clock offset on each
+        # entry. A process with no armed tracer answers an empty
+        # bundle (never an error — the merge just has one fewer lane).
+        from tfidf_tpu import obs
+        t = obs.get_tracer()
+        procs = ([{**t.export_meta(), "traceEvents": t.chrome_events()}]
+                 if t is not None else [])
+        write({"id": req.get("id"),
+               "trace_export": {"schema": "tfidf-trace/1",
+                                "pid": os.getpid(),
+                                "processes": procs}})
+        return True
     if op == "healthz":
         write({"id": req.get("id"), "healthz": server.healthz()})
         return True
@@ -1173,6 +1202,11 @@ def _serve_handle_line(server, line, write, default_k, build_retriever,
         return True
     k = int(req.get("k", default_k))
     names = server.doc_names()
+    # Fleet trace adoption (round 23): a front-routed request arrives
+    # with a compact trace context; malformed/missing/disabled all
+    # degrade to None — the request proceeds rid-only, never fails.
+    from tfidf_tpu.obs import disttrace
+    tctx = disttrace.from_wire(req.get("trace"))
 
     def on_done(f):
         # The request id (round 16) rides every response line — the
@@ -1181,6 +1215,11 @@ def _serve_handle_line(server, line, write, default_k, build_retriever,
         # slow_query event.
         extra = ({"rid": f.rid}
                  if getattr(f, "rid", None) is not None else {})
+        if getattr(f, "trace", None) is not None:
+            # The fleet trace id echoes next to the rid: the front
+            # (and doctor --request) join this response to the spans
+            # every process recorded under the same t<16hex> key.
+            extra["trace"] = f.trace
         if getattr(f, "epoch", None) is not None:
             # The admitted epoch on every response line: the
             # replicated front's mixed-epoch audit (and any client's
@@ -1208,7 +1247,8 @@ def _serve_handle_line(server, line, write, default_k, build_retriever,
                       deadline_ms=req.get("deadline_ms"),
                       use_cache=bool(req.get("use_cache", True)),
                       scorer=req.get("scorer"),
-                      filter=req.get("filter")
+                      filter=req.get("filter"),
+                      trace=(tctx.trace if tctx is not None else None)
                       ).add_done_callback(on_done)
     except (ValueError, TypeError) as e:  # malformed scorer/filter spec
         write({"id": line_id, "error": f"bad request: {e}"})
@@ -1262,10 +1302,20 @@ def _run_serve(args) -> int:
         mesh_shards=args.mesh_shards,
         query_slab=(None if args.query_slab is None
                     else args.query_slab == "on"),
+        disttrace=(None if args.disttrace is None
+                   else args.disttrace == "on"),
         pipeline_depth=args.serve_pipeline_depth,
         replicas=args.replicas,
         replica_timeout_s=args.replica_timeout_s,
         scorer=args.scorer, bm25_k1=args.bm25_k1, bm25_b=args.bm25_b)
+
+    if serve_cfg.disttrace is not None:
+        # Resolve the fleet-tracing verdict once for this process
+        # (flag > env > default-on); a plain single server still
+        # ADOPTS inbound trace contexts — a front one hop up may be
+        # doing the minting.
+        from tfidf_tpu.obs import disttrace
+        disttrace.configure(serve_cfg.disttrace)
 
     if serve_cfg.replicas:
         # Replicated tier: this process becomes the FRONT — it owns

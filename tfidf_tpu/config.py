@@ -384,6 +384,17 @@ class ServeConfig:
       bm25_b: BM25 length-normalization strength, same resolution
         rules as ``bm25_k1``. None = 0.75. CLI ``--bm25-b`` / env
         ``TFIDF_TPU_BM25_B``.
+      disttrace: fleet-wide distributed tracing (round 23): the
+        replicated front mints one ``t<16hex>`` trace id per admitted
+        request and propagates it on the data plane (the ``"trace"``
+        JSONL field, echoed on responses) and the two-phase control
+        plane (``txn_phase`` spans), with a per-replica clock-offset
+        handshake so ``tools/trace_merge.py`` renders one aligned
+        tier timeline (docs/OBSERVABILITY.md "Trace a slow query
+        across the tier"). None resolves the env
+        (``TFIDF_TPU_DISTTRACE``, default on); False is the A/B off
+        lever ``serve_bench --replicas`` measures propagation
+        overhead against. CLI ``--disttrace``.
     """
 
     max_batch: int = 256
@@ -417,6 +428,7 @@ class ServeConfig:
     scorer: Optional[str] = None
     bm25_k1: Optional[float] = None
     bm25_b: Optional[float] = None
+    disttrace: Optional[bool] = None
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -536,6 +548,9 @@ class ServeConfig:
                 ("bm25_k1", "TFIDF_TPU_BM25_K1", float),
                 ("bm25_b", "TFIDF_TPU_BM25_B", float),
                 ("query_slab", "TFIDF_TPU_QUERY_SLAB",
+                 lambda raw: raw.strip().lower() not in
+                 ("0", "off", "false", "no")),
+                ("disttrace", "TFIDF_TPU_DISTTRACE",
                  lambda raw: raw.strip().lower() not in
                  ("0", "off", "false", "no"))):
             val = pick(key, env, cast)

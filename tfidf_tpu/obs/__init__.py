@@ -49,13 +49,15 @@ from tfidf_tpu.obs.tracer import (SpanHandle, Tracer, begin, configure,
                                   device_op_table, device_span, enabled,
                                   end, export, get_tracer, instant,
                                   load_chrome_trace, name_thread,
-                                  set_tracer, span, span_totals,
-                                  spans_by_thread, trace_path)
+                                  set_export_meta, set_tracer, span,
+                                  span_totals, spans_by_thread,
+                                  trace_path)
 
 __all__ = [
     "Tracer", "SpanHandle", "configure", "enabled", "export",
     "get_tracer", "set_tracer", "span", "device_span", "begin", "end",
     "instant", "name_thread", "span_totals", "trace_path",
+    "set_export_meta",
     "load_chrome_trace", "spans_by_thread", "device_op_table",
     "EventLog", "get_log", "set_log", "log_event", "record_digest",
     "configure_flight", "flight_path", "dump_flight",

@@ -112,11 +112,19 @@ class RequestContext:
     writer at a time, so plain attribute writes are safe under the
     GIL (the same discipline as the tracer's ring)."""
 
-    __slots__ = ("rid", "n", "k", "t0", "t0_wall", "epoch", "batch",
-                 "co_occupants", "phases", "anomalies", "_t_dev_end")
+    __slots__ = ("rid", "trace", "n", "k", "t0", "t0_wall", "epoch",
+                 "batch", "co_occupants", "phases", "anomalies",
+                 "_t_dev_end")
 
-    def __init__(self, rid: str, n: int, k: int) -> None:
+    def __init__(self, rid: str, n: int, k: int,
+                 trace: Optional[str] = None) -> None:
         self.rid = rid
+        # Fleet-global trace id (round 23): a front-routed request
+        # arrives with the front-minted ``t<16hex>`` id and every
+        # local span/digest/response carries it next to the rid, so
+        # cross-process evidence joins on one key. None = locally
+        # submitted (or disttrace off) — rid-only, exactly as before.
+        self.trace = trace
         self.n = n
         self.k = k
         self.t0 = time.monotonic()
@@ -150,13 +158,15 @@ class RequestContext:
                 for p in PHASES}
 
 
-def start(n: int, k: int) -> Optional[RequestContext]:
+def start(n: int, k: int,
+          trace: Optional[str] = None) -> Optional[RequestContext]:
     """Mint a request identity at admission; None when request tracing
     is off (every consumer takes ``ctx is None`` as the disabled
-    path)."""
+    path). ``trace`` adopts a front-minted fleet trace id onto the
+    context (:mod:`tfidf_tpu.obs.disttrace`)."""
     if not enabled():
         return None
-    return RequestContext(next_rid(), n, k)
+    return RequestContext(next_rid(), n, k, trace=trace)
 
 
 def _overlapping_watermarks(ctx: RequestContext) -> List[dict]:
@@ -206,5 +216,6 @@ def finish(ctx: Optional[RequestContext], outcome: str,
         rid=ctx.rid, outcome=outcome, breakdown=ctx.breakdown(),
         batch=ctx.batch, co_occupants=ctx.co_occupants,
         epoch=ctx.epoch, queries=ctx.n, k=ctx.k,
-        sampled=sampled, anomalies=anomalies)
+        sampled=sampled, anomalies=anomalies,
+        **({"trace": ctx.trace} if ctx.trace else {}))
     return "slow" if slow else "sampled"

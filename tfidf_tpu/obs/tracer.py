@@ -52,7 +52,8 @@ __all__ = [
     "Tracer", "SpanHandle", "configure", "enabled", "export",
     "get_tracer", "set_tracer", "span", "begin", "end", "instant",
     "device_span", "name_thread", "span_totals", "trace_path",
-    "load_chrome_trace", "device_op_table", "spans_by_thread",
+    "set_export_meta", "load_chrome_trace", "device_op_table",
+    "spans_by_thread",
 ]
 
 _DEFAULT_CAP = 1 << 16
@@ -162,6 +163,12 @@ class Tracer:
         self._names: Dict[int, str] = {}     # tid -> thread name
         self._labels: Dict[int, str] = {}    # tid -> explicit lane label
         self._local = threading.local()
+        # Fleet-trace export metadata (round 23): process identity and
+        # the clock-offset estimate tools/trace_merge.py aligns lanes
+        # with. Written by set_export_meta, embedded under the
+        # "disttrace" key of the exported doc — timestamps themselves
+        # are NEVER rewritten (docs/OBSERVABILITY.md "fleet tracing").
+        self.meta: Dict[str, Any] = {}
 
     # --- recording ---
     def _tid(self) -> int:
@@ -275,11 +282,28 @@ class Tracer:
             events.append(ev)
         return events
 
+    def set_export_meta(self, **kv: Any) -> None:
+        """Merge fleet-trace metadata into the export doc (process
+        identity, clock offset — see module ``set_export_meta``)."""
+        self.meta.update(kv)
+
+    def export_meta(self) -> Dict[str, Any]:
+        """The per-process ``disttrace`` metadata block: identity +
+        the tracer's epoch (``t0_ns``, the perf_counter_ns instant
+        Chrome ``ts`` values are relative to) + whatever
+        :meth:`set_export_meta` recorded (clock offset/uncertainty)."""
+        return {"process": self.meta.get("process", "host"),
+                "os_pid": os.getpid(), "t0_ns": self._t0, **self.meta}
+
     def export(self, path: str) -> str:
         """Write the Chrome trace JSON; returns ``path``. Load it in
-        Perfetto (https://ui.perfetto.dev) or ``chrome://tracing``."""
+        Perfetto (https://ui.perfetto.dev) or ``chrome://tracing``.
+        The doc carries a ``disttrace`` metadata key (Perfetto ignores
+        unknown top-level keys) so ``tools/trace_merge.py`` can align
+        this process's lanes against a peer's."""
         doc = {"traceEvents": self.chrome_events(),
-               "displayTimeUnit": "ms"}
+               "displayTimeUnit": "ms",
+               "disttrace": self.export_meta()}
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
         with open(path, "w") as f:
@@ -405,6 +429,15 @@ def name_thread(label: str) -> None:
 def span_totals() -> Dict[str, float]:
     t = _tracer
     return t.span_totals() if t is not None else {}
+
+
+def set_export_meta(**kv) -> None:
+    """Record fleet-trace metadata (``process`` identity, ``clock``
+    offset estimate) on the global tracer for the next export; no-op
+    when tracing is off."""
+    t = _tracer
+    if t is not None:
+        t.set_export_meta(**kv)
 
 
 # --- Chrome-trace reading (shared by tools/trace_capture.py,

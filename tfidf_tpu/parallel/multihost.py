@@ -57,6 +57,12 @@ _TAG_BARRIER_OUT = -103
 # reductions through Send/Recv with caller tags; our allreduce uses a
 # reserved one so a concurrent p2p exchange cannot interleave).
 _TAG_REDUCE = -105
+# Clock-alignment handshake (round 23): rank 0 brackets each peer's
+# perf_counter_ns reply and estimates the offset at the RTT midpoint —
+# recorded as trace-export metadata and applied only at MERGE time
+# (tools/trace_merge.py); capture timestamps are never rewritten.
+_TAG_CLOCK = -106
+_CLOCK_SAMPLES = 8
 
 _FRAME_HDR = struct.Struct("<iQ")  # [i32 tag][u64 nbytes]
 
@@ -225,6 +231,43 @@ class MpiLiteComm:
         self._fds = [-1] * self.size
 
 
+def clock_handshake(comm: "MpiLiteComm",
+                    samples: int = _CLOCK_SAMPLES) -> dict:
+    """Estimate every rank's clock offset against rank 0, the fleet's
+    reference timeline. Root-sequenced like the other collectives:
+    rank 0 pings each peer ``samples`` times over the reserved
+    ``_TAG_CLOCK`` channel, the peer answers with its raw
+    ``perf_counter_ns``, and the root keeps the minimum-RTT estimate
+    (:class:`tfidf_tpu.obs.disttrace.ClockOffsetEstimator` — the same
+    math the serving front uses on its ctrl plane). Each peer receives
+    its own estimate back and returns it; rank 0 returns the zero
+    self-estimate. The dict is trace-export METADATA
+    (``offset_ns``/``uncertainty_ns``/``rtt_ns``/``samples``): offsets
+    are applied at merge time by ``tools/trace_merge.py``, never at
+    capture."""
+    from tfidf_tpu.obs.disttrace import ClockOffsetEstimator
+
+    if comm.size == 1:
+        return ClockOffsetEstimator().as_meta()
+    if comm.rank == 0:
+        for peer in range(1, comm.size):
+            est = ClockOffsetEstimator()
+            for _ in range(samples):
+                t_send = time.perf_counter_ns()
+                comm.send(peer, _TAG_CLOCK, b"")
+                t_peer = struct.unpack(
+                    "<q", comm.recv(peer, _TAG_CLOCK))[0]
+                est.add_sample(t_send, t_peer, time.perf_counter_ns())
+            comm.send(peer, _TAG_CLOCK,
+                      json.dumps(est.as_meta()).encode())
+        return ClockOffsetEstimator().as_meta()
+    for _ in range(samples):
+        comm.recv(0, _TAG_CLOCK)
+        comm.send(0, _TAG_CLOCK,
+                  struct.pack("<q", time.perf_counter_ns()))
+    return json.loads(comm.recv(0, _TAG_CLOCK).decode())
+
+
 def launch_ranks(n: int, argv_for_rank: Callable[[int], List[str]],
                  env: Optional[dict] = None,
                  stderr=subprocess.PIPE) -> List[subprocess.Popen]:
@@ -359,6 +402,12 @@ def _worker_main(spec_path: str) -> int:
     # One more fence so no rank tears down its channels while a peer
     # is still mid-allreduce.
     comm.barrier()
+    # Clock alignment (round 23): the channels are quiet here, so the
+    # ping RTTs are honest. Identity + offset ride the trace-export
+    # metadata — tools/trace_merge.py folds the N per-rank timelines
+    # onto rank 0's clock.
+    clock = clock_handshake(comm)
+    obs.set_export_meta(process=f"ingest{comm.rank}", clock=clock)
     out = spec["out_paths"][comm.rank]
     arrays = {
         "topk_vals": np.asarray(result.topk_vals),

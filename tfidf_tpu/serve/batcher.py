@@ -167,8 +167,11 @@ class _Pending:
         # (batch-id attributed) or the request sheds — the "queued"
         # stage of the request lifecycle chain (docs/OBSERVABILITY.md).
         if ctx is not None:
-            self.obs = obs.begin("queued", queries=len(self.queries),
-                                 k=self.k, rid=ctx.rid)
+            kw = {"queries": len(self.queries), "k": self.k,
+                  "rid": ctx.rid}
+            if getattr(ctx, "trace", None):
+                kw["trace"] = ctx.trace   # fleet trace id (round 23)
+            self.obs = obs.begin("queued", **kw)
         else:
             self.obs = obs.begin("queued", queries=len(self.queries),
                                  k=self.k)
@@ -457,6 +460,21 @@ class MicroBatcher:
                 p.ctx.co_occupants = len(queries)
         return bid, t_formed, queries, offsets, rids
 
+    @staticmethod
+    def _span_extra(live, rids) -> dict:
+        """rid + fleet-trace stamps for a batch's spans: ``rids`` is
+        positional (round 16); ``traces`` (round 23) is the deduped
+        set of front-minted trace ids riding the batch, so a merged
+        tier timeline joins batched/device/drain spans to the front's
+        route spans without going through the rid table."""
+        extra = {"rids": rids} if rids else {}
+        traces = sorted({p.ctx.trace for p in live
+                         if p.ctx is not None
+                         and getattr(p.ctx, "trace", None)})
+        if traces:
+            extra["traces"] = traces
+        return extra
+
     def _deliver(self, live, offsets, vals, ids, poison, bid) -> None:
         """Slice the batch result back per request and resolve the
         futures (poison rows fail typed, innocents get their rows)."""
@@ -487,7 +505,7 @@ class MicroBatcher:
         if not live:
             return
         bid, t_formed, queries, offsets, rids = self._form(live)
-        span_extra = {"rids": rids} if rids else {}
+        span_extra = self._span_extra(live, rids)
         # Recompile attribution (round 12): with a warm CompileWatch
         # armed, a recompile-count delta across THIS batch's device
         # call pins the offending batch on the trace timeline — the
@@ -576,7 +594,7 @@ class MicroBatcher:
         if not live:
             return
         bid, t_formed, queries, offsets, rids = self._form(live)
-        span_extra = {"rids": rids} if rids else {}
+        span_extra = self._span_extra(live, rids)
         watch = obs_devmon.get_watch()
         pre_rc = (watch.recompile_count
                   if watch is not None and watch.warm else None)
@@ -661,7 +679,7 @@ class MicroBatcher:
         as the unpipelined path would, mark phases, deliver."""
         live, bid, queries = ent.live, ent.bid, ent.queries
         rids, offsets = ent.rids, ent.offsets
-        span_extra = {"rids": rids} if rids else {}
+        span_extra = self._span_extra(live, rids)
         pre_retries = self._retry_count()
         err: Optional[BaseException] = None
         # The drain span closes BEFORE the batched span ends: the

@@ -68,6 +68,7 @@ import time
 import zlib
 from typing import Callable, Dict, List, Optional
 
+from tfidf_tpu.obs import disttrace
 from tfidf_tpu.parallel.multihost import (MpiLiteComm, MpiLiteError,
                                           launch_rank)
 
@@ -80,6 +81,14 @@ _CTRL = 11
 _CTRL_ACK = 12
 
 _OBS_SCHEMA = "tfidf-obs/1"
+#: Fleet trace-export bundle schema (round 23): one entry per process
+#: — its Chrome events verbatim plus the identity/clock metadata
+#: tools/trace_merge.py aligns lanes with.
+_TRACE_SCHEMA = "tfidf-trace/1"
+#: Round trips per clock-offset handshake. Min-RTT filtering over 8
+#: samples bounds the offset error by half the best observed pipe RTT
+#: (tens of µs on a local socketpair) — far under any span we render.
+_CLOCK_SAMPLES = 8
 
 #: env the replicas must NOT inherit: trace/flight paths would have N
 #: processes clobbering one file, and a leaked TFIDF_TPU_REPLICAS
@@ -180,6 +189,15 @@ class ReplicatedFront:
         self._t0 = time.monotonic()
         self._specs_dir = tempfile.mkdtemp(prefix="tfidf_front_")
         self._restart_q: "queue.Queue[Optional[int]]" = queue.Queue()
+        # Fleet tracing (round 23): ServeConfig.disttrace overrides
+        # the env default for this process AND (via the spec) every
+        # replica; per-replica clock-offset estimators feed the
+        # trace-export metadata tools/trace_merge.py aligns with.
+        if serve_cfg.disttrace is not None:
+            disttrace.configure(serve_cfg.disttrace)
+        self._clocks: Dict[int, disttrace.ClockOffsetEstimator] = {
+            r: disttrace.ClockOffsetEstimator()
+            for r in range(1, self._size)}
 
         from tfidf_tpu.obs.registry import MetricsRegistry
         self._registry = MetricsRegistry()
@@ -214,12 +232,18 @@ class ReplicatedFront:
         the snapshot concurrently."""
         if self._started:
             return self
+        from tfidf_tpu import obs
+        obs.set_export_meta(process="front",
+                            clock={"offset_ns": 0, "uncertainty_ns": 0,
+                                   "rtt_ns": 0, "samples": 0})
         self._spawn(1, bootstrap=True)
         self._await_ready(1)
+        self._sync_clock(1)
         for rank in range(2, self._size):
             self._spawn(rank, bootstrap=False)
         for rank in range(2, self._size):
             self._await_ready(rank)
+            self._sync_clock(rank)
         with self._lock:
             epochs = {r: rep.epoch for r, rep in self._replicas.items()}
         if len(set(epochs.values())) != 1:
@@ -246,6 +270,11 @@ class ReplicatedFront:
         serve_kw["replicas"] = None
         spec = {
             "rank": rank, "boot": boot, "bootstrap": bool(bootstrap),
+            # Front-resolved fleet-tracing verdict: a replica inherits
+            # no TFIDF_TPU_TRACE (see _STRIP_ENV) — this flag arms its
+            # IN-MEMORY span ring instead, pulled over the data plane
+            # by the trace_export op.
+            "disttrace": disttrace.enabled(),
             "snapshot_dir": self._serve_cfg.snapshot_dir,
             "input_dir": self._input_dir,
             "k": self._k, "no_strict": self._no_strict,
@@ -324,6 +353,43 @@ class ReplicatedFront:
                 f"{rep.epoch}, {rep.num_docs} docs, pid {rep.pid})",
             replica=rank, boot=rep.boot, epoch=rep.epoch,
             docs=rep.num_docs, pid=rep.pid)
+
+    def _sync_clock(self, rank: int) -> None:
+        """Clock-offset handshake with one replica over the ctrl plane
+        (serialized like every ctrl op — called at boot, before the
+        supervisor threads exist, and from _restart under the swap
+        lock): N ``clock_sync`` round trips, RTT-midpoint estimate,
+        min-RTT filter (obs/disttrace.py). The estimate lands in the
+        trace-export METADATA — captured timestamps are never
+        rewritten, so a bad estimate is re-appliable, not baked in.
+        Always re-estimated from scratch: a restarted replica is a new
+        process and a new ``perf_counter`` epoch."""
+        if not disttrace.enabled():
+            return
+        est = self._clocks[rank]
+        est.reset()
+        for _ in range(_CLOCK_SAMPLES):
+            t_send = time.perf_counter_ns()
+            try:
+                ack = self._ctrl_rpc(rank, {"op": "clock_sync"},
+                                     timeout_s=10.0)
+            except FrontError:
+                return     # supervision handles the death; no estimate
+            t_recv = time.perf_counter_ns()
+            t_peer = ack.get("t_ns")
+            if ack.get("ok") and isinstance(t_peer, int):
+                est.add_sample(t_send, t_peer, t_recv)
+        from tfidf_tpu.obs import log as obs_log
+        rep = self._replicas[rank]
+        obs_log.log_event(
+            "info", "clock_sync",
+            msg=(f"replica {rank} clock offset "
+                 f"{(est.offset_ns or 0) / 1e3:.1f} µs "
+                 f"± {(est.uncertainty_ns or 0) / 1e3:.1f} µs "
+                 f"({est.n_samples} samples, boot {rep.boot})"),
+            replica=rank, boot=rep.boot, offset_ns=est.offset_ns,
+            uncertainty_ns=est.uncertainty_ns, rtt_ns=est.rtt_ns,
+            samples=est.n_samples)
 
     def _kill(self, rank: int) -> None:
         proc = self._replicas[rank].proc
@@ -615,13 +681,24 @@ class ReplicatedFront:
         if not self._admission.wait(
                 timeout=self._serve_cfg.replica_timeout_s):
             return {"error": "overloaded"}   # a wedged swap gate
-        h = obs.begin("route")
+        # Fleet trace context (round 23): minted HERE, at the tier's
+        # admission point, and propagated as the request's "trace"
+        # JSONL field — the replica adopts it onto its
+        # RequestContext, so every span its rid machinery stamps
+        # joins back to this route span across the process boundary.
+        # The route span covers pick -> submit -> response: after
+        # clock alignment it must CONTAIN the replica's request span
+        # (the containment tools/trace_check.py --merged pins).
+        tctx = disttrace.mint()
+        tkw = {"trace": tctx.trace} if tctx is not None else {}
+        h = obs.begin("route", **tkw)
         try:
             target = self._pick(self._norm_for(req), forced=rank)
         except FrontError as e:
             obs.end(h, outcome="error")
             return {"error": str(e)}
-        obs.end(h, replica=target)
+        if tctx is not None:
+            req = {**req, "trace": disttrace.to_wire(tctx)}
         try:
             pend = self._submit_to(target, req, count_routed=True)
         except FrontError:
@@ -630,8 +707,15 @@ class ReplicatedFront:
                 target = self._pick(self._norm_for(req))
                 pend = self._submit_to(target, req, count_routed=True)
             except FrontError as e:
+                obs.end(h, outcome="error")
                 return {"error": str(e)}
-        return self._await(pend, timeout_s)
+        resp = self._await(pend, timeout_s)
+        # The replica's rid rides the route span's end args: the
+        # cross-process join (trace id <-> rid) is recorded on BOTH
+        # sides of the hop, so doctor --request can walk it from
+        # either end.
+        obs.end(h, replica=target, rid=resp.get("rid"))
+        return resp
 
     def query(self, queries, k: Optional[int] = None,
               use_cache: bool = True, rank: Optional[int] = None,
@@ -720,6 +804,9 @@ class ReplicatedFront:
                         rep.state = "dead"
                 continue
             self._m_restarts.inc()
+            # A respawned replica is a NEW clock epoch: re-estimate
+            # its offset before any of its spans can be merged.
+            self._sync_clock(rank)
             with self._lock:
                 behind = rep.epoch != self._epoch
             if not behind:
@@ -772,11 +859,17 @@ class ReplicatedFront:
                 raise FrontError("front is closed")
             txn = next(self._txns)
             target = self._epoch + 1
+            # Control-plane trace context: one id for the whole
+            # transaction — every prepare/ping/commit/abort ctrl op
+            # carries it and every participant's txn_phase span stamps
+            # it, so a tier-wide swap merges into ONE visible tree.
+            tctx = disttrace.mint()
+            tkw = {"trace": tctx.trace} if tctx is not None else {}
             h = obs.begin("epoch_swap", kind=kind, txn=txn,
-                          epoch=target)
+                          epoch=target, **tkw)
             try:
                 result = self._two_phase_locked(
-                    kind, payload, txn, target, obs_log)
+                    kind, payload, txn, target, obs_log, tctx)
             except SwapAborted:
                 obs.end(h, epoch=self._epoch)
                 raise
@@ -784,17 +877,21 @@ class ReplicatedFront:
             return result
 
     def _two_phase_locked(self, kind: str, payload: dict, txn: int,
-                          target: int, obs_log) -> dict:
+                          target: int, obs_log,
+                          tctx=None) -> dict:
+        from tfidf_tpu import obs
         live = self._live_ranks()
         if not live:
             raise FrontError("no live replicas")
+        tkw = {"trace": tctx.trace} if tctx is not None else {}
 
         def abort_txn(prepared, skip, why_rank, why):
             for peer in prepared:
                 if peer == why_rank:
                     continue
                 try:
-                    self._ctrl_rpc(peer, {"op": "abort", "txn": txn})
+                    self._ctrl_rpc(peer, {"op": "abort", "txn": txn,
+                                          **tkw})
                 except FrontError:
                     self._kill(peer)
             self._m_aborts.inc()
@@ -811,7 +908,7 @@ class ReplicatedFront:
             try:
                 ack = self._ctrl_rpc(rank, {
                     "op": "prepare", "txn": txn, "kind": kind,
-                    "epoch": target, **payload})
+                    "epoch": target, **tkw, **payload})
             except FrontError as e:
                 abort_txn(prepared, rank, rank, e)
                 self._kill(rank)
@@ -835,7 +932,8 @@ class ReplicatedFront:
         # epoch everywhere.
         for rank in prepared:
             try:
-                ack = self._ctrl_rpc(rank, {"op": "ping", "txn": txn})
+                ack = self._ctrl_rpc(rank, {"op": "ping", "txn": txn,
+                                            **tkw})
                 if not ack.get("ok"):
                     raise FrontError(ack.get("error", "ping refused"))
             except FrontError as e:
@@ -857,14 +955,21 @@ class ReplicatedFront:
         # old epoch everywhere.
         drain_deadline = (time.monotonic()
                           + self._serve_cfg.replica_timeout_s)
+        # The drain-to-zero gap as a first-class span: the txn tree's
+        # measurable "where did the swap wait" segment — gate closed,
+        # nothing installed, in-flight count bleeding to zero.
+        dh = obs.begin("txn_phase", phase="drain", txn=txn,
+                       epoch=target, **tkw)
         while True:
             with self._lock:
                 inflight = sum(self._replicas[r].inflight
                                for r in prepared
                                if r in self._replicas)
             if inflight == 0:
+                obs.end(dh, outcome="drained")
                 break
             if time.monotonic() > drain_deadline:
+                obs.end(dh, outcome="stalled", inflight=inflight)
                 self._admission.set()
                 abort_txn(prepared, None, None,
                           FrontError("in-flight drain stalled"))
@@ -881,7 +986,7 @@ class ReplicatedFront:
                 try:
                     ack = self._ctrl_rpc(rank, {
                         "op": "commit", "txn": txn,
-                        "snapshot": rank == writer})
+                        "snapshot": rank == writer, **tkw})
                 except FrontError as e:
                     if rank == writer and not committed:
                         # Writer state unknown; survivors are still
@@ -1074,6 +1179,46 @@ class ReplicatedFront:
                 for label, b in sorted(bundles.items())},
         }
 
+    def trace_export(self) -> dict:
+        """The fleet's span evidence in one pull (schema
+        ``tfidf-trace/1``): the front's own ring plus every live
+        replica's in-memory ring (pulled over the data plane — the
+        same transport discipline as ``obs_export``), one entry per
+        process carrying the identity + clock-offset metadata
+        ``tools/trace_merge.py`` aligns lanes with. Offsets ride the
+        METADATA; the Chrome events are each process's verbatim local
+        timeline."""
+        from tfidf_tpu import obs
+        processes: List[dict] = []
+        t = obs.get_tracer()
+        if t is not None:
+            processes.append({**t.export_meta(),
+                              "traceEvents": t.chrome_events()})
+        for rank in self._live_ranks():
+            try:
+                resp = self._request_op(rank, {"op": "trace_export"},
+                                        timeout_s=30.0)
+            except FrontError:
+                continue
+            b = resp.get("trace_export")
+            if not (isinstance(b, dict)
+                    and b.get("schema") == _TRACE_SCHEMA):
+                continue
+            for entry in b.get("processes") or []:
+                if not (isinstance(entry, dict)
+                        and isinstance(entry.get("traceEvents"),
+                                       list)):
+                    continue
+                entry = dict(entry)
+                entry["process"] = f"r{rank}"
+                # The front owns the estimator: offset_ns is REPLICA
+                # minus FRONT clock, stamped here so every non-front
+                # entry of the bundle is alignable.
+                entry["clock"] = self._clocks[rank].as_meta()
+                processes.append(entry)
+        return {"schema": _TRACE_SCHEMA, "pid": os.getpid(),
+                "epoch": self._epoch, "processes": processes}
+
     def replica_info(self) -> Dict[str, dict]:
         """Per-replica identity + compile receipts (the bench's
         recompiles-after-warm audit)."""
@@ -1128,6 +1273,8 @@ class ReplicatedFront:
                 write({"id": rid, "metrics_prom": self.metrics_prom()})
             elif op == "obs_export":
                 write({"id": rid, "obs_export": self.obs_export()})
+            elif op == "trace_export":
+                write({"id": rid, "trace_export": self.trace_export()})
             elif op in ("healthz", "readyz"):
                 desc = self.describe()
                 if op == "readyz":
@@ -1207,6 +1354,18 @@ def _replica_main(spec_path: str) -> int:
     cfg = _config_from_spec(spec["pipeline"])
     apply_compile_cache(cfg.compile_cache)
     serve_cfg = ServeConfig(**spec["serve"])
+    if serve_cfg.disttrace is not None:
+        disttrace.configure(serve_cfg.disttrace)
+    if spec.get("disttrace"):
+        # The replica inherits no TFIDF_TPU_TRACE (_STRIP_ENV): the
+        # front's spec flag arms an IN-MEMORY span ring instead,
+        # pulled on demand over the data plane by the trace_export
+        # op. Identity rides the export metadata; the front stamps
+        # the clock offset when it collects the bundle.
+        from tfidf_tpu import obs
+        if obs.get_tracer() is None:
+            obs.set_tracer(obs.Tracer(), None)
+        obs.set_export_meta(process=f"r{rank}")
     strict = not spec.get("no_strict", False)
     snap_dir = spec["snapshot_dir"]
     bootstrap = bool(spec.get("bootstrap"))
@@ -1300,6 +1459,7 @@ def _replica_main(spec_path: str) -> int:
         raise ValueError(f"unknown commit kind {kind!r}")
 
     def ctrl_loop() -> None:
+        from tfidf_tpu import obs
         while True:
             try:
                 req = json.loads(comm.recv(0, _CTRL).decode())
@@ -1307,6 +1467,16 @@ def _replica_main(spec_path: str) -> int:
                 os._exit(0)     # front gone — nothing left to serve
             op = req.get("op")
             txn = req.get("txn")
+            # Participant half of the txn tree (round 23): each
+            # two-phase op this replica executes is a txn_phase span
+            # stamped with the transaction's fleet trace id, so a
+            # tier-wide swap merges into one tree across processes.
+            tid_wire = req.get("trace")
+            ph = (obs.begin("txn_phase", phase=op, txn=txn,
+                            **({"trace": tid_wire}
+                               if isinstance(tid_wire, str) else {}))
+                  if op in ("prepare", "ping", "commit", "abort")
+                  else None)
             ack: dict = {"ok": True, "rank": rank, "txn": txn}
             fire_text = None
             try:
@@ -1349,11 +1519,20 @@ def _replica_main(spec_path: str) -> int:
                 elif op == "snapshot":
                     server.snapshot(snap_dir)
                     ack["epoch"] = server.epoch
+                elif op == "clock_sync":
+                    # The offset handshake's replica half: one local
+                    # clock reading while holding the request — the
+                    # front brackets it with its own send/recv stamps
+                    # (RTT-midpoint estimate, obs/disttrace.py).
+                    ack["t_ns"] = time.perf_counter_ns()
                 else:
                     raise ValueError(f"unknown ctrl op {op!r}")
             except Exception as e:  # noqa: BLE001 — acked, not fatal
                 ack = {"ok": False, "rank": rank, "txn": txn,
                        "error": str(e)}
+            if ph is not None:
+                obs.end(ph, ok=bool(ack.get("ok")),
+                        epoch=ack.get("epoch"))
             try:
                 comm.send(0, _CTRL_ACK, json.dumps(ack).encode())
             except (MpiLiteError, OSError):

@@ -285,7 +285,7 @@ class TfidfServer:
     def submit(self, queries: Sequence[Union[str, bytes]], k: int = 10,
                deadline_ms: Optional[float] = None, *,
                use_cache: bool = True, scorer=None,
-               filter=None) -> Future:
+               filter=None, trace: Optional[str] = None) -> Future:
         """Admit one request; returns a Future resolving to ``(vals,
         ids)`` — the exact arrays a direct ``retriever.search(queries,
         k)`` returns. Raises :class:`Overloaded` when the admission
@@ -306,7 +306,14 @@ class TfidfServer:
         The returned Future carries the request id as ``.rid`` (None
         with ``TFIDF_TPU_REQTRACE=off``) — the key that joins the
         JSONL response, the request's spans, its flight digest and
-        any ``slow_query`` event (round 16)."""
+        any ``slow_query`` event (round 16).
+
+        ``trace`` (round 23) adopts a front-minted fleet trace id
+        (``t<16hex>``, :mod:`tfidf_tpu.obs.disttrace`) onto the
+        request: the ``request`` span, the flight digest and the
+        returned Future (``.trace``) all carry it next to the rid, so
+        the front's ``route`` span and this replica's lifecycle chain
+        join across processes. None = locally submitted."""
         t0 = time.monotonic()
         queries = list(queries)
         n = len(queries)
@@ -318,16 +325,19 @@ class TfidfServer:
         # Request identity (round 16): minted at admission, carried on
         # the request through batcher -> cache -> supervisor -> device
         # dispatch -> drain, stamped on every span it touches.
-        ctx = reqtrace.start(n, k)
+        ctx = reqtrace.start(n, k, trace=trace)
         rid = ctx.rid if ctx is not None else None
         # The request lifecycle span: begun on the submitting thread,
         # ended (cross-thread) wherever the request resolves, with the
         # outcome as an arg — every submitted request appears exactly
         # once in a trace as drained / cache_hit / shed_* / error
         # (pinned by tests/test_obs.py).
-        req = (obs.begin("request", queries=n, k=k, rid=rid)
-               if rid is not None else
-               obs.begin("request", queries=n, k=k))
+        span_kw = {}
+        if rid is not None:
+            span_kw["rid"] = rid
+        if trace is not None:
+            span_kw["trace"] = trace
+        req = obs.begin("request", queries=n, k=k, **span_kw)
         if deadline_ms is None:
             deadline_ms = self.config.default_deadline_ms
         deadline = None if deadline_ms is None else t0 + deadline_ms / 1e3
@@ -377,6 +387,7 @@ class TfidfServer:
 
         out: Future = Future()
         out.rid = rid
+        out.trace = trace
         # The ADMITTED epoch rides the future: a response's epoch is
         # decided here, never by a swap that lands mid-flight — the
         # per-request half of the replicated tier's no-mixed-epochs

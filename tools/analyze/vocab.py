@@ -38,6 +38,12 @@ SPANS = {
     # streaming top-k across document tiles — carries tiles/rows/
     # queries (and segments on the stacked segmented path)
     "score_tile",
+    # fleet tracing (round 23): each two-phase participant's slice of
+    # a tier-wide transaction (phase=prepare/ping/commit/abort on the
+    # replica's ctrl lane, phase=drain for the front's drain-to-zero
+    # gap) — carries the txn and, when disttrace is on, the trace id
+    # that joins the whole swap into one tree in the merged timeline
+    "txn_phase",
 }
 
 #: Trace instants (``obs.instant``) — point events, not spans.
@@ -60,10 +66,13 @@ DEVICE_HOT_SPANS = {
     "device_tokenize",
 }
 
-#: Outcome labels legal on a ``queued`` span's end in addition to the
-#: request-outcome vocabulary trace_check enforces (a queued span that
-#: reached a batch ends ``batched``; requests never do).
-QUEUED_OUTCOMES = {"batched"}
+#: Outcome labels legal on NON-request spans in addition to the
+#: request-outcome vocabulary trace_check enforces: a ``queued`` span
+#: that reached a batch ends ``batched``; a front ``txn_phase`` drain
+#: span ends ``stalled`` when in-flight never reached zero inside the
+#: two-phase timeout (the drained case reuses the request vocabulary's
+#: ``drained``). Requests never end with either.
+QUEUED_OUTCOMES = {"batched", "stalled"}
 
 #: Every flight-recorder event kind ``obs.log.log_event`` may emit
 #: with a literal name. tools/doctor.py folds a subset into its fault
@@ -100,6 +109,11 @@ FLIGHT_EVENTS = {
     # reads liveness/routed-share/restarts/commits from exactly these
     "replica_up", "replica_down",
     "epoch_prepare", "epoch_commit", "epoch_abort",
+    # fleet tracing (round 23): one clock-offset handshake receipt per
+    # replica boot/restart (offset/uncertainty/rtt/samples) — the
+    # estimate tools/trace_merge.py applies and trace_check's merged
+    # mode audits
+    "clock_sync",
 }
 
 #: ``TFIDF_TPU_*`` env knobs mirrored by a CLI flag: the C004 gate
@@ -138,6 +152,7 @@ ENV_CLI_FLAGS = {
     "TFIDF_TPU_SCORER": "--scorer",
     "TFIDF_TPU_BM25_K1": "--bm25-k1",
     "TFIDF_TPU_BM25_B": "--bm25-b",
+    "TFIDF_TPU_DISTTRACE": "--disttrace",
 }
 
 #: Shared attributes the T001 thread lint tolerates without a lock,

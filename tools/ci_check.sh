@@ -66,12 +66,22 @@ stage "replica_front_smoke" env JAX_PLATFORMS=cpu timeout -k 10 600 \
     python -m pytest tests/test_replica.py -q -m slow \
     -p no:cacheprovider
 
+# 5b. fleet-tracing smoke (slow-marked, round 23): a real 2-replica
+#    front under traced load — trace_export pull, clock-aligned merge
+#    (tools/trace_merge.py), the merged-mode trace_check audit
+#    (route-contains-request after alignment for EVERY sampled query,
+#    one txn tree per tier-wide swap), fleet-wide doctor --request,
+#    and the front's SIGTERM crash-forensics parity.
+stage "disttrace_fleet_smoke" env JAX_PLATFORMS=cpu timeout -k 10 600 \
+    python -m pytest tests/test_disttrace.py -q -m slow \
+    -p no:cacheprovider
+
 # 6. perf gate: re-gate the committed newest artifacts against the
 #    ledger (unchanged artifacts must pass; a refreshed artifact that
 #    regressed fails here)
 for artifact in BENCH_r05.json SERVE_r01.json SERVE_r02.json \
                 SERVE_r03.json SERVE_r04.json SERVE_r05.json \
-                REPLICA_r01.json \
+                REPLICA_r01.json REPLICA_r02.json \
                 INGEST_MH_r01.json RETR_r01.json \
                 SCORING_r01.json; do
     if [ -f "${artifact}" ]; then

@@ -308,6 +308,143 @@ def _span_has_rid(e: dict, rid: str) -> bool:
     return a.get("rid") == rid or rid in (a.get("rids") or ())
 
 
+def _is_trace_id(s: str) -> bool:
+    """Fleet trace-id shape (``t`` + 16 hex): what the front mints per
+    admitted request (tfidf_tpu/obs/disttrace.py). ``--request``
+    dispatches on this — a ``r...`` rid keeps the single-process
+    timeline, a trace id joins across every process in the trace."""
+    if not (isinstance(s, str) and len(s) == 17 and s[0] == "t"):
+        return False
+    try:
+        int(s[1:], 16)
+    except ValueError:
+        return False
+    return True
+
+
+def _span_has_trace(e: dict, tid: str) -> bool:
+    a = e.get("args") or {}
+    return a.get("trace") == tid or tid in (a.get("traces") or ())
+
+
+def fleet_timeline(trace: str, flight: Optional[str],
+                   tid: str) -> Optional[dict]:
+    """The cross-process causal timeline of ONE front-minted trace id
+    (round 23), read from a ``tools/trace_merge.py`` output (or any
+    trace whose spans carry ``trace``/``traces`` args): the front's
+    ``route`` span, the owning replica's ``request``/``queued``/
+    ``batched``/``device``/``drain`` spans (joined through the rids
+    the direct spans carry) and the two-phase ``txn_phase`` spans,
+    time-ordered on the ALIGNED clock with ``process:lane`` labels,
+    plus per-hop latency attribution: ``wire_ms`` is the route wall
+    minus the replica's request wall (protocol + socket + queue-to-
+    submit), ``queued_ms``/``device_ms`` read straight off the
+    replica's spans. None when the id appears nowhere."""
+    events = _tracer.load_chrome_trace(trace)
+    thread_names: Dict[tuple, str] = {}
+    proc_names: Dict[object, str] = {}
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "thread_name":
+            thread_names[(e.get("pid"), e.get("tid"))] = \
+                e.get("args", {}).get("name", "")
+        elif e.get("name") == "process_name":
+            proc_names[e.get("pid")] = \
+                e.get("args", {}).get("name", "")
+    multi = len(proc_names) > 1
+
+    def lane(e: dict) -> str:
+        th = thread_names.get((e.get("pid"), e.get("tid")),
+                              f"{e.get('pid')}/{e.get('tid')}")
+        if multi:
+            return f"{proc_names.get(e.get('pid'), e.get('pid'))}:{th}"
+        return th
+
+    xs = [e for e in events if e.get("ph") == "X"]
+    direct = [e for e in xs if _span_has_trace(e, tid)]
+    rids = sorted({(e.get("args") or {}).get("rid")
+                   for e in direct
+                   if (e.get("args") or {}).get("rid")})
+    spans = [e for e in xs
+             if _span_has_trace(e, tid)
+             or any(_span_has_rid(e, r) for r in rids)]
+    if not spans:
+        return None
+    spans.sort(key=lambda e: e.get("ts", 0.0))
+    t_base = spans[0]["ts"]
+    rows = []
+    for e in spans:
+        args = dict(e.get("args") or {})
+        args.pop("rids", None)    # batch-mate lists: noise in one
+        args.pop("traces", None)  # trace's view
+        rows.append({"span": e["name"], "lane": lane(e),
+                     "at_ms": round((e["ts"] - t_base) / 1e3, 3),
+                     "dur_ms": round(e.get("dur", 0.0) / 1e3, 3),
+                     "args": args})
+
+    def _total(name: str) -> float:
+        return sum(e.get("dur", 0.0) for e in spans
+                   if e["name"] == name) / 1e3
+
+    hops = None
+    routes = [e for e in spans if e["name"] == "route"]
+    requests = [e for e in spans if e["name"] == "request"]
+    if routes and requests:
+        route_ms = routes[0].get("dur", 0.0) / 1e3
+        request_ms = requests[0].get("dur", 0.0) / 1e3
+        hops = {"route_ms": round(route_ms, 3),
+                "request_ms": round(request_ms, 3),
+                # Everything the front saw that the replica's server
+                # didn't: JSONL encode/decode, the socketpair both
+                # ways, and the replica's stdin loop.
+                "wire_ms": round(max(0.0, route_ms - request_ms), 3),
+                "queued_ms": round(_total("queued"), 3),
+                "device_ms": round(_total("device"), 3),
+                "drain_ms": round(_total("drain"), 3)}
+
+    flight_events: List[dict] = []
+    digests: List[dict] = []
+    if flight and os.path.exists(flight):
+        _header, fevents, fdigests = load_flight(flight)
+        flight_events = [
+            e for e in fevents
+            if e.get("trace") == tid or e.get("rid") in rids
+            or any(r in (e.get("rids") or ()) for r in rids)]
+        digests = [d for d in fdigests
+                   if d.get("rid") in rids or d.get("trace") == tid]
+    return {"trace_id": tid, "rids": rids,
+            "processes": sorted({r["lane"].split(":")[0]
+                                 for r in rows}) if multi else [],
+            "spans": rows, "hops": hops,
+            "flight_events": [
+                {k: v for k, v in e.items() if k != "kind"}
+                for e in flight_events],
+            "digests": digests}
+
+
+def render_fleet(rep: dict) -> str:
+    lines = [f"trace {rep['trace_id']}: {len(rep['spans'])} span(s) "
+             f"across {len(rep['processes']) or 1} process(es)"
+             + (f" {rep['processes']}" if rep["processes"] else "")
+             + (f", rids {rep['rids']}" if rep["rids"] else "")]
+    lines.append(f"  {'at ms':>9} {'dur ms':>9} {'lane':<18} "
+                 f"{'span':<16} args")
+    for r in rep["spans"]:
+        lines.append(
+            f"  {r['at_ms']:>9.3f} {r['dur_ms']:>9.3f} "
+            f"{r['lane']:<18} {r['span']:<16} {r['args']}")
+    if rep["hops"]:
+        parts = ", ".join(f"{k}={v}" for k, v in rep["hops"].items())
+        lines.append(f"  per-hop (ms): {parts}")
+    for e in rep["flight_events"]:
+        lines.append(f"  flight [{e.get('level')}] {e.get('event')}: "
+                     f"{e.get('msg', '')}")
+    for d in rep["digests"]:
+        lines.append(f"  digest: {d}")
+    return "\n".join(lines)
+
+
 def request_timeline(trace: str, flight: Optional[str],
                      rid: str) -> Optional[dict]:
     """The full causal timeline of ONE request (round 16): every span
@@ -601,14 +738,20 @@ def main() -> int:
                          "(max/mean per-shard bytes from the newest "
                          "shard_balance flight event); past it exit 1 "
                          "(default: report only)")
-    ap.add_argument("--request", metavar="RID", default=None,
+    ap.add_argument("--request", metavar="RID|TRACE_ID", default=None,
                     help="render ONE request's full causal timeline "
                          "(every span carrying this rid directly or "
                          "via its batch, plus matching flight events "
                          "and digests) instead of the aggregate "
                          "report — the rid comes from a JSONL "
                          "response, a slow_query event, or the "
-                         "slowest-requests table")
+                         "slowest-requests table. A front-minted "
+                         "t<16hex> trace id (against a "
+                         "tools/trace_merge.py output) joins FLEET-"
+                         "wide: front route, replica request/queued/"
+                         "device spans and txn phases across "
+                         "processes, with per-hop wire/queue/device "
+                         "attribution")
     ap.add_argument("--json", action="store_true",
                     help="print the machine-readable report")
     args = ap.parse_args()
@@ -628,19 +771,24 @@ def main() -> int:
         flight = candidate if os.path.exists(candidate) else None
 
     if args.request:
+        fleet = _is_trace_id(args.request)
         try:
-            rep = request_timeline(args.trace, flight, args.request)
+            rep = (fleet_timeline(args.trace, flight, args.request)
+                   if fleet else
+                   request_timeline(args.trace, flight, args.request))
         except (OSError, ValueError, KeyError) as e:
             print(f"doctor: cannot read inputs: {e}", file=sys.stderr)
             return 2
         if rep is None:
-            print(f"doctor: rid {args.request!r} appears in neither "
-                  f"the trace nor the flight dump", file=sys.stderr)
+            kind = "trace id" if fleet else "rid"
+            print(f"doctor: {kind} {args.request!r} appears in "
+                  f"neither the trace nor the flight dump",
+                  file=sys.stderr)
             return 2
         if args.json:
             print(json.dumps(rep, sort_keys=True))
         else:
-            print(render_request(rep))
+            print(render_fleet(rep) if fleet else render_request(rep))
         return 0
 
     try:

@@ -196,6 +196,17 @@ _GATES = {
         "recompiles_after_warmup": ("lower", 0.0),
         "chaos_swap_aborted": ("higher", 0.0),
         "chaos_old_epoch_everywhere": ("higher", 0.0),
+        # Fleet tracing (round 23): parity and recompiles WITH the
+        # trace context on every hop are zero-tolerance — tracing may
+        # never change an answer or mint a program. The propagation
+        # overhead gates directionally with a very wide band (a
+        # cache-off p50 delta on a shared box is noisy) alongside the
+        # raw on-leg p50, so a hop that starts serializing on the
+        # trace plumbing fails CI instead of hiding in the average.
+        "disttrace_parity_ok": ("higher", 0.0),
+        "disttrace_recompiles": ("lower", 0.0),
+        "disttrace_overhead_pct": ("lower", 1.00),
+        "disttrace_p50_on_ms": ("lower", 0.80),
     },
     # Retrieval batch-scaling sweep (tools/retrieval_bench.py): the
     # round-21 tiled-scorer receipts. parity_ok must stay 1 (tiled

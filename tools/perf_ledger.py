@@ -240,6 +240,20 @@ _REPLICA_METRICS = {
     "chaos_old_epoch_everywhere":
         "chaos.old_epoch_everywhere_after_abort",
     "chaos_restarts": "chaos.restarts",
+    # Fleet tracing (round 23): the propagation-overhead A/B — same
+    # 2-replica tier served cache-off with disttrace off then on.
+    # disttrace_parity_ok and disttrace_recompiles are zero-tolerance
+    # (tracing must not change answers or mint programs); the on-leg
+    # p50 and the overhead percentage gate directionally; the merge
+    # receipts (spans joined, worst clock-offset uncertainty) are the
+    # evidence the trace_export -> trace_merge pull really aligned.
+    "disttrace_parity_ok": "disttrace.parity_ok",
+    "disttrace_recompiles": "disttrace.recompiles_after_warmup",
+    "disttrace_overhead_pct": "disttrace.overhead_pct",
+    "disttrace_p50_on_ms": "disttrace.p50_on_ms",
+    "disttrace_spans_merged": "disttrace.spans_merged",
+    "disttrace_max_clock_uncertainty_us":
+        "disttrace.max_clock_uncertainty_us",
 }
 _REPLICA_CONTEXT = {"backend": "backend", "docs": "docs", "k": "k",
                     "requests": "requests",

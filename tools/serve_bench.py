@@ -582,6 +582,104 @@ def run_replicas(args, input_dir) -> int:
         mixed_epoch += chaos_mixed[0]
         parity_fail += chaos_parity_fail
         front.close()
+
+        # Propagation-overhead A/B (round 23): the SAME 2-replica tier
+        # served twice — disttrace off, then on — with identical
+        # single-query requests and the cache bypassed, so the p50
+        # delta is the full price of minting + carrying the trace
+        # context across every hop (front mint, JSONL "trace" field,
+        # replica RequestContext adoption, response echo). The on-leg
+        # then pulls every span ring over the data plane
+        # (front.trace_export) and merges it in memory
+        # (tools.trace_merge.merge_processes): the artifact records
+        # how many spans actually joined, how many process lanes the
+        # merge produced, and the worst clock-offset uncertainty the
+        # alignment absorbed — and pins parity + zero recompiles WITH
+        # tracing on (perf_gate holds all of it).
+        from tfidf_tpu.obs import disttrace as dtr
+        from tools.trace_merge import merge_processes
+        dt_prev_enabled = dtr.enabled()
+        dt_prev_tracer = obs.get_tracer()
+        ab_reqs = [[draw()] for _ in range(48)]
+        dt_p50 = {}
+        dt_parity_fail = 0
+        dt_recompiles = 0
+        dt_spans = 0
+        dt_procs = 0
+        dt_unc_us = 0.0
+        try:
+            for mode in ("off", "on"):
+                dtr.configure(mode == "on")
+                if mode == "on":
+                    # The bench process IS the front: arm an in-memory
+                    # ring so its route spans join the merged pull.
+                    obs.set_tracer(obs.Tracer(), None)
+                    obs.set_export_meta(process="front")
+                serve_cfg = ServeConfig(
+                    max_batch=args.max_batch,
+                    max_wait_ms=args.max_wait_ms,
+                    queue_depth=args.queue_depth,
+                    cache_entries=args.cache_entries,
+                    snapshot_dir=os.path.join(snap_root,
+                                              f"snap_dt_{mode}"),
+                    replicas=2, replica_timeout_s=600.0)
+                front = ReplicatedFront(input_dir, cfg, serve_cfg,
+                                        k=args.k).start()
+                for qs in ab_reqs[:8]:      # warm both replicas
+                    front.query(qs, k=args.k, use_cache=False)
+                lats = []
+                for qs in ab_reqs:
+                    t1 = time.perf_counter()
+                    resp = front.query(qs, k=args.k, use_cache=False)
+                    lats.append((time.perf_counter() - t1) * 1e3)
+                    if mode != "on":
+                        continue
+                    if "error" in resp:
+                        dt_parity_fail += 1
+                        continue
+                    got = [[[nm, float(np.float32(v))]
+                            for nm, v in row]
+                           for row in resp["results"]]
+                    want = [[[nm, float(np.float32(v))]
+                             for nm, v in row]
+                            for row in expect(qs)]
+                    if got != want:
+                        dt_parity_fail += 1
+                dt_p50[mode] = _percentiles(lats)["p50"]
+                if mode == "on":
+                    dt_recompiles = sum(
+                        v.get("recompiles_after_warm", 0)
+                        for v in front.replica_info().values())
+                    merged = merge_processes(
+                        front.trace_export()["processes"])
+                    man = merged["disttrace"]["processes"]
+                    dt_procs = len(man)
+                    dt_spans = sum(1 for e in merged["traceEvents"]
+                                   if e.get("ph") == "X")
+                    dt_unc_us = round(
+                        max(p["uncertainty_ns"] for p in man) / 1e3,
+                        1)
+                front.close()
+        finally:
+            dtr.configure(dt_prev_enabled)
+            obs.set_tracer(dt_prev_tracer)
+        dt_overhead = (round((dt_p50["on"] - dt_p50["off"])
+                             / dt_p50["off"] * 100.0, 2)
+                       if dt_p50.get("off") else 0.0)
+        disttrace_ab = {
+            "replicas": 2,
+            "requests": len(ab_reqs),
+            "p50_off_ms": dt_p50.get("off", 0.0),
+            "p50_on_ms": dt_p50.get("on", 0.0),
+            "overhead_pct": dt_overhead,
+            "processes_merged": dt_procs,
+            "spans_merged": dt_spans,
+            "max_clock_uncertainty_us": dt_unc_us,
+            "parity_mismatches": dt_parity_fail,
+            "parity_ok": int(dt_parity_fail == 0),
+            "recompiles_after_warmup": dt_recompiles,
+        }
+        parity_fail += dt_parity_fail
     finally:
         shutil.rmtree(snap_root, ignore_errors=True)
 
@@ -619,6 +717,7 @@ def run_replicas(args, input_dir) -> int:
         "mixed_epoch_responses": mixed_epoch,
         "recompiles_after_warmup": recompiles_total,
         "chaos": chaos,
+        "disttrace": disttrace_ab,
     }
     with open(args.out, "w") as f:
         json.dump(artifact, f, indent=2, sort_keys=True)
@@ -646,6 +745,12 @@ def run_replicas(args, input_dir) -> int:
         log.error("replica_bench_chaos",
                   msg="chaos rehearsal FAILED: kill-mid-swap did not "
                       "leave the tier on the old epoch everywhere")
+        ok = False
+    if dt_recompiles:
+        log.error("replica_bench_disttrace",
+                  msg=f"{dt_recompiles} recompiles after warmup WITH "
+                      f"disttrace on — carrying the trace context "
+                      f"must not mint new programs")
         ok = False
     return 0 if ok else 1
 

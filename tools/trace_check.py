@@ -47,6 +47,19 @@ serve traces (auto-detected by ``request`` spans):
     INSIDE the batch serving the requests, so the timeline attributes
     the added latency to the right batch).
 
+merged fleet timelines (``tools/trace_merge.py`` output, schema
+``tfidf-trace-merged/1``, auto-detected by its ``disttrace`` key):
+  * one UNIQUE lane group per process (manifest labels, chrome pids
+    and ``process_name`` metadata all consistent);
+  * every non-reference process was merged with a MEASURED clock
+    offset (``samples > 0`` in the manifest — an unaligned lane is an
+    error, not a shrug);
+  * post-alignment causality: a front ``route`` span contains the
+    owning replica's ``request`` span in wall time, to within the two
+    processes' summed offset uncertainty;
+  * cross-process join integrity: rids unique fleet-wide, every
+    traced replica request joins a front-minted route.
+
 flight recorder (``--flight DUMP.jsonl``, round 11):
   * line 1 is a ``tfidf-flight/1`` schema header whose ``events`` /
     ``digests`` counts match the body exactly (an atomic dump is
@@ -89,6 +102,19 @@ spans_by_thread = _tracer.spans_by_thread
 _OUTCOMES = {"drained", "cache_hit", "shed_overload", "shed_deadline",
              "rejected", "error", "empty", "poisoned"}
 
+_MERGED_SCHEMA = "tfidf-trace-merged/1"
+
+
+def _load_doc(path: str):
+    """The raw exported doc — merged-trace validation needs the
+    top-level ``disttrace`` manifest, not just the event list."""
+    import gzip
+    import json
+    opener = (lambda p: gzip.open(p, "rt")) if path.endswith(".gz") \
+        else open
+    with opener(path) as f:
+        return json.load(f)
+
 
 def _overlaps(a: dict, b: dict) -> bool:
     return (a["ts"] < b["ts"] + b.get("dur", 0.0)
@@ -100,7 +126,9 @@ def check_trace(path: str, mode: str = "auto",
     """Returns ``(errors, notes)`` — empty errors == pass."""
     errors: List[str] = []
     notes: List[str] = []
-    events = load_chrome_trace(path)
+    doc = _load_doc(path)
+    events = doc if isinstance(doc, list) \
+        else doc.get("traceEvents", [])
     xs = [e for e in events if e.get("ph") == "X"]
     if not xs:
         return ["trace contains no complete (ph=X) span events"], notes
@@ -153,14 +181,22 @@ def check_trace(path: str, mode: str = "auto",
             by_name.setdefault(e["name"], []).append(e)
 
     if mode == "auto":
-        mode = ("serve" if "request" in by_name
-                else "ingest" if "pack" in by_name else "schema")
+        if isinstance(doc, dict) and (
+                doc.get("schema") == _MERGED_SCHEMA
+                or (doc.get("disttrace") or {}).get("schema")
+                == _MERGED_SCHEMA):
+            mode = "merged"
+        else:
+            mode = ("serve" if "request" in by_name
+                    else "ingest" if "pack" in by_name else "schema")
         notes.append(f"mode: {mode} (auto)")
 
     if mode == "ingest":
         errors += _check_ingest(lanes, by_name, notes)
     elif mode == "serve":
         errors += _check_serve(by_name, notes)
+    elif mode == "merged":
+        errors += _check_merged(doc, xs, by_name, notes)
     return errors, notes
 
 
@@ -359,6 +395,123 @@ def _check_serve(by_name, notes) -> List[str]:
     return errors
 
 
+def _check_merged(doc, xs, by_name, notes) -> List[str]:
+    """Merged fleet timeline (``tools/trace_merge.py`` output, schema
+    ``tfidf-trace-merged/1``): one unique lane group per process,
+    measured clock metadata on every non-reference process, and the
+    CAUSAL invariant the alignment exists to make checkable — after
+    the offsets are applied, a front ``route`` span contains the
+    owning replica's ``request`` span in wall time (slack: the two
+    processes' summed offset uncertainty plus scheduling grace).
+    Cross-process joins (rid / trace id) must be sound: rids unique
+    fleet-wide, every traced replica request joined to a front
+    route."""
+    errors: List[str] = []
+    meta = (doc.get("disttrace") or {}) if isinstance(doc, dict) else {}
+    procs = meta.get("processes")
+    if not isinstance(procs, list) or not procs:
+        return ["merged trace carries no disttrace process manifest"]
+
+    # -- unique process lanes --
+    labels = [p.get("process") for p in procs]
+    pids = [p.get("pid") for p in procs]
+    if len(set(labels)) != len(labels):
+        errors.append(f"duplicate process labels in manifest: "
+                      f"{sorted(labels)}")
+    if len(set(pids)) != len(pids):
+        errors.append(f"duplicate chrome pids in manifest: {pids}")
+    name_meta = {}
+    for e in (doc.get("traceEvents") or []):
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            name_meta[e.get("pid")] = \
+                (e.get("args") or {}).get("name", "")
+    for p in procs:
+        if name_meta.get(p.get("pid")) != p.get("process"):
+            errors.append(
+                f"process {p.get('process')!r} (pid {p.get('pid')}) "
+                f"has no matching process_name lane metadata")
+            break
+    stray = {e.get("pid") for e in xs} - set(pids)
+    if stray:
+        errors.append(f"spans on pids outside the manifest: "
+                      f"{sorted(stray)}")
+    notes.append(f"processes: {labels} "
+                 f"(reference {meta.get('reference')!r})")
+
+    # -- measured clock metadata on every non-reference process --
+    for p in procs:
+        if p.get("reference"):
+            continue
+        if not p.get("samples"):
+            errors.append(
+                f"process {p.get('process')!r} merged with NO "
+                f"measured clock offset (samples=0) — its lane is "
+                f"aligned on faith")
+    unc_us = {p.get("pid"): (p.get("uncertainty_ns") or 0) / 1e3
+              for p in procs}
+
+    # -- post-alignment containment: route contains its request --
+    routes = [e for e in by_name.get("route", [])
+              if (e.get("args") or {}).get("trace")]
+    req_by_rid = {}
+    req_by_trace = {}
+    for e in by_name.get("request", []):
+        a = e.get("args") or {}
+        if a.get("rid"):
+            req_by_rid[a["rid"]] = e
+        if a.get("trace"):
+            req_by_trace[a["trace"]] = e
+    checked = 0
+    for r in routes:
+        a = r.get("args") or {}
+        req = req_by_rid.get(a.get("rid")) \
+            or req_by_trace.get(a.get("trace"))
+        if req is None:
+            continue  # error-outcome route, or the ring dropped it
+        slack = unc_us.get(r.get("pid"), 0.0) \
+            + unc_us.get(req.get("pid"), 0.0) + 250.0
+        if not _contained(req, r, slack=slack):
+            errors.append(
+                f"route span (trace {a.get('trace')!r}, rid "
+                f"{a.get('rid')!r}) does NOT contain its replica's "
+                f"request span after clock alignment "
+                f"(route [{r['ts']:.1f}, "
+                f"{r['ts'] + r.get('dur', 0.0):.1f}] us, request "
+                f"[{req['ts']:.1f}, "
+                f"{req['ts'] + req.get('dur', 0.0):.1f}] us, slack "
+                f"{slack:.1f} us) — offset estimate or span "
+                f"semantics regressed")
+            break
+        checked += 1
+    if routes and not checked and (req_by_rid or req_by_trace):
+        errors.append(
+            f"{len(routes)} traced route span(s) and "
+            f"{len(req_by_rid) or len(req_by_trace)} traced request "
+            f"span(s) share NO rid/trace join — cross-process "
+            f"propagation is broken")
+    if checked:
+        notes.append(f"containment: {checked}/{len(routes)} routed "
+                     f"request(s) inside their route span after "
+                     f"alignment")
+
+    # -- join integrity --
+    rids = [(e.get("args") or {}).get("rid")
+            for e in by_name.get("request", [])]
+    stamped = [r for r in rids if r]
+    if len(set(stamped)) != len(stamped):
+        dupes = sorted({r for r in stamped if stamped.count(r) > 1})
+        errors.append(f"rids reused ACROSS processes: {dupes} — "
+                      f"federated evidence aliases")
+    route_traces = {(e.get("args") or {}).get("trace") for e in routes}
+    orphans = [t for t in req_by_trace if t not in route_traces]
+    if routes and orphans:
+        errors.append(
+            f"request span(s) carry trace id(s) no route span "
+            f"minted: {sorted(orphans)[:3]} — the join key leaked "
+            f"or the front's ring dropped the route")
+    return errors
+
+
 _FLIGHT_SCHEMA = "tfidf-flight/1"
 _FLIGHT_LEVELS = {"debug", "info", "warning", "error"}
 
@@ -456,7 +609,8 @@ def main() -> int:
     ap.add_argument("trace", help="Chrome trace-event JSON "
                                   "(--trace / TFIDF_TPU_TRACE output)")
     ap.add_argument("--mode", choices=["auto", "ingest", "serve",
-                                       "schema"], default="auto")
+                                       "schema", "merged"],
+                    default="auto")
     ap.add_argument("--min-threads", type=int, default=3,
                     help="fewest distinct lanes the trace must carry "
                          "(default 3: main + packer + drainer, or "

@@ -1,0 +1,216 @@
+#!/usr/bin/env python3
+"""Merge N per-process Chrome traces into ONE clock-aligned timeline.
+
+The fleet's span evidence is born scattered: the front exports its own
+ring, every replica's ring is pulled over the data plane
+(``{"op": "trace_export"}`` → a ``tfidf-trace/1`` bundle), and a
+multihost ingest run leaves one exported trace per rank. Each file's
+timestamps are microseconds relative to THAT process's
+``perf_counter_ns`` epoch — loading two of them side by side in
+Perfetto shows two unrelated clocks, and "did the front's route span
+actually contain the replica's request?" is unanswerable.
+
+This tool answers it. Each process's export carries a ``disttrace``
+metadata block (:meth:`tfidf_tpu.obs.tracer.Tracer.export_meta`):
+identity (``process``, ``os_pid``), the tracer epoch ``t0_ns``, and a
+``clock`` offset estimate measured against the fleet reference over
+the live transport (the front's ctrl plane, or mpi_lite tag -106 —
+RTT-midpoint, min-RTT filtered; tfidf_tpu/obs/disttrace.py). Capture
+never rewrites timestamps; the merge is where the offsets are applied:
+
+    aligned_ts_us = ts + (t0_ns - offset_ns - t0_ref_ns) / 1000
+
+``offset_ns`` is the process's clock MINUS the reference's at the same
+instant, so subtracting it folds every lane onto the reference
+timeline. The output is one Perfetto-loadable doc
+(schema ``tfidf-trace-merged/1``): one Chrome ``pid`` lane group per
+process (front first), each process's offset/uncertainty recorded in
+the top-level ``disttrace`` key — ``tools/trace_check.py`` validates
+the merged form, ``tools/doctor.py --request <trace-id>`` renders the
+cross-process causal timeline from it.
+
+Usage::
+
+    python -m tools.trace_merge bundle.json [more.json ...] \
+        -o merged.json [--reference front]
+
+Inputs may be ``tfidf-trace/1`` bundles (the trace_export pull — many
+processes per file) or single-process exported traces (``--trace`` /
+``TFIDF_TPU_TRACE`` files, whose ``disttrace`` key identifies them).
+Exit 0 on success, 2 on unusable input. Stdlib-only, importable with
+no jax at all (the doctor/trace_check discipline).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+_BUNDLE_SCHEMA = "tfidf-trace/1"
+MERGED_SCHEMA = "tfidf-trace-merged/1"
+
+__all__ = ["MERGED_SCHEMA", "load_processes", "merge_processes", "main"]
+
+
+def _norm_clock(raw: Any) -> Dict[str, int]:
+    """A process entry's clock estimate, zero-filled: the reference
+    process exports zeros (it IS the timeline) and a missing block
+    aligns as offset 0 — the merge still loads, trace_check's merged
+    mode is what flags a non-front lane with no measured offset."""
+    out = {"offset_ns": 0, "uncertainty_ns": 0, "rtt_ns": 0,
+           "samples": 0}
+    if isinstance(raw, dict):
+        for k in out:
+            v = raw.get(k)
+            if isinstance(v, (int, float)):
+                out[k] = int(v)
+    return out
+
+
+def _entry(process: Any, os_pid: Any, t0_ns: Any, clock: Any,
+           events: Any, src: str) -> Dict[str, Any]:
+    if not isinstance(events, list):
+        raise ValueError(f"{src}: traceEvents is not a list")
+    if not isinstance(t0_ns, int):
+        raise ValueError(f"{src}: missing tracer epoch t0_ns — "
+                         f"re-export with a disttrace-aware build")
+    return {"process": str(process or "host"),
+            "os_pid": int(os_pid or 0), "t0_ns": t0_ns,
+            "clock": _norm_clock(clock), "traceEvents": events}
+
+
+def load_processes(path: str) -> List[Dict[str, Any]]:
+    """Normalize one input file into process entries. Accepts the
+    ``tfidf-trace/1`` bundle (N processes) or a single exported Chrome
+    trace whose ``disttrace`` key carries the identity."""
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: not a JSON object (bare event "
+                         f"arrays carry no disttrace identity)")
+    if doc.get("schema") == _BUNDLE_SCHEMA:
+        procs = doc.get("processes")
+        if not isinstance(procs, list) or not procs:
+            raise ValueError(f"{path}: bundle has no processes")
+        return [_entry(p.get("process"), p.get("os_pid"),
+                       p.get("t0_ns"), p.get("clock"),
+                       p.get("traceEvents"), f"{path}[{i}]")
+                for i, p in enumerate(procs)]
+    meta = doc.get("disttrace")
+    if not isinstance(meta, dict):
+        raise ValueError(f"{path}: no disttrace metadata — exported "
+                         f"by a pre-fleet-tracing build?")
+    return [_entry(meta.get("process"), meta.get("os_pid"),
+                   meta.get("t0_ns"), meta.get("clock"),
+                   doc.get("traceEvents"), path)]
+
+
+def _pick_reference(entries: List[Dict[str, Any]],
+                    name: Optional[str]) -> int:
+    if name is not None:
+        for i, e in enumerate(entries):
+            if e["process"] == name:
+                return i
+        raise ValueError(f"reference process {name!r} not in inputs "
+                         f"({[e['process'] for e in entries]})")
+    for i, e in enumerate(entries):
+        if e["process"] == "front":
+            return i
+    return 0
+
+
+def merge_processes(entries: List[Dict[str, Any]],
+                    reference: Optional[str] = None) -> Dict[str, Any]:
+    """The pure merge: align every entry onto the reference process's
+    timeline and emit one Chrome doc with per-process ``pid`` lane
+    groups. Library form — serve_bench and the tests call this on
+    in-memory ``trace_export`` pulls without touching disk."""
+    if not entries:
+        raise ValueError("no process entries to merge")
+    ref = _pick_reference(entries, reference)
+    t0_ref = entries[ref]["t0_ns"]
+    # Reference first, then input order — the Perfetto top lane is the
+    # front (or rank 0), where every fleet trace starts.
+    order = [ref] + [i for i in range(len(entries)) if i != ref]
+    seen: Dict[str, int] = {}
+    events: List[dict] = []
+    manifest: List[dict] = []
+    for lane, i in enumerate(order, start=1):
+        e = entries[i]
+        label = e["process"]
+        n = seen.get(label, 0)
+        seen[label] = n + 1
+        if n:  # two pulls of the same process: keep both, uniquely
+            label = f"{label}#{n + 1}"
+        clock = e["clock"]
+        shift_us = (e["t0_ns"] - clock["offset_ns"] - t0_ref) / 1e3
+        events.append({"ph": "M", "pid": lane, "tid": 0,
+                       "name": "process_name",
+                       "args": {"name": label}})
+        events.append({"ph": "M", "pid": lane, "tid": 0,
+                       "name": "process_sort_index",
+                       "args": {"sort_index": lane}})
+        n_ev = 0
+        for ev in e["traceEvents"]:
+            if not isinstance(ev, dict):
+                continue
+            if ev.get("ph") == "M" and ev.get("name") in (
+                    "process_name", "process_sort_index"):
+                continue  # replaced by the lane-group identity above
+            ev = dict(ev)
+            ev["pid"] = lane
+            ts = ev.get("ts")
+            if isinstance(ts, (int, float)):
+                ev["ts"] = ts + shift_us
+            events.append(ev)
+            n_ev += 1
+        manifest.append({"process": label, "pid": lane,
+                         "os_pid": e["os_pid"], "t0_ns": e["t0_ns"],
+                         "reference": i == ref,
+                         "shift_us": round(shift_us, 3),
+                         "events": n_ev, **clock})
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "schema": MERGED_SCHEMA,
+            "disttrace": {"schema": MERGED_SCHEMA,
+                          "reference": manifest[0]["process"],
+                          "processes": manifest}}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge per-process Chrome traces into one "
+                    "clock-aligned fleet timeline")
+    ap.add_argument("inputs", nargs="+",
+                    help="tfidf-trace/1 bundles (the trace_export "
+                         "pull) and/or single-process exported traces")
+    ap.add_argument("-o", "--out", required=True,
+                    help="merged Perfetto-loadable JSON to write")
+    ap.add_argument("--reference", default=None, metavar="NAME",
+                    help="process whose clock is the merged timeline "
+                         "(default: 'front' if present, else the "
+                         "first process)")
+    args = ap.parse_args(argv)
+    entries: List[Dict[str, Any]] = []
+    try:
+        for path in args.inputs:
+            entries.extend(load_processes(path))
+        merged = merge_processes(entries, reference=args.reference)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        sys.stderr.write(f"trace_merge: {e}\n")
+        return 2
+    with open(args.out, "w") as f:
+        json.dump(merged, f)
+    m = merged["disttrace"]["processes"]
+    worst = max((p["uncertainty_ns"] for p in m), default=0)
+    print(f"merged {len(m)} process(es), "
+          f"{sum(p['events'] for p in m)} events onto "
+          f"{m[0]['process']}'s clock "
+          f"(max offset uncertainty {worst / 1e3:.1f} us) "
+          f"-> {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
